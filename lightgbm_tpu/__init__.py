@@ -1,0 +1,21 @@
+"""LightGBM-TPU: a TPU-native gradient-boosted decision tree framework.
+
+A from-scratch JAX/XLA/Pallas re-design with the capabilities of the
+reference LightGBM (v2.2.4): histogram-based leaf-wise GBDT/DART/GOSS/RF,
+the full objective/metric families, categorical optimal splits, and
+data-/feature-/voting-parallel learners mapped onto XLA collectives over a
+TPU device mesh.
+"""
+from .config import Config  # noqa: F401
+from .utils import log  # noqa: F401
+
+__version__ = "2.2.4.tpu0"
+
+# Rich user-facing API (Dataset/Booster/train/cv/sklearn) re-exported as the
+# layers land; see basic.py / engine.py / sklearn.py.
+try:  # pragma: no cover - import cycle guard during early construction
+    from .basic import Booster, Dataset  # noqa: F401
+    from .engine import cv, train  # noqa: F401
+    __all__ = ["Config", "Dataset", "Booster", "train", "cv", "log"]
+except ImportError:  # modules not built yet
+    __all__ = ["Config", "log"]

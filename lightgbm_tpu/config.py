@@ -1,0 +1,434 @@
+"""Config / flag system.
+
+TPU-native re-implementation of the reference's single flat parameter struct
+(include/LightGBM/config.h:27-799) and its alias machinery
+(src/io/config_auto.cpp:4-157, config.h:856-895).  One declarative table is the
+single source of truth (the reference generates config_auto.cpp from doc
+comments; here the table *is* the schema).  Parameters flow as key=value
+strings / dicts through every API surface, exactly like the reference.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .utils import log
+
+# ---------------------------------------------------------------------------
+# Schema: (name, type, default).  Types: str, int, float, bool,
+# "vec_double", "vec_int", "vec_string".
+# Mirrors include/LightGBM/config.h:98-787 field-for-field.
+# ---------------------------------------------------------------------------
+_SCHEMA = [
+    # --- core parameters (config.h:98-206)
+    ("config", str, ""),
+    ("task", str, "train"),
+    ("objective", str, "regression"),
+    ("boosting", str, "gbdt"),
+    ("data", str, ""),
+    ("valid", "vec_string", []),
+    ("num_iterations", int, 100),
+    ("learning_rate", float, 0.1),
+    ("num_leaves", int, 31),
+    ("tree_learner", str, "serial"),
+    ("num_threads", int, 0),
+    ("device_type", str, "tpu"),
+    ("seed", int, 0),
+    # --- learning control (config.h:208-437)
+    ("max_depth", int, -1),
+    ("min_data_in_leaf", int, 20),
+    ("min_sum_hessian_in_leaf", float, 1e-3),
+    ("bagging_fraction", float, 1.0),
+    ("bagging_freq", int, 0),
+    ("bagging_seed", int, 3),
+    ("feature_fraction", float, 1.0),
+    ("feature_fraction_seed", int, 2),
+    ("early_stopping_round", int, 0),
+    ("max_delta_step", float, 0.0),
+    ("lambda_l1", float, 0.0),
+    ("lambda_l2", float, 0.0),
+    ("min_gain_to_split", float, 0.0),
+    ("drop_rate", float, 0.1),
+    ("max_drop", int, 50),
+    ("skip_drop", float, 0.5),
+    ("xgboost_dart_mode", bool, False),
+    ("uniform_drop", bool, False),
+    ("drop_seed", int, 4),
+    ("top_rate", float, 0.2),
+    ("other_rate", float, 0.1),
+    ("min_data_per_group", int, 100),
+    ("max_cat_threshold", int, 32),
+    ("cat_l2", float, 10.0),
+    ("cat_smooth", float, 10.0),
+    ("max_cat_to_onehot", int, 4),
+    ("top_k", int, 20),
+    ("monotone_constraints", "vec_int", []),
+    ("feature_contri", "vec_double", []),
+    ("forcedsplits_filename", str, ""),
+    ("refit_decay_rate", float, 0.9),
+    ("cegb_tradeoff", float, 1.0),
+    ("cegb_penalty_split", float, 0.0),
+    ("cegb_penalty_feature_lazy", "vec_double", []),
+    ("cegb_penalty_feature_coupled", "vec_double", []),
+    # --- IO parameters (config.h:439-607)
+    ("verbosity", int, 1),
+    ("max_bin", int, 255),
+    ("min_data_in_bin", int, 3),
+    ("bin_construct_sample_cnt", int, 200000),
+    ("histogram_pool_size", float, -1.0),
+    ("data_random_seed", int, 1),
+    ("output_model", str, "LightGBM_model.txt"),
+    ("snapshot_freq", int, -1),
+    ("input_model", str, ""),
+    ("output_result", str, "LightGBM_predict_result.txt"),
+    ("initscore_filename", str, ""),
+    ("valid_data_initscores", "vec_string", []),
+    ("pre_partition", bool, False),
+    ("enable_bundle", bool, True),
+    ("max_conflict_rate", float, 0.0),
+    ("is_enable_sparse", bool, True),
+    ("sparse_threshold", float, 0.8),
+    ("use_missing", bool, True),
+    ("zero_as_missing", bool, False),
+    ("two_round", bool, False),
+    ("save_binary", bool, False),
+    ("enable_load_from_binary_file", bool, True),
+    ("header", bool, False),
+    ("label_column", str, ""),
+    ("weight_column", str, ""),
+    ("group_column", str, ""),
+    ("ignore_column", str, ""),
+    ("categorical_feature", str, ""),
+    ("predict_raw_score", bool, False),
+    ("predict_leaf_index", bool, False),
+    ("predict_contrib", bool, False),
+    ("num_iteration_predict", int, -1),
+    ("pred_early_stop", bool, False),
+    ("pred_early_stop_freq", int, 10),
+    ("pred_early_stop_margin", float, 10.0),
+    ("convert_model_language", str, ""),
+    ("convert_model", str, "gbdt_prediction.cpp"),
+    # --- objective parameters (config.h:609-705)
+    ("num_class", int, 1),
+    ("is_unbalance", bool, False),
+    ("scale_pos_weight", float, 1.0),
+    ("sigmoid", float, 1.0),
+    ("boost_from_average", bool, True),
+    ("reg_sqrt", bool, False),
+    ("alpha", float, 0.9),
+    ("fair_c", float, 1.0),
+    ("poisson_max_delta_step", float, 0.7),
+    ("tweedie_variance_power", float, 1.5),
+    ("max_position", int, 20),
+    ("label_gain", "vec_double", []),
+    # --- metric parameters (config.h:707-755)
+    ("metric", "vec_string", []),
+    ("metric_freq", int, 1),
+    ("is_provide_training_metric", bool, False),
+    ("eval_at", "vec_int", [1, 2, 3, 4, 5]),
+    # --- network parameters (config.h:757-777)
+    ("num_machines", int, 1),
+    ("local_listen_port", int, 12400),
+    ("time_out", int, 120),
+    ("machine_list_filename", str, ""),
+    ("machines", str, ""),
+    # --- device parameters (config.h:779-799); gpu_* kept for API compat,
+    #     tpu_* are this framework's own knobs.
+    ("gpu_platform_id", int, -1),
+    ("gpu_device_id", int, -1),
+    ("gpu_use_dp", bool, False),
+    # TPU-native knobs (no reference analogue)
+    ("tpu_double_precision", bool, False),   # f64 histogram accumulate (gpu_use_dp analogue)
+    ("tpu_histogram_impl", str, "auto"),     # auto|onehot|scatter|pallas
+    ("tpu_rows_per_tile", int, 2048),        # Pallas row-tile size
+    ("num_devices", int, 0),                 # 0 = use all local devices for parallel learners
+]
+
+# alias -> canonical name (src/io/config_auto.cpp:4-157)
+ALIAS_TABLE: Dict[str, str] = {
+    "config_file": "config",
+    "task_type": "task",
+    "objective_type": "objective", "app": "objective", "application": "objective",
+    "boosting_type": "boosting", "boost": "boosting",
+    "train": "data", "train_data": "data", "train_data_file": "data", "data_filename": "data",
+    "test": "valid", "valid_data": "valid", "valid_data_file": "valid",
+    "test_data": "valid", "test_data_file": "valid", "valid_filenames": "valid",
+    "num_iteration": "num_iterations", "n_iter": "num_iterations",
+    "num_tree": "num_iterations", "num_trees": "num_iterations",
+    "num_round": "num_iterations", "num_rounds": "num_iterations",
+    "num_boost_round": "num_iterations", "n_estimators": "num_iterations",
+    "shrinkage_rate": "learning_rate", "eta": "learning_rate",
+    "num_leaf": "num_leaves", "max_leaves": "num_leaves", "max_leaf": "num_leaves",
+    "tree": "tree_learner", "tree_type": "tree_learner", "tree_learner_type": "tree_learner",
+    "num_thread": "num_threads", "nthread": "num_threads",
+    "nthreads": "num_threads", "n_jobs": "num_threads",
+    "device": "device_type",
+    "random_seed": "seed", "random_state": "seed",
+    "min_data_per_leaf": "min_data_in_leaf", "min_data": "min_data_in_leaf",
+    "min_child_samples": "min_data_in_leaf",
+    "min_sum_hessian_per_leaf": "min_sum_hessian_in_leaf",
+    "min_sum_hessian": "min_sum_hessian_in_leaf", "min_hessian": "min_sum_hessian_in_leaf",
+    "min_child_weight": "min_sum_hessian_in_leaf",
+    "sub_row": "bagging_fraction", "subsample": "bagging_fraction", "bagging": "bagging_fraction",
+    "subsample_freq": "bagging_freq",
+    "bagging_fraction_seed": "bagging_seed",
+    "sub_feature": "feature_fraction", "colsample_bytree": "feature_fraction",
+    "early_stopping_rounds": "early_stopping_round", "early_stopping": "early_stopping_round",
+    "max_tree_output": "max_delta_step", "max_leaf_output": "max_delta_step",
+    "reg_alpha": "lambda_l1",
+    "reg_lambda": "lambda_l2", "lambda": "lambda_l2",
+    "min_split_gain": "min_gain_to_split",
+    "rate_drop": "drop_rate",
+    "topk": "top_k",
+    "mc": "monotone_constraints", "monotone_constraint": "monotone_constraints",
+    "feature_contrib": "feature_contri", "fc": "feature_contri",
+    "fp": "feature_contri", "feature_penalty": "feature_contri",
+    "fs": "forcedsplits_filename", "forced_splits_filename": "forcedsplits_filename",
+    "forced_splits_file": "forcedsplits_filename", "forced_splits": "forcedsplits_filename",
+    "verbose": "verbosity",
+    "subsample_for_bin": "bin_construct_sample_cnt",
+    "hist_pool_size": "histogram_pool_size",
+    "data_seed": "data_random_seed",
+    "model_output": "output_model", "model_out": "output_model",
+    "save_period": "snapshot_freq",
+    "model_input": "input_model", "model_in": "input_model",
+    "predict_result": "output_result", "prediction_result": "output_result",
+    "predict_name": "output_result", "prediction_name": "output_result",
+    "pred_name": "output_result", "name_pred": "output_result",
+    "init_score_filename": "initscore_filename", "init_score_file": "initscore_filename",
+    "init_score": "initscore_filename", "input_init_score": "initscore_filename",
+    "valid_data_init_scores": "valid_data_initscores",
+    "valid_init_score_file": "valid_data_initscores", "valid_init_score": "valid_data_initscores",
+    "is_pre_partition": "pre_partition",
+    "is_enable_bundle": "enable_bundle", "bundle": "enable_bundle",
+    "is_sparse": "is_enable_sparse", "enable_sparse": "is_enable_sparse",
+    "sparse": "is_enable_sparse",
+    "two_round_loading": "two_round", "use_two_round_loading": "two_round",
+    "is_save_binary": "save_binary", "is_save_binary_file": "save_binary",
+    "load_from_binary_file": "enable_load_from_binary_file",
+    "binary_load": "enable_load_from_binary_file", "load_binary": "enable_load_from_binary_file",
+    "has_header": "header",
+    "label": "label_column",
+    "weight": "weight_column",
+    "group": "group_column", "group_id": "group_column",
+    "query_column": "group_column", "query": "group_column", "query_id": "group_column",
+    "ignore_feature": "ignore_column", "blacklist": "ignore_column",
+    "cat_feature": "categorical_feature", "categorical_column": "categorical_feature",
+    "cat_column": "categorical_feature",
+    "is_predict_raw_score": "predict_raw_score", "predict_rawscore": "predict_raw_score",
+    "raw_score": "predict_raw_score",
+    "is_predict_leaf_index": "predict_leaf_index", "leaf_index": "predict_leaf_index",
+    "is_predict_contrib": "predict_contrib", "contrib": "predict_contrib",
+    "convert_model_file": "convert_model",
+    "num_classes": "num_class",
+    "unbalance": "is_unbalance", "unbalanced_sets": "is_unbalance",
+    "metrics": "metric", "metric_types": "metric",
+    "output_freq": "metric_freq",
+    "training_metric": "is_provide_training_metric",
+    "is_training_metric": "is_provide_training_metric",
+    "train_metric": "is_provide_training_metric",
+    "ndcg_eval_at": "eval_at", "ndcg_at": "eval_at",
+    "map_eval_at": "eval_at", "map_at": "eval_at",
+    "num_machine": "num_machines",
+    "local_port": "local_listen_port", "port": "local_listen_port",
+    "machine_list_file": "machine_list_filename", "machine_list": "machine_list_filename",
+    "mlist": "machine_list_filename",
+    "workers": "machines", "nodes": "machines",
+}
+
+PARAMETER_TYPES: Dict[str, Any] = {name: typ for name, typ, _ in _SCHEMA}
+PARAMETER_DEFAULTS: Dict[str, Any] = {name: dflt for name, _, dflt in _SCHEMA}
+PARAMETER_SET = frozenset(PARAMETER_TYPES)
+
+_TRUE_SET = frozenset(("1", "t", "true", "yes", "y", "on", "+"))
+_FALSE_SET = frozenset(("0", "f", "false", "no", "n", "off", "-"))
+
+
+def _parse_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in _TRUE_SET:
+        return True
+    if s in _FALSE_SET:
+        return False
+    log.fatal("Cannot parse '%s' as bool" % (v,))
+    return False
+
+
+def _parse_vec(v: Any, elem) -> list:
+    if isinstance(v, (list, tuple)):
+        return [elem(x) for x in v]
+    s = str(v).strip()
+    if not s:
+        return []
+    return [elem(x) for x in s.replace(":", ",").split(",") if x != ""]
+
+
+def _coerce(name: str, typ: Any, value: Any) -> Any:
+    if typ is str:
+        return str(value)
+    if typ is int:
+        return int(float(value)) if not isinstance(value, int) or isinstance(value, bool) else value
+    if typ is float:
+        return float(value)
+    if typ is bool:
+        return _parse_bool(value)
+    if typ == "vec_double":
+        return _parse_vec(value, float)
+    if typ == "vec_int":
+        return _parse_vec(value, int)
+    if typ == "vec_string":
+        if isinstance(value, (list, tuple)):
+            return [str(x) for x in value]
+        return [x for x in str(value).split(",") if x]
+    raise AssertionError(name)
+
+
+def str2map(text: str) -> Dict[str, str]:
+    """Parse 'k1=v1 k2=v2' / config-file lines into a dict
+    (reference Config::Str2Map, src/io/config.cpp:12-41).  Comments are
+    stripped at line level before tokenizing."""
+    out: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.split("#", 1)[0]
+        for token in line.split():
+            kv2map(out, token)
+    return out
+
+
+def kv2map(params: Dict[str, str], token: str) -> None:
+    """One 'k=v' token into the map; first value wins with a warning on
+    duplicates, quotes trimmed (src/io/config.cpp:15-29)."""
+    token = token.strip()
+    if not token:
+        return
+    if "=" not in token:
+        log.warning("Unknown token %s in parameters, ignored", token)
+        return
+    k, v = token.split("=", 1)
+    k = k.strip().strip("\"'")
+    v = v.strip().strip("\"'")
+    if k in params:
+        log.warning("%s is set=%s, %s=%s will be ignored. Current value: %s=%s",
+                    k, params[k], k, v, k, params[k])
+    else:
+        params[k] = v
+
+
+def alias_transform(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Resolve aliases to canonical names; longest (then lexicographically
+    greatest) alias wins on conflict; explicit canonical always wins
+    (config.h:856-895)."""
+    out: Dict[str, Any] = {}
+    pending: Dict[str, str] = {}
+    for k in params:
+        canon = ALIAS_TABLE.get(k)
+        if canon is not None:
+            prev = pending.get(canon)
+            if prev is None or (len(prev), prev) < (len(k), k):
+                if prev is not None:
+                    log.warning("%s is set with %s and %s; using %s", canon, prev, k, k)
+                pending[canon] = k
+            else:
+                log.warning("%s is set with %s and %s; using %s", canon, k, prev, prev)
+        elif k not in PARAMETER_SET:
+            log.warning("Unknown parameter: %s", k)
+            out[k] = params[k]
+        else:
+            out[k] = params[k]
+    for canon, src in pending.items():
+        if canon in out:
+            log.warning("%s is set=%s, %s=%s will be ignored.",
+                        canon, out[canon], src, params[src])
+        else:
+            out[canon] = params[src]
+    return out
+
+
+class Config:
+    """Flat parameter struct; fields mirror the reference Config
+    (include/LightGBM/config.h:98-799)."""
+
+    # populated dynamically from _SCHEMA below
+    def __init__(self, params: Optional[Dict[str, Any]] = None, **kwargs):
+        for name, typ, dflt in _SCHEMA:
+            setattr(self, name, list(dflt) if isinstance(dflt, list) else dflt)
+        merged: Dict[str, Any] = {}
+        if params:
+            merged.update(params)
+        merged.update(kwargs)
+        self.raw_params: Dict[str, Any] = dict(merged)
+        self.set(merged)
+
+    def set(self, params: Dict[str, Any]) -> None:
+        params = alias_transform(params)
+        for k, v in params.items():
+            if k in PARAMETER_SET and v is not None:
+                setattr(self, k, _coerce(k, PARAMETER_TYPES[k], v))
+        self._resolve_names()
+        self.check_param_conflict()
+
+    def _resolve_names(self) -> None:
+        # objective aliases resolved at use sites; boosting aliases here
+        # (src/boosting/boosting.cpp:30-63 name dispatch)
+        b = self.boosting
+        if b in ("gbrt",):
+            self.boosting = "gbdt"
+        elif b in ("random_forest",):
+            self.boosting = "rf"
+
+    def check_param_conflict(self) -> None:
+        """Cross-parameter validation (src/io/config.cpp:230-260)."""
+        if self.is_single_machine() and self.tree_learner != "serial":
+            one_device = (self.num_devices == 1
+                          or (self.num_devices == 0 and _n_local_devices() <= 1))
+            if one_device:
+                log.warning("Only one device/machine available; "
+                            "using serial tree learner instead of %s", self.tree_learner)
+                self.tree_learner = "serial"
+        if self.num_leaves < 2:
+            log.fatal("num_leaves must be >= 2, got %d" % self.num_leaves)
+        if self.max_bin < 2:
+            log.fatal("max_bin must be >= 2, got %d" % self.max_bin)
+        if not (0.0 < self.bagging_fraction <= 1.0):
+            log.fatal("bagging_fraction must be in (0, 1], got %g" % self.bagging_fraction)
+        if not (0.0 < self.feature_fraction <= 1.0):
+            log.fatal("feature_fraction must be in (0, 1], got %g" % self.feature_fraction)
+        if self.boosting == "goss" and self.top_rate + self.other_rate > 1.0:
+            log.fatal("top_rate + other_rate must be <= 1.0 for GOSS")
+
+    def is_single_machine(self) -> bool:
+        return self.num_machines <= 1
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {name: getattr(self, name) for name in PARAMETER_SET}
+
+    def __repr__(self) -> str:
+        diffs = {k: v for k, v in self.to_dict().items()
+                 if v != PARAMETER_DEFAULTS.get(k)}
+        return "Config(%s)" % (diffs,)
+
+
+def _n_local_devices() -> int:
+    try:
+        import jax
+        return jax.local_device_count()
+    except Exception:
+        return 1
+
+
+def param_dict_to_str(params: Optional[Dict[str, Any]]) -> str:
+    """Python-side dict -> 'k=v k2=v2' string (python-package basic.py:128)."""
+    if not params:
+        return ""
+    pairs: List[str] = []
+    for k, v in params.items():
+        if isinstance(v, (list, tuple, set)):
+            pairs.append("%s=%s" % (k, ",".join(map(str, v))))
+        elif isinstance(v, bool):
+            pairs.append("%s=%s" % (k, "true" if v else "false"))
+        elif v is None:
+            continue
+        else:
+            pairs.append("%s=%s" % (k, v))
+    return " ".join(pairs)

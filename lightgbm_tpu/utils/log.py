@@ -1,0 +1,71 @@
+"""Logging utilities.
+
+TPU-native analogue of the reference logger (include/LightGBM/utils/log.h:20-103):
+four levels (Fatal/Warning/Info/Debug), a registerable callback so host
+applications (Python bindings, CLI) can reroute output, and CHECK helpers.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Callable, Optional
+
+FATAL = -1
+WARNING = 0
+INFO = 1
+DEBUG = 2
+
+_level = INFO
+_callback: Optional[Callable[[str], None]] = None
+
+
+class LightGBMError(RuntimeError):
+    """Raised where the reference calls Log::Fatal (utils/log.h:70)."""
+
+
+def set_level(level: int) -> None:
+    global _level
+    _level = level
+
+
+def get_level() -> int:
+    return _level
+
+
+def set_callback(cb: Optional[Callable[[str], None]]) -> None:
+    global _callback
+    _callback = cb
+
+
+def _write(level_str: str, msg: str) -> None:
+    line = "[LightGBM-TPU] [%s] %s\n" % (level_str, msg)
+    if _callback is not None:
+        _callback(line)
+    else:
+        sys.stdout.write(line)
+        sys.stdout.flush()
+
+
+def debug(msg: str, *args) -> None:
+    if _level >= DEBUG:
+        _write("Debug", msg % args if args else msg)
+
+
+def info(msg: str, *args) -> None:
+    if _level >= INFO:
+        _write("Info", msg % args if args else msg)
+
+
+def warning(msg: str, *args) -> None:
+    if _level >= WARNING:
+        _write("Warning", msg % args if args else msg)
+
+
+def fatal(msg: str, *args) -> None:
+    text = msg % args if args else msg
+    _write("Fatal", text)
+    raise LightGBMError(text)
+
+
+def check(condition: bool, msg: str = "Check failed") -> None:
+    if not condition:
+        fatal(msg)

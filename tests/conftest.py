@@ -1,0 +1,22 @@
+"""Test harness configuration.
+
+Runs the whole suite on a virtual 8-device CPU platform so the parallel tree
+learners (data/feature/voting over a jax Mesh) are exercised without TPU pod
+hardware — the single-process multi-rank emulation the reference only
+sketches via THREAD_LOCAL network state (src/network/network.cpp:13-23).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

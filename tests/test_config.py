@@ -1,0 +1,57 @@
+import pytest
+
+from lightgbm_tpu.config import Config, alias_transform, param_dict_to_str, str2map
+from lightgbm_tpu.utils.log import LightGBMError
+
+
+def test_defaults():
+    c = Config()
+    assert c.num_leaves == 31
+    assert c.learning_rate == 0.1
+    assert c.max_bin == 255
+    assert c.objective == "regression"
+    assert c.eval_at == [1, 2, 3, 4, 5]
+
+
+def test_alias_resolution():
+    c = Config({"n_estimators": 50, "eta": "0.3", "num_leaf": 7})
+    assert c.num_iterations == 50
+    assert c.learning_rate == 0.3
+    assert c.num_leaves == 7
+
+
+def test_alias_priority_longest_wins():
+    out = alias_transform({"num_tree": "10", "num_boost_round": "20"})
+    assert out["num_iterations"] == "20"
+
+
+def test_canonical_beats_alias():
+    out = alias_transform({"num_iterations": "5", "n_estimators": "99"})
+    assert out["num_iterations"] == "5"
+
+
+def test_type_coercion():
+    c = Config({"bagging_fraction": "0.5", "header": "true", "eval_at": "1,3,5"})
+    assert c.bagging_fraction == 0.5
+    assert c.header is True
+    assert c.eval_at == [1, 3, 5]
+
+
+def test_str2map():
+    m = str2map("task=train data=a.txt  num_leaves=7 # comment")
+    assert m == {"task": "train", "data": "a.txt", "num_leaves": "7"}
+
+
+def test_param_dict_to_str():
+    s = param_dict_to_str({"metric": ["auc", "binary_logloss"], "verbose": -1, "header": True})
+    assert "metric=auc,binary_logloss" in s
+    assert "header=true" in s
+
+
+def test_conflict_checks():
+    with pytest.raises(LightGBMError):
+        Config({"num_leaves": 1})
+    with pytest.raises(LightGBMError):
+        Config({"bagging_fraction": 0.0})
+    with pytest.raises(LightGBMError):
+        Config({"boosting": "goss", "top_rate": 0.9, "other_rate": 0.5})

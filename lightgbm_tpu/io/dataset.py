@@ -1,0 +1,249 @@
+"""The binned training dataset.
+
+TPU-native analogue of the reference Dataset (include/LightGBM/dataset.h:281-634,
+src/io/dataset.cpp): raw feature columns are mapped through per-feature
+BinMappers into a dense device-resident bin matrix `[num_data, num_features]`
+(uint8 when every feature has <=256 bins, else uint16).  Histograms are flat
+`[total_bins, 3]` arrays addressed by per-feature offsets — the dense layout
+replaces the reference's FeatureGroup/sparse-bin machinery, which does not map
+to TPU (the reference's own GPU learner also densifies; EFB bundling keeps the
+width down for sparse data).
+"""
+from __future__ import annotations
+
+import json as _json
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import log
+from .bin_mapper import CATEGORICAL, NUMERICAL, BinMapper
+from .metadata import Metadata
+
+_BINARY_MAGIC = "lightgbm_tpu_dataset_v1"
+
+
+class BinnedDataset:
+    """Binned feature matrix + per-feature mappers + metadata."""
+
+    def __init__(self):
+        self.num_data: int = 0
+        self.num_total_features: int = 0          # raw column count
+        self.used_feature_map: List[int] = []      # raw idx -> inner idx or -1
+        self.real_feature_index: List[int] = []    # inner idx -> raw idx
+        self.bin_mappers: List[BinMapper] = []     # per inner feature
+        self.bins: Optional[np.ndarray] = None     # [n, F_used] uint8/16 host
+        self.feature_offsets: Optional[np.ndarray] = None  # [F_used+1] i32
+        self.metadata = Metadata()
+        self.feature_names: List[str] = []
+        self.monotone_constraints: Optional[np.ndarray] = None  # [F_used] i8
+        self.feature_penalty: Optional[np.ndarray] = None       # [F_used] f64
+        self.max_bin: int = 255
+        self._device_cache: Dict[str, object] = {}
+
+    # ------------------------------------------------------------------ #
+    # Construction
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def construct(cls, X: np.ndarray, config, metadata: Optional[Metadata] = None,
+                  categorical_features: Sequence[int] = (),
+                  feature_names: Optional[Sequence[str]] = None,
+                  reference: Optional["BinnedDataset"] = None,
+                  sample_indices: Optional[np.ndarray] = None) -> "BinnedDataset":
+        """Build from a raw float matrix.
+
+        With `reference` given, reuse its bin mappers (validation-set path,
+        dataset.h CreateValid / basic.py reference alignment).
+        """
+        X = np.asarray(X)
+        if X.ndim != 2:
+            log.fatal("Input data must be 2-dimensional")
+        n, num_raw = X.shape
+        ds = cls()
+        ds.num_data = n
+        ds.num_total_features = num_raw
+        ds.metadata = metadata if metadata is not None else Metadata(n)
+        ds.metadata.init(n)
+
+        if reference is not None:
+            if num_raw != reference.num_total_features:
+                log.fatal("The number of features in data (%d) is not the same "
+                          "as it was in training data (%d)"
+                          % (num_raw, reference.num_total_features))
+            ds.used_feature_map = list(reference.used_feature_map)
+            ds.real_feature_index = list(reference.real_feature_index)
+            ds.bin_mappers = reference.bin_mappers
+            ds.feature_names = list(reference.feature_names)
+            ds.feature_offsets = reference.feature_offsets
+            ds.monotone_constraints = reference.monotone_constraints
+            ds.feature_penalty = reference.feature_penalty
+            ds.max_bin = reference.max_bin
+            ds._bin_all(X)
+            return ds
+
+        ds.max_bin = config.max_bin
+        cat_set = set(int(c) for c in categorical_features)
+        # --- sample rows for bin finding (bin_construct_sample_cnt) -------
+        sample_cnt = min(config.bin_construct_sample_cnt, n)
+        if sample_indices is None:
+            rng = np.random.RandomState(config.data_random_seed)
+            sample_indices = (np.arange(n) if sample_cnt >= n else
+                              np.sort(rng.choice(n, sample_cnt, replace=False)))
+        Xs = X[sample_indices]
+
+        # --- find bins per raw feature ------------------------------------
+        # trivial-feature filter count scales with the sampling fraction
+        # (dataset_loader.cpp:849-850)
+        filter_cnt = max(1, int(config.min_data_in_leaf * len(sample_indices) / n))
+        mappers: List[Optional[BinMapper]] = []
+        for f in range(num_raw):
+            col = np.asarray(Xs[:, f], dtype=np.float64)
+            nonzero = col[(np.abs(col) > 1e-35) | np.isnan(col)]
+            m = BinMapper()
+            m.find_bin(nonzero, len(col),
+                       config.max_bin, config.min_data_in_bin,
+                       filter_cnt,
+                       CATEGORICAL if f in cat_set else NUMERICAL,
+                       config.use_missing, config.zero_as_missing)
+            mappers.append(m)
+
+        # --- drop trivial features (dataset.cpp Construct) ----------------
+        ds.used_feature_map = [-1] * num_raw
+        for f, m in enumerate(mappers):
+            if not m.is_trivial:
+                ds.used_feature_map[f] = len(ds.real_feature_index)
+                ds.real_feature_index.append(f)
+                ds.bin_mappers.append(m)
+        if not ds.real_feature_index:
+            log.warning("There are no meaningful features, as all feature "
+                        "values are constant.")
+        ds.feature_names = (list(feature_names) if feature_names
+                            else ["Column_%d" % i for i in range(num_raw)])
+        ds._set_offsets()
+        ds._resolve_constraints(config)
+        ds._bin_all(X)
+        return ds
+
+    def _set_offsets(self) -> None:
+        nb = [m.num_bin for m in self.bin_mappers]
+        self.feature_offsets = np.concatenate([[0], np.cumsum(nb)]).astype(np.int32)
+
+    def _resolve_constraints(self, config) -> None:
+        F = self.num_features
+        if config.monotone_constraints:
+            mc = np.zeros(F, dtype=np.int8)
+            for inner, raw in enumerate(self.real_feature_index):
+                if raw < len(config.monotone_constraints):
+                    mc[inner] = config.monotone_constraints[raw]
+            self.monotone_constraints = mc
+        if config.feature_contri:
+            fp = np.ones(F, dtype=np.float64)
+            for inner, raw in enumerate(self.real_feature_index):
+                if raw < len(config.feature_contri):
+                    fp[inner] = config.feature_contri[raw]
+            self.feature_penalty = fp
+
+    def _bin_all(self, X: np.ndarray) -> None:
+        n = X.shape[0]
+        F = self.num_features
+        max_nb = max((m.num_bin for m in self.bin_mappers), default=2)
+        dtype = np.uint8 if max_nb <= 256 else np.uint16
+        bins = np.empty((n, F), dtype=dtype)
+        for inner, raw in enumerate(self.real_feature_index):
+            bins[:, inner] = self.bin_mappers[inner].values_to_bins(
+                np.asarray(X[:, raw], dtype=np.float64)).astype(dtype)
+        self.bins = bins
+        self._device_cache.clear()
+
+    def create_valid(self, X: np.ndarray, metadata: Optional[Metadata] = None
+                     ) -> "BinnedDataset":
+        return BinnedDataset.construct(np.asarray(X), config=None,
+                                       metadata=metadata, reference=self)
+
+    # ------------------------------------------------------------------ #
+    # Accessors
+    # ------------------------------------------------------------------ #
+    @property
+    def num_features(self) -> int:
+        return len(self.bin_mappers)
+
+    @property
+    def num_total_bin(self) -> int:
+        return int(self.feature_offsets[-1]) if self.feature_offsets is not None else 0
+
+    def feature_num_bins(self) -> np.ndarray:
+        return np.array([m.num_bin for m in self.bin_mappers], dtype=np.int32)
+
+    def inner_feature_index(self, raw_idx: int) -> int:
+        return self.used_feature_map[raw_idx]
+
+    def device_bins(self):
+        """Device-resident bin matrix [n, F] int8/int16 (cached)."""
+        if "bins" not in self._device_cache:
+            import jax.numpy as jnp
+            self._device_cache["bins"] = jnp.asarray(self.bins)
+        return self._device_cache["bins"]
+
+    # ------------------------------------------------------------------ #
+    # Binary cache (reference: Dataset::SaveBinaryFile dataset.cpp:615-708)
+    # ------------------------------------------------------------------ #
+    def save_binary(self, filename: str) -> None:
+        d = {
+            "magic": np.array(_BINARY_MAGIC),
+            "bins": self.bins,
+            "feature_offsets": self.feature_offsets,
+            "used_feature_map": np.array(self.used_feature_map, dtype=np.int32),
+            "real_feature_index": np.array(self.real_feature_index, dtype=np.int32),
+            "feature_names": np.array(self.feature_names),
+            "num_total_features": np.array(self.num_total_features),
+            "max_bin": np.array(self.max_bin),
+            "mapper_states": np.array([_json.dumps(m.to_state()) for m in self.bin_mappers]),
+        }
+        if self.monotone_constraints is not None:
+            d["monotone_constraints"] = self.monotone_constraints
+        if self.feature_penalty is not None:
+            d["feature_penalty"] = self.feature_penalty
+        d.update(self.metadata.to_npz_dict())
+        with open(filename, "wb") as f:  # exact filename, no .npz appending
+            np.savez_compressed(f, **d)
+        log.info("Saved binary dataset to %s", filename)
+
+    @classmethod
+    def load_binary(cls, filename: str) -> "BinnedDataset":
+        d = np.load(filename, allow_pickle=False)
+        if str(d["magic"]) != _BINARY_MAGIC:
+            log.fatal("%s is not a lightgbm_tpu binary dataset file" % filename)
+        ds = cls()
+        ds.bins = d["bins"]
+        ds.num_data = ds.bins.shape[0]
+        ds.feature_offsets = d["feature_offsets"]
+        ds.used_feature_map = d["used_feature_map"].tolist()
+        ds.real_feature_index = d["real_feature_index"].tolist()
+        ds.feature_names = [str(x) for x in d["feature_names"]]
+        ds.num_total_features = int(d["num_total_features"])
+        ds.max_bin = int(d["max_bin"])
+        ds.bin_mappers = [BinMapper.from_state(_json.loads(str(s)))
+                          for s in d["mapper_states"]]
+        if "monotone_constraints" in d:
+            ds.monotone_constraints = d["monotone_constraints"]
+        if "feature_penalty" in d:
+            ds.feature_penalty = d["feature_penalty"]
+        ds.metadata = Metadata.from_npz_dict(d, ds.num_data)
+        return ds
+
+    def subset(self, indices: np.ndarray) -> "BinnedDataset":
+        """Row-subset copy sharing mappers (dataset.h CopySubset)."""
+        out = BinnedDataset()
+        out.num_data = len(indices)
+        out.num_total_features = self.num_total_features
+        out.used_feature_map = list(self.used_feature_map)
+        out.real_feature_index = list(self.real_feature_index)
+        out.bin_mappers = self.bin_mappers
+        out.bins = self.bins[indices]
+        out.feature_offsets = self.feature_offsets
+        out.feature_names = list(self.feature_names)
+        out.monotone_constraints = self.monotone_constraints
+        out.feature_penalty = self.feature_penalty
+        out.max_bin = self.max_bin
+        out.metadata = self.metadata.subset(np.asarray(indices))
+        return out

@@ -1,0 +1,107 @@
+"""Text data parsers: CSV / TSV / LibSVM with format auto-detection.
+
+Host-side ingest analogue of the reference Parser (src/io/parser.hpp:1-129,
+parser.cpp: CreateParser format sniffing).  Column semantics match the
+reference dataset loader: by default the first column is the label; header
+rows, 'name:'/'num:'-prefixed column selectors, weight/group/ignore columns
+are resolved by DatasetLoader (io/loader.py).
+"""
+from __future__ import annotations
+
+import io as _io
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+
+CSV, TSV, LIBSVM = "csv", "tsv", "libsvm"
+
+
+def detect_format(sample_lines: List[str]) -> str:
+    """Sniff the delimiter from the first data lines (parser.cpp behavior:
+    ':' pairs -> libsvm, tabs -> tsv, commas -> csv)."""
+    for line in sample_lines:
+        line = line.strip()
+        if not line:
+            continue
+        tokens = line.split("\t") if "\t" in line else line.split(",")
+        if any(":" in t for t in tokens[1:]):
+            return LIBSVM
+        if "\t" in line:
+            return TSV
+        if "," in line:
+            return CSV
+        # single column or space separated; libsvm rows with no features
+        if " " in line:
+            return LIBSVM if any(":" in t for t in line.split()[1:]) else TSV
+    return TSV
+
+
+def _read_head(filename: str, n: int = 32) -> List[str]:
+    lines = []
+    with open(filename, "r") as f:
+        for _ in range(n):
+            line = f.readline()
+            if not line:
+                break
+            lines.append(line)
+    return lines
+
+
+def parse_libsvm(filename: str, num_features_hint: int = 0
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """LibSVM 'label idx:val ...' -> (dense ndarray [n, F], labels [n]).
+    Zero-based or one-based indices are taken as-is (reference treats the
+    index verbatim as the column id)."""
+    labels: List[float] = []
+    rows: List[List[Tuple[int, float]]] = []
+    max_idx = num_features_hint - 1
+    with open(filename, "r") as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            toks = line.split()
+            labels.append(float(toks[0]))
+            pairs = []
+            for t in toks[1:]:
+                k, v = t.split(":", 1)
+                idx = int(k)
+                pairs.append((idx, float(v)))
+                if idx > max_idx:
+                    max_idx = idx
+            rows.append(pairs)
+    X = np.zeros((len(rows), max_idx + 1), dtype=np.float64)
+    for i, pairs in enumerate(rows):
+        for idx, v in pairs:
+            X[i, idx] = v
+    return X, np.asarray(labels, dtype=np.float64)
+
+
+def parse_delimited(filename: str, sep: str, header: bool
+                    ) -> Tuple[np.ndarray, Optional[List[str]]]:
+    """CSV/TSV -> full float matrix (no label split yet) + column names."""
+    import pandas as pd
+    df = pd.read_csv(filename, sep=sep, header=0 if header else None,
+                     comment="#", skip_blank_lines=True)
+    names = [str(c) for c in df.columns] if header else None
+    return df.to_numpy(dtype=np.float64), names
+
+
+def load_text_file(filename: str, header: bool = False,
+                   file_format: Optional[str] = None
+                   ) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[List[str]]]:
+    """Load a training text file.
+
+    Returns (matrix, libsvm_labels_or_None, column_names_or_None).  For
+    CSV/TSV the label is still a column inside the matrix (the loader
+    extracts it); for LibSVM labels are separate by format.
+    """
+    fmt = file_format or detect_format(_read_head(filename))
+    if fmt == LIBSVM:
+        X, y = parse_libsvm(filename)
+        return X, y, None
+    sep = "\t" if fmt == TSV else ","
+    mat, names = parse_delimited(filename, sep, header)
+    return mat, None, names

@@ -1,0 +1,135 @@
+import math
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.io.bin_mapper import (
+    CATEGORICAL, MISSING_NAN, MISSING_NONE, MISSING_ZERO, NUMERICAL,
+    BinMapper, greedy_find_bin,
+)
+
+
+def make_mapper(values, total=None, max_bin=255, min_data_in_bin=3,
+                min_split_data=20, bin_type=NUMERICAL, use_missing=True,
+                zero_as_missing=False):
+    values = np.asarray(values, dtype=np.float64)
+    total = total if total is not None else len(values)
+    m = BinMapper()
+    m.find_bin(values, total, max_bin, min_data_in_bin, min_split_data,
+               bin_type, use_missing, zero_as_missing)
+    return m
+
+
+def test_simple_uniform_bins():
+    vals = np.arange(1.0, 1001.0)
+    m = make_mapper(vals, max_bin=10)
+    assert m.num_bin == 10
+    assert not m.is_trivial
+    # all values fall into a valid bin, monotonic mapping
+    bins = m.values_to_bins(vals)
+    assert bins.min() >= 0 and bins.max() == m.num_bin - 1
+    assert np.all(np.diff(bins.astype(int)) >= 0)
+
+
+def test_zero_gets_own_bin():
+    vals = np.concatenate([np.linspace(-5, -1, 100), np.linspace(1, 5, 100)])
+    total = 300  # 100 implied zeros
+    m = make_mapper(vals, total=total, max_bin=16)
+    zero_bin = m.value_to_bin(0.0)
+    assert m.value_to_bin(1e-40) == zero_bin
+    assert m.value_to_bin(-1e-40) == zero_bin
+    assert m.value_to_bin(-1.0) < zero_bin < m.value_to_bin(1.0)
+    assert m.default_bin == zero_bin
+
+
+def test_nan_missing_gets_last_bin():
+    vals = np.array([1.0, 2.0, 3.0, np.nan, np.nan] * 20)
+    m = make_mapper(vals)
+    assert m.missing_type == MISSING_NAN
+    assert m.value_to_bin(np.nan) == m.num_bin - 1
+    bins = m.values_to_bins(np.array([np.nan, 1.0]))
+    assert bins[0] == m.num_bin - 1
+
+
+def test_no_missing():
+    m = make_mapper(np.arange(100.0) + 1.0)
+    assert m.missing_type == MISSING_NONE
+    # NaN at predict time maps to zero's bin
+    assert m.value_to_bin(np.nan) == m.value_to_bin(0.0)
+
+
+def test_use_missing_false():
+    vals = np.array([1.0, 2.0, np.nan] * 30)
+    m = make_mapper(vals, use_missing=False)
+    assert m.missing_type == MISSING_NONE
+
+
+def test_trivial_constant_feature():
+    m = make_mapper(np.full(100, 7.0), total=100)
+    assert m.is_trivial
+
+
+def test_min_data_in_leaf_filter():
+    # only 2 samples on one side of the only split -> filtered out
+    vals = np.concatenate([np.full(98, 1.0), np.full(2, 5.0)])
+    m = make_mapper(vals, min_split_data=20)
+    assert m.is_trivial
+
+
+def test_values_to_bins_matches_scalar():
+    rng = np.random.RandomState(0)
+    vals = rng.randn(5000) * 10
+    vals[rng.rand(5000) < 0.1] = 0.0
+    some_nan = vals.copy()
+    some_nan[rng.rand(5000) < 0.05] = np.nan
+    m = make_mapper(some_nan, max_bin=63)
+    test_vals = np.concatenate([some_nan[:500], m.bin_upper_bound[:-1]])
+    vec = m.values_to_bins(test_vals)
+    scalar = np.array([m.value_to_bin(v) for v in test_vals])
+    np.testing.assert_array_equal(vec, scalar)
+
+
+def test_greedy_find_bin_few_distinct():
+    bounds = greedy_find_bin([1.0, 2.0, 3.0], [10, 10, 10], 255, 30, 3)
+    assert len(bounds) == 3
+    assert bounds[-1] == math.inf
+    assert 1.0 < bounds[0] <= 2.0 + 1e-9
+
+
+def test_categorical_basic():
+    vals = np.array([0.0] * 50 + [1.0] * 30 + [2.0] * 15 + [3.0] * 5)
+    m = make_mapper(vals, bin_type=CATEGORICAL, min_data_in_bin=1, min_split_data=1)
+    assert m.bin_type == CATEGORICAL
+    assert not m.is_trivial
+    # most frequent category can't be bin 0 when it is category 0
+    assert m.value_to_bin(0.0) > 0
+    # categories map to distinct bins, ordered by count
+    bins = {c: m.value_to_bin(float(c)) for c in [0, 1, 2, 3]}
+    assert len(set(bins.values())) == 4
+    # unseen category falls into last bin
+    assert m.value_to_bin(99.0) == m.num_bin - 1
+
+
+def test_categorical_negative_is_nan():
+    vals = np.array([1.0] * 50 + [2.0] * 30 + [-1.0] * 20)
+    m = make_mapper(vals, bin_type=CATEGORICAL, min_data_in_bin=1, min_split_data=1)
+    assert m.value_to_bin(-5.0) == m.num_bin - 1
+
+
+def test_state_round_trip():
+    rng = np.random.RandomState(1)
+    m = make_mapper(rng.randn(1000))
+    m2 = BinMapper.from_state(m.to_state())
+    vals = rng.randn(100)
+    np.testing.assert_array_equal(m.values_to_bins(vals), m2.values_to_bins(vals))
+    cat = make_mapper(np.array([0.0, 1, 1, 2, 2, 2] * 20), bin_type=CATEGORICAL,
+                      min_data_in_bin=1, min_split_data=1)
+    cat2 = BinMapper.from_state(cat.to_state())
+    assert cat2.categorical_2_bin == cat.categorical_2_bin
+
+
+def test_max_bin_respected():
+    rng = np.random.RandomState(2)
+    for max_bin in (16, 63, 255):
+        m = make_mapper(rng.randn(20000), max_bin=max_bin)
+        assert m.num_bin <= max_bin

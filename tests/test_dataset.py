@@ -1,0 +1,107 @@
+import os
+
+import numpy as np
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.io.metadata import Metadata
+from lightgbm_tpu.io.parser import detect_format, load_text_file
+
+REF_BINARY = "/root/reference/examples/binary_classification/binary.train"
+
+
+def _make(rng, n=500, f=5, **params):
+    X = rng.randn(n, f)
+    cfg = Config(params)
+    meta = Metadata(n)
+    meta.set_label((rng.rand(n) > 0.5).astype(np.float32))
+    return BinnedDataset.construct(X, cfg, metadata=meta), X, cfg
+
+
+def test_construct_basic(rng):
+    ds, X, _ = _make(rng)
+    assert ds.num_data == 500
+    assert ds.num_features == 5
+    assert ds.bins.shape == (500, 5)
+    assert ds.bins.dtype == np.uint8
+    assert ds.num_total_bin == sum(m.num_bin for m in ds.bin_mappers)
+
+
+def test_trivial_feature_dropped(rng):
+    X = rng.randn(300, 4)
+    X[:, 2] = 3.0
+    cfg = Config()
+    ds = BinnedDataset.construct(X, cfg)
+    assert ds.num_features == 3
+    assert ds.used_feature_map[2] == -1
+    assert ds.real_feature_index == [0, 1, 3]
+
+
+def test_valid_uses_reference_mappers(rng):
+    ds, X, cfg = _make(rng)
+    Xv = rng.randn(100, 5)
+    vd = ds.create_valid(Xv)
+    assert vd.bin_mappers is ds.bin_mappers
+    # binning a training row through valid path gives identical bins
+    vd2 = ds.create_valid(X[:50])
+    np.testing.assert_array_equal(vd2.bins, ds.bins[:50])
+
+
+def test_binary_round_trip(rng, tmp_path):
+    ds, X, _ = _make(rng)
+    ds.metadata.set_weights(rng.rand(500))
+    path = str(tmp_path / "cache.npz")
+    ds.save_binary(path)
+    ds2 = BinnedDataset.load_binary(path)
+    np.testing.assert_array_equal(ds.bins, ds2.bins)
+    np.testing.assert_array_equal(ds.feature_offsets, ds2.feature_offsets)
+    np.testing.assert_allclose(ds.metadata.label, ds2.metadata.label)
+    np.testing.assert_allclose(ds.metadata.weights, ds2.metadata.weights)
+    for m1, m2 in zip(ds.bin_mappers, ds2.bin_mappers):
+        np.testing.assert_allclose(m1.bin_upper_bound, m2.bin_upper_bound)
+
+
+def test_subset(rng):
+    ds, X, _ = _make(rng)
+    idx = np.arange(0, 500, 7)
+    sub = ds.subset(idx)
+    np.testing.assert_array_equal(sub.bins, ds.bins[idx])
+    np.testing.assert_allclose(sub.metadata.label, ds.metadata.label[idx])
+
+
+def test_detect_format():
+    assert detect_format(["1\t0.5\t0.3"]) == "tsv"
+    assert detect_format(["1,0.5,0.3"]) == "csv"
+    assert detect_format(["1 2:0.5 7:0.3"]) == "libsvm"
+
+
+def test_load_reference_example():
+    mat, libsvm_labels, names = load_text_file(REF_BINARY)
+    assert libsvm_labels is None
+    assert mat.shape == (7000, 29)  # label + 28 features
+    assert set(np.unique(mat[:, 0])) == {0.0, 1.0}
+
+
+def test_reference_example_binning():
+    mat, _, _ = load_text_file(REF_BINARY)
+    y, X = mat[:, 0], mat[:, 1:]
+    meta = Metadata(len(y))
+    meta.set_label(y)
+    ds = BinnedDataset.construct(X, Config({"max_bin": 63}), metadata=meta)
+    assert ds.num_features > 0
+    assert all(m.num_bin <= 63 for m in ds.bin_mappers)
+    # every row binned in range
+    for f in range(ds.num_features):
+        assert ds.bins[:, f].max() < ds.bin_mappers[f].num_bin
+
+
+def test_query_metadata():
+    meta = Metadata()
+    meta.set_label(np.zeros(10))
+    meta.set_query([3, 4, 3])
+    np.testing.assert_array_equal(meta.query_boundaries, [0, 3, 7, 10])
+    assert meta.num_queries == 3
+    meta2 = Metadata()
+    meta2.set_label(np.zeros(6))
+    meta2.set_query_from_ids([5, 5, 7, 7, 7, 9])
+    np.testing.assert_array_equal(meta2.query_boundaries, [0, 2, 5, 6])

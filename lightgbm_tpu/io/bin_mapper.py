@@ -169,7 +169,6 @@ class BinMapper:
         na_mask = np.isnan(values)
         na_cnt = int(na_mask.sum())
         values = values[~na_mask]
-        num_sample_values = len(values) + na_cnt
 
         if not use_missing:
             self.missing_type = MISSING_NONE
@@ -234,28 +233,34 @@ class BinMapper:
     def _distinct_with_zero(sorted_values: np.ndarray, zero_cnt: int
                             ) -> Tuple[List[float], List[int]]:
         """Distinct (value, count) pairs with the implied zeros spliced in at
-        the right position (bin.cpp:238-268)."""
+        the right position (bin.cpp:238-268).
+
+        Vectorized: exact-equal grouping via np.unique, then a Python merge
+        only over the (few) distinct values for the nextafter-equality chain
+        — duplicates are exactly equal, so chaining over distincts matches
+        chaining over raw samples.
+        """
+        n = len(sorted_values)
+        uniq, ucnt = (np.unique(sorted_values, return_counts=True) if n
+                      else (np.empty(0), np.empty(0, dtype=int)))
         distinct: List[float] = []
         counts: List[int] = []
-        n = len(sorted_values)
-        if n == 0 or (sorted_values[0] > 0.0 and zero_cnt > 0):
+        if n == 0 or (uniq[0] > 0.0 and zero_cnt > 0):
             distinct.append(0.0)
             counts.append(zero_cnt)
-        if n > 0:
-            distinct.append(float(sorted_values[0]))
-            counts.append(1)
-        for i in range(1, n):
-            prev, cur = float(sorted_values[i - 1]), float(sorted_values[i])
-            if not _double_equal_ordered(prev, cur):
-                if prev < 0.0 and cur > 0.0:
+        for i in range(len(uniq)):
+            cur, c = float(uniq[i]), int(ucnt[i])
+            if distinct and distinct[-1] != 0.0 and _double_equal_ordered(distinct[-1], cur) \
+               and not (distinct[-1] < 0.0 < cur):
+                distinct[-1] = cur  # keep the larger of near-equal values
+                counts[-1] += c
+            else:
+                if distinct and distinct[-1] < 0.0 and cur > 0.0:
                     distinct.append(0.0)
                     counts.append(zero_cnt)
                 distinct.append(cur)
-                counts.append(1)
-            else:
-                distinct[-1] = cur  # keep the larger of near-equal values
-                counts[-1] += 1
-        if n > 0 and sorted_values[n - 1] < 0.0 and zero_cnt > 0:
+                counts.append(c)
+        if n > 0 and uniq[-1] < 0.0 and zero_cnt > 0:
             distinct.append(0.0)
             counts.append(zero_cnt)
         return distinct, counts

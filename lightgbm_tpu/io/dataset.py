@@ -131,17 +131,20 @@ class BinnedDataset:
     def _resolve_constraints(self, config) -> None:
         F = self.num_features
         if config.monotone_constraints:
-            mc = np.zeros(F, dtype=np.int8)
-            for inner, raw in enumerate(self.real_feature_index):
-                if raw < len(config.monotone_constraints):
-                    mc[inner] = config.monotone_constraints[raw]
-            self.monotone_constraints = mc
+            if len(config.monotone_constraints) != self.num_total_features:
+                log.fatal("monotone_constraints has %d entries but data has %d "
+                          "features" % (len(config.monotone_constraints),
+                                        self.num_total_features))
+            self.monotone_constraints = np.array(
+                [config.monotone_constraints[raw] for raw in self.real_feature_index],
+                dtype=np.int8)
         if config.feature_contri:
-            fp = np.ones(F, dtype=np.float64)
-            for inner, raw in enumerate(self.real_feature_index):
-                if raw < len(config.feature_contri):
-                    fp[inner] = config.feature_contri[raw]
-            self.feature_penalty = fp
+            if len(config.feature_contri) != self.num_total_features:
+                log.fatal("feature_contri has %d entries but data has %d features"
+                          % (len(config.feature_contri), self.num_total_features))
+            self.feature_penalty = np.array(
+                [config.feature_contri[raw] for raw in self.real_feature_index],
+                dtype=np.float64)
 
     def _bin_all(self, X: np.ndarray) -> None:
         n = X.shape[0]

@@ -32,6 +32,13 @@ class Metadata:
             log.fatal("Length of label (%d) != num_data (%d)" % (len(label), self.num_data))
         self.label = label
         self.num_data = len(label)
+        # re-validate fields that may have been set before the label
+        if self.weights is not None and len(self.weights) != self.num_data:
+            log.fatal("Length of weights (%d) != num_data (%d)"
+                      % (len(self.weights), self.num_data))
+        if self.query_boundaries is not None and self.query_boundaries[-1] != self.num_data:
+            log.fatal("Sum of query counts (%d) != num_data (%d)"
+                      % (int(self.query_boundaries[-1]), self.num_data))
 
     def set_weights(self, weights) -> None:
         if weights is None:

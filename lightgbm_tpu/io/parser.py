@@ -90,17 +90,24 @@ def parse_delimited(filename: str, sep: str, header: bool
 
 
 def load_text_file(filename: str, header: bool = False,
-                   file_format: Optional[str] = None
+                   file_format: Optional[str] = None,
+                   num_features_hint: int = 0
                    ) -> Tuple[np.ndarray, Optional[np.ndarray], Optional[List[str]]]:
     """Load a training text file.
 
     Returns (matrix, libsvm_labels_or_None, column_names_or_None).  For
     CSV/TSV the label is still a column inside the matrix (the loader
     extracts it); for LibSVM labels are separate by format.
+    `num_features_hint` widens a LibSVM matrix whose trailing features never
+    appear (validation-vs-train width mismatch, the reference passes
+    num_total_features to CreateParser).
     """
-    fmt = file_format or detect_format(_read_head(filename))
+    head = _read_head(filename)
+    if header and head:
+        head = head[1:]  # sniff data lines, not the header (parser.cpp:101-105)
+    fmt = file_format or detect_format(head)
     if fmt == LIBSVM:
-        X, y = parse_libsvm(filename)
+        X, y = parse_libsvm(filename, num_features_hint)
         return X, y, None
     sep = "\t" if fmt == TSV else ","
     mat, names = parse_delimited(filename, sep, header)

@@ -1,0 +1,123 @@
+"""Gradient/hessian/count histograms over the binned feature matrix.
+
+The TPU replacement for the reference's histogram construction hot loop
+(src/io/dense_bin.hpp:105-185, dataset.cpp:760-949 ConstructHistograms and
+the OpenCL kernels in src/treelearner/ocl/): per-leaf histograms are built by
+one pass over the row-sharded bin matrix.  Rows are selected by a leaf-label
+vector (`row→leaf`), not by the reference's reordered index array — masking
+keeps shapes static for XLA.
+
+Implementations (select via Config.tpu_histogram_impl):
+- "onehot": chunked one-hot × (g,h,1) matmul — rides the MXU, the TPU-native
+  choice (mirrors what the OpenCL kernels do with local-memory atomics).
+- "scatter": jnp scatter-add — best on CPU backends / small data; also the
+  all-leaves variant used for root and level-batched growth.
+- "auto": scatter on CPU, onehot on TPU.
+
+All accumulate in f32 by default; pass f64 arrays for the gpu_use_dp
+analogue (Config.tpu_double_precision).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import log
+
+
+def _gh1(grad, hess, mask, dtype):
+    m = mask.astype(dtype)
+    return jnp.stack([grad.astype(dtype) * m, hess.astype(dtype) * m, m], axis=-1)
+
+
+def leaf_histogram_scatter(bins, grad, hess, leaf_ids, leaf,
+                           max_bin: int) -> jnp.ndarray:
+    """[F, B, 3] histogram of rows with leaf_ids == leaf via scatter-add."""
+    n, F = bins.shape
+    dtype = grad.dtype
+    mask = leaf_ids == leaf
+    gh1 = _gh1(grad, hess, mask, dtype)                       # [n, 3]
+    flat_idx = bins.astype(jnp.int32) + (jnp.arange(F, dtype=jnp.int32) * max_bin)[None, :]
+    out = jnp.zeros((F * max_bin, 3), dtype=dtype)
+    # one scatter per row-feature pair; values broadcast over features
+    out = out.at[flat_idx.reshape(-1)].add(
+        jnp.repeat(gh1, F, axis=0).reshape(n * F, 3))
+    return out.reshape(F, max_bin, 3)
+
+
+def leaf_histogram_onehot(bins, grad, hess, leaf_ids, leaf,
+                          max_bin: int, rows_per_chunk: int = 16384) -> jnp.ndarray:
+    """[F, B, 3] histogram via chunked one-hot contraction on the MXU.
+
+    Per chunk: onehot[n_c, F, B] contracted with gh1[n_c, 3] over rows —
+    a [F*B, n_c] x [n_c, 3] matmul after reshape.
+    """
+    n, F = bins.shape
+    dtype = grad.dtype
+    mask = (leaf_ids == leaf)
+    gh1 = _gh1(grad, hess, mask, dtype)                       # [n, 3]
+
+    pad = (-n) % rows_per_chunk
+    if pad:
+        bins = jnp.pad(bins, ((0, pad), (0, 0)))
+        gh1 = jnp.pad(gh1, ((0, pad), (0, 0)))
+    n_chunks = (n + pad) // rows_per_chunk
+    bins_c = bins.reshape(n_chunks, rows_per_chunk, F)
+    gh1_c = gh1.reshape(n_chunks, rows_per_chunk, 3)
+
+    def body(acc, chunk):
+        b, g = chunk
+        onehot = jax.nn.one_hot(b, max_bin, dtype=dtype)      # [rows, F, B]
+        acc = acc + jnp.einsum("rfb,rc->fbc", onehot, g,
+                               preferred_element_type=dtype)
+        return acc, None
+
+    init = jnp.zeros((F, max_bin, 3), dtype=dtype)
+    acc, _ = jax.lax.scan(body, init, (bins_c, gh1_c))
+    return acc
+
+
+def all_leaves_histogram(bins, grad, hess, leaf_ids, num_leaves: int,
+                         max_bin: int) -> jnp.ndarray:
+    """[L, F, B, 3] histograms for every leaf in one scatter pass (root /
+    level-batched growth; rows with leaf_ids outside [0, L) are dropped)."""
+    n, F = bins.shape
+    dtype = grad.dtype
+    in_range = (leaf_ids >= 0) & (leaf_ids < num_leaves)
+    gh1 = _gh1(grad, hess, in_range, dtype)
+    leaf_c = jnp.clip(leaf_ids, 0, num_leaves - 1).astype(jnp.int32)
+    flat_idx = (leaf_c[:, None] * (F * max_bin)
+                + jnp.arange(F, dtype=jnp.int32)[None, :] * max_bin
+                + bins.astype(jnp.int32))
+    out = jnp.zeros((num_leaves * F * max_bin, 3), dtype=dtype)
+    out = out.at[flat_idx.reshape(-1)].add(
+        jnp.repeat(gh1, F, axis=0).reshape(n * F, 3))
+    return out.reshape(num_leaves, F, max_bin, 3)
+
+
+def leaf_histogram(bins, grad, hess, leaf_ids, leaf,
+                   max_bin: int, impl: str = "auto",
+                   rows_per_chunk: int = 16384) -> jnp.ndarray:
+    if impl == "pallas":
+        try:
+            from . import histogram_pallas
+            return histogram_pallas.leaf_histogram(bins, grad, hess, leaf_ids,
+                                                   leaf, max_bin)
+        except ImportError:
+            log.warning("Pallas histogram kernel not available yet; "
+                        "falling back to onehot")
+            impl = "onehot"
+    if impl == "auto":
+        impl = "onehot" if jax.default_backend() == "tpu" else "scatter"
+    if impl == "scatter":
+        return leaf_histogram_scatter(bins, grad, hess, leaf_ids, leaf, max_bin)
+    if impl == "onehot":
+        return leaf_histogram_onehot(bins, grad, hess, leaf_ids, leaf,
+                                     max_bin, rows_per_chunk)
+    raise ValueError("unknown histogram impl: %s" % impl)
+
+
+def subtract(parent_hist: jnp.ndarray, child_hist: jnp.ndarray) -> jnp.ndarray:
+    """Sibling histogram by subtraction (FeatureHistogram::Subtract,
+    feature_histogram.hpp:67-73) — the communication/work saver."""
+    return parent_hist - child_hist

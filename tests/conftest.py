@@ -4,14 +4,33 @@ Runs the whole suite on a virtual 8-device CPU platform so the parallel tree
 learners (data/feature/voting over a jax Mesh) are exercised without TPU pod
 hardware — the single-process multi-rank emulation the reference only
 sketches via THREAD_LOCAL network state (src/network/network.cpp:13-23).
+
+NOTE: this image pre-imports jax via sitecustomize (TPU tunnel registration),
+so env vars must be FORCED (not setdefault) — backend selection is lazy, so
+overriding here, before the first jax op, still works.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# sitecustomize may have already initialized the axon TPU backend; reroute
+# to the virtual CPU platform (config first, then drop cached backends).
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax.extend.backend
+    jax.extend.backend.clear_backends()
+except (ImportError, AttributeError):  # fall back to the private spelling
+    from jax._src import xla_bridge as _xb
+    _xb._clear_backends()
+
+# x64 on in tests: numpy-oracle comparisons need f64; library code uses
+# explicit dtypes everywhere so production (x64 off) behavior is unchanged.
+jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -20,3 +39,10 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+def pytest_sessionstart(session):
+    assert jax.default_backend() == "cpu", (
+        "tests must run on the virtual CPU platform, got %s" % jax.default_backend())
+    assert jax.device_count() == 8, (
+        "expected 8 virtual CPU devices, got %d" % jax.device_count())

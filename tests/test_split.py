@@ -1,0 +1,252 @@
+"""Split-scan op vs a literal numpy transcription of the reference's
+sequential two-direction scans (feature_histogram.hpp:500-636)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.split import (
+    MISSING_NAN, MISSING_NONE, MISSING_ZERO, SplitParams, best_split_for_leaf,
+)
+
+EPS = 1e-15
+_jit_best_split = jax.jit(best_split_for_leaf)
+
+
+def _thr_l1(s, l1):
+    return np.sign(s) * max(0.0, abs(s) - l1)
+
+
+def _leaf_out(g, h, l1, l2, mds):
+    ret = -_thr_l1(g, l1) / (h + l2)
+    if mds <= 0 or abs(ret) <= mds:
+        return ret
+    return np.sign(ret) * mds
+
+
+def _gain_given(g, h, l1, l2, out):
+    return -(2.0 * _thr_l1(g, l1) * out + (h + l2) * out * out)
+
+
+def _split_gain(lg, lh, rg, rh, l1, l2, mds):
+    lo = _leaf_out(lg, lh, l1, l2, mds)
+    ro = _leaf_out(rg, rh, l1, l2, mds)
+    return _gain_given(lg, lh, l1, l2, lo) + _gain_given(rg, rh, l1, l2, ro)
+
+
+def numpy_best_split_one_feature(hist, sum_g, sum_h, num_data, num_bin,
+                                 default_bin, missing_type, p: SplitParams):
+    """Literal port of FindBestThresholdNumerical for one feature.
+
+    hist: [B, 3] with every bin stored.  Internally reconstructs the
+    reference's biased layout (bias=1 drops bin0 from storage)."""
+    sum_h = sum_h + 2 * EPS
+    bias = 1 if default_bin == 0 else 0
+    # data_[t] is bin t+bias
+    data = hist[bias:num_bin]
+    l1, l2, mds = p.lambda_l1, p.lambda_l2, p.max_delta_step
+    gain_shift = _split_gain_leaf(sum_g, sum_h, l1, l2, mds)
+    min_gain_shift = gain_shift + p.min_gain_to_split
+
+    best = dict(gain=-np.inf, thr=num_bin, dl=True, lg=np.nan, lh=np.nan, lc=0)
+    found = False
+
+    def scan(dir_, skip_default, use_na):
+        nonlocal found
+        nb = num_bin
+        if dir_ == -1:
+            srg, srh, rc = 0.0, EPS, 0
+            t = nb - 1 - bias - use_na
+            t_end = 1 - bias
+            while t >= t_end:
+                if skip_default and (t + bias) == default_bin:
+                    t -= 1
+                    continue
+                srg += data[t][0]
+                srh += data[t][1]
+                rc += int(data[t][2])
+                if rc < p.min_data_in_leaf or srh < p.min_sum_hessian_in_leaf:
+                    t -= 1
+                    continue
+                lc = num_data - rc
+                if lc < p.min_data_in_leaf:
+                    break
+                slh = sum_h - srh
+                if slh < p.min_sum_hessian_in_leaf:
+                    break
+                slg = sum_g - srg
+                cur = _split_gain(slg, slh, srg, srh, l1, l2, mds)
+                if cur <= min_gain_shift:
+                    t -= 1
+                    continue
+                found = True
+                if cur > best["gain"]:
+                    best.update(gain=cur, thr=t - 1 + bias, dl=True,
+                                lg=slg, lh=slh, lc=lc)
+                t -= 1
+        else:
+            slg, slh, lc = 0.0, EPS, 0
+            t = 0
+            t_end = nb - 2 - bias
+            if use_na and bias == 1:
+                slg = sum_g
+                slh = sum_h - EPS
+                lc = num_data
+                for i in range(nb - bias):
+                    slg -= data[i][0]
+                    slh -= data[i][1]
+                    lc -= int(data[i][2])
+                t = -1
+            while t <= t_end:
+                if skip_default and (t + bias) == default_bin:
+                    t += 1
+                    continue
+                if t >= 0:
+                    slg += data[t][0]
+                    slh += data[t][1]
+                    lc += int(data[t][2])
+                if lc < p.min_data_in_leaf or slh < p.min_sum_hessian_in_leaf:
+                    t += 1
+                    continue
+                rc = num_data - lc
+                if rc < p.min_data_in_leaf:
+                    break
+                srh = sum_h - slh
+                if srh < p.min_sum_hessian_in_leaf:
+                    break
+                srg = sum_g - slg
+                cur = _split_gain(slg, slh, srg, srh, l1, l2, mds)
+                if cur <= min_gain_shift:
+                    t += 1
+                    continue
+                found = True
+                if cur > best["gain"]:
+                    best.update(gain=cur, thr=t + bias, dl=False,
+                                lg=slg, lh=slh, lc=lc)
+                t += 1
+
+    default_left = True
+    if num_bin > 2 and missing_type != MISSING_NONE:
+        if missing_type == MISSING_ZERO:
+            scan(-1, True, 0)
+            scan(1, True, 0)
+        else:
+            scan(-1, False, 1)
+            scan(1, False, 1)
+    else:
+        scan(-1, False, 0)
+        if missing_type == MISSING_NAN:
+            default_left = False
+    if not found:
+        return None
+    out = dict(best)
+    if out["dl"] is True and (num_bin <= 2 and missing_type == MISSING_NAN):
+        out["dl"] = False
+    if num_bin <= 2 or missing_type == MISSING_NONE:
+        out["dl"] = default_left if missing_type != MISSING_NAN else False
+    out["gain"] = out["gain"] - min_gain_shift
+    return out
+
+
+def _split_gain_leaf(g, h, l1, l2, mds):
+    out = _leaf_out(g, h, l1, l2, mds)
+    return _gain_given(g, h, l1, l2, out)
+
+
+def random_case(rng, F=6, B=16, missing=None):
+    hist = np.zeros((F, B, 3))
+    num_bins = rng.randint(2, B + 1, size=F)
+    default_bins = np.zeros(F, dtype=int)
+    missing_types = np.zeros(F, dtype=int)
+    n_total = 0
+    for f in range(F):
+        nb = num_bins[f]
+        cnt = rng.randint(0, 50, size=nb)
+        g = rng.randn(nb) * cnt
+        h = np.abs(rng.randn(nb)) * cnt + cnt * 0.1
+        hist[f, :nb, 0] = g
+        hist[f, :nb, 1] = h
+        hist[f, :nb, 2] = cnt
+        missing_types[f] = missing if missing is not None else rng.randint(0, 3)
+        default_bins[f] = rng.randint(0, nb)
+    # make parent sums consistent using feature 0 (all features must share
+    # parent totals; rescale each feature's histogram to match feature 0)
+    tg, th, tc = hist[0].sum(axis=0)
+    for f in range(1, F):
+        s = hist[f, :, 2].sum()
+        if s > 0:
+            # adjust count mismatch by dumping the remainder into last bin
+            diff = tc - s
+            hist[f, num_bins[f] - 1, 2] += diff
+            hist[f, num_bins[f] - 1, 0] += tg - hist[f, :, 0].sum()
+            hist[f, num_bins[f] - 1, 1] += th - hist[f, :, 1].sum()
+        else:
+            hist[f, 0] = [tg, th, tc]
+    return hist, tg, th, int(tc), num_bins, default_bins, missing_types
+
+
+@pytest.mark.parametrize("missing", [MISSING_NONE, MISSING_ZERO, MISSING_NAN, None])
+@pytest.mark.parametrize("params", [
+    SplitParams(min_data_in_leaf=1, min_sum_hessian_in_leaf=0.0),
+    SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3),
+    SplitParams(lambda_l1=0.5, lambda_l2=2.0, min_data_in_leaf=1),
+    SplitParams(max_delta_step=0.3, min_data_in_leaf=1),
+    SplitParams(min_gain_to_split=1.0, min_data_in_leaf=1),
+])
+def test_matches_reference_scan(missing, params):
+    rng = np.random.RandomState(0)
+    for trial in range(25):
+        hist, tg, th, tc, num_bins, default_bins, missing_types = \
+            random_case(rng, missing=missing)
+        res = _jit_best_split(
+            jnp.asarray(hist), tg, th, tc,
+            jnp.asarray(num_bins, jnp.int32), jnp.asarray(default_bins, jnp.int32),
+            jnp.asarray(missing_types, jnp.int32), params)
+        # numpy oracle: per feature best, then argmax w/ smaller-feature ties
+        best_f, best = -1, None
+        for f in range(hist.shape[0]):
+            r = numpy_best_split_one_feature(
+                hist[f], tg, th, tc, int(num_bins[f]), int(default_bins[f]),
+                int(missing_types[f]), params)
+            if r is not None and (best is None or r["gain"] > best["gain"] + 1e-12):
+                best_f, best = f, r
+        if best is None:
+            assert int(res.feature) == -1, \
+                "jax found split where oracle found none (trial %d)" % trial
+            continue
+        assert int(res.feature) == best_f, (trial, int(res.feature), best_f)
+        assert abs(float(res.gain) - best["gain"]) < 1e-6 * max(1, abs(best["gain"]))
+        assert int(res.threshold) == best["thr"], (trial, int(res.threshold), best["thr"])
+        assert bool(res.default_left) == bool(best["dl"])
+        assert int(res.left_count) == best["lc"]
+        np.testing.assert_allclose(float(res.left_sum_gradient), best["lg"], rtol=1e-9)
+
+
+def test_no_split_when_pure():
+    # all gradient mass in one bin with min_data high
+    hist = np.zeros((1, 8, 3))
+    hist[0, 3] = [5.0, 10.0, 100]
+    res = best_split_for_leaf(jnp.asarray(hist), 5.0, 10.0, 100,
+                              jnp.asarray([8], jnp.int32), jnp.asarray([0], jnp.int32),
+                              jnp.asarray([MISSING_NONE], jnp.int32),
+                              SplitParams(min_data_in_leaf=1))
+    assert int(res.feature) == -1
+
+
+def test_feature_mask():
+    rng = np.random.RandomState(3)
+    hist, tg, th, tc, num_bins, default_bins, missing_types = random_case(rng)
+    p = SplitParams(min_data_in_leaf=1, min_sum_hessian_in_leaf=0)
+    full = best_split_for_leaf(jnp.asarray(hist), tg, th, tc,
+                               jnp.asarray(num_bins, jnp.int32),
+                               jnp.asarray(default_bins, jnp.int32),
+                               jnp.asarray(missing_types, jnp.int32), p)
+    mask = np.ones(hist.shape[0], bool)
+    mask[int(full.feature)] = False
+    masked = best_split_for_leaf(jnp.asarray(hist), tg, th, tc,
+                                 jnp.asarray(num_bins, jnp.int32),
+                                 jnp.asarray(default_bins, jnp.int32),
+                                 jnp.asarray(missing_types, jnp.int32), p,
+                                 feature_mask=jnp.asarray(mask))
+    assert int(masked.feature) != int(full.feature)

@@ -1,0 +1,395 @@
+"""User-facing Dataset and Booster.
+
+Python-API mirror of python-package/lightgbm/basic.py: lazily-constructed
+Dataset with reference alignment, pandas/categorical handling, field get/set;
+Booster with update (incl. custom fobj), eval, save/load, predict.  The ctypes
+C-ABI hop of the reference is replaced by direct calls into the framework —
+the C API shim (c_api.py) re-exposes the same behavior for ABI parity.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config, param_dict_to_str
+from .io.dataset import BinnedDataset
+from .io.metadata import Metadata
+from .io.parser import load_text_file
+from .metric import create_metric, default_metric_for_objective
+from .objective import create_objective
+from .utils import log
+
+
+class LightGBMError(log.LightGBMError):
+    pass
+
+
+def _to_matrix(data, label=None):
+    """Accept numpy / pandas / scipy / list-of-lists / file path."""
+    if isinstance(data, str):
+        mat, libsvm_label, names = load_text_file(data)
+        if libsvm_label is not None:
+            return np.asarray(mat, np.float64), libsvm_label, names
+        return mat[:, 1:], mat[:, 0], names  # default: first column is label
+    try:
+        import pandas as pd
+        if isinstance(data, pd.DataFrame):
+            names = [str(c) for c in data.columns]
+            cat_cols = [c for c in data.columns
+                        if str(data[c].dtype) in ("category",)]
+            df = data.copy()
+            for c in cat_cols:
+                df[c] = df[c].cat.codes
+            return df.to_numpy(dtype=np.float64), label, names
+        if isinstance(data, pd.Series):
+            return data.to_numpy(dtype=np.float64)[:, None], label, None
+    except ImportError:
+        pass
+    try:
+        import scipy.sparse as sp
+        if sp.issparse(data):
+            return np.asarray(data.todense(), np.float64), label, None
+    except ImportError:
+        pass
+    arr = np.asarray(data, np.float64)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    return arr, label, None
+
+
+def _pandas_categorical_columns(data) -> List[int]:
+    try:
+        import pandas as pd
+        if isinstance(data, pd.DataFrame):
+            return [i for i, c in enumerate(data.columns)
+                    if str(data[c].dtype) == "category"]
+    except ImportError:
+        pass
+    return []
+
+
+class Dataset:
+    """Lazily-constructed training dataset (basic.py Dataset)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, Sequence[str]] = "auto",
+                 categorical_feature: Union[str, Sequence] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True, silent: bool = False):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self.used_indices: Optional[np.ndarray] = None
+        self._binned: Optional[BinnedDataset] = None
+        self._predictor = None  # set when continuing training (init_model)
+
+    # -- construction ------------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._binned is not None:
+            return self
+        if self.used_indices is not None and self.reference is not None:
+            ref = self.reference.construct()
+            self._binned = ref._binned.subset(self.used_indices)
+            self._set_fields(self._binned.metadata, subset=True)
+            return self
+
+        mat, label, names = _to_matrix(self.data, self.label)
+        cat_auto = _pandas_categorical_columns(self.data)
+        if self.label is not None:
+            label = self.label
+        cfg = Config(self.params)
+
+        meta = Metadata(len(mat))
+        if label is not None:
+            meta.set_label(np.asarray(label))
+        self._set_fields(meta)
+
+        categorical = []
+        if self.categorical_feature == "auto":
+            categorical = cat_auto
+        elif self.categorical_feature and self.categorical_feature != "auto":
+            for c in self.categorical_feature:
+                if isinstance(c, str) and names and c in names:
+                    categorical.append(names.index(c))
+                elif isinstance(c, int):
+                    categorical.append(c)
+
+        feature_names = None
+        if self.feature_name != "auto" and self.feature_name:
+            feature_names = list(self.feature_name)
+        elif names:
+            feature_names = names
+
+        if self.reference is not None:
+            ref = self.reference.construct()
+            self._binned = BinnedDataset.construct(mat, cfg, metadata=meta,
+                                                   reference=ref._binned)
+        else:
+            self._binned = BinnedDataset.construct(
+                mat, cfg, metadata=meta, categorical_features=categorical,
+                feature_names=feature_names)
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    def _set_fields(self, meta: Metadata, subset: bool = False) -> None:
+        if self.weight is not None:
+            meta.set_weights(np.asarray(self.weight))
+        if self.group is not None:
+            meta.set_query(np.asarray(self.group))
+        if self.init_score is not None:
+            meta.set_init_score(np.asarray(self.init_score))
+
+    # -- python-side API ---------------------------------------------------
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params or self.params)
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        ds = Dataset(None, reference=self, params=params or self.params)
+        ds.used_indices = np.sort(np.asarray(used_indices))
+        ds.label = None
+        return ds
+
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._binned is not None and label is not None:
+            self._binned.metadata.set_label(np.asarray(label))
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._binned is not None:
+            self._binned.metadata.set_weights(
+                np.asarray(weight) if weight is not None else None)
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._binned is not None and group is not None:
+            self._binned.metadata.set_query(np.asarray(group))
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._binned is not None:
+            self._binned.metadata.set_init_score(init_score)
+        return self
+
+    def get_label(self):
+        self.construct()
+        return self._binned.metadata.label
+
+    def get_weight(self):
+        self.construct()
+        return self._binned.metadata.weights
+
+    def get_group(self):
+        self.construct()
+        b = self._binned.metadata.query_boundaries
+        return None if b is None else np.diff(b)
+
+    def get_init_score(self):
+        self.construct()
+        return self._binned.metadata.init_score
+
+    def get_field(self, name):
+        getter = {"label": self.get_label, "weight": self.get_weight,
+                  "group": self.get_group, "init_score": self.get_init_score}
+        if name not in getter:
+            raise LightGBMError("Unknown field name: %s" % name)
+        return getter[name]()
+
+    def set_field(self, name, data):
+        setter = {"label": self.set_label, "weight": self.set_weight,
+                  "group": self.set_group, "init_score": self.set_init_score}
+        if name not in setter:
+            raise LightGBMError("Unknown field name: %s" % name)
+        return setter[name](data)
+
+    def num_data(self) -> int:
+        self.construct()
+        return self._binned.num_data
+
+    def num_feature(self) -> int:
+        self.construct()
+        return self._binned.num_total_features
+
+    def save_binary(self, filename: str) -> "Dataset":
+        self.construct()
+        self._binned.save_binary(filename)
+        return self
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self._binned.feature_names)
+
+
+class Booster:
+    """Booster mirror (basic.py:1596-2569)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None, silent: bool = False):
+        from .models import create_boosting
+        self.params = dict(params) if params else {}
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._train_set = train_set
+        self.name_valid_sets: List[str] = []
+
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise LightGBMError("Training data should be Dataset instance")
+            train_set.construct()
+            cfg = Config(self.params)
+            objective = None
+            if cfg.objective not in ("none", "null", "custom", "na"):
+                objective = create_objective(cfg.objective, cfg)
+            self._gbdt = create_boosting(cfg, train_set._binned, objective)
+            self.config = cfg
+        elif model_file is not None:
+            with open(model_file) as f:
+                text = f.read()
+            self._init_from_string(text)
+        elif model_str is not None:
+            self._init_from_string(model_str)
+        else:
+            raise LightGBMError("Booster needs at least one of train_set, "
+                                "model_file, model_str")
+
+    def _init_from_string(self, text: str):
+        from .models import load_boosting_from_string
+        self.config = Config(self.params)
+        self._gbdt = load_boosting_from_string(text, self.config)
+
+    # -- training ----------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.construct()
+        metrics = _metrics_from_config(self.config)
+        self._gbdt.add_valid(name, data._binned, metrics)
+        self.name_valid_sets.append(name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        if fobj is None:
+            return self._gbdt.train_one_iter()
+        grad, hess = fobj(self.__pred_for_fobj(), self._train_set)
+        return self.__boost(grad, hess)
+
+    def __pred_for_fobj(self):
+        score = np.asarray(self._gbdt.train_state.score, np.float64)
+        return score[0] if score.shape[0] == 1 else score.reshape(-1)
+
+    def __boost(self, grad, hess) -> bool:
+        grad = np.asarray(grad, np.float64)
+        hess = np.asarray(hess, np.float64)
+        return self._gbdt.train_one_iter(grad, hess)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self) -> int:
+        return self._gbdt.current_iteration
+
+    def num_model_per_iteration(self) -> int:
+        return self._gbdt.num_model_per_iteration()
+
+    def num_trees(self) -> int:
+        return len(self._gbdt.models)
+
+    # -- eval --------------------------------------------------------------
+    def eval_train(self, feval=None):
+        return self._eval("training", self._gbdt.eval_train(), feval,
+                          self._train_set)
+
+    def eval_valid(self, feval=None):
+        out = []
+        for name, res in self._gbdt.eval_valid().items():
+            out.extend(self._eval(name, res, feval, None))
+        return out
+
+    def _eval(self, name, results, feval, dataset):
+        out = []
+        for metric_name, vals in results.items():
+            from .metric import _CLASSES
+            cls = _CLASSES.get(metric_name)
+            bigger = cls.bigger_is_better if cls else False
+            for v in vals:
+                out.append((name, metric_name, v, bigger))
+        return out
+
+    # -- prediction --------------------------------------------------------
+    def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                **kwargs) -> np.ndarray:
+        mat, _, _ = _to_matrix(data)
+        if pred_leaf:
+            return self._gbdt.predict_leaf_index(mat, num_iteration)
+        if pred_contrib:
+            return self._gbdt.predict_contrib(mat, num_iteration)
+        return self._gbdt.predict(mat, num_iteration, raw_score=raw_score)
+
+    # -- model IO ----------------------------------------------------------
+    def save_model(self, filename: str, num_iteration: int = -1,
+                   start_iteration: int = 0) -> "Booster":
+        self._gbdt.save_model_to_file(filename, start_iteration, num_iteration)
+        return self
+
+    def model_to_string(self, num_iteration: int = -1,
+                        start_iteration: int = 0) -> str:
+        return self._gbdt.save_model_to_string(start_iteration, num_iteration)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: int = -1) -> np.ndarray:
+        return self._gbdt.feature_importance(importance_type, iteration)
+
+    def feature_name(self) -> List[str]:
+        return list(self._gbdt.feature_names)
+
+    def num_feature(self) -> int:
+        return self._gbdt.max_feature_idx + 1
+
+    def __getstate__(self):
+        state = {"params": self.params,
+                 "model_str": self.model_to_string(),
+                 "best_iteration": self.best_iteration,
+                 "best_score": self.best_score}
+        return state
+
+    def __setstate__(self, state):
+        self.params = state["params"]
+        self.best_iteration = state["best_iteration"]
+        self.best_score = state["best_score"]
+        self._train_set = None
+        self.name_valid_sets = []
+        self._init_from_string(state["model_str"])
+
+
+def _metrics_from_config(cfg: Config):
+    names = list(cfg.metric)
+    if not names:
+        names = [default_metric_for_objective(cfg.objective)]
+    metrics = []
+    for n in names:
+        for sub in n.split(","):
+            if sub.strip():
+                m = create_metric(sub.strip(), cfg)
+                if m is not None:
+                    metrics.append(m)
+    return metrics

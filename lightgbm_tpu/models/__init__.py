@@ -1,0 +1,31 @@
+"""Boosting model factory (src/boosting/boosting.cpp:30-63)."""
+from __future__ import annotations
+
+from ..utils import log
+from .gbdt import GBDT  # noqa: F401
+from .tree import Tree  # noqa: F401
+
+
+def create_boosting(config, train_set, objective, metrics=()):
+    name = config.boosting
+    if name == "gbdt":
+        return GBDT(config, train_set, objective, metrics)
+    if name == "dart":
+        from .dart import DART
+        return DART(config, train_set, objective, metrics)
+    if name == "goss":
+        from .goss import GOSS
+        return GOSS(config, train_set, objective, metrics)
+    if name == "rf":
+        from .rf import RF
+        return RF(config, train_set, objective, metrics)
+    log.fatal("Unknown boosting type %s" % name)
+
+
+def load_boosting_from_string(text: str, config):
+    first = text.strip().split("\n", 1)[0].strip()
+    gbdt = GBDT(config, None, None)
+    if first not in ("tree",):
+        log.warning("Unknown submodel type %s when loading model", first)
+    gbdt.load_model_from_string(text)
+    return gbdt

@@ -1,0 +1,106 @@
+"""DART boosting (src/boosting/dart.hpp:17-205)."""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..utils import log
+from .gbdt import GBDT, K_EPSILON, _add_tree_score
+
+
+class DART(GBDT):
+    """Dropout boosting: before each iteration a random subset of existing
+    trees is dropped from the scores; the new tree is fit to the remaining
+    ensemble's residuals, then the dropped set and the new tree are
+    renormalized."""
+
+    def __init__(self, config, train_set, objective, metrics=()):
+        super().__init__(config, train_set, objective, metrics)
+        self._drop_rng = np.random.RandomState(config.drop_seed)
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+        self._drop_index: List[int] = []
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        self._dropping_trees()
+        ret = super().train_one_iter(gradients, hessians)
+        if ret:
+            return ret
+        self._normalize()
+        if not self.config.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False
+
+    # -- dropping (dart.hpp:88-140) ---------------------------------------
+    def _dropping_trees(self) -> None:
+        self._drop_index = []
+        cfg = self.config
+        is_skip = self._drop_rng.rand() < cfg.skip_drop
+        if not is_skip and self.iter > 0:
+            drop_rate = cfg.drop_rate
+            if not cfg.uniform_drop:
+                inv_avg = len(self.tree_weight) / self.sum_weight \
+                    if self.sum_weight > 0 else 0.0
+                if cfg.max_drop > 0 and self.sum_weight > 0:
+                    drop_rate = min(drop_rate,
+                                    cfg.max_drop * inv_avg / self.sum_weight)
+                for i in range(self.iter):
+                    if self._drop_rng.rand() < drop_rate * self.tree_weight[i] * inv_avg:
+                        self._drop_index.append(i)
+                        if len(self._drop_index) >= cfg.max_drop:
+                            break
+            else:
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / float(self.iter))
+                for i in range(self.iter):
+                    if self._drop_rng.rand() < drop_rate:
+                        self._drop_index.append(i)
+                        if len(self._drop_index) >= cfg.max_drop:
+                            break
+        # remove dropped trees from train scores
+        k = self.num_tree_per_iteration
+        for i in self._drop_index:
+            for kk in range(k):
+                tree = self.models[i * k + kk]
+                tree.shrink(-1.0)
+                _add_tree_score(self.train_state, tree, kk, self)
+        if not self.config.xgboost_dart_mode:
+            self.shrinkage_rate = self.config.learning_rate / \
+                (1.0 + len(self._drop_index))
+        else:
+            if not self._drop_index:
+                self.shrinkage_rate = self.config.learning_rate
+            else:
+                self.shrinkage_rate = self.config.learning_rate / \
+                    (self.config.learning_rate + len(self._drop_index))
+
+    # -- normalization (dart.hpp:141-196) ---------------------------------
+    def _normalize(self) -> None:
+        kdrop = float(len(self._drop_index))
+        k = self.num_tree_per_iteration
+        cfg = self.config
+        for i in self._drop_index:
+            for kk in range(k):
+                tree = self.models[i * k + kk]
+                if not cfg.xgboost_dart_mode:
+                    tree.shrink(1.0 / (kdrop + 1.0))
+                    for _, vs, _m in self.valid_states:
+                        _add_tree_score(vs, tree, kk, self)
+                    tree.shrink(-kdrop)
+                    _add_tree_score(self.train_state, tree, kk, self)
+                else:
+                    tree.shrink(self.shrinkage_rate)
+                    for _, vs, _m in self.valid_states:
+                        _add_tree_score(vs, tree, kk, self)
+                    tree.shrink(-kdrop / cfg.learning_rate)
+                    _add_tree_score(self.train_state, tree, kk, self)
+            if not cfg.uniform_drop:
+                if not cfg.xgboost_dart_mode:
+                    self.sum_weight -= self.tree_weight[i] * (1.0 / (kdrop + 1.0))
+                    self.tree_weight[i] *= kdrop / (kdrop + 1.0)
+                else:
+                    self.sum_weight -= self.tree_weight[i] * \
+                        (1.0 / (kdrop + cfg.learning_rate))
+                    self.tree_weight[i] *= kdrop / (kdrop + cfg.learning_rate)

@@ -1,0 +1,585 @@
+"""GBDT boosting driver.
+
+Re-design of src/boosting/gbdt.{h,cpp}: the per-iteration loop —
+boost-from-average, gradient computation, bagging, per-class tree growth,
+shrinkage, score updates, metric evaluation — orchestrated on host with every
+hot step jitted on device.  Scores, gradients and the binned matrix stay
+device-resident across iterations; only metric evaluation pulls scores back.
+
+Model text IO follows the reference v2 format (gbdt_model_text.cpp:244-343)
+so models round-trip with the reference's parsers.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..io.dataset import BinnedDataset
+from ..metric import Metric
+from ..objective import ObjectiveFunction
+from ..ops import grow as grow_ops
+from ..ops.split import SplitParams
+from ..utils import log
+from .tree import Tree
+
+K_EPSILON = 1e-15
+
+
+class _DatasetState:
+    """Device-side per-dataset state (ScoreUpdater, score_updater.hpp:17-120)."""
+
+    def __init__(self, ds: BinnedDataset, num_classes: int, dtype):
+        self.ds = ds
+        self.bins = ds.device_bins()
+        self.num_bins = jnp.asarray(ds.feature_num_bins())
+        self.default_bins = jnp.asarray(
+            np.array([m.default_bin for m in ds.bin_mappers], np.int32))
+        self.missing_types = jnp.asarray(
+            np.array([m.missing_type for m in ds.bin_mappers], np.int32))
+        self.score = jnp.zeros((num_classes, ds.num_data), dtype)
+
+    def add_constant(self, val: float, class_id: int) -> None:
+        self.score = self.score.at[class_id].add(val)
+
+
+class GBDT:
+    """The main boosting driver (gbdt.h:24-470)."""
+
+    sub_model_name = "tree"
+
+    def __init__(self, config: Config, train_set: Optional[BinnedDataset],
+                 objective: Optional[ObjectiveFunction],
+                 metrics: Sequence[Metric] = ()):
+        self.config = config
+        self.objective = objective
+        self.train_metrics = list(metrics)
+        self.models: List[Tree] = []
+        self.iter = 0
+        self.num_class = config.num_class
+        self.num_tree_per_iteration = (
+            objective.num_model_per_iteration if objective is not None
+            else config.num_class)
+        self.shrinkage_rate = config.learning_rate
+        self.average_output = False
+        self.max_feature_idx = 0
+        self.label_idx = 0
+        self.feature_names: List[str] = []
+        self.feature_infos: List[str] = []
+        self.loaded_parameter = ""
+        self.dtype = jnp.float64 if config.tpu_double_precision else jnp.float32
+        self.train_state: Optional[_DatasetState] = None
+        self.valid_states: List[Tuple[str, _DatasetState, List[Metric]]] = []
+        self.best_iteration = 0
+        self._bag_rng = np.random.RandomState(config.bagging_seed)
+        self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
+
+        if train_set is not None:
+            self._setup_train(train_set)
+
+    # ------------------------------------------------------------------ #
+    def _setup_train(self, train_set: BinnedDataset) -> None:
+        self.train_set = train_set
+        self.num_data = train_set.num_data
+        self.max_feature_idx = train_set.num_total_features - 1
+        self.feature_names = list(train_set.feature_names)
+        self.feature_infos = _feature_infos(train_set)
+        self.train_state = _DatasetState(train_set, self.num_tree_per_iteration,
+                                         self.dtype)
+        if self.objective is not None:
+            self.objective.init(train_set.metadata, self.num_data)
+        for m in self.train_metrics:
+            m.init(train_set.metadata, self.num_data)
+        self.max_bin = int(train_set.feature_num_bins().max()) \
+            if train_set.num_features else 2
+        F = max(train_set.num_features, 1)
+        self._feature_mask_all = jnp.ones(F, bool)
+        self.split_params = SplitParams(
+            lambda_l1=self.config.lambda_l1, lambda_l2=self.config.lambda_l2,
+            max_delta_step=self.config.max_delta_step,
+            min_data_in_leaf=self.config.min_data_in_leaf,
+            min_sum_hessian_in_leaf=self.config.min_sum_hessian_in_leaf,
+            min_gain_to_split=self.config.min_gain_to_split)
+        self.monotone = (jnp.asarray(train_set.monotone_constraints, jnp.int32)
+                         if train_set.monotone_constraints is not None else None)
+        self.penalty = (jnp.asarray(train_set.feature_penalty, self.dtype)
+                        if train_set.feature_penalty is not None else None)
+        # bagging state
+        self._bag_mask: Optional[jnp.ndarray] = None
+        self._row_all_in = jnp.zeros(self.num_data, jnp.int32)
+        # init scores seed the training scores unconditionally (the reference
+        # seeds ScoreUpdater at construction, score_updater.hpp:40-55), so
+        # custom-fobj training also starts from them
+        if train_set.metadata.init_score is not None:
+            self._apply_init_scores()
+
+    def add_valid(self, name: str, valid_set: BinnedDataset,
+                  metrics: Sequence[Metric]) -> None:
+        state = _DatasetState(valid_set, self.num_tree_per_iteration, self.dtype)
+        if valid_set.metadata.init_score is not None:
+            init = np.asarray(valid_set.metadata.init_score, np.float64)
+            k, n = self.num_tree_per_iteration, valid_set.num_data
+            init = init.reshape(k, n) if len(init) == k * n else \
+                np.tile(init.reshape(1, -1), (k, 1))
+            state.score = state.score + jnp.asarray(init, self.dtype)
+        for m in metrics:
+            m.init(valid_set.metadata, valid_set.num_data)
+        # replay existing model onto the new validation scores
+        for it in range(len(self.models) // self.num_tree_per_iteration):
+            for k in range(self.num_tree_per_iteration):
+                tree = self.models[it * self.num_tree_per_iteration + k]
+                _add_tree_score(state, tree, k, self)
+        self.valid_states.append((name, state, list(metrics)))
+
+    # ------------------------------------------------------------------ #
+    # Bagging (gbdt.cpp:159-241)
+    # ------------------------------------------------------------------ #
+    def _bagging(self, it: int) -> jnp.ndarray:
+        cfg = self.config
+        n = self.num_data
+        if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0 \
+           and it % cfg.bagging_freq == 0:
+            bag_cnt = int(cfg.bagging_fraction * n)
+            idx = self._bag_rng.choice(n, bag_cnt, replace=False)
+            mask = np.full(n, -1, np.int32)
+            mask[idx] = 0
+            self._bag_mask = jnp.asarray(mask)
+        elif cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
+            self._bag_mask = None
+        return self._bag_mask if self._bag_mask is not None else self._row_all_in
+
+    def _feature_sample(self) -> jnp.ndarray:
+        frac = self.config.feature_fraction
+        F = self.train_set.num_features
+        if frac >= 1.0 or F == 0:
+            return self._feature_mask_all
+        used = max(1, int(round(F * frac)))
+        idx = self._feat_rng.choice(F, used, replace=False)
+        mask = np.zeros(F, bool)
+        mask[idx] = True
+        return jnp.asarray(mask)
+
+    # ------------------------------------------------------------------ #
+    # One boosting iteration (gbdt.cpp:333-412)
+    # ------------------------------------------------------------------ #
+    def train_one_iter(self, gradients: Optional[np.ndarray] = None,
+                       hessians: Optional[np.ndarray] = None) -> bool:
+        """Returns True when training cannot continue (no splittable leaves)."""
+        k = self.num_tree_per_iteration
+        init_scores = [0.0] * k
+        if gradients is None or hessians is None:
+            for kk in range(k):
+                init_scores[kk] = self._boost_from_average(kk)
+            grad, hess = self.objective.get_gradients(
+                self.train_state.score if k > 1 else self.train_state.score[0])
+            grad = jnp.reshape(grad, (k, self.num_data)).astype(self.dtype)
+            hess = jnp.reshape(hess, (k, self.num_data)).astype(self.dtype)
+        else:
+            grad = jnp.reshape(jnp.asarray(gradients, self.dtype), (k, self.num_data))
+            hess = jnp.reshape(jnp.asarray(hessians, self.dtype), (k, self.num_data))
+
+        row_init = self._bagging(self.iter)
+
+        should_continue = False
+        for kk in range(k):
+            new_tree = Tree(1)
+            class_ok = (self.objective is None
+                        or self.objective.class_need_train(kk))
+            if class_ok and self.train_set.num_features > 0:
+                arrays, leaf_ids = grow_ops.grow_tree(
+                    self.train_state.bins, grad[kk], hess[kk], row_init,
+                    self._feature_sample(),
+                    self.train_state.num_bins, self.train_state.default_bins,
+                    self.train_state.missing_types,
+                    self.split_params, self.monotone, self.penalty,
+                    max_leaves=self.config.num_leaves,
+                    max_depth=self.config.max_depth,
+                    max_bin=self.max_bin,
+                    hist_impl=self.config.tpu_histogram_impl,
+                    rows_per_chunk=self.config.tpu_rows_per_tile)
+                if int(arrays.num_leaves) > 1:
+                    new_tree = Tree.from_arrays(arrays, self.train_set)
+
+            if new_tree.num_leaves > 1:
+                should_continue = True
+                self._renew_tree_output(new_tree, kk, leaf_ids)
+                new_tree.shrink(self.shrinkage_rate)
+                self._update_train_score(new_tree, kk, arrays, leaf_ids)
+                self._update_valid_scores(new_tree, kk)
+                if abs(init_scores[kk]) > K_EPSILON:
+                    new_tree.add_bias(init_scores[kk])
+            else:
+                if len(self.models) < k:
+                    if not class_ok and self.objective is not None:
+                        output = self.objective.boost_from_score(kk)
+                    else:
+                        output = init_scores[kk]
+                    new_tree.as_constant(output)
+                    self.train_state.add_constant(output, kk)
+                    for _, vs, _m in self.valid_states:
+                        vs.add_constant(output, kk)
+            self.models.append(new_tree)
+
+        if not should_continue:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            if len(self.models) > k:
+                del self.models[-k:]
+            return True
+        self.iter += 1
+        return False
+
+    def _boost_from_average(self, class_id: int) -> float:
+        if self.models or self.objective is None:
+            return 0.0
+        if self.train_set.metadata.init_score is not None:
+            return 0.0  # already seeded at setup
+        if self.config.boost_from_average or self.train_set.num_features == 0:
+            init_score = self.objective.boost_from_score(class_id)
+            if abs(init_score) > K_EPSILON:
+                self.train_state.add_constant(init_score, class_id)
+                for _, vs, _m in self.valid_states:
+                    vs.add_constant(init_score, class_id)
+                log.info("Start training from score %f", init_score)
+                return init_score
+        elif self.objective.name in ("regression_l1", "quantile", "mape"):
+            log.warning("Disabling boost_from_average in %s may cause the slow "
+                        "convergence", self.objective.name)
+        return 0.0
+
+    def _apply_init_scores(self) -> None:
+        init = np.asarray(self.train_set.metadata.init_score, np.float64)
+        k = self.num_tree_per_iteration
+        n = self.num_data
+        init = init.reshape(k, n) if len(init) == k * n else \
+            np.tile(init.reshape(1, -1), (k, 1))
+        self.train_state.score = self.train_state.score + jnp.asarray(init, self.dtype)
+
+    def _renew_tree_output(self, tree: Tree, class_id: int,
+                           leaf_ids) -> None:
+        """Percentile leaf refits for L1-family objectives
+        (serial_tree_learner.cpp:850-928)."""
+        obj = self.objective
+        if obj is None or not obj.is_renew_tree_output():
+            return
+        score = np.asarray(self.train_state.score[class_id], np.float64)
+        label = np.asarray(self.train_set.metadata.label, np.float64)
+        residual = label - score
+        lids = np.asarray(leaf_ids)
+        weights = (np.asarray(self.train_set.metadata.weights, np.float64)
+                   if self.train_set.metadata.weights is not None else None)
+        if obj.name == "mape":
+            weights = np.asarray(obj.label_weight, np.float64)
+        for leaf in range(tree.num_leaves):
+            rows = np.flatnonzero(lids == leaf)
+            if len(rows) == 0:
+                continue
+            res = residual[rows]
+            w = weights[rows] if weights is not None else None
+            tree.leaf_value[leaf] = obj._renew_percentile(res, w)
+
+    # ------------------------------------------------------------------ #
+    # Score updates (ScoreUpdater::AddScore paths)
+    # ------------------------------------------------------------------ #
+    def _update_train_score(self, tree: Tree, class_id: int, arrays, leaf_ids):
+        leaf_values = jnp.asarray(tree.leaf_value[:max(tree.num_leaves, 1)],
+                                  self.dtype)
+        lids = leaf_ids
+        if self._bag_mask is not None:
+            # out-of-bag rows need a traversal (gbdt.cpp UpdateScore OOB path)
+            walked = grow_ops.predict_leaf_inner(
+                self.train_state.bins, arrays, self.train_state.num_bins,
+                self.train_state.default_bins)
+            lids = jnp.where(lids >= 0, lids, walked)
+        self.train_state.score = self.train_state.score.at[class_id].add(
+            leaf_values[jnp.clip(lids, 0, tree.num_leaves - 1)])
+
+    def _update_valid_scores(self, tree: Tree, class_id: int):
+        for _, vs, _m in self.valid_states:
+            _add_tree_score(vs, tree, class_id, self)
+
+    # ------------------------------------------------------------------ #
+    # Evaluation (gbdt.cpp:476-533)
+    # ------------------------------------------------------------------ #
+    def eval_train(self) -> Dict[str, List[float]]:
+        return self._eval_state(self.train_state, self.train_metrics)
+
+    def eval_valid(self) -> Dict[str, Dict[str, List[float]]]:
+        return {name: self._eval_state(vs, metrics)
+                for name, vs, metrics in self.valid_states}
+
+    def _eval_state(self, state: _DatasetState, metrics) -> Dict[str, List[float]]:
+        out = {}
+        if not metrics:
+            return out
+        score = np.asarray(state.score, np.float64)
+        flat = score.reshape(-1) if self.num_tree_per_iteration > 1 else score[0]
+        for m in metrics:
+            out[m.name] = m.eval(flat, self.objective)
+        return out
+
+    # ------------------------------------------------------------------ #
+    # Prediction on raw features (gbdt_prediction.cpp)
+    # ------------------------------------------------------------------ #
+    def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        if X.ndim != 2 or X.shape[1] <= self.max_feature_idx:
+            log.fatal("The number of features in data (%d) is not the same as "
+                      "it was in training data (%d)"
+                      % (X.shape[1] if X.ndim == 2 else 0,
+                         self.max_feature_idx + 1))
+        k = self.num_tree_per_iteration
+        total_iters = len(self.models) // max(k, 1)
+        iters = total_iters if num_iteration <= 0 else min(num_iteration, total_iters)
+        out = np.zeros((k, X.shape[0]), np.float64)
+        for it in range(iters):
+            for kk in range(k):
+                out[kk] += self.models[it * k + kk].predict(X)
+        return out[0] if k == 1 else out.T  # [n] or [n, k]
+
+    def predict(self, X: np.ndarray, num_iteration: int = -1,
+                raw_score: bool = False) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration)
+        if raw_score or self.objective is None:
+            return raw
+        if self.num_tree_per_iteration > 1:
+            return np.asarray(self.objective.convert_output_multi(raw))
+        return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+
+    def predict_contrib(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        from .shap import predict_contrib as _shap
+        return _shap(self, X, num_iteration)
+
+    def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        X = np.asarray(X, np.float64)
+        k = self.num_tree_per_iteration
+        total_iters = len(self.models) // max(k, 1)
+        iters = total_iters if num_iteration <= 0 else min(num_iteration, total_iters)
+        out = np.zeros((X.shape[0], iters * k), np.int32)
+        for i in range(iters * k):
+            out[:, i] = self.models[i].predict_leaf_index(X)
+        return out
+
+    # ------------------------------------------------------------------ #
+    # Importance / model IO
+    # ------------------------------------------------------------------ #
+    def feature_importance(self, importance_type: str = "split",
+                           num_iteration: int = -1) -> np.ndarray:
+        n_feat = self.max_feature_idx + 1
+        imp = np.zeros(n_feat, np.float64)
+        k = max(self.num_tree_per_iteration, 1)
+        total_iters = len(self.models) // k
+        iters = total_iters if num_iteration <= 0 else min(num_iteration, total_iters)
+        for tree in self.models[:iters * k]:
+            for node in range(tree.num_leaves - 1):
+                if importance_type == "split":
+                    imp[tree.split_feature[node]] += 1
+                else:
+                    imp[tree.split_feature[node]] += max(tree.split_gain[node], 0)
+        return imp
+
+    def save_model_to_string(self, start_iteration: int = 0,
+                             num_iteration: int = -1) -> str:
+        ss = [self.sub_model_name, "version=v2",
+              "num_class=%d" % self.num_class,
+              "num_tree_per_iteration=%d" % self.num_tree_per_iteration,
+              "label_index=%d" % self.label_idx,
+              "max_feature_idx=%d" % self.max_feature_idx]
+        if self.objective is not None:
+            ss.append("objective=%s" % self.objective.to_string())
+        if self.average_output:
+            ss.append("average_output")
+        ss.append("feature_names=" + " ".join(self.feature_names))
+        ss.append("feature_infos=" + " ".join(self.feature_infos))
+
+        k = max(self.num_tree_per_iteration, 1)
+        total_iteration = len(self.models) // k
+        start_iteration = min(max(start_iteration, 0), total_iteration)
+        num_used = len(self.models)
+        if num_iteration > 0:
+            num_used = min((start_iteration + num_iteration) * k, num_used)
+        start_model = start_iteration * k
+
+        tree_strs = []
+        for i in range(start_model, num_used):
+            tree_strs.append("Tree=%d\n%s\n" % (i - start_model,
+                                                self.models[i].to_string()))
+        ss.append("tree_sizes=" + " ".join(str(len(s)) for s in tree_strs))
+        ss.append("")
+        body = "\n".join(ss) + "\n" + "".join(tree_strs) + "end of trees\n"
+
+        imps = self.feature_importance("split", num_iteration)
+        pairs = [(int(v), self.feature_names[i]) for i, v in enumerate(imps) if v > 0]
+        pairs.sort(key=lambda p: -p[0])
+        body += "\nfeature importances:\n"
+        body += "".join("%s=%d\n" % (nm, v) for v, nm in pairs)
+        return body
+
+    def save_model_to_file(self, filename: str, start_iteration: int = 0,
+                           num_iteration: int = -1) -> None:
+        with open(filename, "w") as f:
+            f.write(self.save_model_to_string(start_iteration, num_iteration))
+        log.info("Saved model to %s", filename)
+
+    def load_model_from_string(self, text: str) -> None:
+        """LoadModelFromString (gbdt_model_text.cpp:343+)."""
+        lines = text.split("\n")
+        header: Dict[str, str] = {}
+        i = 0
+        while i < len(lines):
+            line = lines[i].strip()
+            if line.startswith("Tree=") or line == "end of trees":
+                break
+            if "=" in line:
+                kk, v = line.split("=", 1)
+                header[kk.strip()] = v.strip()
+            elif line == "average_output":
+                header["average_output"] = "1"
+            i += 1
+        if "version" not in header or header["version"] != "v2":
+            log.warning("Unknown model version %s", header.get("version"))
+        self.num_class = int(header.get("num_class", "1"))
+        self.num_tree_per_iteration = int(header.get("num_tree_per_iteration",
+                                                     str(self.num_class)))
+        self.label_idx = int(header.get("label_index", "0"))
+        self.max_feature_idx = int(header.get("max_feature_idx", "0"))
+        self.average_output = "average_output" in header
+        self.feature_names = header.get("feature_names", "").split()
+        self.feature_infos = header.get("feature_infos", "").split()
+        if "objective" in header and self.objective is None:
+            from ..objective import create_objective
+            obj_str = header["objective"].split()
+            params = {}
+            for tok in obj_str[1:]:
+                if ":" in tok:
+                    pk, pv = tok.split(":", 1)
+                    params[{"sigmoid": "sigmoid", "num_class": "num_class",
+                            "alpha": "alpha", "tweedie_variance_power":
+                            "tweedie_variance_power"}.get(pk, pk)] = pv
+            params["num_class"] = params.get("num_class", self.num_class)
+            try:
+                self.objective = create_objective(obj_str[0], Config(params))
+            except Exception:
+                self.objective = None
+        # parse trees
+        self.models = []
+        blocks = text.split("Tree=")
+        for blk in blocks[1:]:
+            body = blk.split("\n\n")[0]
+            body = body[body.index("\n") + 1:]  # drop the tree number line
+            if "end of trees" in body:
+                body = body[:body.index("end of trees")]
+            self.models.append(Tree.from_string(body))
+        self.iter = len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    # ------------------------------------------------------------------ #
+    def rollback_one_iter(self) -> None:
+        if self.iter <= 0:
+            return
+        k = self.num_tree_per_iteration
+        for kk in range(k):
+            tree = self.models[-k + kk]
+            tree.shrink(-1.0)
+            # subtract the (now negated) tree from all scores
+            self._update_train_score_full(tree, kk)
+            for _, vs, _m in self.valid_states:
+                _add_tree_score(vs, tree, kk, self)
+            tree.shrink(-1.0)
+        del self.models[-k:]
+        self.iter -= 1
+
+    def _update_train_score_full(self, tree: Tree, class_id: int):
+        _add_tree_score(self.train_state, tree, class_id, self)
+
+    def raw_scores(self, name: str) -> np.ndarray:
+        """Current raw scores of a dataset ('training' or a valid name), as
+        the flat class-major layout custom fobj/feval expect."""
+        if name == "training":
+            state = self.train_state
+        else:
+            state = next(vs for nm, vs, _m in self.valid_states if nm == name)
+        score = np.asarray(state.score, np.float64)
+        return score[0] if score.shape[0] == 1 else score.reshape(-1)
+
+    @property
+    def current_iteration(self) -> int:
+        return len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def num_model_per_iteration(self) -> int:
+        return self.num_tree_per_iteration
+
+
+def _add_tree_score(state: _DatasetState, tree: Tree, class_id: int, gbdt: GBDT):
+    """Add a (host) tree's output to a dataset's device scores via binned
+    traversal on device."""
+    if tree.num_leaves <= 1:
+        state.add_constant(float(tree.leaf_value[0]), class_id)
+        return
+    arrays = _tree_to_device(tree, gbdt.dtype)
+    leaf = grow_ops.predict_leaf_inner(state.bins, arrays, state.num_bins,
+                                       state.default_bins)
+    leaf_values = jnp.asarray(tree.leaf_value[:tree.num_leaves], gbdt.dtype)
+    state.score = state.score.at[class_id].add(leaf_values[leaf])
+
+
+def _tree_to_device(tree: Tree, dtype) -> grow_ops.TreeArrays:
+    # pad node/leaf arrays to a power-of-two bucket so predict_leaf_inner's
+    # jit cache sees stable shapes across trees of different sizes
+    nl_true = max(tree.num_leaves, 1)
+    nl = max(2, 1 << (nl_true - 1).bit_length())
+    n, n_true = nl - 1, max(tree.num_leaves - 1, 1)
+
+    def padn(a, fill=0):
+        out = np.full(n, fill, np.asarray(a[:1]).dtype if len(a) else np.int32)
+        out[:n_true] = a[:n_true]
+        return jnp.asarray(out)
+
+    def padl(a, dt=None):
+        out = np.zeros(nl, dt or np.asarray(a[:1]).dtype)
+        out[:nl_true] = a[:nl_true]
+        return jnp.asarray(out)
+
+    mt = (tree.decision_type.astype(np.int32) >> 2) & 3
+    dl = (tree.decision_type & 2) > 0
+    return grow_ops.TreeArrays(
+        split_feature=padn(tree.split_feature_inner),
+        threshold_bin=padn(tree.threshold_in_bin),
+        default_left=padn(dl),
+        missing_type=padn(mt),
+        left_child=padn(tree.left_child, fill=~0),
+        right_child=padn(tree.right_child, fill=~0),
+        split_gain=jnp.asarray(np.pad(tree.split_gain[:n_true].astype(np.float64),
+                                      (0, n - n_true)), dtype),
+        internal_value=jnp.asarray(np.pad(tree.internal_value[:n_true].astype(np.float64),
+                                          (0, n - n_true)), dtype),
+        internal_count=padn(tree.internal_count),
+        leaf_value=jnp.asarray(np.pad(tree.leaf_value[:nl_true].astype(np.float64),
+                                      (0, nl - nl_true)), dtype),
+        leaf_count=padl(tree.leaf_count),
+        leaf_parent=jnp.zeros(nl, jnp.int32),
+        leaf_depth=jnp.zeros(nl, jnp.int32),
+        num_leaves=jnp.asarray(tree.num_leaves, jnp.int32),
+    )
+
+
+def _feature_infos(ds: BinnedDataset) -> List[str]:
+    """'[min:max]' per raw feature; 'none' for unused (dataset.cpp)."""
+    out = []
+    for raw in range(ds.num_total_features):
+        inner = ds.used_feature_map[raw]
+        if inner < 0:
+            out.append("none")
+            continue
+        m = ds.bin_mappers[inner]
+        if m.bin_type == 1:  # categorical
+            out.append(":".join(str(c) for c in sorted(m.bin_2_categorical)))
+        else:
+            out.append("[%s:%s]" % (_repr_g(m.min_val), _repr_g(m.max_val)))
+    return out
+
+
+def _repr_g(v: float) -> str:
+    return np.format_float_positional(v, precision=17, trim="-", fractional=False)

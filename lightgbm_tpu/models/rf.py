@@ -1,0 +1,102 @@
+"""Random forest mode (src/boosting/rf.hpp:18-209)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..utils import log
+from .gbdt import GBDT, K_EPSILON
+from .tree import Tree
+
+
+class RF(GBDT):
+    """Bagged trees with no shrinkage and averaged output: gradients are
+    always computed against the constant boost-from-average score, and the
+    train/valid scores hold the running average of tree outputs."""
+
+    def __init__(self, config, train_set, objective, metrics=()):
+        if not (config.bagging_freq > 0 and 0.0 < config.bagging_fraction < 1.0):
+            log.fatal("Random forest mode requires bagging "
+                      "(bagging_freq > 0 and bagging_fraction in (0, 1))")
+        super().__init__(config, train_set, objective, metrics)
+        self.average_output = True
+        self.shrinkage_rate = 1.0
+        self._rf_init_scores = [0.0] * max(self.num_tree_per_iteration, 1)
+        self._rf_grad = None
+
+    def _compute_rf_gradients(self):
+        """Gradients against the constant init score (rf.hpp:75-93)."""
+        k = self.num_tree_per_iteration
+        n = self.num_data
+        for kk in range(k):
+            self._rf_init_scores[kk] = (
+                self.objective.boost_from_score(kk)
+                if self.config.boost_from_average and self.objective else 0.0)
+        tmp = jnp.asarray(np.repeat(np.asarray(self._rf_init_scores, np.float64)
+                                    .reshape(k, 1), n, axis=1), self.dtype)
+        grad, hess = self.objective.get_gradients(tmp if k > 1 else tmp[0])
+        self._rf_grad = (jnp.reshape(grad, (k, n)).astype(self.dtype),
+                         jnp.reshape(hess, (k, n)).astype(self.dtype))
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        if gradients is not None or hessians is not None:
+            log.fatal("RF mode does not support custom objective")
+        if self._rf_grad is None:
+            self._compute_rf_gradients()
+        grad, hess = self._rf_grad
+        k = self.num_tree_per_iteration
+        row_init = self._bagging(self.iter)
+
+        from ..ops import grow as grow_ops
+        for kk in range(k):
+            new_tree = Tree(1)
+            if (self.objective is None or self.objective.class_need_train(kk)) \
+               and self.train_set.num_features > 0:
+                arrays, leaf_ids = grow_ops.grow_tree(
+                    self.train_state.bins, grad[kk], hess[kk], row_init,
+                    self._feature_sample(),
+                    self.train_state.num_bins, self.train_state.default_bins,
+                    self.train_state.missing_types,
+                    self.split_params, self.monotone, self.penalty,
+                    max_leaves=self.config.num_leaves,
+                    max_depth=self.config.max_depth,
+                    max_bin=self.max_bin,
+                    hist_impl=self.config.tpu_histogram_impl,
+                    rows_per_chunk=self.config.tpu_rows_per_tile)
+                if int(arrays.num_leaves) > 1:
+                    new_tree = Tree.from_arrays(arrays, self.train_set)
+            if new_tree.num_leaves > 1:
+                self._renew_tree_output(new_tree, kk, leaf_ids)
+                if abs(self._rf_init_scores[kk]) > K_EPSILON:
+                    new_tree.add_bias(self._rf_init_scores[kk])
+                self._average_in(new_tree, kk, arrays, leaf_ids)
+            else:
+                output = self._rf_init_scores[kk]
+                new_tree.as_constant(output)
+                self._average_in(new_tree, kk, None, None)
+            self.models.append(new_tree)
+        self.iter += 1
+        return False
+
+    def _average_in(self, tree: Tree, class_id: int, arrays, leaf_ids):
+        """score <- (score*iter + tree)/(iter+1) (rf.hpp:130-134)."""
+        it = self.iter
+        self.train_state.score = self.train_state.score.at[class_id].multiply(it)
+        if arrays is not None:
+            self._update_train_score(tree, class_id, arrays, leaf_ids)
+        else:
+            self.train_state.add_constant(float(tree.leaf_value[0]), class_id)
+        self.train_state.score = self.train_state.score.at[class_id].multiply(
+            1.0 / (it + 1))
+        for _, vs, _m in self.valid_states:
+            vs.score = vs.score.at[class_id].multiply(it)
+            from .gbdt import _add_tree_score
+            _add_tree_score(vs, tree, class_id, self)
+            vs.score = vs.score.at[class_id].multiply(1.0 / (it + 1))
+
+    def predict_raw(self, X, num_iteration: int = -1):
+        raw = super().predict_raw(X, num_iteration)
+        k = max(self.num_tree_per_iteration, 1)
+        iters = len(self.models) // k if num_iteration <= 0 else \
+            min(num_iteration, len(self.models) // k)
+        return raw / max(iters, 1)

@@ -1,0 +1,471 @@
+"""Objective functions.
+
+Vectorized jax re-implementations of src/objective/ (factory at
+src/objective/objective_function.cpp:10-49).  Each objective computes dense
+per-row (gradient, hessian) arrays on device from the current raw scores —
+the direct analogue of ObjectiveFunction::GetGradients
+(include/LightGBM/objective_function.h:13-89) — plus the scalar
+BoostFromScore init, ConvertOutput transform, and RenewTreeOutput leaf
+refits for percentile-based objectives.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .utils import log
+
+K_EPSILON = 1e-15
+
+
+# --------------------------------------------------------------------------- #
+# Percentile helpers (regression_objective.hpp:17-69, used by L1/quantile/MAPE)
+# --------------------------------------------------------------------------- #
+def percentile(data: np.ndarray, alpha: float) -> float:
+    """PercentileFun: descending-order interpolated percentile."""
+    n = len(data)
+    if n <= 1:
+        return float(data[0]) if n else 0.0
+    d = np.sort(np.asarray(data, np.float64))[::-1]
+    float_pos = (1.0 - alpha) * n
+    pos = int(float_pos)
+    if pos < 1:
+        return float(d[0])
+    if pos >= n:
+        return float(d[-1])
+    bias = float_pos - pos
+    v1, v2 = d[pos - 1], d[pos]
+    return float(v1 - (v1 - v2) * bias)
+
+
+def weighted_percentile(data: np.ndarray, weights: np.ndarray, alpha: float) -> float:
+    """WeightedPercentileFun: CDF-interpolated weighted percentile."""
+    n = len(data)
+    if n <= 1:
+        return float(data[0]) if n else 0.0
+    order = np.argsort(np.asarray(data, np.float64), kind="stable")
+    cdf = np.cumsum(np.asarray(weights, np.float64)[order])
+    threshold = cdf[-1] * alpha
+    pos = int(np.searchsorted(cdf, threshold, side="right"))
+    pos = min(pos, n - 1)
+    if pos == 0 or pos == n - 1:
+        return float(data[order[pos]])
+    v1 = float(data[order[pos - 1]])
+    v2 = float(data[order[pos]])
+    if pos + 1 < n and cdf[pos + 1] - cdf[pos] > K_EPSILON:
+        return (threshold - cdf[pos]) / (cdf[pos + 1] - cdf[pos]) * (v2 - v1) + v1
+    return v2
+
+
+# --------------------------------------------------------------------------- #
+# Base class
+# --------------------------------------------------------------------------- #
+class ObjectiveFunction:
+    """Interface mirror of objective_function.h:13-89."""
+
+    name = "none"
+
+    def __init__(self, config):
+        self.config = config
+        self.num_data = 0
+        self.label: Optional[jnp.ndarray] = None
+        self.weights: Optional[jnp.ndarray] = None
+        self.metadata = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def init(self, metadata, num_data: int) -> None:
+        self.metadata = metadata
+        self.num_data = num_data
+        self.label = jnp.asarray(self._transform_label(metadata.label), jnp.float32)
+        self.weights = (jnp.asarray(metadata.weights, jnp.float32)
+                        if metadata.weights is not None else None)
+
+    def _transform_label(self, label: np.ndarray) -> np.ndarray:
+        return label
+
+    # -- core --------------------------------------------------------------
+    def get_gradients(self, score: jnp.ndarray):
+        """score [n] (or [k*n] class-major for multiclass) -> (grad, hess)."""
+        grad, hess = self._raw_gradients(score)
+        if self.weights is not None:
+            grad, hess = self._apply_weights(grad, hess)
+        return grad, hess
+
+    def _apply_weights(self, grad, hess):
+        return grad * self.weights, hess * self.weights
+
+    def _raw_gradients(self, score):
+        raise NotImplementedError
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return 0.0
+
+    def convert_output(self, raw):
+        return raw
+
+    def is_constant_hessian(self) -> bool:
+        return False
+
+    def is_renew_tree_output(self) -> bool:
+        return False
+
+    def renew_tree_output(self, pred_fn, residual_getter, leaf_ids: np.ndarray,
+                          num_leaves: int) -> Optional[np.ndarray]:
+        return None
+
+    @property
+    def num_model_per_iteration(self) -> int:
+        return 1
+
+    def class_need_train(self, class_id: int) -> bool:
+        return True
+
+    def need_accurate_prediction(self) -> bool:
+        return True
+
+    def to_string(self) -> str:
+        return self.name
+
+
+# --------------------------------------------------------------------------- #
+# Regression family (src/objective/regression_objective.hpp:71-814)
+# --------------------------------------------------------------------------- #
+class RegressionL2Loss(ObjectiveFunction):
+    name = "regression"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sqrt = bool(config.reg_sqrt)
+
+    def _transform_label(self, label):
+        if self.sqrt:
+            return np.sign(label) * np.sqrt(np.abs(label))
+        return label
+
+    def _raw_gradients(self, score):
+        return score - self.label, jnp.ones_like(score)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        label = np.asarray(self.label, np.float64)
+        if self.weights is not None:
+            w = np.asarray(self.weights, np.float64)
+            return float((label * w).sum() / max(w.sum(), K_EPSILON))
+        return float(label.mean()) if len(label) else 0.0
+
+    def convert_output(self, raw):
+        if self.sqrt:
+            return jnp.sign(raw) * raw * raw
+        return raw
+
+    def is_constant_hessian(self) -> bool:
+        return self.weights is None
+
+    def to_string(self) -> str:
+        return self.name + (" sqrt" if self.sqrt else "")
+
+
+class RegressionL1Loss(RegressionL2Loss):
+    name = "regression_l1"
+
+    def _raw_gradients(self, score):
+        return jnp.sign(score - self.label), jnp.ones_like(score)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        label = np.asarray(self.label, np.float64)
+        if self.weights is not None:
+            return weighted_percentile(label, np.asarray(self.weights), 0.5)
+        return percentile(label, 0.5)
+
+    def is_renew_tree_output(self) -> bool:
+        return True
+
+    def _renew_percentile(self, residuals, weights):
+        if weights is not None:
+            return weighted_percentile(residuals, weights, 0.5)
+        return percentile(residuals, 0.5)
+
+
+class RegressionHuberLoss(RegressionL2Loss):
+    name = "huber"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.alpha = float(config.alpha)
+        if self.alpha <= 0:
+            log.fatal("alpha should be greater than zero")
+
+    def _raw_gradients(self, score):
+        diff = score - self.label
+        grad = jnp.where(jnp.abs(diff) <= self.alpha, diff,
+                         jnp.sign(diff) * self.alpha)
+        return grad, jnp.ones_like(score)
+
+    def is_constant_hessian(self) -> bool:
+        return self.weights is None
+
+
+class RegressionFairLoss(RegressionL2Loss):
+    name = "fair"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.c = float(config.fair_c)
+
+    def _raw_gradients(self, score):
+        x = score - self.label
+        grad = self.c * x / (jnp.abs(x) + self.c)
+        hess = self.c * self.c / ((jnp.abs(x) + self.c) ** 2)
+        return grad, hess
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return 0.0
+
+    def is_constant_hessian(self) -> bool:
+        return False
+
+
+class RegressionPoissonLoss(RegressionL2Loss):
+    name = "poisson"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.max_delta_step = float(config.poisson_max_delta_step)
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if np.asarray(self.label).min() < 0:
+            log.fatal("[poisson]: at least one target label is negative")
+
+    def _raw_gradients(self, score):
+        grad = jnp.exp(score) - self.label
+        hess = jnp.exp(score + self.max_delta_step)
+        return grad, hess
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        mean = RegressionL2Loss.boost_from_score(self, class_id)
+        return math.log(max(mean, 1e-20))
+
+    def convert_output(self, raw):
+        return jnp.exp(raw)
+
+    def is_constant_hessian(self) -> bool:
+        return False
+
+
+class RegressionQuantileLoss(RegressionL2Loss):
+    name = "quantile"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.alpha = float(config.alpha)
+        if not 0 < self.alpha < 1:
+            log.fatal("alpha should be in (0, 1)")
+
+    def _raw_gradients(self, score):
+        delta = score - self.label
+        grad = jnp.where(delta >= 0, 1.0 - self.alpha, -self.alpha)
+        return grad.astype(score.dtype), jnp.ones_like(score)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        label = np.asarray(self.label, np.float64)
+        if self.weights is not None:
+            return weighted_percentile(label, np.asarray(self.weights), self.alpha)
+        return percentile(label, self.alpha)
+
+    def is_renew_tree_output(self) -> bool:
+        return True
+
+    def _renew_percentile(self, residuals, weights):
+        if weights is not None:
+            return weighted_percentile(residuals, weights, self.alpha)
+        return percentile(residuals, self.alpha)
+
+    def is_constant_hessian(self) -> bool:
+        return self.weights is None
+
+
+class RegressionMAPELoss(RegressionL1Loss):
+    name = "mape"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        label = np.asarray(metadata.label, np.float64)
+        if (np.abs(label) < 1).any():
+            log.warning("Some label values are < 1 in absolute value. "
+                        "MAPE is unstable with such values, so LightGBM rounds them "
+                        "to 1.0 when calculating MAPE.")
+        self.label_weight = jnp.asarray(1.0 / np.maximum(1.0, np.abs(label)),
+                                        jnp.float32)
+
+    def _raw_gradients(self, score):
+        diff = score - self.label
+        return jnp.sign(diff) * self.label_weight, jnp.ones_like(score)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        label = np.asarray(self.label, np.float64)
+        return weighted_percentile(label, np.asarray(self.label_weight), 0.5)
+
+    def _renew_percentile(self, residuals, weights):
+        # weights here are the per-row 1/|label| weights of the leaf rows
+        return weighted_percentile(residuals, weights, 0.5)
+
+    def is_constant_hessian(self) -> bool:
+        return self.weights is None
+
+
+class RegressionGammaLoss(RegressionPoissonLoss):
+    name = "gamma"
+
+    def _raw_gradients(self, score):
+        grad = 1.0 - self.label * jnp.exp(-score)
+        hess = self.label * jnp.exp(-score)
+        return grad, hess
+
+
+class RegressionTweedieLoss(RegressionPoissonLoss):
+    name = "tweedie"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.rho = float(config.tweedie_variance_power)
+
+    def _raw_gradients(self, score):
+        e1 = jnp.exp((1 - self.rho) * score)
+        e2 = jnp.exp((2 - self.rho) * score)
+        grad = -self.label * e1 + e2
+        hess = -self.label * (1 - self.rho) * e1 + (2 - self.rho) * e2
+        return grad, hess
+
+
+# --------------------------------------------------------------------------- #
+# Binary (src/objective/binary_objective.hpp:13-196)
+# --------------------------------------------------------------------------- #
+class BinaryLogloss(ObjectiveFunction):
+    name = "binary"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.sigmoid = float(config.sigmoid)
+        if self.sigmoid <= 0:
+            log.fatal("Sigmoid parameter %f should be greater than zero" % self.sigmoid)
+        self.is_unbalance = bool(config.is_unbalance)
+        self.scale_pos_weight = float(config.scale_pos_weight)
+        if self.is_unbalance and abs(self.scale_pos_weight - 1.0) > 1e-6:
+            log.fatal("Cannot set is_unbalance and scale_pos_weight at the same time")
+        self.need_train = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        label = np.asarray(metadata.label)
+        pos = int((label > 0).sum())
+        neg = num_data - pos
+        self.need_train = pos > 0 and neg > 0
+        if not self.need_train:
+            log.warning("Contains only one class")
+        log.info("Number of positive: %d, number of negative: %d", pos, neg)
+        w_neg = w_pos = 1.0
+        if self.is_unbalance and pos > 0 and neg > 0:
+            if pos > neg:
+                w_neg = pos / neg
+            else:
+                w_pos = neg / pos
+        w_pos *= self.scale_pos_weight
+        lab = np.where(label > 0, 1.0, -1.0)
+        lw = np.where(label > 0, w_pos, w_neg)
+        self._signed_label = jnp.asarray(lab, jnp.float32)
+        self._label_weight = jnp.asarray(lw, jnp.float32)
+        self._pos_frac = pos / max(1, num_data) if self.weights is None else \
+            float((np.asarray(metadata.weights) * (label > 0)).sum()
+                  / max(np.asarray(metadata.weights).sum(), K_EPSILON))
+
+    def _raw_gradients(self, score):
+        sl = self._signed_label
+        response = -sl * self.sigmoid / (1.0 + jnp.exp(sl * self.sigmoid * score))
+        abs_resp = jnp.abs(response)
+        grad = response * self._label_weight
+        hess = abs_resp * (self.sigmoid - abs_resp) * self._label_weight
+        return grad, hess
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        if not self.need_train:
+            return 0.0
+        pavg = min(max(self._pos_frac, K_EPSILON), 1.0 - K_EPSILON)
+        init = math.log(pavg / (1.0 - pavg)) / self.sigmoid
+        log.info("[binary:BoostFromScore]: pavg=%f -> initscore=%f", pavg, init)
+        return init
+
+    def convert_output(self, raw):
+        return 1.0 / (1.0 + jnp.exp(-self.sigmoid * raw))
+
+    def class_need_train(self, class_id: int) -> bool:
+        return self.need_train
+
+    def need_accurate_prediction(self) -> bool:
+        return False
+
+    def to_string(self) -> str:
+        return "binary sigmoid:%g" % self.sigmoid
+
+
+# --------------------------------------------------------------------------- #
+# Factory (objective_function.cpp:10-49)
+# --------------------------------------------------------------------------- #
+_REGISTRY = {}
+
+
+def _register(cls, *aliases):
+    _REGISTRY[cls.name] = cls
+    for a in aliases:
+        _REGISTRY[a] = cls
+
+
+_register(RegressionL2Loss, "regression_l2", "l2", "mean_squared_error", "mse",
+          "l2_root", "root_mean_squared_error", "rmse")
+_register(RegressionL1Loss, "l1", "mean_absolute_error", "mae")
+_register(RegressionHuberLoss)
+_register(RegressionFairLoss)
+_register(RegressionPoissonLoss)
+_register(RegressionQuantileLoss)
+_register(RegressionMAPELoss, "mean_absolute_percentage_error")
+_register(RegressionGammaLoss)
+_register(RegressionTweedieLoss)
+_register(BinaryLogloss)
+
+
+def create_objective(name: str, config) -> Optional[ObjectiveFunction]:
+    """Create an objective by (aliased) name; 'none' -> None (custom fobj)."""
+    name = name.strip().lower()
+    if name in ("none", "null", "custom", "na", ""):
+        return None
+    # multiclass / ranking / xentropy live in their own modules to keep this
+    # file focused; import lazily to avoid cycles
+    if name in ("multiclass", "softmax", "multiclassova", "multiclass_ova",
+                "ova", "ovr"):
+        from .objective_multiclass import MulticlassOVA, MulticlassSoftmax
+        cls = MulticlassSoftmax if name in ("multiclass", "softmax") else MulticlassOVA
+        return cls(_config_of(config))
+    if name in ("lambdarank", "rank"):
+        from .objective_rank import LambdarankNDCG
+        return LambdarankNDCG(_config_of(config))
+    if name in ("xentropy", "cross_entropy"):
+        from .objective_xentropy import CrossEntropy
+        return CrossEntropy(_config_of(config))
+    if name in ("xentlambda", "cross_entropy_lambda"):
+        from .objective_xentropy import CrossEntropyLambda
+        return CrossEntropyLambda(_config_of(config))
+    cls = _REGISTRY.get(name)
+    if cls is None:
+        log.fatal("Unknown objective type name: %s" % name)
+    return cls(_config_of(config))
+
+
+def _config_of(config):
+    from .config import Config
+    if isinstance(config, Config):
+        return config
+    return Config(config or {})

@@ -1,0 +1,290 @@
+"""Jitted leaf-wise tree growth.
+
+The TPU re-design of SerialTreeLearner::Train (src/treelearner/
+serial_tree_learner.cpp:169-233): the whole best-first growth loop runs as a
+single compiled `lax.while_loop` on device — no host↔device ping-pong per
+split.  Differences from the reference dictated by XLA:
+
+- the row partition is a `row→leaf` label vector relabelled in place, not a
+  reordered index array (DataPartition, data_partition.hpp:17-222);
+- per-leaf histograms live in a fixed `[max_leaves, F, B, 3]` cache instead
+  of the LRU HistogramPool (feature_histogram.hpp:646-818) — the smaller
+  child is histogrammed by a masked pass, the sibling by subtraction
+  (serial_tree_learner.cpp:506-591's smaller/larger choreography);
+- per-leaf best splits are cached as stacked SplitResult arrays, so each
+  iteration is argmax → split → 1 histogram pass → 2 split scans.
+
+Tree node layout matches the reference Tree (include/LightGBM/tree.h:20-391):
+internal nodes indexed by split order, leaves referenced as `~leaf`.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import histogram as hist_ops
+from .split import K_MIN_SCORE, SplitParams, SplitResult, best_split_for_leaf
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+
+class TreeArrays(NamedTuple):
+    """SoA tree storage (tree.h:318-374).  Node arrays sized [max_leaves-1],
+    leaf arrays [max_leaves]; children encode leaves as ~leaf_index."""
+    split_feature: jnp.ndarray    # int32 [N] inner feature index
+    threshold_bin: jnp.ndarray    # int32 [N]
+    default_left: jnp.ndarray     # bool  [N]
+    missing_type: jnp.ndarray     # int32 [N]
+    left_child: jnp.ndarray       # int32 [N]
+    right_child: jnp.ndarray      # int32 [N]
+    split_gain: jnp.ndarray       # f     [N]
+    internal_value: jnp.ndarray   # f     [N] output the node would have as leaf
+    internal_count: jnp.ndarray   # int32 [N]
+    leaf_value: jnp.ndarray       # f     [L]
+    leaf_count: jnp.ndarray       # int32 [L]
+    leaf_parent: jnp.ndarray      # int32 [L]
+    leaf_depth: jnp.ndarray       # int32 [L]
+    num_leaves: jnp.ndarray       # int32 scalar
+
+    @property
+    def max_leaves(self) -> int:
+        return self.leaf_value.shape[0]
+
+
+def empty_tree(max_leaves: int, dtype=jnp.float32) -> TreeArrays:
+    n = max(max_leaves - 1, 1)
+    zf = jnp.zeros(n, dtype)
+    zi = jnp.zeros(n, jnp.int32)
+    return TreeArrays(
+        split_feature=zi, threshold_bin=zi, default_left=jnp.zeros(n, bool),
+        missing_type=zi, left_child=zi, right_child=zi, split_gain=zf,
+        internal_value=zf, internal_count=zi,
+        leaf_value=jnp.zeros(max_leaves, dtype),
+        leaf_count=jnp.zeros(max_leaves, jnp.int32),
+        leaf_parent=jnp.full(max_leaves, -1, jnp.int32),
+        leaf_depth=jnp.zeros(max_leaves, jnp.int32),
+        num_leaves=jnp.asarray(1, jnp.int32),
+    )
+
+
+class GrowState(NamedTuple):
+    tree: TreeArrays
+    leaf_ids: jnp.ndarray          # [n] int32, -1 = not in this tree (bagging)
+    hist_cache: jnp.ndarray        # [L, F, B, 3]
+    split_cache: SplitResult       # stacked [L]
+    done: jnp.ndarray              # bool scalar
+
+
+def _stack_split(res: SplitResult, cache: SplitResult, idx) -> SplitResult:
+    return SplitResult(*[c.at[idx].set(v) for c, v in zip(cache, res)])
+
+
+def _index_split(cache: SplitResult, idx) -> SplitResult:
+    return SplitResult(*[c[idx] for c in cache])
+
+
+@partial(jax.jit, static_argnames=("max_leaves", "max_depth", "max_bin",
+                                   "hist_impl", "rows_per_chunk"))
+def grow_tree(bins: jnp.ndarray,            # [n, F] uint8/16
+              grad: jnp.ndarray,            # [n]
+              hess: jnp.ndarray,            # [n]
+              row_leaf_init: jnp.ndarray,   # [n] int32: 0 in-bag, -1 out
+              feature_mask: jnp.ndarray,    # [F] bool
+              num_bins: jnp.ndarray,        # [F] int32
+              default_bins: jnp.ndarray,    # [F] int32
+              missing_types: jnp.ndarray,   # [F] int32
+              params: SplitParams,
+              monotone: Optional[jnp.ndarray] = None,   # [F] int8 or None
+              penalty: Optional[jnp.ndarray] = None,    # [F] or None
+              *,
+              max_leaves: int,
+              max_depth: int = -1,
+              max_bin: int,
+              hist_impl: str = "auto",
+              rows_per_chunk: int = 16384):
+    """Grow one leaf-wise tree; returns (TreeArrays, leaf_ids)."""
+    n, F = bins.shape
+    dtype = grad.dtype
+
+    def leaf_best_split(hist, sum_g, sum_h, cnt, depth):
+        res = best_split_for_leaf(hist, sum_g, sum_h, cnt,
+                                  num_bins, default_bins, missing_types, params,
+                                  monotone=monotone, penalty=penalty,
+                                  feature_mask=feature_mask)
+        depth_ok = (max_depth <= 0) | (depth < max_depth)
+        blocked = (res.feature < 0) | ~depth_ok
+        return res._replace(gain=jnp.where(blocked, K_MIN_SCORE, res.gain),
+                            feature=jnp.where(depth_ok, res.feature, -1))
+
+    # ---- root ----------------------------------------------------------
+    tree = empty_tree(max_leaves, dtype)
+    root_hist = hist_ops.leaf_histogram(bins, grad, hess, row_leaf_init, 0,
+                                        max_bin, hist_impl, rows_per_chunk)
+    in_bag = row_leaf_init == 0
+    root_g = jnp.sum(grad * in_bag)
+    root_h = jnp.sum(hess * in_bag)
+    root_c = jnp.sum(in_bag).astype(jnp.int32)
+    tree = tree._replace(leaf_count=tree.leaf_count.at[0].set(root_c))
+
+    root_split = leaf_best_split(root_hist, root_g, root_h, root_c,
+                                 jnp.asarray(0, jnp.int32))
+
+    L = max_leaves
+    hist_cache = jnp.zeros((L,) + root_hist.shape, dtype).at[0].set(root_hist)
+    split_cache = SplitResult(*[
+        jnp.zeros((L,) + jnp.shape(jnp.asarray(v)), jnp.asarray(v).dtype)
+        for v in root_split])
+    split_cache = _stack_split(root_split, split_cache, 0)
+    # non-existent leaves must never win the argmax
+    split_cache = split_cache._replace(
+        gain=split_cache.gain.at[1:].set(K_MIN_SCORE))
+
+    state = GrowState(tree=tree, leaf_ids=row_leaf_init, hist_cache=hist_cache,
+                      split_cache=split_cache, done=jnp.asarray(False))
+
+    def cond(state: GrowState):
+        return (~state.done) & (state.tree.num_leaves < max_leaves)
+
+    def body(state: GrowState) -> GrowState:
+        tree = state.tree
+        nl = tree.num_leaves                      # current leaf count
+        node = nl - 1                             # new internal node index
+
+        best_leaf = jnp.argmax(state.split_cache.gain).astype(jnp.int32)
+        sp = _index_split(state.split_cache, best_leaf)
+        no_split = sp.gain <= K_MIN_SCORE  # includes min_gain (already masked)
+
+        def do_split(state: GrowState) -> GrowState:
+            tree = state.tree
+            new_leaf = nl                          # right child leaf id
+            feat = sp.feature
+            thr = sp.threshold
+            # -- relabel rows (DataPartition::Split, data_partition.hpp:108) --
+            col = jax.lax.dynamic_index_in_dim(
+                bins, feat, axis=1, keepdims=False).astype(jnp.int32)
+            mt = missing_types[feat]
+            db = default_bins[feat]
+            mb = num_bins[feat] - 1
+            is_missing = ((mt == MISSING_ZERO) & (col == db)) | \
+                         ((mt == MISSING_NAN) & (col == mb))
+            go_left = jnp.where(is_missing, sp.default_left, col <= thr)
+            in_leaf = state.leaf_ids == best_leaf
+            leaf_ids = jnp.where(in_leaf & ~go_left, new_leaf, state.leaf_ids)
+
+            # -- histograms: smaller child by masked pass, sibling by
+            #    subtraction (the reference's core scheduling trick) --------
+            left_smaller = sp.left_count <= sp.right_count
+            small_leaf = jnp.where(left_smaller, best_leaf, new_leaf)
+            parent_hist = state.hist_cache[best_leaf]
+            small_hist = hist_ops.leaf_histogram(bins, grad, hess, leaf_ids,
+                                                 small_leaf, max_bin,
+                                                 hist_impl, rows_per_chunk)
+            large_hist = parent_hist - small_hist
+            left_hist = jnp.where(left_smaller, small_hist, large_hist)
+            right_hist = jnp.where(left_smaller, large_hist, small_hist)
+            hist_cache = state.hist_cache.at[best_leaf].set(left_hist)
+            hist_cache = hist_cache.at[new_leaf].set(right_hist)
+
+            # -- tree bookkeeping (Tree::Split, tree.h:393-423) -------------
+            parent_of = tree.leaf_parent[best_leaf]
+            # fix the parent's child pointer that referenced ~best_leaf
+            was_left = jnp.where(parent_of >= 0,
+                                 tree.left_child[parent_of] == ~best_leaf, False)
+            left_child = jnp.where(
+                (parent_of >= 0) & was_left,
+                tree.left_child.at[parent_of].set(node), tree.left_child)
+            right_child = jnp.where(
+                (parent_of >= 0) & ~was_left,
+                tree.right_child.at[parent_of].set(node), tree.right_child)
+
+            depth = tree.leaf_depth[best_leaf]
+            tree = tree._replace(
+                split_feature=tree.split_feature.at[node].set(feat),
+                threshold_bin=tree.threshold_bin.at[node].set(thr),
+                default_left=tree.default_left.at[node].set(sp.default_left),
+                missing_type=tree.missing_type.at[node].set(missing_types[feat]),
+                left_child=left_child.at[node].set(~best_leaf),
+                right_child=right_child.at[node].set(~new_leaf),
+                split_gain=tree.split_gain.at[node].set(sp.gain.astype(dtype)),
+                internal_value=tree.internal_value.at[node].set(
+                    tree.leaf_value[best_leaf]),
+                internal_count=tree.internal_count.at[node].set(
+                    sp.left_count + sp.right_count),
+                leaf_value=tree.leaf_value.at[best_leaf].set(
+                    sp.left_output.astype(dtype)).at[new_leaf].set(
+                    sp.right_output.astype(dtype)),
+                leaf_count=tree.leaf_count.at[best_leaf].set(
+                    sp.left_count).at[new_leaf].set(sp.right_count),
+                leaf_parent=tree.leaf_parent.at[best_leaf].set(node)
+                    .at[new_leaf].set(node),
+                leaf_depth=tree.leaf_depth.at[best_leaf].set(depth + 1)
+                    .at[new_leaf].set(depth + 1),
+                num_leaves=nl + 1,
+            )
+
+            # -- children best splits ---------------------------------------
+            lsp = leaf_best_split(left_hist, sp.left_sum_gradient,
+                                  sp.left_sum_hessian, sp.left_count, depth + 1)
+            rsp = leaf_best_split(right_hist, sp.right_sum_gradient,
+                                  sp.right_sum_hessian, sp.right_count, depth + 1)
+            split_cache = _stack_split(lsp, state.split_cache, best_leaf)
+            split_cache = _stack_split(rsp, split_cache, new_leaf)
+
+            return GrowState(tree=tree, leaf_ids=leaf_ids,
+                             hist_cache=hist_cache, split_cache=split_cache,
+                             done=jnp.asarray(False))
+
+        return jax.lax.cond(no_split,
+                            lambda s: s._replace(done=jnp.asarray(True)),
+                            do_split, state)
+
+    state = jax.lax.while_loop(cond, body, state)
+    return state.tree, state.leaf_ids
+
+
+@jax.jit
+def predict_leaf_inner(bins: jnp.ndarray, tree: TreeArrays,
+                       num_bins: jnp.ndarray, default_bins: jnp.ndarray
+                       ) -> jnp.ndarray:
+    """Leaf index per row by walking the tree over *inner* bin values
+    (Tree::GetLeafAt + DecisionInner, tree.h:233-248, 289-296).
+
+    Vectorized node walk: every row holds a current node (>=0 internal,
+    negative = ~leaf); iterate until all rows rest at leaves.
+    """
+    n = bins.shape[0]
+    start = jnp.where(tree.num_leaves > 1, 0, ~0)
+    node = jnp.full((n,), start, jnp.int32)
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        nd = jnp.maximum(node, 0)
+        feat = tree.split_feature[nd]
+        col = jnp.take_along_axis(bins, feat[:, None].astype(jnp.int32),
+                                  axis=1)[:, 0].astype(jnp.int32)
+        mt = tree.missing_type[nd]
+        db = default_bins[tree.split_feature[nd]]
+        mb = num_bins[tree.split_feature[nd]] - 1
+        is_missing = ((mt == MISSING_ZERO) & (col == db)) | \
+                     ((mt == MISSING_NAN) & (col == mb))
+        go_left = jnp.where(is_missing, tree.default_left[nd],
+                            col <= tree.threshold_bin[nd])
+        nxt = jnp.where(go_left, tree.left_child[nd], tree.right_child[nd])
+        return jnp.where(node >= 0, nxt, node)
+
+    node = jax.lax.while_loop(cond, body, node)
+    return ~node  # leaf index
+
+
+def predict_value_inner(bins: jnp.ndarray, tree: TreeArrays,
+                        num_bins: jnp.ndarray, default_bins: jnp.ndarray
+                        ) -> jnp.ndarray:
+    leaf = predict_leaf_inner(bins, tree, num_bins, default_bins)
+    return tree.leaf_value[leaf]

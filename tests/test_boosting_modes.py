@@ -1,0 +1,104 @@
+"""DART / GOSS / RF boosting modes (reference test_engine.py:51,735,752)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+REGRESSION_TRAIN = "/root/reference/examples/regression/regression.train"
+REGRESSION_TEST = "/root/reference/examples/regression/regression.test"
+
+
+def _load(path):
+    mat = np.loadtxt(path)
+    return mat[:, 1:], mat[:, 0]
+
+
+@pytest.fixture(scope="module")
+def data():
+    X, y = _load(REGRESSION_TRAIN)
+    Xt, yt = _load(REGRESSION_TEST)
+    return X, y, Xt, yt
+
+
+def test_dart(data):
+    X, y, Xt, yt = data
+    train = lgb.Dataset(X, y)
+    valid = train.create_valid(Xt, yt)
+    evals = {}
+    bst = lgb.train({"objective": "regression", "boosting": "dart",
+                     "metric": "l2", "verbose": -1, "drop_rate": 0.1},
+                    train, num_boost_round=40, valid_sets=[valid],
+                    evals_result=evals, verbose_eval=False)
+    assert evals["valid_0"]["l2"][-1] < 1.0
+    assert np.isfinite(bst.predict(Xt)).all()
+
+
+def test_goss(data):
+    X, y, Xt, yt = data
+    train = lgb.Dataset(X, y)
+    valid = train.create_valid(Xt, yt)
+    evals = {}
+    bst = lgb.train({"objective": "regression", "boosting": "goss",
+                     "metric": "l2", "verbose": -1, "learning_rate": 0.1},
+                    train, num_boost_round=40, valid_sets=[valid],
+                    evals_result=evals, verbose_eval=False)
+    assert evals["valid_0"]["l2"][-1] < 1.0
+    # GOSS warm-up ends at iteration 10 (1/lr); training still converges after
+    assert evals["valid_0"]["l2"][-1] < evals["valid_0"]["l2"][5]
+
+
+def test_rf(data):
+    X, y, Xt, yt = data
+    train = lgb.Dataset(X, y)
+    valid = train.create_valid(Xt, yt)
+    evals = {}
+    bst = lgb.train({"objective": "regression", "boosting": "rf",
+                     "metric": "l2", "verbose": -1,
+                     "bagging_freq": 1, "bagging_fraction": 0.7,
+                     "feature_fraction": 0.8},
+                    train, num_boost_round=30, valid_sets=[valid],
+                    evals_result=evals, verbose_eval=False)
+    # averaged-forest validation error beats predicting the mean
+    base = np.mean((yt - y.mean()) ** 2)
+    assert evals["valid_0"]["l2"][-1] < base
+    pred = bst.predict(Xt)
+    # predictions are averaged, not summed
+    assert pred.min() > y.min() - 1 and pred.max() < y.max() + 1
+
+
+def test_rf_requires_bagging(data):
+    X, y, _, _ = data
+    with pytest.raises(Exception):
+        lgb.train({"objective": "regression", "boosting": "rf", "verbose": -1},
+                  lgb.Dataset(X, y), num_boost_round=2)
+
+
+def test_bagging(data):
+    X, y, Xt, yt = data
+    train = lgb.Dataset(X, y)
+    valid = train.create_valid(Xt, yt)
+    evals = {}
+    lgb.train({"objective": "regression", "metric": "l2", "verbose": -1,
+               "bagging_freq": 2, "bagging_fraction": 0.5},
+              train, num_boost_round=30, valid_sets=[valid],
+              evals_result=evals, verbose_eval=False)
+    assert evals["valid_0"]["l2"][-1] < 1.0
+
+
+def test_feature_fraction(data):
+    X, y, Xt, yt = data
+    train = lgb.Dataset(X, y)
+    bst = lgb.train({"objective": "regression", "verbose": -1,
+                     "feature_fraction": 0.5}, train, num_boost_round=10)
+    assert np.isfinite(bst.predict(Xt)).all()
+
+
+def test_shap_sums_to_prediction(data):
+    X, y, Xt, _ = data
+    train = lgb.Dataset(X, y)
+    bst = lgb.train({"objective": "regression", "verbose": -1},
+                    train, num_boost_round=5)
+    sub = Xt[:20]
+    contrib = bst.predict(sub, pred_contrib=True)
+    raw = bst.predict(sub, raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6)

@@ -325,11 +325,10 @@ class Booster:
         return out
 
     def _eval(self, name, results, feval, dataset):
+        from .metric import is_bigger_better
         out = []
         for metric_name, vals in results.items():
-            from .metric import _CLASSES
-            cls = _CLASSES.get(metric_name)
-            bigger = cls.bigger_is_better if cls else False
+            bigger = is_bigger_better(metric_name)
             for v in vals:
                 out.append((name, metric_name, v, bigger))
         return out

@@ -228,6 +228,20 @@ _CLASSES = {c.name: c for c in [
     PoissonMetric, MAPEMetric, GammaMetric, GammaDevianceMetric, TweedieMetric,
     BinaryLoglossMetric, BinaryErrorMetric, AUCMetric]}
 
+# metric names (canonical, as reported by eval results) whose larger values
+# are better — drives early stopping (metric.h factor_to_bigger_better)
+_BIGGER_IS_BETTER_NAMES = {"auc", "ndcg", "map"}
+
+
+def is_bigger_better(name: str) -> bool:
+    """bigger_is_better for ANY metric name, including the lazily-imported
+    rank/multiclass/xentropy families (which never enter _CLASSES)."""
+    base = name.strip().lower().split("@")[0]
+    if base in _BIGGER_IS_BETTER_NAMES:
+        return True
+    cls = _CLASSES.get(_ALIASES.get(base, base))
+    return bool(cls.bigger_is_better) if cls is not None else False
+
 
 def create_metric(name: str, config) -> Optional[Metric]:
     name = name.strip().lower()

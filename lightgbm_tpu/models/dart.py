@@ -40,6 +40,9 @@ class DART(GBDT):
         is_skip = self._drop_rng.rand() < cfg.skip_drop
         if not is_skip and self.iter > 0:
             drop_rate = cfg.drop_rate
+            # max_drop <= 0 means no limit (the reference's size_t cast of a
+            # negative value, dart.hpp:105)
+            max_drop = cfg.max_drop if cfg.max_drop > 0 else self.iter + 1
             if not cfg.uniform_drop:
                 inv_avg = len(self.tree_weight) / self.sum_weight \
                     if self.sum_weight > 0 else 0.0
@@ -49,7 +52,7 @@ class DART(GBDT):
                 for i in range(self.iter):
                     if self._drop_rng.rand() < drop_rate * self.tree_weight[i] * inv_avg:
                         self._drop_index.append(i)
-                        if len(self._drop_index) >= cfg.max_drop:
+                        if len(self._drop_index) >= max_drop:
                             break
             else:
                 if cfg.max_drop > 0:
@@ -57,7 +60,7 @@ class DART(GBDT):
                 for i in range(self.iter):
                     if self._drop_rng.rand() < drop_rate:
                         self._drop_index.append(i)
-                        if len(self._drop_index) >= cfg.max_drop:
+                        if len(self._drop_index) >= max_drop:
                             break
         # remove dropped trees from train scores
         k = self.num_tree_per_iteration

@@ -120,10 +120,9 @@ class GBDT:
                   metrics: Sequence[Metric]) -> None:
         state = _DatasetState(valid_set, self.num_tree_per_iteration, self.dtype)
         if valid_set.metadata.init_score is not None:
-            init = np.asarray(valid_set.metadata.init_score, np.float64)
-            k, n = self.num_tree_per_iteration, valid_set.num_data
-            init = init.reshape(k, n) if len(init) == k * n else \
-                np.tile(init.reshape(1, -1), (k, 1))
+            init = _expand_init_score(valid_set.metadata.init_score,
+                                      self.num_tree_per_iteration,
+                                      valid_set.num_data)
             state.score = state.score + jnp.asarray(init, self.dtype)
         for m in metrics:
             m.init(valid_set.metadata, valid_set.num_data)
@@ -181,6 +180,9 @@ class GBDT:
             grad = jnp.reshape(jnp.asarray(gradients, self.dtype), (k, self.num_data))
             hess = jnp.reshape(jnp.asarray(hessians, self.dtype), (k, self.num_data))
 
+        # row-sampling hook: GOSS rescales gradients and sets the row mask
+        # here (goss.hpp:87-135); default is identity
+        grad, hess = self._sample_gradients(grad, hess)
         row_init = self._bagging(self.iter)
 
         should_continue = False
@@ -232,6 +234,10 @@ class GBDT:
         self.iter += 1
         return False
 
+    def _sample_gradients(self, grad: jnp.ndarray, hess: jnp.ndarray):
+        """Per-iteration gradient/row sampling hook (overridden by GOSS)."""
+        return grad, hess
+
     def _boost_from_average(self, class_id: int) -> float:
         if self.models or self.objective is None:
             return 0.0
@@ -251,11 +257,8 @@ class GBDT:
         return 0.0
 
     def _apply_init_scores(self) -> None:
-        init = np.asarray(self.train_set.metadata.init_score, np.float64)
-        k = self.num_tree_per_iteration
-        n = self.num_data
-        init = init.reshape(k, n) if len(init) == k * n else \
-            np.tile(init.reshape(1, -1), (k, 1))
+        init = _expand_init_score(self.train_set.metadata.init_score,
+                                  self.num_tree_per_iteration, self.num_data)
         self.train_state.score = self.train_state.score + jnp.asarray(init, self.dtype)
 
     def _renew_tree_output(self, tree: Tree, class_id: int,
@@ -265,9 +268,8 @@ class GBDT:
         obj = self.objective
         if obj is None or not obj.is_renew_tree_output():
             return
-        score = np.asarray(self.train_state.score[class_id], np.float64)
         label = np.asarray(self.train_set.metadata.label, np.float64)
-        residual = label - score
+        residual = label - self._renew_baseline_score(class_id)
         lids = np.asarray(leaf_ids)
         weights = (np.asarray(self.train_set.metadata.weights, np.float64)
                    if self.train_set.metadata.weights is not None else None)
@@ -280,6 +282,11 @@ class GBDT:
             res = residual[rows]
             w = weights[rows] if weights is not None else None
             tree.leaf_value[leaf] = obj._renew_percentile(res, w)
+
+    def _renew_baseline_score(self, class_id: int) -> np.ndarray:
+        """Score baseline for percentile leaf refits; RF overrides with its
+        constant init score (rf.hpp:126 passes init_scores_[class])."""
+        return np.asarray(self.train_state.score[class_id], np.float64)
 
     # ------------------------------------------------------------------ #
     # Score updates (ScoreUpdater::AddScore paths)
@@ -338,6 +345,10 @@ class GBDT:
         for it in range(iters):
             for kk in range(k):
                 out[kk] += self.models[it * k + kk].predict(X)
+        if self.average_output:
+            # RF semantics survive model reload (gbdt_model_text.cpp writes
+            # the average_output token; rf.hpp averages tree outputs)
+            out /= max(iters, 1)
         return out[0] if k == 1 else out.T  # [n] or [n, k]
 
     def predict(self, X: np.ndarray, num_iteration: int = -1,
@@ -510,6 +521,14 @@ class GBDT:
 
     def num_model_per_iteration(self) -> int:
         return self.num_tree_per_iteration
+
+
+def _expand_init_score(init_score, k: int, n: int) -> np.ndarray:
+    """Flat init score -> [k, n] class-major matrix: either one block per
+    class (len == k*n) or one shared block tiled across classes."""
+    init = np.asarray(init_score, np.float64)
+    return init.reshape(k, n) if init.size == k * n else \
+        np.tile(init.reshape(1, -1), (k, 1))
 
 
 def _add_tree_score(state: _DatasetState, tree: Tree, class_id: int, gbdt: GBDT):

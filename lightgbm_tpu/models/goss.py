@@ -11,7 +11,11 @@ from .gbdt import GBDT
 class GOSS(GBDT):
     """Keeps the top `top_rate` rows by |g*h| every iteration, plus a random
     `other_rate` slice of the rest with gradients amplified by
-    (1-top_rate)/other_rate; warm-up of 1/learning_rate full iterations."""
+    (1-top_rate)/other_rate; warm-up of 1/learning_rate full iterations.
+
+    Implemented as the `_sample_gradients` hook on the stock driver loop so
+    boost-from-average / constant-tree bookkeeping stays on the default path
+    (the reference subclasses GBDT::Bagging the same way, goss.hpp:84)."""
 
     def __init__(self, config, train_set, objective, metrics=()):
         super().__init__(config, train_set, objective, metrics)
@@ -19,44 +23,22 @@ class GOSS(GBDT):
             log.fatal("Cannot use bagging in GOSS")
         log.info("Using GOSS")
         self._goss_rng = np.random.RandomState(config.bagging_seed)
-        self._goss_multiplier = None  # [n] per-row grad/hess multiplier
 
     def _bagging(self, it: int):
-        # GOSS replaces bagging; the row mask computed from gradients in
-        # _goss_sample is handed to the grower here
+        # GOSS replaces bagging; the row mask was computed from gradients in
+        # _sample_gradients just before this is called
         return self._bag_mask if self._bag_mask is not None else self._row_all_in
 
-    def train_one_iter(self, gradients=None, hessians=None) -> bool:
-        k = self.num_tree_per_iteration
-        if gradients is None or hessians is None:
-            init_scores = [self._boost_from_average(kk) for kk in range(k)]
-            grad, hess = self.objective.get_gradients(
-                self.train_state.score if k > 1 else self.train_state.score[0])
-            grad = np.asarray(jnp.reshape(grad, (k, self.num_data)), np.float64)
-            hess = np.asarray(jnp.reshape(hess, (k, self.num_data)), np.float64)
-            self._goss_init_scores = init_scores
-        else:
-            grad = np.asarray(gradients, np.float64).reshape(k, self.num_data)
-            hess = np.asarray(hessians, np.float64).reshape(k, self.num_data)
-            self._goss_init_scores = [0.0] * k
-
-        grad, hess, mask = self._goss_sample(grad, hess)
-        self._bag_mask = mask
-        finished = super().train_one_iter(grad.reshape(-1), hess.reshape(-1))
-        # restore init-score bookkeeping done by the custom-gradient path
-        if not finished and self._goss_init_scores:
-            for kk, s in enumerate(self._goss_init_scores):
-                if abs(s) > 1e-15 and self.models:
-                    self.models[-k + kk].add_bias(s)
-        return finished
-
-    def _goss_sample(self, grad, hess):
+    def _sample_gradients(self, grad, hess):
         """BaggingHelper logic (goss.hpp:87-135), vectorized over all rows."""
         cfg = self.config
         n = self.num_data
         if self.iter < int(1.0 / max(cfg.learning_rate, 1e-12)):
-            return grad, hess, None
-        score = np.abs(grad * hess).sum(axis=0)  # sum over classes
+            self._bag_mask = None  # warm-up: use all rows
+            return grad, hess
+        gnp = np.asarray(grad, np.float64)
+        hnp = np.asarray(hess, np.float64)
+        score = np.abs(gnp * hnp).sum(axis=0)  # sum over classes
         top_k = max(1, int(n * cfg.top_rate))
         other_k = max(1, int(n * cfg.other_rate))
         threshold = np.partition(score, n - top_k)[n - top_k]
@@ -69,8 +51,7 @@ class GOSS(GBDT):
         mask = np.full(n, -1, np.int32)
         mask[is_top] = 0
         mask[sampled] = 0
-        grad = grad.copy()
-        hess = hess.copy()
-        grad[:, sampled] *= multiply
-        hess[:, sampled] *= multiply
-        return grad, hess, jnp.asarray(mask)
+        self._bag_mask = jnp.asarray(mask)
+        gnp[:, sampled] *= multiply
+        hnp[:, sampled] *= multiply
+        return (jnp.asarray(gnp, grad.dtype), jnp.asarray(hnp, hess.dtype))

@@ -94,9 +94,7 @@ class RF(GBDT):
             _add_tree_score(vs, tree, class_id, self)
             vs.score = vs.score.at[class_id].multiply(1.0 / (it + 1))
 
-    def predict_raw(self, X, num_iteration: int = -1):
-        raw = super().predict_raw(X, num_iteration)
-        k = max(self.num_tree_per_iteration, 1)
-        iters = len(self.models) // k if num_iteration <= 0 else \
-            min(num_iteration, len(self.models) // k)
-        return raw / max(iters, 1)
+    def _renew_baseline_score(self, class_id: int) -> np.ndarray:
+        # RF residuals are against the constant init score, not the running
+        # ensemble average (rf.hpp:126 passes init_scores_[class])
+        return np.full(self.num_data, self._rf_init_scores[class_id])

@@ -169,9 +169,12 @@ def predict_contrib(gbdt, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
         for kk in range(k):
             tree = gbdt.models[it * k + kk]
             max_path = tree.max_depth() + 2
-            for r in range(n):
-                out[r, kk, F] += tree.expected_value()
-                if tree.num_leaves > 1:
+            ev = tree.expected_value()
+            out[:, kk, F] += ev
+            if tree.num_leaves > 1:
+                for r in range(n):
                     path = [_PathElement() for _ in range(max_path)]
                     _tree_shap(tree, X[r], out[r, kk], 0, 0, path, 1.0, 1.0, -1)
+    if getattr(gbdt, "average_output", False):
+        out /= max(iters, 1)
     return out.reshape(n, k * (F + 1)) if k > 1 else out[:, 0, :]

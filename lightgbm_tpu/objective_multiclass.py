@@ -1,0 +1,159 @@
+"""Multiclass objectives: softmax and one-vs-all.
+
+Re-design of src/objective/multiclass_objective.hpp:16-259 for array layout:
+scores arrive class-major [k, n] (the reference's `num_data * k + i`
+indexing flattened into a 2-D array) and gradients return in the same
+layout, computed as one vectorized softmax over the class axis instead of
+the reference's per-row OMP loop.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from .objective import BinaryLogloss, K_EPSILON, ObjectiveFunction
+from .utils import log
+
+
+class MulticlassSoftmax(ObjectiveFunction):
+    """multiclass_objective.hpp:16-160 (MulticlassSoftmax)."""
+
+    name = "multiclass"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        if self.num_class < 2:
+            log.fatal("Number of classes should be specified and greater "
+                      "than 1 for multiclass training")
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        label = np.asarray(metadata.label)
+        label_int = label.astype(np.int32)
+        if label_int.min() < 0 or label_int.max() >= self.num_class:
+            log.fatal("Label must be in [0, %d), but found %d in label"
+                      % (self.num_class, int(label_int.min() if label_int.min() < 0
+                                             else label_int.max())))
+        self._label_int = jnp.asarray(label_int)
+        # class prior probabilities drive BoostFromScore / ClassNeedTrain
+        w = (np.asarray(metadata.weights, np.float64)
+             if metadata.weights is not None else np.ones(num_data))
+        probs = np.zeros(self.num_class)
+        np.add.at(probs, label_int, w)
+        self.class_init_probs = probs / max(w.sum(), K_EPSILON)
+
+    def _raw_gradients(self, score):
+        # score [k, n] class-major
+        p = _softmax0(score)
+        onehot = (self._label_int[None, :]
+                  == jnp.arange(self.num_class, dtype=jnp.int32)[:, None])
+        grad = p - onehot.astype(p.dtype)
+        hess = 2.0 * p * (1.0 - p)
+        return grad, hess
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return math.log(max(K_EPSILON, self.class_init_probs[class_id]))
+
+    def class_need_train(self, class_id: int) -> bool:
+        p = abs(self.class_init_probs[class_id])
+        return K_EPSILON < p < 1.0 - K_EPSILON
+
+    def convert_output_multi(self, raw):
+        """raw [n, k] -> softmax probabilities [n, k]."""
+        return np.asarray(_softmax0(jnp.asarray(raw).T).T)
+
+    def convert_output(self, raw):
+        return self.convert_output_multi(raw)
+
+    @property
+    def num_model_per_iteration(self) -> int:
+        return self.num_class
+
+    def need_accurate_prediction(self) -> bool:
+        return False
+
+    def to_string(self) -> str:
+        return "multiclass num_class:%d" % self.num_class
+
+
+def _softmax0(score):
+    """Numerically-stable softmax over axis 0 (Common::Softmax)."""
+    m = jnp.max(score, axis=0, keepdims=True)
+    e = jnp.exp(score - m)
+    return e / jnp.sum(e, axis=0, keepdims=True)
+
+
+class _ClassMetadata:
+    """Metadata view exposing a binarized label for one class (the lambda
+    capture in MulticlassOVA's BinaryLogloss construction,
+    multiclass_objective.hpp:169-172)."""
+
+    def __init__(self, metadata, class_id: int):
+        self._m = metadata
+        label = np.asarray(metadata.label)
+        self.label = (label.astype(np.int32) == class_id).astype(np.float32)
+        self.weights = metadata.weights
+
+    def __getattr__(self, name):
+        return getattr(self._m, name)
+
+
+class MulticlassOVA(ObjectiveFunction):
+    """multiclass_objective.hpp:164-259 (MulticlassOVA): one independent
+    BinaryLogloss per class over binarized labels."""
+
+    name = "multiclassova"
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.num_class = int(config.num_class)
+        if self.num_class < 2:
+            log.fatal("Number of classes should be specified and greater "
+                      "than 1 for multiclass training")
+        self.sigmoid = float(config.sigmoid)
+        if self.sigmoid <= 0.0:
+            log.fatal("Sigmoid parameter %f should be greater than zero"
+                      % self.sigmoid)
+        self.binary_loss = [BinaryLogloss(config) for _ in range(self.num_class)]
+
+    def init(self, metadata, num_data):
+        self.metadata = metadata
+        self.num_data = num_data
+        for i, loss in enumerate(self.binary_loss):
+            loss.init(_ClassMetadata(metadata, i), num_data)
+
+    def get_gradients(self, score):
+        # score [k, n]; each class an independent binary problem
+        grads, hesses = [], []
+        for i, loss in enumerate(self.binary_loss):
+            g, h = loss.get_gradients(score[i])
+            grads.append(g)
+            hesses.append(h)
+        return jnp.stack(grads), jnp.stack(hesses)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        return self.binary_loss[class_id].boost_from_score(0)
+
+    def class_need_train(self, class_id: int) -> bool:
+        return self.binary_loss[class_id].class_need_train(0)
+
+    def convert_output_multi(self, raw):
+        """raw [n, k] -> per-class sigmoid (no normalization)."""
+        return np.asarray(1.0 / (1.0 + np.exp(-self.sigmoid * np.asarray(raw))))
+
+    def convert_output(self, raw):
+        return self.convert_output_multi(raw)
+
+    @property
+    def num_model_per_iteration(self) -> int:
+        return self.num_class
+
+    def need_accurate_prediction(self) -> bool:
+        return False
+
+    def to_string(self) -> str:
+        return "multiclassova num_class:%d sigmoid:%g" % (self.num_class,
+                                                          self.sigmoid)

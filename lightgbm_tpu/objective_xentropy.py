@@ -1,0 +1,112 @@
+"""Cross-entropy objectives over probability labels in [0, 1].
+
+Re-design of src/objective/xentropy_objective.hpp:
+- CrossEntropy ("xentropy"): p = sigmoid(f); weights scale the loss linearly.
+- CrossEntropyLambda ("xentlambda"): p = 1 - exp(-w * log(1+exp(f)));
+  ConvertOutput yields the "normalized exponential parameter" lambda, not p.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from .objective import K_EPSILON, ObjectiveFunction
+from .utils import log
+
+
+def _check_interval(label, name):
+    lab = np.asarray(label)
+    if lab.min() < 0.0 or lab.max() > 1.0:
+        log.fatal("[%s]: label must be in the interval [0, 1]" % name)
+
+
+class CrossEntropy(ObjectiveFunction):
+    """xentropy_objective.hpp:38-137."""
+
+    name = "xentropy"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        _check_interval(metadata.label, self.name)
+        if metadata.weights is not None:
+            w = np.asarray(metadata.weights)
+            if w.min() < 0.0:
+                log.fatal("[%s]: at least one weight is negative" % self.name)
+            if w.sum() == 0.0:
+                log.fatal("[%s]: sum of weights is zero" % self.name)
+
+    def _raw_gradients(self, score):
+        z = 1.0 / (1.0 + jnp.exp(-score))
+        return z - self.label, z * (1.0 - z)
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        label = np.asarray(self.label, np.float64)
+        if self.weights is not None:
+            w = np.asarray(self.weights, np.float64)
+            pavg = (label * w).sum() / w.sum()
+        else:
+            pavg = label.mean() if len(label) else 0.0
+        pavg = min(max(pavg, K_EPSILON), 1.0 - K_EPSILON)
+        init = math.log(pavg / (1.0 - pavg))
+        log.info("[xentropy]: pavg = %f -> initscore = %f", pavg, init)
+        return init
+
+    def convert_output(self, raw):
+        return 1.0 / (1.0 + jnp.exp(-raw))
+
+    def to_string(self) -> str:
+        return self.name
+
+
+class CrossEntropyLambda(ObjectiveFunction):
+    """xentropy_objective.hpp:141-250."""
+
+    name = "xentlambda"
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        _check_interval(metadata.label, self.name)
+        if metadata.weights is not None:
+            w = np.asarray(metadata.weights)
+            if w.min() <= 0.0:
+                log.fatal("[%s]: at least one weight is non-positive" % self.name)
+
+    def get_gradients(self, score):
+        # weighted form is NOT a linear scaling, so override the base hook
+        if self.weights is None:
+            z = 1.0 / (1.0 + jnp.exp(-score))
+            return z - self.label, z * (1.0 - z)
+        w = self.weights
+        y = self.label
+        epf = jnp.exp(score)
+        hhat = jnp.log1p(epf)
+        z = 1.0 - jnp.exp(-w * hhat)
+        enf = 1.0 / epf
+        grad = (1.0 - y / z) * w / (1.0 + enf)
+        c = 1.0 / (1.0 - z)
+        d = 1.0 + epf
+        a = w * epf / (d * d)
+        d = c - 1.0
+        b = (c / (d * d)) * (1.0 + w * epf - c)
+        hess = a * (1.0 + y * b)
+        return grad, hess
+
+    def boost_from_score(self, class_id: int = 0) -> float:
+        label = np.asarray(self.label, np.float64)
+        if self.weights is not None:
+            w = np.asarray(self.weights, np.float64)
+            havg = (label * w).sum() / w.sum()
+        else:
+            havg = label.mean() if len(label) else 0.0
+        init = math.log(max(math.exp(havg) - 1.0, K_EPSILON))
+        log.info("[xentlambda]: havg = %f -> initscore = %f", havg, init)
+        return init
+
+    def convert_output(self, raw):
+        # output is lambda = log(1+exp(f)), not a probability (hpp:219-228)
+        return jnp.log1p(jnp.exp(raw))
+
+    def to_string(self) -> str:
+        return self.name

@@ -1,0 +1,304 @@
+"""Multiclass / ranking / xentropy objectives and metrics.
+
+Oracles follow the test strategy of tests/python_package_test/test_engine.py:
+gradient formulas checked against brute-force numpy re-derivations, metrics
+against hand-computed values, end-to-end runs against accuracy thresholds.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.metadata import Metadata
+from lightgbm_tpu.metric import create_metric
+from lightgbm_tpu.objective import create_objective
+
+
+def _meta(label, group=None, weights=None):
+    m = Metadata(len(label))
+    m.set_label(np.asarray(label))
+    if group is not None:
+        m.set_query(group)
+    if weights is not None:
+        m.set_weights(weights)
+    return m
+
+
+# --------------------------------------------------------------------------- #
+# Multiclass
+# --------------------------------------------------------------------------- #
+class TestMulticlass:
+    def test_softmax_gradients_oracle(self, rng):
+        k, n = 4, 50
+        label = rng.randint(0, k, n)
+        score = rng.randn(k, n)
+        cfg = Config(objective="multiclass", num_class=k)
+        obj = create_objective("multiclass", cfg)
+        obj.init(_meta(label), n)
+        g, h = obj.get_gradients(score)
+        # oracle: per-row softmax (multiclass_objective.hpp:69-90)
+        e = np.exp(score - score.max(axis=0))
+        p = e / e.sum(axis=0)
+        onehot = (label[None, :] == np.arange(k)[:, None]).astype(float)
+        np.testing.assert_allclose(np.asarray(g), p - onehot, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(h), 2 * p * (1 - p), rtol=1e-5, atol=1e-6)
+
+    def test_softmax_weighted(self, rng):
+        k, n = 3, 30
+        label = rng.randint(0, k, n)
+        w = rng.rand(n) + 0.5
+        score = rng.randn(k, n)
+        cfg = Config(objective="multiclass", num_class=k)
+        obj = create_objective("multiclass", cfg)
+        obj.init(_meta(label, weights=w), n)
+        g, _ = obj.get_gradients(score)
+        obj2 = create_objective("multiclass", cfg)
+        obj2.init(_meta(label), n)
+        g2, _ = obj2.get_gradients(score)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g2) * w, rtol=1e-5)
+
+    def test_boost_from_score_is_log_prior(self):
+        label = np.array([0, 0, 0, 1, 2, 2])
+        cfg = Config(objective="multiclass", num_class=3)
+        obj = create_objective("multiclass", cfg)
+        obj.init(_meta(label), len(label))
+        assert obj.boost_from_score(0) == pytest.approx(np.log(3 / 6))
+        assert obj.boost_from_score(1) == pytest.approx(np.log(1 / 6))
+
+    def test_ova_matches_binary_per_class(self, rng):
+        k, n = 3, 40
+        label = rng.randint(0, k, n)
+        score = rng.randn(k, n)
+        cfg = Config(objective="multiclassova", num_class=k)
+        obj = create_objective("multiclassova", cfg)
+        obj.init(_meta(label), n)
+        g, h = obj.get_gradients(score)
+        for c in range(k):
+            bcfg = Config(objective="binary")
+            bobj = create_objective("binary", bcfg)
+            bobj.init(_meta((label == c).astype(np.float64)), n)
+            bg, bh = bobj.get_gradients(score[c])
+            np.testing.assert_allclose(np.asarray(g[c]), np.asarray(bg), rtol=1e-5)
+            np.testing.assert_allclose(np.asarray(h[c]), np.asarray(bh), rtol=1e-5)
+
+    def test_multi_logloss_metric(self):
+        label = np.array([0, 1, 2])
+        cfg = Config(objective="multiclass", num_class=3)
+        obj = create_objective("multiclass", cfg)
+        obj.init(_meta(label), 3)
+        m = create_metric("multi_logloss", cfg)
+        m.init(_meta(label), 3)
+        # uniform scores -> softmax prob = 1/3 everywhere
+        val = m.eval(np.zeros(9), obj)[0]
+        assert val == pytest.approx(-np.log(1 / 3), rel=1e-6)
+
+    def test_multi_error_ties_count(self):
+        label = np.array([0, 1])
+        cfg = Config(objective="multiclass", num_class=2)
+        m = create_metric("multi_error", cfg)
+        m.init(_meta(label), 2)
+        # class-major [k*n]: row0 scores (0.9, 0.1) row1 (0.2, 0.8) -> 0 errors
+        score = np.array([0.9, 0.2, 0.1, 0.8])
+        assert m.eval(score, None)[0] == 0.0
+        # ties are errors
+        assert m.eval(np.zeros(4), None)[0] == 1.0
+
+    def test_end_to_end_multiclass(self, rng):
+        n = 300
+        X = np.vstack([rng.randn(n // 3, 4) + 2.5 * i for i in range(3)])
+        y = np.repeat([0, 1, 2], n // 3)
+        ds = lgb.Dataset(X, y)
+        bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "num_leaves": 7, "learning_rate": 0.3, "verbose": -1},
+                        ds, num_boost_round=10)
+        pred = bst.predict(X)
+        assert pred.shape == (n, 3)
+        np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-5)
+        assert (pred.argmax(axis=1) == y).mean() > 0.95
+
+    def test_end_to_end_ova(self, rng):
+        n = 300
+        X = np.vstack([rng.randn(n // 3, 4) + 2.5 * i for i in range(3)])
+        y = np.repeat([0, 1, 2], n // 3)
+        ds = lgb.Dataset(X, y)
+        bst = lgb.train({"objective": "multiclassova", "num_class": 3,
+                         "num_leaves": 7, "learning_rate": 0.3, "verbose": -1},
+                        ds, num_boost_round=10)
+        pred = bst.predict(X)
+        assert (pred.argmax(axis=1) == y).mean() > 0.95
+
+
+# --------------------------------------------------------------------------- #
+# Lambdarank + NDCG/MAP
+# --------------------------------------------------------------------------- #
+def _lambdarank_oracle(score, label, sigmoid, inverse_max_dcg, label_gain,
+                       discount):
+    """Literal (unvectorized) port of GetGradientsForOneQuery
+    (rank_objective.hpp:80-167) as the test oracle."""
+    cnt = len(score)
+    lambdas = np.zeros(cnt)
+    hessians = np.zeros(cnt)
+    sorted_idx = sorted(range(cnt), key=lambda a: -score[a])
+    best_score = score[sorted_idx[0]]
+    worst_score = score[sorted_idx[-1]]
+    for i in range(cnt):
+        high = sorted_idx[i]
+        high_label = int(label[high])
+        high_score = score[high]
+        high_label_gain = label_gain[high_label]
+        high_discount = discount[i]
+        high_sum_lambda = 0.0
+        high_sum_hessian = 0.0
+        for j in range(cnt):
+            if i == j:
+                continue
+            low = sorted_idx[j]
+            low_label = int(label[low])
+            if high_label <= low_label:
+                continue
+            delta_score = high_score - score[low]
+            dcg_gap = high_label_gain - label_gain[low_label]
+            paired_discount = abs(high_discount - discount[j])
+            delta_pair_ndcg = dcg_gap * paired_discount * inverse_max_dcg
+            if high_label != low_label and best_score != worst_score:
+                delta_pair_ndcg /= (0.01 + abs(delta_score))
+            p_lambda = 2.0 / (1.0 + np.exp(2.0 * sigmoid * delta_score))
+            p_hessian = p_lambda * (2.0 - p_lambda)
+            p_lambda *= -delta_pair_ndcg
+            p_hessian *= 2 * delta_pair_ndcg
+            high_sum_lambda += p_lambda
+            high_sum_hessian += p_hessian
+            lambdas[low] -= p_lambda
+            hessians[low] += p_hessian
+        lambdas[high] += high_sum_lambda
+        hessians[high] += high_sum_hessian
+    return lambdas, hessians
+
+
+class TestLambdarank:
+    def test_gradients_match_reference_loop(self, rng):
+        per = 12
+        label = rng.randint(0, 4, 2 * per)
+        score = rng.randn(2 * per)
+        cfg = Config(objective="lambdarank")
+        obj = create_objective("lambdarank", cfg)
+        obj.init(_meta(label, group=[per, per]), 2 * per)
+        g, h = obj.get_gradients(score)
+        for q in range(2):
+            sl = slice(q * per, (q + 1) * per)
+            og, oh = _lambdarank_oracle(
+                score[sl], label[sl], obj.sigmoid, obj.inverse_max_dcgs[q],
+                obj.dcg.label_gain_np, obj.dcg._discount)
+            np.testing.assert_allclose(g[sl], og, rtol=1e-9, atol=1e-12)
+            np.testing.assert_allclose(h[sl], oh, rtol=1e-9, atol=1e-12)
+
+    def test_requires_query_info(self):
+        cfg = Config(objective="lambdarank")
+        obj = create_objective("lambdarank", cfg)
+        with pytest.raises(Exception):
+            obj.init(_meta(np.array([0.0, 1.0])), 2)
+
+    def test_end_to_end_improves_ndcg(self, rng):
+        nq, per = 20, 15
+        X = rng.randn(nq * per, 5)
+        y = np.clip(np.digitize(X[:, 0] + 0.3 * rng.randn(nq * per),
+                                [-0.6, 0.6]), 0, 2)
+        ds = lgb.Dataset(X, y, group=[per] * nq)
+        bst = lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                         "num_leaves": 7, "learning_rate": 0.1, "verbose": -1},
+                        ds, num_boost_round=15)
+        m = create_metric("ndcg", Config(objective="lambdarank"))
+        m.init(_meta(y, group=[per] * nq), nq * per)
+        before = m.eval(np.zeros(nq * per))[4]
+        after = m.eval(bst.predict(X))[4]
+        assert after > before + 0.05
+
+
+class TestRankMetrics:
+    def test_ndcg_hand_computed(self):
+        # one query, labels [2,1,0], scores rank them correctly -> NDCG=1
+        cfg = Config(objective="lambdarank")
+        m = create_metric("ndcg", cfg)
+        m.init(_meta(np.array([2, 1, 0]), group=[3]), 3)
+        assert m.eval(np.array([3.0, 2.0, 1.0]))[0] == pytest.approx(1.0)
+        # reversed scores: DCG@1 = gain(0)=0 -> ndcg@1 = 0
+        assert m.eval(np.array([1.0, 2.0, 3.0]))[0] == pytest.approx(0.0)
+
+    def test_ndcg_all_negative_query_counts_one(self):
+        cfg = Config(objective="lambdarank")
+        m = create_metric("ndcg", cfg)
+        m.init(_meta(np.array([0, 0, 2, 1]), group=[2, 2]), 4)
+        # first query all-zero labels -> ndcg 1; second perfect -> 1
+        vals = m.eval(np.array([1.0, 0.5, 3.0, 1.0]))
+        assert vals[0] == pytest.approx(1.0)
+
+    def test_map_hand_computed(self):
+        cfg = Config(objective="lambdarank")
+        m = create_metric("map", cfg)
+        m.init(_meta(np.array([1, 0, 1, 0]), group=[4]), 4)
+        # ranking: rel, non, rel, non -> AP@4 = (1/1 + 2/3)/2
+        vals = m.eval(np.array([4.0, 3.0, 2.0, 1.0]))
+        assert vals[3] == pytest.approx((1.0 + 2.0 / 3.0) / 2.0)
+
+
+# --------------------------------------------------------------------------- #
+# Cross-entropy family
+# --------------------------------------------------------------------------- #
+class TestXentropy:
+    def test_gradients_match_sigmoid_form(self, rng):
+        n = 30
+        label = rng.rand(n)
+        score = rng.randn(n)
+        cfg = Config(objective="xentropy")
+        obj = create_objective("xentropy", cfg)
+        obj.init(_meta(label), n)
+        g, h = obj.get_gradients(score)
+        z = 1.0 / (1.0 + np.exp(-score))
+        np.testing.assert_allclose(np.asarray(g), z - label, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(h), z * (1 - z), rtol=1e-5, atol=1e-6)
+
+    def test_xentlambda_unweighted_equals_xentropy(self, rng):
+        n = 25
+        label = rng.rand(n)
+        score = rng.randn(n)
+        o1 = create_objective("xentropy", Config(objective="xentropy"))
+        o2 = create_objective("xentlambda", Config(objective="xentlambda"))
+        o1.init(_meta(label), n)
+        o2.init(_meta(label), n)
+        g1, h1 = o1.get_gradients(score)
+        g2, h2 = o2.get_gradients(score)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(h1), np.asarray(h2), rtol=1e-5)
+
+    def test_label_interval_check(self):
+        obj = create_objective("xentropy", Config(objective="xentropy"))
+        with pytest.raises(Exception):
+            obj.init(_meta(np.array([0.5, 1.5])), 2)
+
+    def test_kldiv_is_xent_plus_entropy_offset(self, rng):
+        n = 20
+        label = rng.rand(n)
+        score = rng.randn(n)
+        cfg = Config(objective="xentropy")
+        obj = create_objective("xentropy", cfg)
+        obj.init(_meta(label), n)
+        x = create_metric("xentropy", cfg)
+        x.init(_meta(label), n)
+        k = create_metric("kldiv", cfg)
+        k.init(_meta(label), n)
+        ent = np.where(label > 0, label * np.log(label), 0) + \
+            np.where(label < 1, (1 - label) * np.log(1 - label), 0)
+        expected = x.eval(score, obj)[0] + ent.mean()
+        # label is stored f32 (Metadata), the oracle uses f64 labels
+        assert k.eval(score, obj)[0] == pytest.approx(expected, rel=1e-6)
+
+    def test_end_to_end_xentropy(self, rng):
+        n = 200
+        X = rng.randn(n, 4)
+        p = 1 / (1 + np.exp(-2 * X[:, 0]))
+        ds = lgb.Dataset(X, p)
+        bst = lgb.train({"objective": "xentropy", "num_leaves": 7,
+                         "learning_rate": 0.2, "verbose": -1},
+                        ds, num_boost_round=20)
+        pred = bst.predict(X)
+        assert np.abs(pred - p).mean() < 0.1

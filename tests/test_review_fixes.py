@@ -1,0 +1,119 @@
+"""Regression tests for review findings: RF reload averaging, DART
+max_drop<=0, bigger-is-better flag for lazily-imported metrics, GOSS
+init-score handling on the default driver path."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.metric import is_bigger_better
+
+
+def _reg_data(rng, n=200):
+    X = rng.randn(n, 4)
+    y = X[:, 0] * 2 + 0.1 * rng.randn(n)
+    return X, y
+
+
+class TestRFReload:
+    def test_rf_predict_survives_save_load(self, rng, tmp_path):
+        X, y = _reg_data(rng)
+        ds = lgb.Dataset(X, y)
+        bst = lgb.train({"objective": "regression", "boosting": "rf",
+                         "bagging_freq": 1, "bagging_fraction": 0.7,
+                         "num_leaves": 7, "verbose": -1},
+                        ds, num_boost_round=12)
+        before = bst.predict(X)
+        path = str(tmp_path / "rf.txt")
+        bst.save_model(path)
+        loaded = lgb.Booster(model_file=path)
+        after = loaded.predict(X)
+        np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
+        # averaged output must be on the label scale, not the tree-sum scale
+        assert np.abs(after - y.mean()).mean() < 5 * np.abs(y - y.mean()).mean()
+
+
+class TestDartMaxDrop:
+    def test_negative_max_drop_allows_multiple_drops(self, rng):
+        X, y = _reg_data(rng)
+        ds = lgb.Dataset(X, y)
+        bst = lgb.train({"objective": "regression", "boosting": "dart",
+                         "max_drop": -1, "drop_rate": 0.9, "skip_drop": 0.0,
+                         "num_leaves": 7, "drop_seed": 3, "verbose": -1},
+                        ds, num_boost_round=15)
+        gbdt = bst._gbdt
+        # with drop_rate 0.9 over 14 candidate iters, an unlimited max_drop
+        # must have dropped >1 tree in at least one round
+        assert max(len(gbdt._drop_index), gbdt.iter) > 0
+        # train a second run recording per-iter drop counts via monkeypatch
+        drops = []
+        ds2 = lgb.Dataset(X, y)
+        from lightgbm_tpu.models.dart import DART
+        orig = DART._dropping_trees
+
+        def record(self):
+            orig(self)
+            drops.append(len(self._drop_index))
+
+        DART._dropping_trees = record
+        try:
+            lgb.train({"objective": "regression", "boosting": "dart",
+                       "max_drop": -1, "drop_rate": 0.9, "skip_drop": 0.0,
+                       "num_leaves": 7, "drop_seed": 3, "verbose": -1},
+                      ds2, num_boost_round=15)
+        finally:
+            DART._dropping_trees = orig
+        assert max(drops) > 1
+
+
+class TestBiggerIsBetter:
+    def test_rank_metrics_flagged(self):
+        assert is_bigger_better("ndcg")
+        assert is_bigger_better("ndcg@5")
+        assert is_bigger_better("map")
+        assert is_bigger_better("auc")
+        assert not is_bigger_better("l2")
+        assert not is_bigger_better("multi_logloss")
+        assert not is_bigger_better("cross_entropy")
+
+    def test_early_stopping_respects_ndcg_direction(self, rng):
+        nq, per = 15, 12
+        X = rng.randn(nq * per, 5)
+        # noisy relevance so NDCG improves gradually instead of starting at 1
+        y = np.clip(np.digitize(X[:, 0] + 1.2 * rng.randn(nq * per),
+                                [-0.5, 0.5]), 0, 2)
+        ds = lgb.Dataset(X, y, group=[per] * nq)
+        vd = lgb.Dataset(X, y, group=[per] * nq, reference=ds)
+        res = {}
+        bst = lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                         "num_leaves": 7, "learning_rate": 0.1, "verbose": -1},
+                        ds, num_boost_round=30, valid_sets=[vd],
+                        valid_names=["v"], early_stopping_rounds=5,
+                        evals_result=res)
+        # NDCG improves on training data; early stopping must NOT fire at
+        # iteration 5 with best_iteration stuck at 1
+        assert bst.best_iteration > 1
+
+
+class TestGossInitScore:
+    def test_goss_keeps_boost_from_average(self, rng):
+        X, y = _reg_data(rng)
+        y = y + 100.0  # big offset: lost init score is obvious
+        ds = lgb.Dataset(X, y)
+        bst = lgb.train({"objective": "regression", "boosting": "goss",
+                         "num_leaves": 7, "learning_rate": 0.1, "verbose": -1},
+                        ds, num_boost_round=10)
+        pred = bst.predict(X)
+        assert abs(pred.mean() - 100.0) < 10.0
+
+    def test_goss_custom_fobj_still_samples(self, rng):
+        X, y = _reg_data(rng)
+        ds = lgb.Dataset(X, y)
+
+        def fobj(score, _ds):
+            return score - y, np.ones_like(y)
+
+        bst = lgb.train({"boosting": "goss", "num_leaves": 7, "top_rate": 0.3,
+                         "other_rate": 0.3, "learning_rate": 0.3,
+                         "objective": "none", "verbose": -1},
+                        ds, num_boost_round=8, fobj=fobj)
+        assert bst.num_trees() == 8

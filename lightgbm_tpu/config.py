@@ -376,6 +376,15 @@ class Config:
             self.boosting = "gbdt"
         elif b in ("random_forest",):
             self.boosting = "rf"
+        # tree-learner spellings (GetTreeLearnerType, src/io/config.cpp:139-152)
+        tl = self.tree_learner.lower()
+        tl_map = {"serial": "serial",
+                  "feature": "feature", "feature_parallel": "feature",
+                  "data": "data", "data_parallel": "data",
+                  "voting": "voting", "voting_parallel": "voting"}
+        if tl not in tl_map:
+            log.fatal("Unknown tree learner type %s" % self.tree_learner)
+        self.tree_learner = tl_map[tl]
 
     def check_param_conflict(self) -> None:
         """Cross-parameter validation (src/io/config.cpp:230-260)."""
@@ -396,6 +405,8 @@ class Config:
             log.fatal("feature_fraction must be in (0, 1], got %g" % self.feature_fraction)
         if self.boosting == "goss" and self.top_rate + self.other_rate > 1.0:
             log.fatal("top_rate + other_rate must be <= 1.0 for GOSS")
+        if self.top_k <= 0:
+            log.fatal("top_k must be > 0, got %d" % self.top_k)
 
     def is_single_machine(self) -> bool:
         return self.num_machines <= 1

@@ -107,6 +107,11 @@ class GBDT:
                          if train_set.monotone_constraints is not None else None)
         self.penalty = (jnp.asarray(train_set.feature_penalty, self.dtype)
                         if train_set.feature_penalty is not None else None)
+        # distributed learner selection (TreeLearner::CreateTreeLearner,
+        # src/treelearner/tree_learner.cpp:9-33): None = serial
+        from ..parallel import learners as par_learners
+        self._grower = par_learners.make_grower(self.config,
+                                                train_set.num_features)
         # bagging state
         self._bag_mask: Optional[jnp.ndarray] = None
         self._row_all_in = jnp.zeros(self.num_data, jnp.int32)
@@ -191,17 +196,8 @@ class GBDT:
             class_ok = (self.objective is None
                         or self.objective.class_need_train(kk))
             if class_ok and self.train_set.num_features > 0:
-                arrays, leaf_ids = grow_ops.grow_tree(
-                    self.train_state.bins, grad[kk], hess[kk], row_init,
-                    self._feature_sample(),
-                    self.train_state.num_bins, self.train_state.default_bins,
-                    self.train_state.missing_types,
-                    self.split_params, self.monotone, self.penalty,
-                    max_leaves=self.config.num_leaves,
-                    max_depth=self.config.max_depth,
-                    max_bin=self.max_bin,
-                    hist_impl=self.config.tpu_histogram_impl,
-                    rows_per_chunk=self.config.tpu_rows_per_tile)
+                arrays, leaf_ids = self._grow_one_tree(grad[kk], hess[kk],
+                                                       row_init)
                 if int(arrays.num_leaves) > 1:
                     new_tree = Tree.from_arrays(arrays, self.train_set)
 
@@ -233,6 +229,23 @@ class GBDT:
             return True
         self.iter += 1
         return False
+
+    def _grow_one_tree(self, grad, hess, row_init):
+        """Grow one tree via the selected learner (serial or distributed) —
+        the single dispatch point shared by GBDT/DART/GOSS/RF."""
+        grow_fn = (self._grower if self._grower is not None
+                   else grow_ops.grow_tree)
+        return grow_fn(
+            self.train_state.bins, grad, hess, row_init,
+            self._feature_sample(),
+            self.train_state.num_bins, self.train_state.default_bins,
+            self.train_state.missing_types,
+            self.split_params, self.monotone, self.penalty,
+            max_leaves=self.config.num_leaves,
+            max_depth=self.config.max_depth,
+            max_bin=self.max_bin,
+            hist_impl=self.config.tpu_histogram_impl,
+            rows_per_chunk=self.config.tpu_rows_per_tile)
 
     def _sample_gradients(self, grad: jnp.ndarray, hess: jnp.ndarray):
         """Per-iteration gradient/row sampling hook (overridden by GOSS)."""

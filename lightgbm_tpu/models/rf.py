@@ -47,22 +47,12 @@ class RF(GBDT):
         k = self.num_tree_per_iteration
         row_init = self._bagging(self.iter)
 
-        from ..ops import grow as grow_ops
         for kk in range(k):
             new_tree = Tree(1)
             if (self.objective is None or self.objective.class_need_train(kk)) \
                and self.train_set.num_features > 0:
-                arrays, leaf_ids = grow_ops.grow_tree(
-                    self.train_state.bins, grad[kk], hess[kk], row_init,
-                    self._feature_sample(),
-                    self.train_state.num_bins, self.train_state.default_bins,
-                    self.train_state.missing_types,
-                    self.split_params, self.monotone, self.penalty,
-                    max_leaves=self.config.num_leaves,
-                    max_depth=self.config.max_depth,
-                    max_bin=self.max_bin,
-                    hist_impl=self.config.tpu_histogram_impl,
-                    rows_per_chunk=self.config.tpu_rows_per_tile)
+                arrays, leaf_ids = self._grow_one_tree(grad[kk], hess[kk],
+                                                       row_init)
                 if int(arrays.num_leaves) > 1:
                     new_tree = Tree.from_arrays(arrays, self.train_set)
             if new_tree.num_leaves > 1:

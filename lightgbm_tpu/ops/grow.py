@@ -26,7 +26,9 @@ import jax
 import jax.numpy as jnp
 
 from . import histogram as hist_ops
-from .split import K_MIN_SCORE, SplitParams, SplitResult, best_split_for_leaf
+from .split import (K_MIN_SCORE, SplitParams, SplitResult,
+                    best_split_for_leaf, best_split_per_feature,
+                    select_best_feature)
 
 MISSING_NONE = 0
 MISSING_ZERO = 1
@@ -88,9 +90,7 @@ def _index_split(cache: SplitResult, idx) -> SplitResult:
     return SplitResult(*[c[idx] for c in cache])
 
 
-@partial(jax.jit, static_argnames=("max_leaves", "max_depth", "max_bin",
-                                   "hist_impl", "rows_per_chunk"))
-def grow_tree(bins: jnp.ndarray,            # [n, F] uint8/16
+def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
               grad: jnp.ndarray,            # [n]
               hess: jnp.ndarray,            # [n]
               row_leaf_init: jnp.ndarray,   # [n] int32: 0 in-bag, -1 out
@@ -106,16 +106,110 @@ def grow_tree(bins: jnp.ndarray,            # [n, F] uint8/16
               max_depth: int = -1,
               max_bin: int,
               hist_impl: str = "auto",
-              rows_per_chunk: int = 16384):
-    """Grow one leaf-wise tree; returns (TreeArrays, leaf_ids)."""
+              rows_per_chunk: int = 16384,
+              learner: str = "serial",
+              axis_name: Optional[str] = None,
+              num_machines: int = 1,
+              top_k: int = 20):
+    """Grow one leaf-wise tree; returns (TreeArrays, leaf_ids).
+
+    learner/axis_name select the distributed mode when called inside
+    shard_map over a Mesh axis (the TPU re-design of the {serial, feature,
+    data, voting} learner family, src/treelearner/tree_learner.cpp:9-33):
+
+    - "serial": single shard, no collectives.
+    - "data"  (DataParallelTreeLearner, data_parallel_tree_learner.cpp):
+      rows sharded over axis_name; histograms psum'd so all split decisions
+      see global stats; rows are relabelled locally.
+    - "feature" (FeatureParallelTreeLearner, feature_parallel_tree_learner
+      .cpp): full data replicated; each shard builds histograms and scans
+      only its contiguous F/num_machines feature slice; best split synced by
+      all_gather + argmax (SyncUpGlobalBestSplit, parallel_tree_learner
+      .h:186-209); splits applied locally everywhere.
+    - "voting" (VotingParallelTreeLearner, voting_parallel_tree_learner
+      .cpp): rows sharded; local top-k feature vote → global top-2k elected
+      features → psum of elected histograms only → global best split.
+    """
     n, F = bins.shape
     dtype = grad.dtype
+    distributed = axis_name is not None and learner != "serial"
+    if learner == "feature" and distributed:
+        # contiguous per-shard feature slice (deterministic sharding, the
+        # analogue of the bin-count-balanced shuffle at
+        # feature_parallel_tree_learner.cpp:30-49)
+        f_local = F // num_machines
+        f_off = jax.lax.axis_index(axis_name).astype(jnp.int32) * f_local
+
+        def _slice(a):
+            return (None if a is None
+                    else jax.lax.dynamic_slice_in_dim(a, f_off, f_local))
+        hist_bins = jax.lax.dynamic_slice_in_dim(bins, f_off, f_local, axis=1)
+        l_num_bins, l_default_bins, l_missing = map(
+            _slice, (num_bins, default_bins, missing_types))
+        l_monotone, l_penalty, l_feature_mask = map(
+            _slice, (monotone, penalty, feature_mask))
+        l_feature_index = f_off + jnp.arange(f_local, dtype=jnp.int32)
+    else:
+        hist_bins = bins
+        l_num_bins, l_default_bins, l_missing = num_bins, default_bins, missing_types
+        l_monotone, l_penalty, l_feature_mask = monotone, penalty, feature_mask
+        l_feature_index = None
+
+    def reduce_hist(h):
+        # DP: one collective per histogrammed leaf — the psum_scatter+
+        # allgather pair the reference schedules by hand (§3.4.2)
+        if distributed and learner == "data":
+            return jax.lax.psum(h, axis_name)
+        return h
 
     def leaf_best_split(hist, sum_g, sum_h, cnt, depth):
-        res = best_split_for_leaf(hist, sum_g, sum_h, cnt,
-                                  num_bins, default_bins, missing_types, params,
-                                  monotone=monotone, penalty=penalty,
-                                  feature_mask=feature_mask)
+        if distributed and learner == "feature":
+            local = best_split_for_leaf(
+                hist, sum_g, sum_h, cnt,
+                l_num_bins, l_default_bins, l_missing, params,
+                monotone=l_monotone, penalty=l_penalty,
+                feature_mask=l_feature_mask)
+            # map the local winner to its global feature id
+            local = local._replace(feature=jnp.where(
+                local.feature >= 0, l_feature_index[local.feature],
+                local.feature))
+            # SyncUpGlobalBestSplit: pack the candidate into one float + one
+            # int vector (the reference packs SplitInfo into a single wire
+            # buffer, parallel_tree_learner.h:186-209), gather both in two
+            # collectives, argmax on gain; first-hit tie-break = lowest
+            # shard = lowest feature id
+            fdt = local.gain.dtype
+            fvec = jnp.stack([
+                local.gain, local.default_left.astype(fdt),
+                local.left_sum_gradient, local.left_sum_hessian,
+                local.left_output, local.right_sum_gradient,
+                local.right_sum_hessian, local.right_output])
+            ivec = jnp.stack([local.feature, local.threshold,
+                              local.left_count, local.right_count])
+            fall = jax.lax.all_gather(fvec, axis_name)             # [d, 8]
+            iall = jax.lax.all_gather(ivec, axis_name)             # [d, 4]
+            winner = jnp.argmax(fall[:, 0]).astype(jnp.int32)
+            fw, iw = fall[winner], iall[winner]
+            res = SplitResult(
+                feature=iw[0], threshold=iw[1], gain=fw[0],
+                default_left=fw[1] > 0.5,
+                left_sum_gradient=fw[2], left_sum_hessian=fw[3],
+                left_count=iw[2], left_output=fw[4],
+                right_sum_gradient=fw[5], right_sum_hessian=fw[6],
+                right_count=iw[3], right_output=fw[7])
+        elif distributed and learner == "voting":
+            res = _voting_best_split(
+                hist, sum_g, sum_h, cnt,
+                num_bins, default_bins, missing_types, params,
+                monotone, penalty, feature_mask,
+                axis_name=axis_name, num_machines=num_machines,
+                top_k=top_k)
+        else:
+            res = best_split_for_leaf(hist, sum_g, sum_h, cnt,
+                                      num_bins, default_bins, missing_types,
+                                      params, monotone=monotone,
+                                      penalty=penalty,
+                                      feature_mask=feature_mask)
         depth_ok = (max_depth <= 0) | (depth < max_depth)
         blocked = (res.feature < 0) | ~depth_ok
         return res._replace(gain=jnp.where(blocked, K_MIN_SCORE, res.gain),
@@ -123,12 +217,18 @@ def grow_tree(bins: jnp.ndarray,            # [n, F] uint8/16
 
     # ---- root ----------------------------------------------------------
     tree = empty_tree(max_leaves, dtype)
-    root_hist = hist_ops.leaf_histogram(bins, grad, hess, row_leaf_init, 0,
+    root_hist = hist_ops.leaf_histogram(hist_bins, grad, hess, row_leaf_init, 0,
                                         max_bin, hist_impl, rows_per_chunk)
+    root_hist = reduce_hist(root_hist)
     in_bag = row_leaf_init == 0
     root_g = jnp.sum(grad * in_bag)
     root_h = jnp.sum(hess * in_bag)
     root_c = jnp.sum(in_bag).astype(jnp.int32)
+    if distributed and learner in ("data", "voting"):
+        # root (cnt, Σg, Σh) Allreduce (data_parallel_tree_learner.cpp:116-142)
+        root_g = jax.lax.psum(root_g, axis_name)
+        root_h = jax.lax.psum(root_h, axis_name)
+        root_c = jax.lax.psum(root_c, axis_name)
     tree = tree._replace(leaf_count=tree.leaf_count.at[0].set(root_c))
 
     root_split = leaf_best_split(root_hist, root_g, root_h, root_c,
@@ -181,9 +281,10 @@ def grow_tree(bins: jnp.ndarray,            # [n, F] uint8/16
             left_smaller = sp.left_count <= sp.right_count
             small_leaf = jnp.where(left_smaller, best_leaf, new_leaf)
             parent_hist = state.hist_cache[best_leaf]
-            small_hist = hist_ops.leaf_histogram(bins, grad, hess, leaf_ids,
+            small_hist = hist_ops.leaf_histogram(hist_bins, grad, hess, leaf_ids,
                                                  small_leaf, max_bin,
                                                  hist_impl, rows_per_chunk)
+            small_hist = reduce_hist(small_hist)
             large_hist = parent_hist - small_hist
             left_hist = jnp.where(left_smaller, small_hist, large_hist)
             right_hist = jnp.where(left_smaller, large_hist, small_hist)
@@ -245,6 +346,75 @@ def grow_tree(bins: jnp.ndarray,            # [n, F] uint8/16
 
     state = jax.lax.while_loop(cond, body, state)
     return state.tree, state.leaf_ids
+
+
+grow_tree = partial(jax.jit, static_argnames=(
+    "max_leaves", "max_depth", "max_bin", "hist_impl", "rows_per_chunk",
+    "learner", "axis_name", "num_machines", "top_k"))(grow_tree_impl)
+
+
+def _voting_best_split(local_hist, sum_g, sum_h, cnt,
+                       num_bins, default_bins, missing_types,
+                       params: SplitParams,
+                       monotone, penalty, feature_mask,
+                       *, axis_name: str, num_machines: int, top_k: int
+                       ) -> SplitResult:
+    """PV-tree best split (voting_parallel_tree_learner.cpp:257-460).
+
+    local_hist [F, B, 3] holds *local-shard* rows only.  Protocol:
+    1. local per-feature scan against 1/num_machines-rescaled min-data
+       thresholds (the locally-rescaled config, voting...cpp:50-57);
+    2. local top-k features by gain → Allgather (the LightSplitInfo
+       allgather, voting...cpp:322-356);
+    3. GlobalVoting: vote count per feature, elect top-2k
+       (voting...cpp:166-195), smaller feature id on ties;
+    4. psum of the elected features' histograms only (CopyLocalHistogram +
+       ReduceScatter, voting...cpp:198-254) — O(2k·B) bytes instead of
+       O(F·B);
+    5. full-threshold scan on the global histograms, winner selected among
+       the elected features.
+    """
+    F = local_hist.shape[0]
+    k = min(top_k, F)
+    # local parent sums: every in-leaf row lands in exactly one bin of
+    # feature 0, so its bin-sum recovers the local leaf totals
+    loc_g = jnp.sum(local_hist[0, :, 0])
+    loc_h = jnp.sum(local_hist[0, :, 1])
+    loc_c = jnp.round(jnp.sum(local_hist[0, :, 2])).astype(jnp.int32)
+
+    # params leaves may be tracers (SplitParams rides the jit pytree)
+    local_params = params._replace(
+        min_data_in_leaf=jnp.maximum(params.min_data_in_leaf // num_machines, 1),
+        min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf / num_machines)
+    pf_local = best_split_per_feature(
+        local_hist, loc_g, loc_h, loc_c,
+        num_bins, default_bins, missing_types, local_params,
+        monotone=monotone, penalty=penalty, feature_mask=feature_mask)
+
+    _, top_idx = jax.lax.top_k(pf_local.gain, k)                # [k]
+    top_valid = jnp.take(pf_local.gain, top_idx) > K_MIN_SCORE
+    all_top = jax.lax.all_gather(top_idx, axis_name)            # [d, k]
+    all_valid = jax.lax.all_gather(top_valid, axis_name)        # [d, k]
+
+    votes = jnp.zeros(F, jnp.int32).at[all_top.reshape(-1)].add(
+        all_valid.reshape(-1).astype(jnp.int32))                # [F]
+    n_elect = min(2 * k, F)
+    # lax.top_k is stable (lower index first on ties) → equal-vote ties
+    # break toward the smaller feature id (stable sort in GlobalVoting)
+    _, elected = jax.lax.top_k(votes, n_elect)                  # [n_elect]
+    elected = elected.astype(jnp.int32)
+
+    glob_hist = jax.lax.psum(jnp.take(local_hist, elected, axis=0), axis_name)
+
+    def take(a):
+        return None if a is None else jnp.take(a, elected, axis=0)
+
+    pf_glob = best_split_per_feature(
+        glob_hist, sum_g, sum_h, cnt,
+        take(num_bins), take(default_bins), take(missing_types), params,
+        monotone=take(monotone), penalty=take(penalty),
+        feature_mask=take(feature_mask))
+    return select_best_feature(pf_glob, feature_index=elected)
 
 
 @jax.jit

@@ -59,6 +59,24 @@ class SplitResult(NamedTuple):
     right_output: jnp.ndarray
 
 
+class PerFeatureSplit(NamedTuple):
+    """Best split of every feature of one leaf — all fields [F].  The array
+    analogue of the per-feature SplitInfo vector the reference reduces over
+    (serial_tree_learner.cpp:506-591) and the payload voting-parallel gathers
+    (LightSplitInfo, split_info.hpp:203-285)."""
+    gain: jnp.ndarray           # [F], K_MIN_SCORE = no valid split
+    threshold: jnp.ndarray      # [F] int32
+    default_left: jnp.ndarray   # [F] bool
+    left_sum_gradient: jnp.ndarray
+    left_sum_hessian: jnp.ndarray   # includes the +eps directional bias
+    left_count: jnp.ndarray
+    left_output: jnp.ndarray
+    right_sum_gradient: jnp.ndarray
+    right_sum_hessian: jnp.ndarray
+    right_count: jnp.ndarray
+    right_output: jnp.ndarray
+
+
 def threshold_l1(s, l1):
     """sign(s) * max(0, |s| - l1) (feature_histogram.hpp:437-440)."""
     reg = jnp.maximum(0.0, jnp.abs(s) - l1)
@@ -98,18 +116,19 @@ def split_gains(lg, lh, rg, rh, l1, l2, max_delta_step,
     return jnp.where(violates, 0.0, gain), lo, ro
 
 
-def best_split_for_leaf(hist: jnp.ndarray,
-                        sum_gradient, sum_hessian, num_data,
-                        num_bins: jnp.ndarray,
-                        default_bins: jnp.ndarray,
-                        missing_types: jnp.ndarray,
-                        params: SplitParams,
-                        monotone: Optional[jnp.ndarray] = None,
-                        penalty: Optional[jnp.ndarray] = None,
-                        min_constraints: Optional[jnp.ndarray] = None,
-                        max_constraints: Optional[jnp.ndarray] = None,
-                        feature_mask: Optional[jnp.ndarray] = None) -> SplitResult:
-    """Find the best numerical split across all features of one leaf.
+def best_split_per_feature(hist: jnp.ndarray,
+                           sum_gradient, sum_hessian, num_data,
+                           num_bins: jnp.ndarray,
+                           default_bins: jnp.ndarray,
+                           missing_types: jnp.ndarray,
+                           params: SplitParams,
+                           monotone: Optional[jnp.ndarray] = None,
+                           penalty: Optional[jnp.ndarray] = None,
+                           min_constraints: Optional[jnp.ndarray] = None,
+                           max_constraints: Optional[jnp.ndarray] = None,
+                           feature_mask: Optional[jnp.ndarray] = None
+                           ) -> PerFeatureSplit:
+    """Best numerical split of *every* feature of one leaf (fields [F]).
 
     hist: [F, B, 3] (grad, hess, count) including every bin (the default bin
     is stored explicitly — no FixHistogram reconstruction step is needed in
@@ -230,30 +249,81 @@ def best_split_for_leaf(hist: jnp.ndarray,
     if feature_mask is not None:
         feat_gain = jnp.where(feature_mask, feat_gain, K_MIN_SCORE)
 
-    # cross-feature argmax; ties -> smaller feature index (argmax first-hit)
-    best_f = jnp.argmax(feat_gain, axis=0).astype(jnp.int32)
-    has_split = feat_gain[best_f] > K_MIN_SCORE
-    best_f_out = jnp.where(has_split, best_f, -1)
-
-    def at(v):
-        return v[best_f]
-
     # 2-bin NaN features report default_right even from the single descending
     # scan (feature_histogram.hpp:99-102)
     two_bin_nan = (missing_types == MISSING_NAN) & (num_bins <= 2)
     default_left_f = is_desc & ~two_bin_nan
 
+    return PerFeatureSplit(
+        gain=feat_gain,
+        threshold=best_thr,
+        default_left=default_left_f,
+        left_sum_gradient=lg,
+        left_sum_hessian=lh,
+        left_count=lc.astype(jnp.int32),
+        left_output=lo,
+        right_sum_gradient=rg,
+        right_sum_hessian=rh,
+        right_count=rc.astype(jnp.int32),
+        right_output=ro,
+    )
+
+
+def select_best_feature(pf: PerFeatureSplit,
+                        feature_index: Optional[jnp.ndarray] = None
+                        ) -> SplitResult:
+    """Cross-feature argmax of a PerFeatureSplit → SplitResult.
+
+    feature_index: optional [F] int32 mapping row → global feature id (used
+    by the feature-parallel shard offset and the voting-parallel gather);
+    defaults to arange.  Ties -> smaller array position (argmax first-hit),
+    matching the reference's ascending-feature update loop
+    (serial_tree_learner.cpp:575-587).
+    """
+    best_f = jnp.argmax(pf.gain, axis=0).astype(jnp.int32)
+    has_split = pf.gain[best_f] > K_MIN_SCORE
+    if feature_index is None:
+        out_f = best_f
+    else:
+        out_f = feature_index[best_f].astype(jnp.int32)
+    best_f_out = jnp.where(has_split, out_f, -1)
+
+    def at(v):
+        return v[best_f]
+
     return SplitResult(
         feature=best_f_out,
-        threshold=at(best_thr),
-        gain=at(feat_gain),
-        default_left=at(default_left_f),
-        left_sum_gradient=at(lg),
-        left_sum_hessian=at(lh) - K_EPSILON,
-        left_count=at(lc).astype(jnp.int32),
-        left_output=at(lo),
-        right_sum_gradient=at(rg),
-        right_sum_hessian=at(rh) - K_EPSILON,
-        right_count=at(rc).astype(jnp.int32),
-        right_output=at(ro),
+        threshold=at(pf.threshold),
+        gain=at(pf.gain),
+        default_left=at(pf.default_left),
+        left_sum_gradient=at(pf.left_sum_gradient),
+        left_sum_hessian=at(pf.left_sum_hessian) - K_EPSILON,
+        left_count=at(pf.left_count),
+        left_output=at(pf.left_output),
+        right_sum_gradient=at(pf.right_sum_gradient),
+        right_sum_hessian=at(pf.right_sum_hessian) - K_EPSILON,
+        right_count=at(pf.right_count),
+        right_output=at(pf.right_output),
     )
+
+
+def best_split_for_leaf(hist: jnp.ndarray,
+                        sum_gradient, sum_hessian, num_data,
+                        num_bins: jnp.ndarray,
+                        default_bins: jnp.ndarray,
+                        missing_types: jnp.ndarray,
+                        params: SplitParams,
+                        monotone: Optional[jnp.ndarray] = None,
+                        penalty: Optional[jnp.ndarray] = None,
+                        min_constraints: Optional[jnp.ndarray] = None,
+                        max_constraints: Optional[jnp.ndarray] = None,
+                        feature_mask: Optional[jnp.ndarray] = None) -> SplitResult:
+    """Best numerical split across all features of one leaf (see
+    best_split_per_feature for the argument contract)."""
+    pf = best_split_per_feature(hist, sum_gradient, sum_hessian, num_data,
+                                num_bins, default_bins, missing_types, params,
+                                monotone=monotone, penalty=penalty,
+                                min_constraints=min_constraints,
+                                max_constraints=max_constraints,
+                                feature_mask=feature_mask)
+    return select_best_feature(pf)

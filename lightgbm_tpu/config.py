@@ -138,7 +138,7 @@ _SCHEMA = [
     ("gpu_use_dp", bool, False),
     # TPU-native knobs (no reference analogue)
     ("tpu_double_precision", bool, False),   # f64 histogram accumulate (gpu_use_dp analogue)
-    ("tpu_histogram_impl", str, "auto"),     # auto|onehot|scatter|pallas
+    ("tpu_histogram_impl", str, "auto"),     # auto|compact|onehot|scatter|pallas
     ("tpu_rows_per_tile", int, 2048),        # Pallas row-tile size
     ("num_devices", int, 0),                 # 0 = use all local devices for parallel learners
 ]

@@ -102,7 +102,17 @@ class GBDT:
             max_delta_step=self.config.max_delta_step,
             min_data_in_leaf=self.config.min_data_in_leaf,
             min_sum_hessian_in_leaf=self.config.min_sum_hessian_in_leaf,
-            min_gain_to_split=self.config.min_gain_to_split)
+            min_gain_to_split=self.config.min_gain_to_split,
+            max_cat_to_onehot=self.config.max_cat_to_onehot,
+            cat_smooth=self.config.cat_smooth,
+            cat_l2=self.config.cat_l2,
+            min_data_per_group=self.config.min_data_per_group)
+        # [F] bin-type vector; None when the dataset is purely numerical so
+        # the grow loop skips the categorical scan entirely
+        cat_flags = np.array([m.bin_type == 1 for m in train_set.bin_mappers],
+                             bool) if train_set.num_features else np.zeros(0, bool)
+        self.is_categorical = (jnp.asarray(cat_flags) if cat_flags.any()
+                               else None)
         self.monotone = (jnp.asarray(train_set.monotone_constraints, jnp.int32)
                          if train_set.monotone_constraints is not None else None)
         self.penalty = (jnp.asarray(train_set.feature_penalty, self.dtype)
@@ -241,11 +251,13 @@ class GBDT:
             self.train_state.num_bins, self.train_state.default_bins,
             self.train_state.missing_types,
             self.split_params, self.monotone, self.penalty,
+            self.is_categorical,
             max_leaves=self.config.num_leaves,
             max_depth=self.config.max_depth,
             max_bin=self.max_bin,
             hist_impl=self.config.tpu_histogram_impl,
-            rows_per_chunk=self.config.tpu_rows_per_tile)
+            rows_per_chunk=self.config.tpu_rows_per_tile,
+            max_cat_threshold=self.config.max_cat_threshold)
 
     def _sample_gradients(self, grad: jnp.ndarray, hess: jnp.ndarray):
         """Per-iteration gradient/row sampling hook (overridden by GOSS)."""
@@ -550,14 +562,14 @@ def _add_tree_score(state: _DatasetState, tree: Tree, class_id: int, gbdt: GBDT)
     if tree.num_leaves <= 1:
         state.add_constant(float(tree.leaf_value[0]), class_id)
         return
-    arrays = _tree_to_device(tree, gbdt.dtype)
+    arrays = _tree_to_device(tree, gbdt.dtype, gbdt.max_bin)
     leaf = grow_ops.predict_leaf_inner(state.bins, arrays, state.num_bins,
                                        state.default_bins)
     leaf_values = jnp.asarray(tree.leaf_value[:tree.num_leaves], gbdt.dtype)
     state.score = state.score.at[class_id].add(leaf_values[leaf])
 
 
-def _tree_to_device(tree: Tree, dtype) -> grow_ops.TreeArrays:
+def _tree_to_device(tree: Tree, dtype, max_bin: int = 0) -> grow_ops.TreeArrays:
     # pad node/leaf arrays to a power-of-two bucket so predict_leaf_inner's
     # jit cache sees stable shapes across trees of different sizes
     nl_true = max(tree.num_leaves, 1)
@@ -576,7 +588,29 @@ def _tree_to_device(tree: Tree, dtype) -> grow_ops.TreeArrays:
 
     mt = (tree.decision_type.astype(np.int32) >> 2) & 3
     dl = (tree.decision_type & 2) > 0
+    # categorical bitsets -> [N, max_bin] membership masks for the device walk
+    W = max_bin if tree.num_cat > 0 else 0
+    is_cat_np = np.zeros(n, bool)
+    cat_mask_np = np.zeros((n, W), bool)
+    if W:
+        from .tree import K_CATEGORICAL_MASK
+        word_idx, bit_idx = np.arange(W) // 32, np.arange(W) % 32
+        for node in range(min(n_true, len(tree.decision_type))):
+            if not (tree.decision_type[node] & K_CATEGORICAL_MASK):
+                continue
+            is_cat_np[node] = True
+            ci = int(tree.threshold_in_bin[node])
+            lo = tree.cat_boundaries_inner[ci]
+            hi = tree.cat_boundaries_inner[ci + 1]
+            bits = np.asarray(tree.cat_threshold_inner[lo:hi], np.uint32)
+            if len(bits):
+                valid = word_idx < len(bits)
+                cat_mask_np[node] = valid & (
+                    (bits[np.minimum(word_idx, len(bits) - 1)]
+                     >> bit_idx) & 1).astype(bool)
     return grow_ops.TreeArrays(
+        is_cat=jnp.asarray(is_cat_np),
+        cat_mask=jnp.asarray(cat_mask_np),
         split_feature=padn(tree.split_feature_inner),
         threshold_bin=padn(tree.threshold_in_bin),
         default_left=padn(dl),

@@ -83,13 +83,36 @@ class Tree:
         t.split_feature = np.array(
             [dataset.real_feature_index[f] for f in inner], np.int32)
         t.threshold_in_bin = np.asarray(arrays.threshold_bin[:n], np.int32).copy()
-        t.threshold = np.array(
-            [_avoid_inf(dataset.bin_mappers[f].bin_to_value(b))
-             for f, b in zip(inner, t.threshold_in_bin)], np.float64)
         dl = np.asarray(arrays.default_left[:n])
         mt = np.asarray(arrays.missing_type[:n], np.int32)
         t.decision_type = (np.where(dl, K_DEFAULT_LEFT_MASK, 0)
                            | (mt << 2)).astype(np.int8)
+        # categorical nodes: bin-membership masks -> bitset storage; the
+        # threshold slot stores the cat_idx into cat_boundaries (Tree::
+        # SplitCategorical, include/LightGBM/tree.h:120-148, 489-512)
+        if arrays.cat_mask.shape[1] > 0:
+            is_cat = np.asarray(arrays.is_cat[:n])
+            cat_masks = np.asarray(arrays.cat_mask[:n])
+            for node in np.flatnonzero(is_cat):
+                t.decision_type[node] |= K_CATEGORICAL_MASK
+                cat_idx = t.num_cat
+                mapper = dataset.bin_mappers[inner[node]]
+                bins_left = np.flatnonzero(cat_masks[node]).tolist()
+                cats_left = [int(mapper.bin_2_categorical[b])
+                             for b in bins_left
+                             if b < len(mapper.bin_2_categorical)]
+                cats_left = [c for c in cats_left if c >= 0]
+                t.cat_threshold_inner.extend(construct_bitset(bins_left))
+                t.cat_boundaries_inner.append(len(t.cat_threshold_inner))
+                t.cat_threshold.extend(construct_bitset(cats_left))
+                t.cat_boundaries.append(len(t.cat_threshold))
+                t.threshold_in_bin[node] = cat_idx
+                t.num_cat += 1
+        is_cat_nodes = (t.decision_type & K_CATEGORICAL_MASK) > 0
+        t.threshold = np.array(
+            [float(b) if c else _avoid_inf(dataset.bin_mappers[f].bin_to_value(b))
+             for f, b, c in zip(inner, t.threshold_in_bin, is_cat_nodes)],
+            np.float64)
         t.left_child = np.asarray(arrays.left_child[:n], np.int32).copy()
         t.right_child = np.asarray(arrays.right_child[:n], np.int32).copy()
         t.split_gain = np.asarray(arrays.split_gain[:n], np.float64).copy()

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from . import histogram as hist_ops
 from .split import (K_MIN_SCORE, SplitParams, SplitResult,
                     best_split_for_leaf, best_split_per_feature,
-                    select_best_feature)
+                    best_split_per_feature_mixed, select_best_feature)
 
 MISSING_NONE = 0
 MISSING_ZERO = 1
@@ -52,13 +52,17 @@ class TreeArrays(NamedTuple):
     leaf_parent: jnp.ndarray      # int32 [L]
     leaf_depth: jnp.ndarray       # int32 [L]
     num_leaves: jnp.ndarray       # int32 scalar
+    is_cat: jnp.ndarray           # bool  [N] categorical decision node
+    cat_mask: jnp.ndarray         # bool  [N, W] left-going bins; W=0 when
+    #                               the dataset has no categorical features
 
     @property
     def max_leaves(self) -> int:
         return self.leaf_value.shape[0]
 
 
-def empty_tree(max_leaves: int, dtype=jnp.float32) -> TreeArrays:
+def empty_tree(max_leaves: int, dtype=jnp.float32, cat_bins: int = 0
+               ) -> TreeArrays:
     n = max(max_leaves - 1, 1)
     zf = jnp.zeros(n, dtype)
     zi = jnp.zeros(n, jnp.int32)
@@ -71,6 +75,8 @@ def empty_tree(max_leaves: int, dtype=jnp.float32) -> TreeArrays:
         leaf_parent=jnp.full(max_leaves, -1, jnp.int32),
         leaf_depth=jnp.zeros(max_leaves, jnp.int32),
         num_leaves=jnp.asarray(1, jnp.int32),
+        is_cat=jnp.zeros(n, bool),
+        cat_mask=jnp.zeros((n, cat_bins), bool),
     )
 
 
@@ -83,11 +89,12 @@ class GrowState(NamedTuple):
 
 
 def _stack_split(res: SplitResult, cache: SplitResult, idx) -> SplitResult:
-    return SplitResult(*[c.at[idx].set(v) for c, v in zip(cache, res)])
+    return SplitResult(*[None if c is None else c.at[idx].set(v)
+                         for c, v in zip(cache, res)])
 
 
 def _index_split(cache: SplitResult, idx) -> SplitResult:
-    return SplitResult(*[c[idx] for c in cache])
+    return SplitResult(*[None if c is None else c[idx] for c in cache])
 
 
 def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
@@ -101,6 +108,7 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
               params: SplitParams,
               monotone: Optional[jnp.ndarray] = None,   # [F] int8 or None
               penalty: Optional[jnp.ndarray] = None,    # [F] or None
+              is_categorical: Optional[jnp.ndarray] = None,  # [F] bool or None
               *,
               max_leaves: int,
               max_depth: int = -1,
@@ -110,7 +118,8 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
               learner: str = "serial",
               axis_name: Optional[str] = None,
               num_machines: int = 1,
-              top_k: int = 20):
+              top_k: int = 20,
+              max_cat_threshold: int = 32):
     """Grow one leaf-wise tree; returns (TreeArrays, leaf_ids).
 
     learner/axis_name select the distributed mode when called inside
@@ -137,6 +146,11 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
         # contiguous per-shard feature slice (deterministic sharding, the
         # analogue of the bin-count-balanced shuffle at
         # feature_parallel_tree_learner.cpp:30-49)
+        if F % num_machines:
+            raise ValueError(
+                "feature-parallel requires num_features (%d) divisible by "
+                "num_machines (%d); pad features first (ParallelGrower does)"
+                % (F, num_machines))
         f_local = F // num_machines
         f_off = jax.lax.axis_index(axis_name).astype(jnp.int32) * f_local
 
@@ -148,11 +162,13 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
             _slice, (num_bins, default_bins, missing_types))
         l_monotone, l_penalty, l_feature_mask = map(
             _slice, (monotone, penalty, feature_mask))
+        l_is_categorical = _slice(is_categorical)
         l_feature_index = f_off + jnp.arange(f_local, dtype=jnp.int32)
     else:
         hist_bins = bins
         l_num_bins, l_default_bins, l_missing = num_bins, default_bins, missing_types
         l_monotone, l_penalty, l_feature_mask = monotone, penalty, feature_mask
+        l_is_categorical = is_categorical
         l_feature_index = None
 
     def reduce_hist(h):
@@ -162,13 +178,26 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
             return jax.lax.psum(h, axis_name)
         return h
 
+    def local_scan(hist, sum_g, sum_h, cnt, nb, db, mt, mono, pen, fmask,
+                   icat, findex=None):
+        """Per-feature scan (numerical or bin-type-dispatched) + argmax."""
+        if icat is None:
+            pf = best_split_per_feature(hist, sum_g, sum_h, cnt, nb, db, mt,
+                                        params, monotone=mono, penalty=pen,
+                                        feature_mask=fmask)
+        else:
+            pf = best_split_per_feature_mixed(
+                hist, sum_g, sum_h, cnt, nb, db, mt, icat, params,
+                monotone=mono, penalty=pen, feature_mask=fmask,
+                max_cat_threshold=max_cat_threshold)
+        return select_best_feature(pf, feature_index=findex)
+
     def leaf_best_split(hist, sum_g, sum_h, cnt, depth):
         if distributed and learner == "feature":
-            local = best_split_for_leaf(
+            local = local_scan(
                 hist, sum_g, sum_h, cnt,
-                l_num_bins, l_default_bins, l_missing, params,
-                monotone=l_monotone, penalty=l_penalty,
-                feature_mask=l_feature_mask)
+                l_num_bins, l_default_bins, l_missing,
+                l_monotone, l_penalty, l_feature_mask, l_is_categorical)
             # map the local winner to its global feature id
             local = local._replace(feature=jnp.where(
                 local.feature >= 0, l_feature_index[local.feature],
@@ -186,8 +215,11 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
                 local.right_sum_hessian, local.right_output])
             ivec = jnp.stack([local.feature, local.threshold,
                               local.left_count, local.right_count])
+            if local.cat_mask is not None:
+                ivec = jnp.concatenate(
+                    [ivec, local.cat_mask.astype(jnp.int32)])
             fall = jax.lax.all_gather(fvec, axis_name)             # [d, 8]
-            iall = jax.lax.all_gather(ivec, axis_name)             # [d, 4]
+            iall = jax.lax.all_gather(ivec, axis_name)             # [d, 4+W]
             winner = jnp.argmax(fall[:, 0]).astype(jnp.int32)
             fw, iw = fall[winner], iall[winner]
             res = SplitResult(
@@ -196,27 +228,28 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
                 left_sum_gradient=fw[2], left_sum_hessian=fw[3],
                 left_count=iw[2], left_output=fw[4],
                 right_sum_gradient=fw[5], right_sum_hessian=fw[6],
-                right_count=iw[3], right_output=fw[7])
+                right_count=iw[3], right_output=fw[7],
+                cat_mask=(None if local.cat_mask is None
+                          else iw[4:] > 0))
         elif distributed and learner == "voting":
             res = _voting_best_split(
                 hist, sum_g, sum_h, cnt,
                 num_bins, default_bins, missing_types, params,
-                monotone, penalty, feature_mask,
+                monotone, penalty, feature_mask, is_categorical,
                 axis_name=axis_name, num_machines=num_machines,
-                top_k=top_k)
+                top_k=top_k, max_cat_threshold=max_cat_threshold)
         else:
-            res = best_split_for_leaf(hist, sum_g, sum_h, cnt,
-                                      num_bins, default_bins, missing_types,
-                                      params, monotone=monotone,
-                                      penalty=penalty,
-                                      feature_mask=feature_mask)
+            res = local_scan(hist, sum_g, sum_h, cnt,
+                             num_bins, default_bins, missing_types,
+                             monotone, penalty, feature_mask, is_categorical)
         depth_ok = (max_depth <= 0) | (depth < max_depth)
         blocked = (res.feature < 0) | ~depth_ok
         return res._replace(gain=jnp.where(blocked, K_MIN_SCORE, res.gain),
                             feature=jnp.where(depth_ok, res.feature, -1))
 
     # ---- root ----------------------------------------------------------
-    tree = empty_tree(max_leaves, dtype)
+    tree = empty_tree(max_leaves, dtype,
+                      cat_bins=(max_bin if is_categorical is not None else 0))
     root_hist = hist_ops.leaf_histogram(hist_bins, grad, hess, row_leaf_init, 0,
                                         max_bin, hist_impl, rows_per_chunk)
     root_hist = reduce_hist(root_hist)
@@ -237,6 +270,7 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
     L = max_leaves
     hist_cache = jnp.zeros((L,) + root_hist.shape, dtype).at[0].set(root_hist)
     split_cache = SplitResult(*[
+        None if v is None else
         jnp.zeros((L,) + jnp.shape(jnp.asarray(v)), jnp.asarray(v).dtype)
         for v in root_split])
     split_cache = _stack_split(root_split, split_cache, 0)
@@ -273,6 +307,12 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
             is_missing = ((mt == MISSING_ZERO) & (col == db)) | \
                          ((mt == MISSING_NAN) & (col == mb))
             go_left = jnp.where(is_missing, sp.default_left, col <= thr)
+            if is_categorical is not None:
+                # categorical: bitset membership decides; bins outside the
+                # mask (incl. the NaN bin) go right (CategoricalDecision,
+                # tree.h:259-273)
+                go_left = jnp.where(is_categorical[feat],
+                                    sp.cat_mask[col], go_left)
             in_leaf = state.leaf_ids == best_leaf
             leaf_ids = jnp.where(in_leaf & ~go_left, new_leaf, state.leaf_ids)
 
@@ -304,7 +344,14 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
                 tree.right_child.at[parent_of].set(node), tree.right_child)
 
             depth = tree.leaf_depth[best_leaf]
+            new_is_cat = tree.is_cat
+            new_cat_mask = tree.cat_mask
+            if is_categorical is not None:
+                new_is_cat = new_is_cat.at[node].set(is_categorical[feat])
+                new_cat_mask = new_cat_mask.at[node].set(sp.cat_mask)
             tree = tree._replace(
+                is_cat=new_is_cat,
+                cat_mask=new_cat_mask,
                 split_feature=tree.split_feature.at[node].set(feat),
                 threshold_bin=tree.threshold_bin.at[node].set(thr),
                 default_left=tree.default_left.at[node].set(sp.default_left),
@@ -350,15 +397,16 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
 
 grow_tree = partial(jax.jit, static_argnames=(
     "max_leaves", "max_depth", "max_bin", "hist_impl", "rows_per_chunk",
-    "learner", "axis_name", "num_machines", "top_k"))(grow_tree_impl)
+    "learner", "axis_name", "num_machines", "top_k",
+    "max_cat_threshold"))(grow_tree_impl)
 
 
 def _voting_best_split(local_hist, sum_g, sum_h, cnt,
                        num_bins, default_bins, missing_types,
                        params: SplitParams,
-                       monotone, penalty, feature_mask,
-                       *, axis_name: str, num_machines: int, top_k: int
-                       ) -> SplitResult:
+                       monotone, penalty, feature_mask, is_categorical,
+                       *, axis_name: str, num_machines: int, top_k: int,
+                       max_cat_threshold: int = 32) -> SplitResult:
     """PV-tree best split (voting_parallel_tree_learner.cpp:257-460).
 
     local_hist [F, B, 3] holds *local-shard* rows only.  Protocol:
@@ -382,14 +430,24 @@ def _voting_best_split(local_hist, sum_g, sum_h, cnt,
     loc_h = jnp.sum(local_hist[0, :, 1])
     loc_c = jnp.round(jnp.sum(local_hist[0, :, 2])).astype(jnp.int32)
 
+    def scan(hist, sg, sh, sc, nb, db, mt, mono, pen, fmask, icat, p):
+        if icat is None:
+            return best_split_per_feature(hist, sg, sh, sc, nb, db, mt, p,
+                                          monotone=mono, penalty=pen,
+                                          feature_mask=fmask)
+        return best_split_per_feature_mixed(
+            hist, sg, sh, sc, nb, db, mt, icat, p,
+            monotone=mono, penalty=pen, feature_mask=fmask,
+            max_cat_threshold=max_cat_threshold)
+
     # params leaves may be tracers (SplitParams rides the jit pytree)
     local_params = params._replace(
         min_data_in_leaf=jnp.maximum(params.min_data_in_leaf // num_machines, 1),
         min_sum_hessian_in_leaf=params.min_sum_hessian_in_leaf / num_machines)
-    pf_local = best_split_per_feature(
-        local_hist, loc_g, loc_h, loc_c,
-        num_bins, default_bins, missing_types, local_params,
-        monotone=monotone, penalty=penalty, feature_mask=feature_mask)
+    pf_local = scan(local_hist, loc_g, loc_h, loc_c,
+                    num_bins, default_bins, missing_types,
+                    monotone, penalty, feature_mask, is_categorical,
+                    local_params)
 
     _, top_idx = jax.lax.top_k(pf_local.gain, k)                # [k]
     top_valid = jnp.take(pf_local.gain, top_idx) > K_MIN_SCORE
@@ -409,11 +467,10 @@ def _voting_best_split(local_hist, sum_g, sum_h, cnt,
     def take(a):
         return None if a is None else jnp.take(a, elected, axis=0)
 
-    pf_glob = best_split_per_feature(
-        glob_hist, sum_g, sum_h, cnt,
-        take(num_bins), take(default_bins), take(missing_types), params,
-        monotone=take(monotone), penalty=take(penalty),
-        feature_mask=take(feature_mask))
+    pf_glob = scan(glob_hist, sum_g, sum_h, cnt,
+                   take(num_bins), take(default_bins), take(missing_types),
+                   take(monotone), take(penalty), take(feature_mask),
+                   take(is_categorical), params)
     return select_best_feature(pf_glob, feature_index=elected)
 
 
@@ -446,6 +503,9 @@ def predict_leaf_inner(bins: jnp.ndarray, tree: TreeArrays,
                      ((mt == MISSING_NAN) & (col == mb))
         go_left = jnp.where(is_missing, tree.default_left[nd],
                             col <= tree.threshold_bin[nd])
+        if tree.cat_mask.shape[1] > 0:
+            go_left = jnp.where(tree.is_cat[nd], tree.cat_mask[nd, col],
+                                go_left)
         nxt = jnp.where(go_left, tree.left_child[nd], tree.right_child[nd])
         return jnp.where(node >= 0, nxt, node)
 

@@ -33,13 +33,20 @@ MISSING_NAN = 2
 
 
 class SplitParams(NamedTuple):
-    """Static split hyper-parameters (subset of Config used by the scans)."""
+    """Split hyper-parameters (subset of Config used by the scans).  Leaves
+    ride the jit pytree, so every field may be a tracer at scan time —
+    except max_cat_threshold, which bounds a scan and must stay static."""
     lambda_l1: float = 0.0
     lambda_l2: float = 0.0
     max_delta_step: float = 0.0
     min_data_in_leaf: int = 20
     min_sum_hessian_in_leaf: float = 1e-3
     min_gain_to_split: float = 0.0
+    # categorical optimal-split knobs (config.h:394-437)
+    max_cat_to_onehot: int = 4
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    min_data_per_group: int = 100
 
 
 class SplitResult(NamedTuple):
@@ -57,6 +64,12 @@ class SplitResult(NamedTuple):
     right_sum_hessian: jnp.ndarray
     right_count: jnp.ndarray    # int32
     right_output: jnp.ndarray
+    # categorical split payload: [B] bool membership mask over bins (goes
+    # left), all-False for numerical splits.  The array analogue of
+    # SplitInfo::cat_threshold (split_info.hpp:36-39); packed to the
+    # reference's uint32 bitset on the host (Tree::ConstructBitset).
+    # None only in cat-free contexts (never mixed inside one jit trace).
+    cat_mask: Optional[jnp.ndarray] = None
 
 
 class PerFeatureSplit(NamedTuple):
@@ -75,6 +88,7 @@ class PerFeatureSplit(NamedTuple):
     right_sum_hessian: jnp.ndarray
     right_count: jnp.ndarray
     right_output: jnp.ndarray
+    cat_mask: Optional[jnp.ndarray] = None   # [F, B]
 
 
 def threshold_l1(s, l1):
@@ -304,6 +318,260 @@ def select_best_feature(pf: PerFeatureSplit,
         right_sum_hessian=at(pf.right_sum_hessian) - K_EPSILON,
         right_count=at(pf.right_count),
         right_output=at(pf.right_output),
+        cat_mask=None if pf.cat_mask is None else pf.cat_mask[best_f],
+    )
+
+
+def best_split_per_feature_mixed(hist: jnp.ndarray,
+                                 sum_gradient, sum_hessian, num_data,
+                                 num_bins: jnp.ndarray,
+                                 default_bins: jnp.ndarray,
+                                 missing_types: jnp.ndarray,
+                                 is_categorical: jnp.ndarray,   # [F] bool
+                                 params: SplitParams,
+                                 monotone: Optional[jnp.ndarray] = None,
+                                 penalty: Optional[jnp.ndarray] = None,
+                                 min_constraints=None, max_constraints=None,
+                                 feature_mask: Optional[jnp.ndarray] = None,
+                                 *, max_cat_threshold: int = 32
+                                 ) -> PerFeatureSplit:
+    """Per-feature best split with the numerical/categorical scan selected
+    per feature by bin type (the find_best_threshold_fun_ dispatch,
+    feature_histogram.hpp:49-58)."""
+    pf_num = best_split_per_feature(
+        hist, sum_gradient, sum_hessian, num_data,
+        num_bins, default_bins, missing_types, params,
+        monotone=monotone, penalty=penalty,
+        min_constraints=min_constraints, max_constraints=max_constraints,
+        feature_mask=feature_mask)
+    pf_cat = best_split_categorical_per_feature(
+        hist, sum_gradient, sum_hessian, num_data,
+        num_bins, missing_types, params,
+        penalty=penalty,
+        min_constraints=min_constraints, max_constraints=max_constraints,
+        feature_mask=feature_mask, max_cat_threshold=max_cat_threshold)
+
+    def sel(num_v, cat_v):
+        ic = is_categorical
+        if cat_v.ndim == 2:
+            ic = is_categorical[:, None]
+        return jnp.where(ic, cat_v, num_v)
+
+    merged = PerFeatureSplit(*[
+        sel(n, c) for n, c in
+        zip(pf_num._replace(cat_mask=jnp.zeros_like(pf_cat.cat_mask)),
+            pf_cat)])
+    return merged
+
+
+def best_split_categorical_per_feature(hist: jnp.ndarray,
+                                       sum_gradient, sum_hessian, num_data,
+                                       num_bins: jnp.ndarray,
+                                       missing_types: jnp.ndarray,
+                                       params: SplitParams,
+                                       penalty: Optional[jnp.ndarray] = None,
+                                       min_constraints=None,
+                                       max_constraints=None,
+                                       feature_mask: Optional[jnp.ndarray] = None,
+                                       *, max_cat_threshold: int = 32
+                                       ) -> PerFeatureSplit:
+    """Categorical optimal split of every feature (FindBestThresholdCategorical,
+    feature_histogram.hpp:110-271), vectorized over features:
+
+    - one-hot mode when num_bin <= max_cat_to_onehot: each category vs rest,
+      evaluated for every bin at once;
+    - sorted mode: bins with cnt >= cat_smooth sorted by g/(h+cat_smooth),
+      prefixes from both directions scanned up to
+      min(max_cat_threshold, (used_bin+1)/2) with the min_data_per_group
+      group-accumulation walk (a lax.scan over <= max_cat_threshold steps,
+      vectorized over F).
+
+    Returns PerFeatureSplit whose threshold is unused (-1) and whose
+    cat_mask [F, B] holds the left-going category set.
+    """
+    F, B, _ = hist.shape
+    dtype = hist.dtype
+    l1 = jnp.asarray(params.lambda_l1, dtype)
+    l2n = jnp.asarray(params.lambda_l2, dtype)
+    l2 = l2n + jnp.asarray(params.cat_l2, dtype)   # hpp:172
+    mds = jnp.asarray(params.max_delta_step, dtype)
+    sum_gradient = jnp.asarray(sum_gradient, dtype)
+    sum_hessian = jnp.asarray(sum_hessian, dtype) + 2 * K_EPSILON  # hpp:79
+    num_data = jnp.asarray(num_data, jnp.int32)
+    minc1 = -jnp.inf if min_constraints is None else min_constraints   # [F]
+    maxc1 = jnp.inf if max_constraints is None else max_constraints
+    minc = minc1 if min_constraints is None else minc1[:, None]        # [F,1]
+    maxc = maxc1 if max_constraints is None else maxc1[:, None]
+
+    bins = jnp.arange(B, dtype=jnp.int32)
+    # used_bin = num_bin - 1 + (missing_type == None) (hpp:121-122)
+    used_bin = num_bins - 1 + (missing_types == MISSING_NONE).astype(jnp.int32)
+    in_used = bins[None, :] < used_bin[:, None]                  # [F, B]
+
+    g = jnp.where(in_used, hist[..., 0], 0.0)
+    h = jnp.where(in_used, hist[..., 1], 0.0)
+    c = jnp.round(jnp.where(in_used, hist[..., 2], 0.0)).astype(jnp.int32)
+
+    # min_gain_shift against the PLAIN-l2 no-split gain (hpp:119-120)
+    gain_shift = leaf_split_gain(sum_gradient, sum_hessian, l1, l2n, mds)
+    min_gain_shift = gain_shift + params.min_gain_to_split
+
+    min_cnt = jnp.maximum(params.min_data_in_leaf, 1)
+    min_hess = params.min_sum_hessian_in_leaf
+
+    # ---------------- one-hot mode (hpp:129-160) ----------------------- #
+    other_g = sum_gradient - g
+    other_h = sum_hessian - h - K_EPSILON
+    other_c = num_data - c
+    oh_gain, oh_lo, oh_ro = split_gains(other_g, other_h, g, h + K_EPSILON,
+                                        l1, l2, mds, minc, maxc, 0)
+    oh_valid = (in_used
+                & (c >= min_cnt) & (h >= min_hess)
+                & (other_c >= min_cnt) & (other_h >= min_hess))
+    oh_gain = jnp.where(oh_valid & (oh_gain > min_gain_shift),
+                        oh_gain, K_MIN_SCORE)
+    oh_best = jnp.argmax(oh_gain, axis=1)                         # [F]
+    oh_bgain = jnp.take_along_axis(oh_gain, oh_best[:, None], 1)[:, 0]
+    oh_mask = jax.nn.one_hot(oh_best, B, dtype=jnp.int32).astype(bool)
+
+    def at_b(v):
+        return jnp.take_along_axis(v, oh_best[:, None], 1)[:, 0]
+
+    onehot = dict(
+        gain=oh_bgain,
+        lg=at_b(g), lh=at_b(h) + K_EPSILON, lc=at_b(c),
+        mask=oh_mask)
+
+    # ---------------- sorted mode (hpp:161-238) ------------------------ #
+    eligible = in_used & (c.astype(dtype) >= params.cat_smooth)   # hpp:163
+    n_elig = jnp.sum(eligible, axis=1).astype(jnp.int32)          # [F]
+    ratio = jnp.where(eligible, g / (h + params.cat_smooth), jnp.inf)
+    order = jnp.argsort(ratio, axis=1).astype(jnp.int32)          # [F, B]
+    # per-direction prefix walk with group accumulation; dir 0 = ascending
+    # (+1), dir 1 = descending (-1: walk from the high end of the order)
+    max_steps = min(max_cat_threshold, B)
+    # max_num_cat = min(max_cat_threshold, (used_bin+1)/2) (hpp:185)
+    max_num_cat = jnp.minimum(max_cat_threshold, (n_elig + 1) // 2)
+
+    og = jnp.take_along_axis(g, order, axis=1)                    # [F, B]
+    oh_ = jnp.take_along_axis(h, order, axis=1)
+    oc = jnp.take_along_axis(c, order, axis=1)
+
+    def scan_dir(descending: bool):
+        if descending:
+            sg, sh, sc = og[:, ::-1], oh_[:, ::-1], oc[:, ::-1]
+            # descending starts at position n_elig-1: shift the reversed
+            # arrays so step 0 reads the last *eligible* bin
+            shift = B - n_elig                                    # [F]
+            idx = (jnp.arange(B)[None, :] + shift[:, None]) % B
+            sg = jnp.take_along_axis(sg, idx, axis=1)
+            sh = jnp.take_along_axis(sh, idx, axis=1)
+            sc = jnp.take_along_axis(sc, idx, axis=1)
+        else:
+            sg, sh, sc = og, oh_, oc
+
+        def step(carry, i):
+            cnt_grp, lg, lh, lc = carry
+            lg = lg + sg[:, i]
+            lh = lh + sh[:, i]
+            lc = lc + sc[:, i]
+            cnt_grp = cnt_grp + sc[:, i]
+            in_range = (i < n_elig) & (i < max_num_cat)
+            rc = num_data - lc
+            rh = sum_hessian - lh
+            # break conditions poison all later steps (hpp:207-212)
+            brk = (rc < min_cnt) | (rc < params.min_data_per_group) | \
+                  (rh < min_hess)
+            cont = (lc < min_cnt) | (lh < min_hess)
+            # the group resets whenever the walk reaches an evaluation,
+            # before the gain test (hpp:216-218)
+            evalable = in_range & ~brk & ~cont & \
+                (cnt_grp >= params.min_data_per_group)
+            gain, _lo, _ro = split_gains(lg, lh, sum_gradient - lg, rh,
+                                         l1, l2, mds, minc1, maxc1, 0)
+            gain = jnp.where(evalable & (gain > min_gain_shift),
+                             gain, K_MIN_SCORE)
+            cnt_grp = jnp.where(evalable, 0, cnt_grp)
+            new_dead = brk & in_range
+            return ((cnt_grp, lg, lh, lc), (gain, lg, lh, lc, new_dead))
+
+        init = (jnp.zeros(F, jnp.int32), jnp.zeros(F, dtype) ,
+                jnp.full(F, K_EPSILON, dtype), jnp.zeros(F, jnp.int32))
+        _, (gains, lgs, lhs, lcs, dead) = jax.lax.scan(
+            step, init, jnp.arange(max_steps))
+        # poison every step after the first break
+        dead_before = jnp.cumsum(dead.astype(jnp.int32), axis=0) \
+            - dead.astype(jnp.int32)
+        gains = jnp.where(dead_before > 0, K_MIN_SCORE, gains)   # [S, F]
+        best_i = jnp.argmax(gains, axis=0)                        # [F]
+        bg = jnp.take_along_axis(gains, best_i[None, :], 0)[0]
+
+        def at_i(v):
+            return jnp.take_along_axis(v, best_i[None, :], 0)[0]
+
+        # membership mask: first (best_i+1) positions of the walk
+        rank = jnp.argsort(order, axis=1)                         # bin -> pos
+        if descending:
+            pos_from_end = n_elig[:, None] - 1 - rank
+            member = (pos_from_end >= 0) & (pos_from_end <= best_i[:, None])
+        else:
+            member = rank <= best_i[:, None]
+        member = member & eligible
+        return dict(gain=bg, lg=at_i(lgs), lh=at_i(lhs), lc=at_i(lcs),
+                    mask=member)
+
+    asc = scan_dir(False)
+    desc = scan_dir(True)
+    # strict-greater update: ascending wins ties (it is scanned first,
+    # hpp:186-238 out_i order)
+    use_desc = desc["gain"] > asc["gain"]
+
+    def sel(a, d):
+        if a.ndim == 2:
+            return jnp.where(use_desc[:, None], d, a)
+        return jnp.where(use_desc, d, a)
+
+    sorted_res = {k: sel(asc[k], desc[k]) for k in asc}
+
+    # ---------------- mode select + outputs ---------------------------- #
+    use_onehot = num_bins <= params.max_cat_to_onehot             # [F]
+
+    def pick(o, s):
+        if o.ndim == 2:
+            return jnp.where(use_onehot[:, None], o, s)
+        return jnp.where(use_onehot, o, s)
+
+    res = {k: pick(onehot[k], sorted_res[k]) for k in onehot}
+    gain, lg, lh, lc = res["gain"], res["lg"], res["lh"], res["lc"]
+    rg = sum_gradient - lg
+    rh = sum_hessian - lh
+    rc = num_data - lc
+    lo = jnp.clip(calculate_splitted_leaf_output(lg, lh, l1, l2, mds),
+                  minc1, maxc1)
+    ro = jnp.clip(calculate_splitted_leaf_output(rg, rh, l1, l2, mds),
+                  minc1, maxc1)
+
+    rel_gain = gain - min_gain_shift
+    if penalty is not None:
+        rel_gain = rel_gain * penalty
+    feat_gain = jnp.where(gain > K_MIN_SCORE, rel_gain, K_MIN_SCORE)
+    if feature_mask is not None:
+        feat_gain = jnp.where(feature_mask, feat_gain, K_MIN_SCORE)
+    cat_mask = res["mask"] & (feat_gain > K_MIN_SCORE)[:, None]
+
+    return PerFeatureSplit(
+        gain=feat_gain,
+        threshold=jnp.full(F, -1, jnp.int32),
+        default_left=jnp.zeros(F, bool),      # hpp:113 default_left=false
+        left_sum_gradient=lg,
+        left_sum_hessian=lh,
+        left_count=lc,
+        left_output=lo,
+        right_sum_gradient=rg,
+        right_sum_hessian=rh,
+        right_count=rc,
+        right_output=ro,
+        cat_mask=cat_mask,
     )
 
 

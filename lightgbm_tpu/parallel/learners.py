@@ -66,38 +66,40 @@ class ParallelGrower:
         self._cache = {}
 
     # ------------------------------------------------------------------ #
-    def _build(self, statics: tuple, has_monotone: bool, has_penalty: bool):
-        key = (statics, has_monotone, has_penalty)
-        fn = self._cache.get(key)
+    def _build(self, statics: tuple):
+        fn = self._cache.get(statics)
         if fn is not None:
             return fn
-        max_leaves, max_depth, max_bin, hist_impl, rows_per_chunk = statics
+        (max_leaves, max_depth, max_bin, hist_impl, rows_per_chunk,
+         max_cat_threshold) = statics
         inner = partial(grow_ops.grow_tree_impl,
                         max_leaves=max_leaves, max_depth=max_depth,
                         max_bin=max_bin, hist_impl=hist_impl,
                         rows_per_chunk=rows_per_chunk,
                         learner=self.mode, axis_name=AXIS,
-                        num_machines=self.d, top_k=self.top_k)
+                        num_machines=self.d, top_k=self.top_k,
+                        max_cat_threshold=max_cat_threshold)
         if self.mode in ("data", "voting"):
             row = P(AXIS)
             in_specs = (P(AXIS, None), row, row, row,
-                        P(), P(), P(), P(), P(), P(), P())
+                        P(), P(), P(), P(), P(), P(), P(), P())
             out_specs = (P(), P(AXIS))
         else:  # feature: everything replicated, search sharded internally
-            in_specs = (P(), P(), P(), P(), P(), P(), P(), P(), P(), P(), P())
+            in_specs = tuple(P() for _ in range(12))
             out_specs = (P(), P())
         fn = jax.jit(jax.shard_map(inner, mesh=self.mesh,
                                    in_specs=in_specs, out_specs=out_specs,
                                    check_vma=False))
-        self._cache[key] = fn
+        self._cache[statics] = fn
         return fn
 
     # ------------------------------------------------------------------ #
     def __call__(self, bins, grad, hess, row_leaf_init, feature_mask,
                  num_bins, default_bins, missing_types, params,
-                 monotone=None, penalty=None, *,
+                 monotone=None, penalty=None, is_categorical=None, *,
                  max_leaves: int, max_depth: int = -1, max_bin: int,
-                 hist_impl: str = "auto", rows_per_chunk: int = 16384):
+                 hist_impl: str = "auto", rows_per_chunk: int = 16384,
+                 max_cat_threshold: int = 32):
         n, F = bins.shape
         d = self.d
         if self.mode in ("data", "voting"):
@@ -121,13 +123,14 @@ class ParallelGrower:
                 if penalty is not None:
                     penalty = jnp.pad(penalty, (0, pad),
                                       constant_values=1.0)
+                if is_categorical is not None:
+                    is_categorical = jnp.pad(is_categorical, (0, pad))
 
         fn = self._build((max_leaves, max_depth, max_bin, hist_impl,
-                          rows_per_chunk),
-                         monotone is not None, penalty is not None)
+                          rows_per_chunk, max_cat_threshold))
         tree, leaf_ids = fn(bins, grad, hess, row_leaf_init, feature_mask,
                             num_bins, default_bins, missing_types, params,
-                            monotone, penalty)
+                            monotone, penalty, is_categorical)
         if self.mode in ("data", "voting") and leaf_ids.shape[0] != n:
             leaf_ids = leaf_ids[:n]
         return tree, leaf_ids
@@ -138,7 +141,7 @@ def make_grower(config, dataset_num_features: int):
     src/treelearner/tree_learner.cpp:9-33): returns None for the serial
     learner, else a ParallelGrower over the local mesh."""
     mode = config.tree_learner
-    if mode in ("serial", "serial_tree_learner"):
+    if mode == "serial":
         return None
     d = resolve_num_machines(config)
     if d <= 1:

@@ -1,0 +1,212 @@
+"""Categorical optimal-split tests.
+
+Oracle: a direct numpy transliteration of FindBestThresholdCategorical
+(src/treelearner/feature_histogram.hpp:110-271) checked against the
+vectorized device scan, plus end-to-end quality/round-trip tests.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.split import (K_EPSILON, SplitParams,
+                                    best_split_categorical_per_feature,
+                                    calculate_splitted_leaf_output,
+                                    leaf_split_gain,
+                                    leaf_split_gain_given_output)
+
+MISSING_NONE = 0
+
+
+def _gain(lg, lh, rg, rh, l1, l2, mds):
+    lo = calculate_splitted_leaf_output(lg, lh, l1, l2, mds)
+    ro = calculate_splitted_leaf_output(rg, rh, l1, l2, mds)
+    return float(leaf_split_gain_given_output(lg, lh, l1, l2, lo)
+                 + leaf_split_gain_given_output(rg, rh, l1, l2, ro))
+
+
+def oracle_categorical(hist, sum_g, sum_h, n_data, num_bin, missing_type,
+                       p: SplitParams, max_cat_threshold=32):
+    """hpp:110-271 for one feature; returns (gain_rel, left_bins or None)."""
+    sum_h = sum_h + 2 * K_EPSILON
+    l2n = p.lambda_l2
+    gain_shift = float(leaf_split_gain(sum_g, sum_h, p.lambda_l1, l2n,
+                                       p.max_delta_step))
+    min_gain_shift = gain_shift + p.min_gain_to_split
+    used_bin = num_bin - 1 + (missing_type == MISSING_NONE)
+    use_onehot = num_bin <= p.max_cat_to_onehot
+    l2 = l2n + p.cat_l2
+    best_gain, best_left = -np.inf, None
+    if use_onehot:
+        for t in range(used_bin):
+            g, h, c = hist[t]
+            c = int(round(c))
+            if c < p.min_data_in_leaf or h < p.min_sum_hessian_in_leaf:
+                continue
+            oc = n_data - c
+            if oc < p.min_data_in_leaf:
+                continue
+            oh = sum_h - h - K_EPSILON
+            if oh < p.min_sum_hessian_in_leaf:
+                continue
+            og = sum_g - g
+            cur = _gain(og, oh, g, h + K_EPSILON, p.lambda_l1, l2,
+                        p.max_delta_step)
+            if cur <= min_gain_shift:
+                continue
+            if cur > best_gain:
+                best_gain, best_left = cur, [t]
+    else:
+        sorted_idx = [i for i in range(used_bin)
+                      if round(hist[i, 2]) >= p.cat_smooth]
+        ub = len(sorted_idx)
+        sorted_idx.sort(key=lambda i: hist[i, 0] / (hist[i, 1] + p.cat_smooth))
+        max_num_cat = min(max_cat_threshold, (ub + 1) // 2)
+        for dir_, start in ((1, 0), (-1, ub - 1)):
+            pos = start
+            grp = 0
+            lg, lh, lc = 0.0, K_EPSILON, 0
+            for i in range(min(ub, max_num_cat)):
+                t = sorted_idx[pos]
+                pos += dir_
+                lg += hist[t, 0]
+                lh += hist[t, 1]
+                lc += int(round(hist[t, 2]))
+                grp += int(round(hist[t, 2]))
+                if lc < p.min_data_in_leaf or lh < p.min_sum_hessian_in_leaf:
+                    continue
+                rc = n_data - lc
+                if rc < p.min_data_in_leaf or rc < p.min_data_per_group:
+                    break
+                rh = sum_h - lh
+                if rh < p.min_sum_hessian_in_leaf:
+                    break
+                if grp < p.min_data_per_group:
+                    continue
+                grp = 0
+                cur = _gain(lg, lh, sum_g - lg, rh, p.lambda_l1, l2,
+                            p.max_delta_step)
+                if cur <= min_gain_shift:
+                    continue
+                if cur > best_gain:
+                    best_gain = cur
+                    if dir_ == 1:
+                        best_left = sorted_idx[:i + 1]
+                    else:
+                        best_left = sorted_idx[ub - 1 - i:]
+    if best_left is None:
+        return -np.inf, None
+    return best_gain - min_gain_shift, sorted(best_left)
+
+
+@pytest.mark.parametrize("mode_params", [
+    dict(max_cat_to_onehot=32),                      # one-hot mode
+    dict(max_cat_to_onehot=1, cat_smooth=2.0,
+         min_data_per_group=5),                      # sorted mode
+    dict(max_cat_to_onehot=1, cat_smooth=10.0,
+         min_data_per_group=50, cat_l2=3.0),         # sorted, heavier reg
+])
+def test_cat_scan_vs_oracle(rng, mode_params):
+    import jax.numpy as jnp
+    F, B = 6, 16
+    params = SplitParams(min_data_in_leaf=5, **mode_params)
+    for trial in range(5):
+        counts = rng.randint(0, 60, (F, B)).astype(np.float64)
+        g = rng.randn(F, B) * np.sqrt(counts)
+        h = np.abs(rng.randn(F, B)) * counts * 0.1 + counts * 0.05
+        hist = np.stack([g, h, counts], axis=-1)
+        num_bins = rng.randint(4, B + 1, F).astype(np.int32)
+        for f in range(F):
+            hist[f, num_bins[f]:] = 0.0
+        missing = np.zeros(F, np.int32)
+        sum_g = hist[..., 0].sum(1)
+        sum_h = hist[..., 1].sum(1)
+        n_data = hist[..., 2].sum(1).astype(np.int32)
+
+        # scan whole leaf per feature (vectorized call takes one leaf's sums;
+        # use per-feature totals by evaluating features one at a time)
+        for f in range(F):
+            pf = best_split_categorical_per_feature(
+                jnp.asarray(hist[f:f + 1]), sum_g[f], sum_h[f], n_data[f],
+                jnp.asarray(num_bins[f:f + 1]), jnp.asarray(missing[f:f + 1]),
+                params, max_cat_threshold=8)
+            og, oleft = oracle_categorical(hist[f], sum_g[f], sum_h[f],
+                                           int(n_data[f]), int(num_bins[f]),
+                                           0, params, max_cat_threshold=8)
+            got = float(pf.gain[0])
+            if oleft is None:
+                assert got == -np.inf, (trial, f, got)
+            else:
+                assert got > -np.inf, (trial, f, og)
+                np.testing.assert_allclose(got, og, rtol=1e-4, atol=1e-7)
+                left = sorted(int(v) for v in
+                              np.flatnonzero(np.asarray(pf.cat_mask[0])))
+                # near-tied asc/desc scans can pick the same partition of
+                # eligible bins with sides swapped (the reference breaks the
+                # tie on ~1e-9 float noise); accept either side assignment
+                eligible = sorted(
+                    i for i in range(int(num_bins[f]))
+                    if round(hist[f, i, 2]) >= params.cat_smooth)
+                complement = sorted(set(eligible) - set(oleft))
+                assert left in (oleft, complement), (trial, f, left, oleft)
+
+
+def _cat_data(rng, n=2000):
+    cat = rng.randint(0, 8, n)
+    Xnum = rng.randn(n, 3)
+    y = ((cat % 3 == 0).astype(float) * 2.0 + Xnum[:, 0] * 0.3
+         + 0.1 * rng.randn(n) > 1.0).astype(float)
+    X = np.column_stack([cat.astype(float), Xnum])
+    return X, y
+
+
+@pytest.mark.parametrize("onehot", [1, 32])
+def test_cat_end_to_end(rng, onehot):
+    X, y = _cat_data(rng)
+    params = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.2,
+              "verbose": -1, "min_data_in_leaf": 20, "cat_smooth": 1.0,
+              "min_data_per_group": 10, "max_cat_to_onehot": onehot}
+    b = lgb.train(params, lgb.Dataset(X, y, categorical_feature=[0]),
+                  num_boost_round=20)
+    p = b.predict(X)
+    assert np.mean((p > 0.5) == y) > 0.95
+    # round-trip: bitsets survive the v2 text format
+    b2 = lgb.Booster(model_str=b.model_to_string())
+    np.testing.assert_allclose(b2.predict(X), p, rtol=1e-5, atol=1e-6)
+    assert b.num_trees() == 20
+
+
+def test_cat_unseen_category_goes_right(rng):
+    X, y = _cat_data(rng)
+    params = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.2,
+              "verbose": -1, "min_data_in_leaf": 20, "cat_smooth": 1.0,
+              "min_data_per_group": 10, "max_cat_to_onehot": 1}
+    b = lgb.train(params, lgb.Dataset(X, y, categorical_feature=[0]),
+                  num_boost_round=10)
+    Xq = X[:4].copy()
+    Xq[:, 0] = 999.0          # unseen category
+    Xq2 = X[:4].copy()
+    Xq2[:, 0] = np.nan        # missing
+    # both must route deterministically (right path) without crashing
+    assert np.isfinite(b.predict(Xq)).all()
+    assert np.isfinite(b.predict(Xq2)).all()
+
+
+@pytest.mark.parametrize("mode", ["data", "feature", "voting"])
+def test_cat_parallel_matches_serial(rng, mode):
+    X, y = _cat_data(rng)
+    params = {"objective": "binary", "num_leaves": 15, "learning_rate": 0.2,
+              "verbose": -1, "min_data_in_leaf": 20, "cat_smooth": 1.0,
+              "min_data_per_group": 10, "max_cat_to_onehot": 4,
+              "num_machines": 8}
+    serial = lgb.train(dict(params, tree_learner="serial"),
+                       lgb.Dataset(X, y, categorical_feature=[0]),
+                       num_boost_round=5)
+    par = lgb.train(dict(params, tree_learner=mode),
+                    lgb.Dataset(X, y, categorical_feature=[0]),
+                    num_boost_round=5)
+    ps, pp = serial.predict(X), par.predict(X)
+    # the sorted-ctr category order is tie-sensitive to psum accumulation
+    # order, so individual splits may pick equivalent near-tied partitions;
+    # assert tight drift + quality parity instead of tree identity
+    assert np.mean(np.abs(ps - pp)) < 0.01
+    assert np.mean((pp > 0.5) == y) > 0.95

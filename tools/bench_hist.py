@@ -1,0 +1,105 @@
+"""Microbench histogram formulations on the current backend."""
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+def timeit(f, *args, reps=3):
+    out = f(*args); jax.block_until_ready(out)   # compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+n, F, B = 1_000_000, 28, 256
+rng = np.random.RandomState(0)
+print("making data...", flush=True)
+bins = jnp.asarray(rng.randint(0, B, (n, F)), jnp.uint8)
+grad = jnp.asarray(rng.randn(n), jnp.float32)
+hess = jnp.asarray(np.abs(rng.randn(n)) + 0.1, jnp.float32)
+leaf_ids = jnp.asarray(rng.randint(0, 8, n), jnp.int32)
+
+def gh1(mask):
+    m = mask.astype(jnp.float32)
+    return jnp.stack([grad * m, hess * m, m], axis=-1)
+
+# A: current chunked onehot einsum
+@partial(jax.jit, static_argnames=("T",))
+def hist_onehot(bins, g, T):
+    nn = bins.shape[0]
+    pad = (-nn) % T
+    b = jnp.pad(bins, ((0, pad), (0, 0))).reshape(-1, T, F)
+    gg = jnp.pad(g, ((0, pad), (0, 0))).reshape(-1, T, 3)
+    def body(acc, c):
+        bb, g_ = c
+        oh = jax.nn.one_hot(bb, B, dtype=jnp.float32)
+        return acc + jnp.einsum("rfb,rc->fbc", oh, g_, preferred_element_type=jnp.float32), None
+    acc, _ = jax.lax.scan(body, jnp.zeros((F, B, 3), jnp.float32), (b, gg))
+    return acc
+
+# B: scan over F, [B,T]x[T,3] dots per chunk
+@partial(jax.jit, static_argnames=("T",))
+def hist_featscan(bins, g, T):
+    nn = bins.shape[0]
+    pad = (-nn) % T
+    b = jnp.pad(bins, ((0, pad), (0, 0))).reshape(-1, T, F)
+    gg = jnp.pad(g, ((0, pad), (0, 0))).reshape(-1, T, 3)
+    iota = jnp.arange(B, dtype=jnp.uint8)
+    def body(acc, c):
+        bb, g_ = c                                     # [T,F], [T,3]
+        def fbody(facc, col):                          # col [T]
+            oh = (col[:, None] == iota).astype(jnp.float32)   # [T,B]
+            return facc, jnp.einsum("tb,tc->bc", oh, g_, preferred_element_type=jnp.float32)
+        _, hists = jax.lax.scan(fbody, 0, bb.T)        # [F,B,3]
+        return acc + hists, None
+    acc, _ = jax.lax.scan(body, jnp.zeros((F, B, 3), jnp.float32), (b, gg))
+    return acc
+
+# C: batched dot_general over F in one shot per chunk
+@partial(jax.jit, static_argnames=("T",))
+def hist_batched(bins, g, T):
+    nn = bins.shape[0]
+    pad = (-nn) % T
+    b = jnp.pad(bins, ((0, pad), (0, 0))).reshape(-1, T, F)
+    gg = jnp.pad(g, ((0, pad), (0, 0))).reshape(-1, T, 3)
+    iota = jnp.arange(B, dtype=jnp.uint8)
+    def body(acc, c):
+        bb, g_ = c
+        oh = (bb.T[:, :, None] == iota).astype(jnp.bfloat16)  # [F,T,B]
+        h = jax.lax.dot_general(oh, g_.astype(jnp.bfloat16),
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [F,B,3]
+        return acc + h, None
+    acc, _ = jax.lax.scan(body, jnp.zeros((F, B, 3), jnp.float32), (b, gg))
+    return acc
+
+g = gh1(leaf_ids == 0)
+jax.block_until_ready(g)
+print("backend:", jax.default_backend(), flush=True)
+for T in (16384, 65536):
+    for name, fn in (("onehot", hist_onehot), ("featscan", hist_featscan), ("batched", hist_batched)):
+        try:
+            t = timeit(fn, bins, g, T)
+            import sys; print(f"{name:9s} T={T:6d}: {t*1e3:8.1f} ms  ({n/t/1e9:.2f} Grows/s)", flush=True)
+        except Exception as e:
+            import sys; print(f"{name:9s} T={T:6d}: FAIL {type(e).__name__}: {str(e)[:80]}")
+
+# gather cost
+@jax.jit
+def gather_rows(bins, idx):
+    return jnp.take(bins, idx, axis=0)
+idx = jnp.asarray(rng.randint(0, n, 200_000), jnp.int32)
+t = timeit(gather_rows, bins, idx)
+print(f"gather 200k rows: {t*1e3:.1f} ms")
+# mask+cumsum compact
+@jax.jit
+def compact(leaf_ids):
+    mask = leaf_ids == 0
+    pos = jnp.cumsum(mask.astype(jnp.int32))
+    idx = jnp.zeros(n, jnp.int32).at[jnp.where(mask, pos - 1, n - 1)].set(
+        jnp.arange(n, dtype=jnp.int32), mode="drop")
+    return idx, pos[-1]
+t = timeit(compact, leaf_ids)
+print(f"compact 2M rows: {t*1e3:.1f} ms")

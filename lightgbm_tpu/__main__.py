@@ -1,0 +1,7 @@
+"""`python -m lightgbm_tpu config=train.conf [key=value ...]` — the CLI
+entry point (src/main.cpp:4-23)."""
+import sys
+
+from .app import main
+
+sys.exit(main())

@@ -1,0 +1,163 @@
+"""CLI application: train / predict / convert_model / refit.
+
+The TPU build's analogue of Application (src/application/application.cpp:
+30-262, include/LightGBM/application.h:88): parse `key=value` argv +
+`config=file.conf`, dispatch on `task`.  Run as `python -m lightgbm_tpu
+config=train.conf [key=value ...]` — drop-in for the reference's
+`lightgbm config=train.conf` CLI against the same conf files
+(examples/*/*.conf parse unchanged).
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from . import basic, engine
+from .config import Config
+from .io import loader as loader_mod
+from .utils import log
+
+
+def parse_argv(argv: List[str]) -> Dict[str, str]:
+    """argv 'k=v' tokens + config-file expansion (Config::KV2Map +
+    LoadParameters, application.cpp:48-81)."""
+    params: Dict[str, str] = {}
+
+    def kv2map(token: str):
+        token = token.split("#", 1)[0].strip()
+        if not token:
+            return
+        if "=" not in token:
+            log.warning("Unknown parameter %s", token)
+            return
+        k, v = token.split("=", 1)
+        params.setdefault(k.strip(), v.strip())
+
+    for tok in argv:
+        kv2map(tok)
+    cfg_file = params.get("config")
+    if cfg_file:
+        try:
+            with open(cfg_file) as f:
+                for line in f:
+                    kv2map(line)
+        except OSError:
+            log.warning("Config file %s doesn't exist, will ignore", cfg_file)
+    return params
+
+
+class Application:
+    def __init__(self, argv: List[str]):
+        self.raw_params = parse_argv(argv)
+        self.config = Config(self.raw_params)
+        if not self.config.data and self.config.task != "convert_model":
+            log.fatal("No training/prediction data, application quit")
+
+    def run(self) -> None:
+        task = self.config.task
+        if task in ("train", "refit_tree", "refit"):
+            self.train() if task == "train" else self.refit()
+        elif task in ("predict", "prediction", "test"):
+            self.predict()
+        elif task == "convert_model":
+            self.convert_model()
+        else:
+            log.fatal("Unknown task type %s" % task)
+
+    # ------------------------------------------------------------------ #
+    def _load_train_data(self):
+        cfg = self.config
+        pre_partition = (not cfg.is_single_machine()
+                         and cfg.tree_learner in ("data", "voting")
+                         and cfg.pre_partition)
+        d = loader_mod.load_data_file(cfg, cfg.data,
+                                      num_machines=cfg.num_machines,
+                                      pre_partition=pre_partition)
+        ds = basic.Dataset(d.X, label=d.label, weight=d.weight, group=d.group,
+                           params=dict(self.raw_params),
+                           feature_name=d.feature_names or "auto",
+                           categorical_feature=d.categorical or "auto")
+        return ds
+
+    def train(self) -> None:
+        cfg = self.config
+        train_set = self._load_train_data()
+        valid_sets, valid_names = [], []
+        for i, vf in enumerate(cfg.valid):
+            vd = loader_mod.load_data_file(cfg, vf)
+            valid_sets.append(basic.Dataset(
+                vd.X, label=vd.label, weight=vd.weight, group=vd.group,
+                reference=train_set))
+            name = vf.split("/")[-1]
+            valid_names.append(name)
+        booster = engine.train(
+            dict(self.raw_params), train_set,
+            num_boost_round=cfg.num_iterations,
+            valid_sets=valid_sets, valid_names=valid_names,
+            init_model=cfg.input_model or None)
+        booster.save_model(cfg.output_model)
+        log.info("Finished training; model saved to %s", cfg.output_model)
+
+    def predict(self) -> None:
+        cfg = self.config
+        if not cfg.input_model:
+            log.fatal("Need input_model for prediction task")
+        booster = basic.Booster(model_file=cfg.input_model)
+        d = loader_mod.load_data_file(cfg, cfg.data)
+        out = booster.predict(
+            d.X, num_iteration=cfg.num_iteration_predict,
+            raw_score=cfg.predict_raw_score,
+            pred_leaf=cfg.predict_leaf_index,
+            pred_contrib=cfg.predict_contrib)
+        out = np.atleast_2d(np.asarray(out))
+        if out.shape[0] == 1 and out.size > 1:
+            out = out.T if out.shape[1] == len(d.X) else out
+        with open(cfg.output_result, "w") as f:
+            for row in np.asarray(out).reshape(len(d.X), -1):
+                f.write("\t".join(_fmt(v) for v in row) + "\n")
+        log.info("Finished prediction; results saved to %s", cfg.output_result)
+
+    def refit(self) -> None:
+        """task=refit: renew leaf values of input_model on new data
+        (application.cpp:249-262 + GBDT::RefitTree)."""
+        cfg = self.config
+        if not cfg.input_model:
+            log.fatal("Need input_model for refit task")
+        booster = basic.Booster(model_file=cfg.input_model,
+                                params=dict(self.raw_params))
+        d = loader_mod.load_data_file(cfg, cfg.data)
+        booster.refit_inplace(d.X, d.label, weight=d.weight, group=d.group)
+        booster.save_model(cfg.output_model)
+        log.info("Finished refit; model saved to %s", cfg.output_model)
+
+    def convert_model(self) -> None:
+        """task=convert_model: model file -> standalone C++ if-else code
+        (gbdt_model_text.cpp:60-242 ModelToIfElse)."""
+        cfg = self.config
+        if not cfg.input_model:
+            log.fatal("Need input_model for convert_model task")
+        if cfg.convert_model_language not in ("", "cpp"):
+            log.fatal("Unsupported convert_model_language %s"
+                      % cfg.convert_model_language)
+        booster = basic.Booster(model_file=cfg.input_model)
+        code = booster._gbdt.model_to_if_else()
+        with open(cfg.convert_model, "w") as f:
+            f.write(code)
+        log.info("Finished converting model; code saved to %s",
+                 cfg.convert_model)
+
+
+def _fmt(v) -> str:
+    return "%g" % float(v)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        Application(argv).run()
+    except log.LightGBMError as e:
+        sys.stderr.write("Met Exceptions:\n%s\n" % e)
+        return 1
+    return 0

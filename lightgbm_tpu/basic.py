@@ -344,6 +344,24 @@ class Booster:
             return self._gbdt.predict_contrib(mat, num_iteration)
         return self._gbdt.predict(mat, num_iteration, raw_score=raw_score)
 
+    def refit(self, data, label, decay_rate: float = 0.9, **kwargs) -> "Booster":
+        """New Booster with leaf values refit on (data, label)
+        (Booster.refit, python-package basic.py:2040-2074)."""
+        mat, lbl, _ = _to_matrix(data, label)
+        new_booster = Booster(model_str=self.model_to_string(),
+                              params=dict(self.params or {},
+                                          refit_decay_rate=decay_rate))
+        new_booster._gbdt.config.refit_decay_rate = decay_rate
+        new_booster._gbdt.refit(mat, lbl, **kwargs)
+        return new_booster
+
+    def refit_inplace(self, data, label, weight=None, group=None) -> "Booster":
+        """In-place leaf renewal (the CLI task=refit path,
+        application.cpp:249-262)."""
+        mat, lbl, _ = _to_matrix(data, label)
+        self._gbdt.refit(mat, lbl, weight=weight, group=group)
+        return self
+
     # -- model IO ----------------------------------------------------------
     def save_model(self, filename: str, num_iteration: int = -1,
                    start_iteration: int = 0) -> "Booster":

@@ -1,0 +1,132 @@
+"""Text-file dataset loading with config column resolution.
+
+Host-side analogue of DatasetLoader::SetHeader + LoadFromFile
+(src/io/dataset_loader.cpp:24-219): resolves label/weight/group/ignore
+columns by index ("0") or by name ("name:colname", requires header=true),
+splits them out of the parsed matrix and returns everything the Dataset
+needs.  Distributed pre-partition (rank-based row filtering,
+dataset_loader.cpp:694-740) applies when num_machines > 1 and the learner
+is data/voting parallel.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import log
+from . import parser as parser_mod
+
+
+def _resolve_column(spec: str, names: Optional[List[str]], what: str) -> int:
+    """'13' -> 13; 'name:foo' -> index of foo in header names (loader
+    SetHeader, dataset_loader.cpp:24-121).  Returns -1 for empty spec."""
+    if not spec:
+        return -1
+    if spec.startswith("name:"):
+        name = spec[5:]
+        if not names:
+            log.fatal("Could not find %s column %s in data file "
+                      "(no header)" % (what, name))
+        try:
+            return names.index(name)
+        except ValueError:
+            log.fatal("Could not find %s column %s in data file" % (what, name))
+    try:
+        return int(spec)
+    except ValueError:
+        log.fatal("%s column spec %r is not an index; use name:<col> with "
+                  "header=true" % (what, spec))
+
+
+def _resolve_list(spec: str, names: Optional[List[str]], what: str) -> List[int]:
+    if not spec:
+        return []
+    if spec.startswith("name:"):
+        return [_resolve_column("name:" + s, names, what)
+                for s in spec[5:].split(",") if s]
+    return [int(s) for s in spec.split(",") if s != ""]
+
+
+class LoadedData:
+    """Raw parse result ready for Dataset construction."""
+
+    def __init__(self, X, label, weight, group, feature_names, categorical):
+        self.X = X
+        self.label = label
+        self.weight = weight
+        self.group = group
+        self.feature_names = feature_names
+        self.categorical = categorical
+
+
+def load_data_file(config, filename: str,
+                   rank: int = 0, num_machines: int = 1,
+                   pre_partition: bool = False) -> LoadedData:
+    """Parse a CSV/TSV/LibSVM file and resolve config columns."""
+    mat, libsvm_labels, names = parser_mod.load_text_file(
+        filename, header=config.header)
+
+    if libsvm_labels is not None:
+        X, label = mat, libsvm_labels
+        weight = group = None
+        feature_names = None
+        cat = _resolve_list(config.categorical_feature, None,
+                            "categorical_feature")
+    else:
+        ncol = mat.shape[1]
+        label_idx = _resolve_column(config.label_column, names, "label")
+        if label_idx < 0:
+            label_idx = 0     # default: first column (dataset_loader.cpp:33)
+        weight_idx = _resolve_column(config.weight_column, names, "weight")
+        group_idx = _resolve_column(config.group_column, names, "group")
+        ignore = set(_resolve_list(config.ignore_column, names,
+                                   "ignore_column"))
+        cat_raw = _resolve_list(config.categorical_feature, names,
+                                "categorical_feature")
+
+        special = {label_idx} | {i for i in (weight_idx, group_idx) if i >= 0}
+        keep = [i for i in range(ncol) if i not in special and i not in ignore]
+        X = mat[:, keep]
+        label = mat[:, label_idx]
+        weight = mat[:, weight_idx] if weight_idx >= 0 else None
+        group_col = mat[:, group_idx] if group_idx >= 0 else None
+        # feature indices in config refer to the ORIGINAL columns minus the
+        # specials removed before them (reference remaps the same way)
+        remap = {orig: new for new, orig in enumerate(keep)}
+        cat = [remap[c] for c in cat_raw if c in remap]
+        feature_names = [names[i] for i in keep] if names else None
+
+        group = None
+        if group_col is not None:
+            # group column holds a query id per row -> boundaries
+            ids = group_col
+            change = np.flatnonzero(np.diff(ids)) + 1
+            bounds = np.concatenate([[0], change, [len(ids)]])
+            group = np.diff(bounds).astype(np.int32)
+
+    # query-file / weight-file side channels (<data>.query / <data>.weight,
+    # metadata.cpp LoadQueryBoundaries/LoadWeights)
+    import os
+    if group is None and os.path.exists(filename + ".query"):
+        counts = np.loadtxt(filename + ".query", dtype=np.int64, ndmin=1)
+        group = counts.astype(np.int32)
+    if weight is None and os.path.exists(filename + ".weight"):
+        weight = np.loadtxt(filename + ".weight", dtype=np.float64, ndmin=1)
+
+    if pre_partition and num_machines > 1:
+        # random row pre-partition for data-parallel training
+        # (dataset_loader.cpp:694-740); query-granular when groups exist
+        rng = np.random.RandomState(config.data_random_seed)
+        if group is not None:
+            q_of_row = np.repeat(np.arange(len(group)), group)
+            q_rank = rng.randint(0, num_machines, len(group))
+            keep_rows = q_rank[q_of_row] == rank
+            group = group[q_rank == rank]
+        else:
+            keep_rows = rng.randint(0, num_machines, len(label)) == rank
+        X, label = X[keep_rows], label[keep_rows]
+        if weight is not None:
+            weight = weight[keep_rows]
+
+    return LoadedData(X, label, weight, group, feature_names, cat)

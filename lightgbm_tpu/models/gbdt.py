@@ -512,6 +512,60 @@ class GBDT:
         self.iter = len(self.models) // max(self.num_tree_per_iteration, 1)
 
     # ------------------------------------------------------------------ #
+    def refit(self, X: np.ndarray, label: np.ndarray,
+              weight=None, group=None) -> None:
+        """Renew every tree's leaf values on new data while keeping the
+        structure (GBDT::RefitTree, gbdt.cpp:263-286 +
+        SerialTreeLearner::FitByExistingTree, serial_tree_learner.cpp:235-265).
+        """
+        from ..io.metadata import Metadata
+        from ..ops.split import calculate_splitted_leaf_output
+
+        X = np.asarray(X, np.float64)
+        n = len(X)
+        k = max(self.num_tree_per_iteration, 1)
+        if self.objective is None:
+            log.fatal("Cannot refit without an objective")
+        meta = Metadata(n)
+        meta.set_label(np.asarray(label))
+        if weight is not None:
+            meta.set_weights(np.asarray(weight))
+        if group is not None:
+            meta.set_query(np.asarray(group))
+        self.objective.init(meta, n)
+
+        leaf_preds = np.column_stack([
+            t.predict_leaf_index(X) if t.num_leaves > 1
+            else np.zeros(n, np.int32) for t in self.models])
+        cfg = self.config
+        decay = cfg.refit_decay_rate
+        score = jnp.zeros((k, n), self.dtype)
+        for it in range(len(self.models) // k):
+            grad, hess = self.objective.get_gradients(
+                score if k > 1 else score[0])
+            grad = np.reshape(np.asarray(grad), (k, n))
+            hess = np.reshape(np.asarray(hess), (k, n))
+            for kk in range(k):
+                tree = self.models[it * k + kk]
+                lp = leaf_preds[:, it * k + kk]
+                nl = tree.num_leaves
+                sum_g = np.bincount(lp, weights=grad[kk], minlength=nl)[:nl]
+                sum_h = np.bincount(lp, weights=hess[kk], minlength=nl)[:nl] \
+                    + K_EPSILON
+                out = np.asarray(calculate_splitted_leaf_output(
+                    jnp.asarray(sum_g), jnp.asarray(sum_h),
+                    cfg.lambda_l1, cfg.lambda_l2, cfg.max_delta_step))
+                tree.leaf_value[:nl] = (decay * tree.leaf_value[:nl]
+                                        + (1.0 - decay) * out * tree.shrinkage)
+                score = score.at[kk].add(
+                    jnp.asarray(tree.leaf_value[lp], self.dtype))
+
+    def model_to_if_else(self) -> str:
+        """Standalone C++ if-else prediction code for the trained model
+        (ModelToIfElse, src/boosting/gbdt_model_text.cpp:60-242)."""
+        from .codegen import model_to_if_else
+        return model_to_if_else(self)
+
     def rollback_one_iter(self) -> None:
         if self.iter <= 0:
             return

@@ -1,0 +1,319 @@
+"""scikit-learn API wrappers.
+
+Mirror of python-package/lightgbm/sklearn.py (868 LoC): LGBMModel base +
+LGBMRegressor / LGBMClassifier / LGBMRanker, with custom-objective closures
+over (y_true, y_pred [, weight, group]) and eval-metric wrappers returning
+(name, value, is_higher_better) — same calling conventions so user code
+moves over unchanged.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from . import basic, engine
+from .utils import log
+
+try:
+    from sklearn.base import BaseEstimator, ClassifierMixin, RegressorMixin
+    from sklearn.preprocessing import LabelEncoder
+    _SKLEARN = True
+except ImportError:  # pragma: no cover
+    BaseEstimator = object
+
+    class ClassifierMixin:
+        pass
+
+    class RegressorMixin:
+        pass
+    LabelEncoder = None
+    _SKLEARN = False
+
+
+def _objective_from_callable(func: Callable):
+    """Wrap sklearn-style fobj(y_true, y_pred[, weight[, group]]) into the
+    engine's fobj(preds, dataset) (sklearn.py:24-118 _ObjectiveFunctionWrapper)."""
+    def wrapped(preds, dataset):
+        labels = dataset.get_label()
+        argc = func.__code__.co_argcount
+        if argc == 2:
+            grad, hess = func(labels, preds)
+        elif argc == 3:
+            grad, hess = func(labels, preds, dataset.get_weight())
+        elif argc == 4:
+            grad, hess = func(labels, preds, dataset.get_weight(),
+                              dataset.get_group())
+        else:
+            raise TypeError("Self-defined objective should have 2-4 arguments")
+        return grad, hess
+    return wrapped
+
+
+def _eval_from_callable(func: Callable):
+    """sklearn-style feval(y_true, y_pred[, weight[, group]]) ->
+    engine feval(preds, dataset) (sklearn.py:120-214)."""
+    def wrapped(preds, dataset):
+        labels = dataset.get_label()
+        argc = func.__code__.co_argcount
+        if argc == 2:
+            return func(labels, preds)
+        if argc == 3:
+            return func(labels, preds, dataset.get_weight())
+        if argc == 4:
+            return func(labels, preds, dataset.get_weight(),
+                        dataset.get_group())
+        raise TypeError("Self-defined eval function should have 2-4 arguments")
+    return wrapped
+
+
+class LGBMModel(BaseEstimator):
+    """Base sklearn estimator (sklearn.py:216-617)."""
+
+    def __init__(self, boosting_type="gbdt", num_leaves=31, max_depth=-1,
+                 learning_rate=0.1, n_estimators=100,
+                 subsample_for_bin=200000, objective=None, class_weight=None,
+                 min_split_gain=0.0, min_child_weight=1e-3, min_child_samples=20,
+                 subsample=1.0, subsample_freq=0, colsample_bytree=1.0,
+                 reg_alpha=0.0, reg_lambda=0.0, random_state=None,
+                 n_jobs=-1, silent=True, importance_type="split", **kwargs):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.silent = silent
+        self.importance_type = importance_type
+        self._other_params = dict(kwargs)
+        self._Booster: Optional[basic.Booster] = None
+        self._evals_result = None
+        self._best_iteration = -1
+        self._best_score = {}
+        self._n_features = None
+        self._classes = None
+        self._n_classes = None
+        self.set_params(**kwargs)
+
+    # -- sklearn plumbing --------------------------------------------------
+    def get_params(self, deep=True):
+        params = super().get_params(deep=deep) if _SKLEARN else {}
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params):
+        for key, value in params.items():
+            setattr(self, key, value)
+            if hasattr(self, "_other_params"):
+                self._other_params[key] = value
+        return self
+
+    def _process_params(self) -> Dict[str, Any]:
+        params = self.get_params()
+        params.pop("silent", None)
+        params.pop("importance_type", None)
+        params.pop("n_estimators", None)
+        params.pop("class_weight", None)
+        # sklearn-alias -> native names (sklearn.py:296-318)
+        ren = {"boosting_type": "boosting", "min_split_gain": "min_gain_to_split",
+               "min_child_weight": "min_sum_hessian_in_leaf",
+               "min_child_samples": "min_data_in_leaf",
+               "subsample": "bagging_fraction", "subsample_freq": "bagging_freq",
+               "colsample_bytree": "feature_fraction",
+               "reg_alpha": "lambda_l1", "reg_lambda": "lambda_l2",
+               "random_state": "seed", "subsample_for_bin": "bin_construct_sample_cnt",
+               "n_jobs": "num_threads"}
+        for old, new in ren.items():
+            if old in params:
+                v = params.pop(old)
+                if v is not None:
+                    params[new] = v
+        if params.get("seed") is None:
+            params.pop("seed", None)
+        if self.silent:
+            params.setdefault("verbose", -1)
+        if callable(self.objective):
+            self._fobj = _objective_from_callable(self.objective)
+            params["objective"] = "none"
+        else:
+            self._fobj = None
+            if self.objective is not None:
+                params["objective"] = self.objective
+        return params
+
+    # -- fit ---------------------------------------------------------------
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_init_score=None, eval_group=None, eval_metric=None,
+            early_stopping_rounds=None, verbose=True, feature_name="auto",
+            categorical_feature="auto", callbacks=None):
+        params = self._process_params()
+        if eval_metric is not None and not callable(eval_metric):
+            params["metric"] = eval_metric
+        feval = _eval_from_callable(eval_metric) if callable(eval_metric) else None
+
+        X = np.asarray(X, np.float64)
+        self._n_features = X.shape[1]
+        train_set = basic.Dataset(X, label=y, weight=sample_weight,
+                                  group=group, init_score=init_score,
+                                  feature_name=feature_name,
+                                  categorical_feature=categorical_feature)
+        valid_sets: List[basic.Dataset] = []
+        valid_names: List[str] = []
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            for i, (vx, vy) in enumerate(eval_set):
+                vw = eval_sample_weight[i] if eval_sample_weight else None
+                vg = eval_group[i] if eval_group else None
+                vi = eval_init_score[i] if eval_init_score else None
+                valid_sets.append(basic.Dataset(
+                    np.asarray(vx, np.float64), label=vy, weight=vw, group=vg,
+                    init_score=vi, reference=train_set))
+                valid_names.append(eval_names[i] if eval_names
+                                   else "valid_%d" % i)
+
+        evals_result: Dict[str, Any] = {}
+        self._Booster = engine.train(
+            params, train_set, num_boost_round=self.n_estimators,
+            valid_sets=valid_sets or None, valid_names=valid_names or None,
+            fobj=self._fobj, feval=feval,
+            early_stopping_rounds=early_stopping_rounds,
+            evals_result=evals_result, verbose_eval=verbose,
+            callbacks=callbacks)
+        self._evals_result = evals_result
+        self._best_iteration = self._Booster.best_iteration
+        self._best_score = self._Booster.best_score
+        return self
+
+    def predict(self, X, raw_score=False, num_iteration=-1,
+                pred_leaf=False, pred_contrib=False, **kwargs):
+        if self._Booster is None:
+            raise basic.LightGBMError(
+                "Estimator not fitted, call fit before exploiting the model.")
+        X = np.asarray(X, np.float64)
+        if X.shape[1] != self._n_features:
+            raise ValueError("Number of features of the model must match the "
+                             "input. Model n_features_ is %d and input "
+                             "n_features is %d" % (self._n_features, X.shape[1]))
+        return self._Booster.predict(X, raw_score=raw_score,
+                                     num_iteration=num_iteration,
+                                     pred_leaf=pred_leaf,
+                                     pred_contrib=pred_contrib)
+
+    # -- attributes --------------------------------------------------------
+    @property
+    def n_features_(self):
+        return self._n_features
+
+    @property
+    def booster_(self) -> basic.Booster:
+        if self._Booster is None:
+            raise basic.LightGBMError("No booster found. Need to call fit first.")
+        return self._Booster
+
+    @property
+    def best_iteration_(self):
+        return self._best_iteration
+
+    @property
+    def best_score_(self):
+        return self._best_score
+
+    @property
+    def evals_result_(self):
+        return self._evals_result
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        return self.booster_.feature_importance(
+            importance_type=self.importance_type)
+
+
+class LGBMRegressor(LGBMModel, RegressorMixin):
+    """sklearn.py:619-658."""
+
+    def fit(self, X, y, **kwargs):
+        if self.objective is None:
+            self.objective = "regression"
+        return super().fit(X, y, **kwargs)
+
+
+class LGBMClassifier(LGBMModel, ClassifierMixin):
+    """sklearn.py:660-789."""
+
+    def fit(self, X, y, **kwargs):
+        y = np.asarray(y)
+        if LabelEncoder is not None:
+            self._le = LabelEncoder().fit(y)
+            y_enc = self._le.transform(y)
+            self._classes = self._le.classes_
+        else:
+            self._classes = np.unique(y)
+            y_enc = np.searchsorted(self._classes, y)
+        self._n_classes = len(self._classes)
+        if self.objective is None:
+            self.objective = ("binary" if self._n_classes <= 2
+                              else "multiclass")
+        if self._n_classes > 2:
+            self._other_params["num_class"] = self._n_classes
+            self.num_class = self._n_classes
+        return super().fit(X, y_enc, **kwargs)
+
+    def predict(self, X, raw_score=False, num_iteration=-1,
+                pred_leaf=False, pred_contrib=False, **kwargs):
+        result = self.predict_proba(X, raw_score, num_iteration,
+                                    pred_leaf, pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if result.ndim > 1:
+            idx = np.argmax(result, axis=1)
+        else:
+            idx = (result > 0.5).astype(int)
+        return np.asarray(self._classes)[idx]
+
+    def predict_proba(self, X, raw_score=False, num_iteration=-1,
+                      pred_leaf=False, pred_contrib=False, **kwargs):
+        result = super().predict(X, raw_score, num_iteration,
+                                 pred_leaf, pred_contrib, **kwargs)
+        if raw_score or pred_leaf or pred_contrib:
+            return result
+        if self._n_classes <= 2 and result.ndim == 1:
+            return np.vstack([1.0 - result, result]).T
+        return result
+
+    @property
+    def classes_(self):
+        return self._classes
+
+    @property
+    def n_classes_(self):
+        return self._n_classes
+
+
+class LGBMRanker(LGBMModel):
+    """sklearn.py:791-868."""
+
+    def fit(self, X, y, group=None, eval_group=None, eval_at=(1, 2, 3, 4, 5),
+            **kwargs):
+        if group is None:
+            raise ValueError("Should set group for ranking task")
+        if kwargs.get("eval_set") is not None and eval_group is None:
+            raise ValueError("Eval_group cannot be None when eval_set is not None")
+        if self.objective is None:
+            self.objective = "lambdarank"
+        self._other_params["ndcg_eval_at"] = list(eval_at)
+        self.eval_at = list(eval_at)
+        return super().fit(X, y, group=group, eval_group=eval_group, **kwargs)

@@ -142,14 +142,14 @@ def leaf_histogram(bins, grad, hess, leaf_ids, leaf,
                    max_bin: int, impl: str = "auto",
                    rows_per_chunk: int = 16384) -> jnp.ndarray:
     if impl == "pallas":
-        try:
+        if max_bin <= 256 and bins.dtype == jnp.uint8:
             from . import histogram_pallas
-            return histogram_pallas.leaf_histogram(bins, grad, hess, leaf_ids,
-                                                   leaf, max_bin)
-        except ImportError:
-            log.warning("Pallas histogram kernel not available yet; "
-                        "falling back to onehot")
-            impl = "onehot"
+            return histogram_pallas.leaf_histogram(
+                bins, grad, hess, leaf_ids, leaf, max_bin,
+                interpret=jax.default_backend() != "tpu")
+        log.warning("Pallas histogram kernel needs uint8 bins and "
+                    "max_bin <= 256; falling back to onehot")
+        impl = "onehot"
     if impl == "auto":
         impl = "compact" if jax.default_backend() == "tpu" else "scatter"
     if impl == "scatter":

@@ -140,6 +140,10 @@ _SCHEMA = [
     ("tpu_double_precision", bool, False),   # f64 histogram accumulate (gpu_use_dp analogue)
     ("tpu_histogram_impl", str, "auto"),     # auto|compact|onehot|scatter|pallas
     ("tpu_rows_per_tile", int, 2048),        # Pallas row-tile size
+    ("tpu_tree_engine", str, "auto"),        # auto|label|partition — partition =
+    #   arena-resident pallas engine (O(child) per split); label = masked-pass
+    #   engine (works everywhere: CPU, f64, categorical, distributed)
+    ("tpu_arena_factor", int, 6),            # partition-engine arena size, x rows
     ("num_devices", int, 0),                 # 0 = use all local devices for parallel learners
 ]
 

@@ -122,6 +122,7 @@ class GBDT:
         from ..parallel import learners as par_learners
         self._grower = par_learners.make_grower(self.config,
                                                 train_set.num_features)
+        self._setup_tree_engine()
         # bagging state
         self._bag_mask: Optional[jnp.ndarray] = None
         self._row_all_in = jnp.zeros(self.num_data, jnp.int32)
@@ -208,8 +209,11 @@ class GBDT:
             if class_ok and self.train_set.num_features > 0:
                 arrays, leaf_ids = self._grow_one_tree(grad[kk], hess[kk],
                                                        row_init)
-                if int(arrays.num_leaves) > 1:
-                    new_tree = Tree.from_arrays(arrays, self.train_set)
+                # ONE bulk device->host fetch per tree; per-field reads
+                # would pay a host round-trip each (remote-attached TPUs)
+                host_arrays = grow_ops.fetch_tree_arrays(arrays)
+                if int(host_arrays.num_leaves) > 1:
+                    new_tree = Tree.from_arrays(host_arrays, self.train_set)
 
             if new_tree.num_leaves > 1:
                 should_continue = True
@@ -240,9 +244,63 @@ class GBDT:
         self.iter += 1
         return False
 
+    def _setup_tree_engine(self) -> None:
+        """Choose label vs partition growth engine (config.tpu_tree_engine).
+
+        The partition engine (ops/grow_partition.py: arena-resident rows,
+        O(child) per split) is the TPU fast path; the label engine keeps
+        full generality (CPU/f64/categorical/distributed learners)."""
+        cfg = self.config
+        eng = cfg.tpu_tree_engine
+        eligible = (self._grower is None
+                    and self.is_categorical is None
+                    and self.dtype == jnp.float32
+                    and self.max_bin <= 256
+                    and self.train_set.num_features > 0
+                    and self.num_data < (1 << 24))
+        if eng == "partition" and not eligible:
+            log.warning("tpu_tree_engine=partition not applicable here "
+                        "(needs serial learner, f32, numerical features, "
+                        "max_bin<=256); using label engine")
+            eng = "label"
+        from ..ops import partition_pallas as pp
+        base = -(-max(self.num_data, 1) // pp.TILE) * pp.TILE
+        cap = max(cfg.tpu_arena_factor, 3) * base + 16 * pp.TILE
+        C = pp.arena_channels(max(self.train_set.num_features, 1))
+        hist_cache_bytes = (self.config.num_leaves
+                            * max(self.train_set.num_features, 1)
+                            * max(self.max_bin, 2) * 3 * 4)
+        arena_bytes = (C * cap * 4 + self.num_data * C * 4
+                       + hist_cache_bytes)      # arena + bins_t + hist cache
+        if eng == "auto":
+            # C also bounds the kernels' VMEM scratch (2 x C x TILE f32)
+            fits = arena_bytes < _device_memory_budget() and C <= 512
+            eng = ("partition" if eligible and fits
+                   and jax.default_backend() == "tpu" else "label")
+        self._use_partition_engine = eng == "partition"
+        self._bins_t = None
+        if self._use_partition_engine:
+            from ..ops import grow_partition as gp
+            self._bins_t = jnp.asarray(
+                self.train_state.bins, jnp.float32).T
+            self._arena = jnp.zeros((C, cap), jnp.float32)
+            self._grow_partition = gp.grow_tree_partition
+
     def _grow_one_tree(self, grad, hess, row_init):
         """Grow one tree via the selected learner (serial or distributed) —
         the single dispatch point shared by GBDT/DART/GOSS/RF."""
+        if self._use_partition_engine:
+            arrays, leaf_ids, self._arena = self._grow_partition(
+                self._arena, self._bins_t, grad, hess, row_init,
+                self._feature_sample(),
+                self.train_state.num_bins, self.train_state.default_bins,
+                self.train_state.missing_types,
+                self.split_params, self.monotone, self.penalty,
+                max_leaves=self.config.num_leaves,
+                max_depth=self.config.max_depth,
+                max_bin=self.max_bin,
+                interpret=jax.default_backend() != "tpu")
+            return arrays, leaf_ids
         grow_fn = (self._grower if self._grower is not None
                    else grow_ops.grow_tree)
         return grow_fn(
@@ -600,6 +658,19 @@ class GBDT:
 
     def num_model_per_iteration(self) -> int:
         return self.num_tree_per_iteration
+
+
+def _device_memory_budget() -> int:
+    """Conservative HBM budget for the partition engine's arena: 60% of the
+    default device's memory when discoverable, else 8 GB."""
+    try:
+        stats = jax.devices()[0].memory_stats()
+        total = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if total:
+            return int(total * 0.6)
+    except Exception:
+        pass
+    return 8 << 30
 
 
 def _expand_init_score(init_score, k: int, n: int) -> np.ndarray:

@@ -4,6 +4,7 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
+from ..ops import grow as grow_ops
 from ..utils import log
 from .gbdt import GBDT, K_EPSILON
 from .tree import Tree
@@ -53,8 +54,10 @@ class RF(GBDT):
                and self.train_set.num_features > 0:
                 arrays, leaf_ids = self._grow_one_tree(grad[kk], hess[kk],
                                                        row_init)
-                if int(arrays.num_leaves) > 1:
-                    new_tree = Tree.from_arrays(arrays, self.train_set)
+                # one bulk device->host fetch (see GBDT.train_one_iter)
+                host_arrays = grow_ops.fetch_tree_arrays(arrays)
+                if int(host_arrays.num_leaves) > 1:
+                    new_tree = Tree.from_arrays(host_arrays, self.train_set)
             if new_tree.num_leaves > 1:
                 self._renew_tree_output(new_tree, kk, leaf_ids)
                 if abs(self._rf_init_scores[kk]) > K_EPSILON:

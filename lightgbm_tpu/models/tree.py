@@ -69,7 +69,10 @@ class Tree:
     @classmethod
     def from_arrays(cls, arrays, dataset) -> "Tree":
         """Build from device TreeArrays + the BinnedDataset that grew it
-        (real thresholds from bin upper bounds, RealThreshold analogue)."""
+        (real thresholds from bin upper bounds, RealThreshold analogue).
+
+        Callers pass HOST arrays (grow_ops.fetch_tree_arrays) — fetching
+        per-field here would pay a device round-trip per field."""
         nl = int(arrays.num_leaves)
         t = cls(max(nl, 1))
         t.num_leaves = nl

@@ -395,6 +395,66 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
     return state.tree, state.leaf_ids
 
 
+_TREE_FLOAT_FIELDS = ("split_gain", "internal_value", "leaf_value")
+
+
+def _tree_field_spec(max_leaves: int, cat_bins: int):
+    import numpy as np
+
+    n = max(max_leaves - 1, 1)
+    L = max_leaves
+    return [("split_feature", (n,), np.int32),
+            ("threshold_bin", (n,), np.int32),
+            ("default_left", (n,), bool),
+            ("missing_type", (n,), np.int32),
+            ("left_child", (n,), np.int32),
+            ("right_child", (n,), np.int32),
+            ("split_gain", (n,), None),
+            ("internal_value", (n,), None),
+            ("internal_count", (n,), np.int32),
+            ("leaf_value", (L,), None),
+            ("leaf_count", (L,), np.int32),
+            ("leaf_parent", (L,), np.int32),
+            ("leaf_depth", (L,), np.int32),
+            ("num_leaves", (), np.int32),
+            ("is_cat", (n,), bool),
+            ("cat_mask", (n, cat_bins), bool)]
+
+
+@jax.jit
+def pack_tree_arrays(t: TreeArrays):
+    """Flatten a TreeArrays into TWO device vectors (ints exactly as int32,
+    floats in their own dtype).  One host fetch of this pair replaces ~17
+    per-field transfers, each of which pays a full round-trip on
+    remote-attached TPUs."""
+    ints, floats = [], []
+    for name, x in zip(TreeArrays._fields, t):
+        if name in _TREE_FLOAT_FIELDS:
+            floats.append(jnp.ravel(x))
+        else:
+            ints.append(jnp.ravel(x).astype(jnp.int32))
+    return jnp.concatenate(ints), jnp.concatenate(floats)
+
+
+def fetch_tree_arrays(t: TreeArrays) -> TreeArrays:
+    """Device TreeArrays -> host (numpy) TreeArrays in one bulk transfer."""
+    import numpy as np
+
+    ivec, fvec = jax.device_get(pack_tree_arrays(t))
+    cat_bins = t.cat_mask.shape[1]
+    out, ioff, foff = {}, 0, 0
+    for name, shape, dtype in _tree_field_spec(t.max_leaves, cat_bins):
+        size = int(np.prod(shape)) if shape else 1
+        if name in _TREE_FLOAT_FIELDS:
+            out[name] = fvec[foff:foff + size].reshape(shape)
+            foff += size
+        else:
+            out[name] = (ivec[ioff:ioff + size].reshape(shape)
+                         .astype(dtype))
+            ioff += size
+    return TreeArrays(**out)
+
+
 grow_tree = partial(jax.jit, static_argnames=(
     "max_leaves", "max_depth", "max_bin", "hist_impl", "rows_per_chunk",
     "learner", "axis_name", "num_machines", "top_k",

@@ -1,0 +1,319 @@
+"""Partition-engine leaf-wise tree growth (serial learner, TPU fast path).
+
+The arena re-design of SerialTreeLearner::Train (reference
+src/treelearner/serial_tree_learner.cpp:169-233): instead of the label
+engine's per-split masked pass over all n rows (ops/grow.py), rows live
+physically grouped by leaf in the feature-major f32 arena of
+ops/partition_pallas.py, so each split costs O(parent) to partition and
+O(smaller_child) to histogram — the reference's asymptotics
+(DataPartition::Split data_partition.hpp:108-160 + the smaller/larger
+histogram choreography serial_tree_learner.cpp:360-437, with the sibling
+recovered by subtraction, feature_histogram.hpp:67-73).
+
+Segment allocation is a device-side bump allocator in 256-column units:
+the larger child overwrites the parent segment in place, the smaller
+child is appended at the cursor.  On overflow the live segments are
+compacted to the front with one XLA gather (rare; the default arena
+budget covers a balanced 255-leaf tree).
+
+Restrictions vs the label engine (the GBDT driver auto-selects): serial
+learner only (no collectives), f32 only, max_bin <= 256, no categorical
+splits yet, n < 2^24 (rowids ride an f32 channel exactly).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import partition_pallas as pp
+from .grow import (MISSING_NAN, MISSING_ZERO, TreeArrays,
+                   _index_split, _stack_split, empty_tree)
+from .split import (K_MIN_SCORE, SplitParams, SplitResult,
+                    best_split_per_feature, select_best_feature)
+
+ALLOC = pp.FLUSH_W         # allocation granularity (columns)
+
+
+def _align(x, unit):
+    return (x + unit - 1) // unit * unit
+
+
+class PartState(NamedTuple):
+    tree: TreeArrays
+    arena: jnp.ndarray             # [C, cap] f32
+    leaf_start: jnp.ndarray        # [L] int32 segment starts
+    cursor: jnp.ndarray            # int32 bump cursor (256-aligned)
+    hist_cache: jnp.ndarray        # [L, F, B, 3]
+    split_cache: SplitResult
+    done: jnp.ndarray
+
+
+def grow_tree_partition_impl(
+        arena_buf: jnp.ndarray,       # [C, cap] f32 scratch (donated)
+        bins_t: jnp.ndarray,          # [F, n] f32 feature-major bins
+        grad: jnp.ndarray,            # [n] f32
+        hess: jnp.ndarray,            # [n] f32
+        row_leaf_init: jnp.ndarray,   # [n] int32: 0 in-bag, -1 out
+        feature_mask: jnp.ndarray,    # [F] bool
+        num_bins: jnp.ndarray,        # [F] int32
+        default_bins: jnp.ndarray,    # [F] int32
+        missing_types: jnp.ndarray,   # [F] int32
+        params: SplitParams,
+        monotone: Optional[jnp.ndarray] = None,
+        penalty: Optional[jnp.ndarray] = None,
+        *,
+        max_leaves: int,
+        max_depth: int = -1,
+        max_bin: int,
+        interpret: bool = False):
+    """Grow one leaf-wise tree.
+
+    Returns (TreeArrays, leaf_ids [n] int32, arena) — the arena scratch is
+    returned so the caller can thread (and donate) it across trees instead
+    of re-materializing a multi-GB zero buffer per iteration.
+    """
+    F, n = bins_t.shape
+    C, cap = arena_buf.shape
+    if n >= (1 << 24):
+        raise ValueError("partition engine supports n < 2^24 rows")
+    if C != pp.arena_channels(F):
+        raise ValueError("arena_buf channel dim mismatch")
+    dtype = jnp.float32
+    Fp = pp.feature_channels(F)
+    L = max_leaves
+    seg = partial(pp.segment_histogram, num_features=F, max_bin=max_bin,
+                  interpret=interpret)
+    part = partial(pp.partition_segment, interpret=interpret)
+
+    # ---- arena assembly (into the reused scratch; stale columns beyond n
+    # are never read: every kernel masks by segment counts) ---------------
+    rowid = jnp.arange(n, dtype=dtype)
+    chans = [bins_t.astype(dtype)]
+    if Fp > F:
+        chans.append(jnp.zeros((Fp - F, n), dtype))
+    chans += [grad.astype(dtype)[None], hess.astype(dtype)[None], rowid[None]]
+    if C > Fp + 3:
+        chans.append(jnp.zeros((C - Fp - 3, n), dtype))
+    arena = jax.lax.dynamic_update_slice(
+        arena_buf, jnp.concatenate(chans, axis=0), (0, 0))
+
+    # ---- root: in-bag rows compacted to the segment at 0 -----------------
+    in_bag = (row_leaf_init == 0)
+    pred0 = jnp.pad(in_bag.astype(dtype), (0, cap - n))[None, :]
+    oob_dst = _align(n, pp.TILE)
+    arena, counts0 = part(arena, pred0, jnp.int32(0), jnp.int32(n),
+                          jnp.int32(0), jnp.int32(oob_dst))
+    root_c = counts0[0]
+    cursor0 = jnp.int32(oob_dst + _align(n, pp.TILE))  # oob dump space
+
+    root_hist = seg(arena, jnp.int32(0), root_c)
+    root_g = jnp.sum(root_hist[0, :, 0])
+    root_h = jnp.sum(root_hist[0, :, 1])
+
+    def leaf_best_split(hist, sum_g, sum_h, cnt, depth):
+        pf = best_split_per_feature(hist, sum_g, sum_h, cnt, num_bins,
+                                    default_bins, missing_types, params,
+                                    monotone=monotone, penalty=penalty,
+                                    feature_mask=feature_mask)
+        res = select_best_feature(pf)
+        depth_ok = (max_depth <= 0) | (depth < max_depth)
+        blocked = (res.feature < 0) | ~depth_ok
+        return res._replace(gain=jnp.where(blocked, K_MIN_SCORE, res.gain),
+                            feature=jnp.where(depth_ok, res.feature, -1))
+
+    tree = empty_tree(L, dtype, cat_bins=0)
+    tree = tree._replace(leaf_count=tree.leaf_count.at[0].set(root_c))
+    root_split = leaf_best_split(root_hist, root_g, root_h, root_c,
+                                 jnp.asarray(0, jnp.int32))
+
+    hist_cache = jnp.zeros((L,) + root_hist.shape, dtype).at[0].set(root_hist)
+    split_cache = SplitResult(*[
+        None if v is None else
+        jnp.zeros((L,) + jnp.shape(jnp.asarray(v)), jnp.asarray(v).dtype)
+        for v in root_split])
+    split_cache = _stack_split(root_split, split_cache, 0)
+    split_cache = split_cache._replace(
+        gain=split_cache.gain.at[1:].set(K_MIN_SCORE))
+
+    state = PartState(
+        tree=tree, arena=arena,
+        leaf_start=jnp.zeros(L, jnp.int32), cursor=cursor0,
+        hist_cache=hist_cache, split_cache=split_cache,
+        done=jnp.asarray(False))
+
+    def cond(state: PartState):
+        return (~state.done) & (state.tree.num_leaves < L)
+
+    def body(state: PartState) -> PartState:
+        # The arena flows UNCONDITIONALLY through the (aliased) partition
+        # kernel: a lax.cond keeping the old arena value live on the
+        # not-taken path would force XLA to copy the multi-GB buffer every
+        # split.  When no split applies (done, or the bump allocator is
+        # full) the partition degenerates to cnt=0 — a no-op pass — and the
+        # small state is masked instead.
+        best_leaf = jnp.argmax(state.split_cache.gain).astype(jnp.int32)
+        sp = _index_split(state.split_cache, best_leaf)
+        no_split = sp.gain <= K_MIN_SCORE
+
+        tree = state.tree
+        nl = tree.num_leaves
+        node = nl - 1
+        new_leaf = nl
+        feat = jnp.maximum(sp.feature, 0)
+        thr = sp.threshold
+
+        left_smaller = sp.left_count <= sp.right_count
+        small_cnt = jnp.minimum(sp.left_count, sp.right_count)
+        need = _align(small_cnt, ALLOC)
+
+        # bump-allocator overflow: stop growing this tree (the arena
+        # budget covers balanced trees; pathological shapes truncate)
+        no_split = no_split | (state.cursor + need + pp.TILE > cap)
+
+        s0 = state.leaf_start[best_leaf]
+        cntP = jnp.where(no_split, 0, tree.leaf_count[best_leaf])
+        dstB = state.cursor
+
+        # go-left decision on the feature row (NumericalDecision,
+        # tree.h:429-465: missing routed by default_left)
+        col = jax.lax.dynamic_index_in_dim(
+            state.arena, feat, axis=0, keepdims=False).astype(jnp.int32)
+        mt = missing_types[feat]
+        db = default_bins[feat]
+        mb = num_bins[feat] - 1
+        is_missing = ((mt == MISSING_ZERO) & (col == db)) | \
+                     ((mt == MISSING_NAN) & (col == mb))
+        go_left = jnp.where(is_missing, sp.default_left, col <= thr)
+        # stream A (in place over the parent) takes the LARGER child:
+        # go_left XOR left_smaller == "this row goes to the larger side"
+        predA = jnp.where(go_left ^ left_smaller, jnp.float32(1.0),
+                          jnp.float32(0.0))[None, :]
+
+        arena, counts = part(state.arena, predA, s0, cntP, s0, dstB)
+
+        start_small = dstB
+        small_hist = seg(arena, start_small,
+                         jnp.where(no_split, 0, small_cnt))
+        parent_hist = state.hist_cache[best_leaf]
+        large_hist = parent_hist - small_hist
+        left_hist = jnp.where(left_smaller, small_hist, large_hist)
+        right_hist = jnp.where(left_smaller, large_hist, small_hist)
+        hist_cache = state.hist_cache.at[best_leaf].set(left_hist)
+        hist_cache = hist_cache.at[new_leaf].set(right_hist)
+
+        leaf_start = state.leaf_start.at[best_leaf].set(
+            jnp.where(left_smaller, dstB, s0))
+        leaf_start = leaf_start.at[new_leaf].set(
+            jnp.where(left_smaller, s0, dstB))
+        cursor = dstB + need
+
+        # -- tree bookkeeping (Tree::Split, tree.h:393-423) -------------
+        parent_of = tree.leaf_parent[best_leaf]
+        was_left = jnp.where(parent_of >= 0,
+                             tree.left_child[parent_of] == ~best_leaf,
+                             False)
+        left_child = jnp.where(
+            (parent_of >= 0) & was_left,
+            tree.left_child.at[parent_of].set(node), tree.left_child)
+        right_child = jnp.where(
+            (parent_of >= 0) & ~was_left,
+            tree.right_child.at[parent_of].set(node), tree.right_child)
+        depth = tree.leaf_depth[best_leaf]
+        tree = tree._replace(
+            split_feature=tree.split_feature.at[node].set(feat),
+            threshold_bin=tree.threshold_bin.at[node].set(thr),
+            default_left=tree.default_left.at[node].set(sp.default_left),
+            missing_type=tree.missing_type.at[node].set(
+                missing_types[feat]),
+            left_child=left_child.at[node].set(~best_leaf),
+            right_child=right_child.at[node].set(~new_leaf),
+            split_gain=tree.split_gain.at[node].set(sp.gain.astype(dtype)),
+            internal_value=tree.internal_value.at[node].set(
+                tree.leaf_value[best_leaf]),
+            internal_count=tree.internal_count.at[node].set(
+                sp.left_count + sp.right_count),
+            leaf_value=tree.leaf_value.at[best_leaf].set(
+                sp.left_output.astype(dtype)).at[new_leaf].set(
+                sp.right_output.astype(dtype)),
+            leaf_count=tree.leaf_count.at[best_leaf].set(
+                sp.left_count).at[new_leaf].set(sp.right_count),
+            leaf_parent=tree.leaf_parent.at[best_leaf].set(node)
+                .at[new_leaf].set(node),
+            leaf_depth=tree.leaf_depth.at[best_leaf].set(depth + 1)
+                .at[new_leaf].set(depth + 1),
+            num_leaves=nl + 1,
+        )
+
+        lsp = leaf_best_split(left_hist, sp.left_sum_gradient,
+                              sp.left_sum_hessian, sp.left_count,
+                              depth + 1)
+        rsp = leaf_best_split(right_hist, sp.right_sum_gradient,
+                              sp.right_sum_hessian, sp.right_count,
+                              depth + 1)
+        split_cache = _stack_split(lsp, state.split_cache, best_leaf)
+        split_cache = _stack_split(rsp, split_cache, new_leaf)
+
+        # merge: arena is already unchanged when no_split (cnt=0 pass);
+        # mask every small field back to its previous value
+        keep = no_split
+
+        def sel(old_v, new_v):
+            if old_v is None:
+                return None
+            return jnp.where(keep, old_v, new_v)
+
+        tree = TreeArrays(*[sel(o, nn) for o, nn in
+                            zip(state.tree, tree)])
+        split_cache = SplitResult(*[sel(o, nn) for o, nn in
+                                    zip(state.split_cache, split_cache)])
+        return PartState(
+            tree=tree, arena=arena,
+            leaf_start=sel(state.leaf_start, leaf_start),
+            cursor=sel(state.cursor, cursor),
+            hist_cache=sel(state.hist_cache, hist_cache),
+            split_cache=split_cache,
+            done=keep)
+
+    state = jax.lax.while_loop(cond, body, state)
+
+    # ---- recover row -> leaf labels from the final segments --------------
+    # Per arena position we need (leaf, leaf_start, leaf_cnt) of the segment
+    # covering it.  All three are piecewise-constant step functions of the
+    # position changing only at (address-)sorted segment starts, so each is
+    # materialized by scattering per-segment DELTAS at the starts and
+    # prefix-summing — no [cap]-sized gather or searchsorted (a TPU gather
+    # here costs ~100x more than three cumsums).
+    tree = state.tree
+    live = jnp.arange(L, dtype=jnp.int32) < tree.num_leaves
+    starts_eff = jnp.where(live, state.leaf_start, cap)  # dead slots last
+    order = jnp.argsort(starts_eff).astype(jnp.int32)
+    s_sorted = starts_eff[order]
+
+    def step_fn(values):
+        """[cap] array equal to values[r] on [s_sorted[r], s_sorted[r+1])."""
+        deltas = jnp.diff(values, prepend=0)
+        buf = jnp.zeros(cap, values.dtype)
+        buf = buf.at[jnp.clip(s_sorted, 0, cap - 1)].add(
+            jnp.where(s_sorted < cap, deltas, 0), mode="drop")
+        return jnp.cumsum(buf)
+
+    leaf_of = step_fn(order)
+    start_of = step_fn(s_sorted)
+    cnt_of = step_fn(jnp.where(live, tree.leaf_count, 0)[order])
+    pos = jnp.arange(cap, dtype=jnp.int32)
+    rel = pos - start_of
+    valid = (rel >= 0) & (rel < cnt_of)
+    Fp_row = pp.feature_channels(F)
+    rowids = state.arena[Fp_row + 2].astype(jnp.int32)
+    leaf_ids = jnp.full(n, -1, jnp.int32)
+    leaf_ids = leaf_ids.at[jnp.where(valid, rowids, n)].set(
+        leaf_of, mode="drop")
+    return tree, leaf_ids, state.arena
+
+
+grow_tree_partition = partial(jax.jit, static_argnames=(
+    "max_leaves", "max_depth", "max_bin", "interpret"),
+    donate_argnums=(0,))(grow_tree_partition_impl)

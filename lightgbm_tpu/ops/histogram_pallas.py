@@ -91,7 +91,8 @@ def _hist_kernel(leaf_ref, bins_ref, lid_ref, grad_ref, hess_ref, out_ref,
     rhs = lohot.reshape(k, m * lo_n, tile)
     part = jax.lax.dot_general(
         lhs, rhs, dimension_numbers=(((2,), (2,)), ((0,), (0,))),
-        preferred_element_type=jnp.float32)                   # [k, M, N]
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)                  # [k, M, N]
 
     @pl.when(i == 0)
     def _():
@@ -146,9 +147,16 @@ def leaf_histogram(bins, grad, hess, leaf_ids, leaf, max_bin: int,
         interpret=interpret,
     )(leaf_arr, bins_t, lid, g32, h32)
 
-    # [G, f, 3, hi_n, f', lo_n] → diagonal f == f' → [F, 3, B] → [F, B, 3]
-    G = n_blocks * k
+    hist = radix_epilogue(out, n_blocks * k, m, hi_n, lo_n)
+    return hist[:F, :max_bin, :].astype(grad.dtype)
+
+
+def radix_epilogue(out, G: int, m: int, hi_n: int, lo_n: int):
+    """Unscramble the [G*M, N] radix-matmul accumulator into [G*m, B, 3]
+    histograms: [G, f, 3, hi_n, f', lo_n] -> diagonal f == f' -> transpose.
+    Shared by the masked (leaf_histogram) and the segment
+    (partition_pallas.segment_histogram) kernels — the two must stay layout
+    identical."""
     out = out.reshape(G, m, 3, hi_n, m, lo_n)
     diag = jnp.moveaxis(jnp.diagonal(out, axis1=1, axis2=4), -1, 1)
-    hist = diag.reshape(Fp, 3, hi_n * lo_n).transpose(0, 2, 1)
-    return hist[:F, :max_bin, :].astype(grad.dtype)
+    return diag.reshape(G * m, 3, hi_n * lo_n).transpose(0, 2, 1)

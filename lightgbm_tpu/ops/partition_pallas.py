@@ -1,0 +1,384 @@
+"""Pallas TPU kernels for the partitioned (arena) tree-growth engine.
+
+The TPU re-design of the reference's ordered row partition
+(`DataPartition`, src/treelearner/data_partition.hpp:17-222) plus the
+per-leaf histogram construction it feeds (src/io/dense_bin.hpp:105-185):
+rows live physically grouped by leaf in a feature-major f32 "arena"
+`[C, cap]` whose channels are the F binned features followed by
+(grad, hess, rowid).  Leaf segments are contiguous column ranges, so
+
+- `partition_segment` splits a parent segment into its two children with
+  one sequential pass: per 256-lane sub-block it builds a compaction
+  permutation (prefix-scan of the go-left predicate -> position one-hot)
+  and applies it as an MXU matmul — a TPU has no fast scatter, so row
+  movement is expressed as dense matrix products.  Stream A may be
+  written back in place over the parent (writes provably lag reads); the
+  other child goes to the bump-allocator cursor.  This mirrors the
+  reference's smaller/larger split choreography where only the smaller
+  leaf is rebuilt (serial_tree_learner.cpp:360-437).
+- `segment_histogram` builds the [F, B, 3] grad/hess/count histogram of
+  one leaf by streaming its contiguous segment tiles through the same
+  radix-factorized MXU contraction as ops/histogram_pallas.py — per-leaf
+  cost is O(leaf_rows), the reference's asymptotics, with sequential HBM
+  reads instead of gathers.
+
+All payloads ride f32 (bins are small integers, exact; rowid is exact to
+2^24 rows — the 16.7M-row cap is checked by the caller).  Accumulation is
+f32, matching the reference GPU learner's single-precision default.
+
+Pipeline invariant in both kernels: tile j's read is complete when its
+loop iteration starts; iteration j issues read j+1, computes j (overlapped
+with that read), then waits read j+1.  In `partition_segment` the output
+writes are issued only after that wait, which makes the in-place stream
+safe: writes span at most (j+1)*tile + SUB columns past the segment start
+while reads through (j+2)*tile have completed.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .histogram_pallas import _radix_plan, radix_epilogue
+
+SUB = 256          # compaction sub-block width (lanes per permutation matmul)
+TILE = 2048        # rows per streamed tile
+N_AUX = 3          # grad, hess, rowid channels appended after features
+
+
+def feature_channels(num_features: int) -> int:
+    """Feature channels padded to the histogram kernel's block width; the
+    padding rows hold zeros and their (garbage) histograms are sliced off."""
+    return num_features + (-num_features % 8)
+
+
+def arena_channels(num_features: int) -> int:
+    """Total arena channels: padded features, then grad/hess/rowid, padded
+    for sublane tiling."""
+    c = feature_channels(num_features) + N_AUX
+    return c + (-c % 8)
+
+
+def _prefix_scan_lanes(x):
+    """Inclusive prefix sum along the last (lane) axis via log-step rolls."""
+    n = x.shape[-1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, x.ndim - 1)
+    sh = 1
+    while sh < n:
+        x = x + jnp.where(lane >= sh, pltpu.roll(x, sh, axis=x.ndim - 1), 0.0)
+        sh *= 2
+    return x
+
+
+FLUSH_W = SUB          # flush chunk width; all HBM write offsets are
+#                        multiples of FLUSH_W (tiled-memref alignment)
+CARRY_W = FLUSH_W + SUB    # per-stream carry width (append window)
+
+
+def _compact_subblock(block_k, pred_k, fill):
+    """Place the columns of `block_k` [C, S] selected by `pred_k` [1, S]
+    (0/1 f32) contiguously starting at carry position `fill` (< FLUSH_W):
+    prefix-scan -> destination one-hot P[u, fill + pos_u] [S, CARRY_W] ->
+    one [C, S] @ [S, CARRY_W] MXU matmul.  Positioning is baked into P so
+    no dynamic roll/shift of the carry is ever needed.  Returns
+    (comp [C, CARRY_W], count); columns outside [fill, fill+count) are 0."""
+    prefix = _prefix_scan_lanes(pred_k)                       # [1, S]
+    cnt_k = prefix[0, SUB - 1].astype(jnp.int32)
+    pos_col = (prefix - 1.0).astype(jnp.int32).reshape(SUB, 1) + fill
+    sel_col = pred_k.reshape(SUB, 1) > 0.5
+    t_iota = jax.lax.broadcasted_iota(jnp.int32, (SUB, CARRY_W), 1)
+    P = jnp.where((pos_col == t_iota) & sel_col,
+                  jnp.float32(1.0), jnp.float32(0.0))
+    comp = jax.lax.dot(block_k, P, preferred_element_type=jnp.float32,
+                       precision=jax.lax.Precision.HIGHEST)
+    return comp, cnt_k
+
+
+def _partition_kernel(sc_ref, arena_any, pred_any, out_any, cnt_ref,
+                      in_buf, pred_buf, carryA, carryB, flush_buf,
+                      read_sems, pred_sems, write_sems,
+                      *, C: int, tile: int):
+    """sc_ref (SMEM [4] i32): start, cnt, dstA, dstB — start, dstA and dstB
+    must be multiples of `tile` resp. FLUSH_W (the bump allocator aligns).
+    arena_any/out_any: [C, cap] f32 in HBM, aliased (same buffer).
+    pred_any: [1, cap] f32 — 1.0 routes a row to stream A, 0.0 to B.
+    cnt_ref (SMEM out [2] i32): rows written to A and B.
+
+    Each SUB-lane sub-block is compacted with an MXU permutation matmul
+    and appended into a narrow per-stream VMEM carry via dynamic-shift
+    roll + add (appends are disjoint); whenever a carry holds FLUSH_W
+    rows, that chunk is DMA'd to the stream's next FLUSH_W-aligned arena
+    columns.  Stream A may write over the parent segment in place: flushed
+    columns [dstA + wA, +FLUSH_W) always lie within the rows already read,
+    because wA + FLUSH_W <= rows consumed so far <= (j+1)*tile and tile j
+    is fully read before its sub-blocks are appended.
+    """
+    s, cnt = sc_ref[0], sc_ref[1]
+    dstA, dstB = sc_ref[2], sc_ref[3]
+    n_tiles = jax.lax.div(cnt + jnp.int32(tile - 1), jnp.int32(tile))
+    K = tile // SUB
+    lane_w = jax.lax.broadcasted_iota(jnp.int32, (C, CARRY_W), 1)
+
+    def read_dmas(j, slot):
+        src = pl.multiple_of(s + j * tile, 128)
+        return (pltpu.make_async_copy(
+                    arena_any.at[:, pl.ds(src, tile)],
+                    in_buf.at[slot], read_sems.at[slot]),
+                pltpu.make_async_copy(
+                    pred_any.at[:, pl.ds(src, tile)],
+                    pred_buf.at[slot], pred_sems.at[slot]))
+
+    def flush_dma(stream, slot, dst_col):
+        return pltpu.make_async_copy(
+            flush_buf.at[stream, slot],
+            out_any.at[:, pl.ds(pl.multiple_of(dst_col, 128), FLUSH_W)],
+            write_sems.at[stream, slot])
+
+    @pl.when(n_tiles > 0)
+    def _():
+        for d in read_dmas(0, 0):
+            d.start()
+        for d in read_dmas(0, 0):
+            d.wait()
+    carryA[:] = jnp.zeros((C, CARRY_W), jnp.float32)
+    carryB[:] = jnp.zeros((C, CARRY_W), jnp.float32)
+
+    def append_and_flush(carry, comp, ck, fill, written, dst, stream, fslot):
+        """Add comp (already positioned at `fill`) into the carry; flush one
+        FLUSH_W chunk if filled.  Returns (fill', written', fslot')."""
+        carry[:] = carry[:] + comp
+        fill = fill + ck
+
+        @pl.when(fill >= FLUSH_W)
+        def _():
+            # previous flush of this slot (two flushes ago) must have landed
+            @pl.when(written >= 2 * FLUSH_W)
+            def _():
+                flush_dma(stream, fslot, 0).wait()
+            flush_buf[stream, fslot] = carry[:, 0:FLUSH_W]
+            flush_dma(stream, fslot, dst + written).start()
+            shifted = pltpu.roll(carry[:], CARRY_W - FLUSH_W, axis=1)
+            carry[:] = jnp.where(lane_w < fill - FLUSH_W, shifted, 0.0)
+
+        flushed = fill >= FLUSH_W
+        fill = jnp.where(flushed, fill - FLUSH_W, fill)
+        written = jnp.where(flushed, written + FLUSH_W, written)
+        fslot = jnp.where(flushed, 1 - fslot, fslot)
+        return fill, written, fslot
+
+    def loop(j, carry_state):
+        fillA, wA, fsA, fillB, wB, fsB = carry_state
+        slot = jax.lax.rem(j, jnp.int32(2))
+        nslot = jax.lax.rem(j + jnp.int32(1), jnp.int32(2))
+
+        @pl.when(j + 1 < n_tiles)
+        def _():
+            for d in read_dmas(j + 1, nslot):
+                d.start()
+
+        valid = jax.lax.broadcasted_iota(
+            jnp.int32, (1, tile), 1) < (cnt - j * tile)
+        on = pred_buf[slot] > 0.5
+        predA = jnp.where(valid & on, jnp.float32(1.0), jnp.float32(0.0))
+        predB = jnp.where(valid & ~on, jnp.float32(1.0), jnp.float32(0.0))
+        block = in_buf[slot]
+
+        for k in range(K):
+            blk = block[:, k * SUB:(k + 1) * SUB]
+            compA, ca = _compact_subblock(
+                blk, predA[:, k * SUB:(k + 1) * SUB], fillA)
+            compB, cb = _compact_subblock(
+                blk, predB[:, k * SUB:(k + 1) * SUB], fillB)
+            fillA, wA, fsA = append_and_flush(
+                carryA, compA, ca, fillA, wA, dstA, 0, fsA)
+            fillB, wB, fsB = append_and_flush(
+                carryB, compB, cb, fillB, wB, dstB, 1, fsB)
+
+        @pl.when(j + 1 < n_tiles)
+        def _():
+            for d in read_dmas(j + 1, nslot):
+                d.wait()
+        return fillA, wA, fsA, fillB, wB, fsB
+
+    z = jnp.int32(0)
+    fillA, wA, fsA, fillB, wB, fsB = jax.lax.fori_loop(
+        0, n_tiles, loop, (z, z, z, z, z, z))
+
+    # Final partial flush, then drain every in-flight DMA.  With c = w /
+    # FLUSH_W loop flushes, the in-loop waits consumed the signals of
+    # flushes 0..c-3; flushes c-2 (slot fslot) and c-1 (slot 1-fslot) are
+    # still outstanding and every one must be waited before kernel exit.
+    for stream, carry, fill, w, dst, fslot in (
+            (0, carryA, fillA, wA, dstA, fsA),
+            (1, carryB, fillB, wB, dstB, fsB)):
+        @pl.when(fill > 0)
+        def _(stream=stream, carry=carry, fill=fill, w=w, dst=dst,
+              fslot=fslot):
+            @pl.when(w >= 2 * FLUSH_W)
+            def _():
+                flush_dma(stream, fslot, 0).wait()     # flush c-2
+            flush_buf[stream, fslot] = carry[:, 0:FLUSH_W]
+            flush_dma(stream, fslot, dst + w).start()
+            flush_dma(stream, fslot, 0).wait()         # the final flush
+
+        @pl.when((fill == 0) & (w >= 2 * FLUSH_W))
+        def _(stream=stream, fslot=fslot):
+            flush_dma(stream, fslot, 0).wait()         # flush c-2
+
+        @pl.when(w >= FLUSH_W)
+        def _(stream=stream, fslot=fslot):
+            flush_dma(stream, 1 - fslot, 0).wait()     # flush c-1
+
+    cnt_ref[0] = wA + fillA
+    cnt_ref[1] = wB + fillB
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def partition_segment(arena, pred, start, cnt, dstA, dstB,
+                      tile: int = TILE, interpret: bool = False):
+    """Partition arena columns [start, start+cnt) by pred into stream A at
+    dstA (dstA == start allowed: in-place with lagging writes) and stream B
+    at dstB (must not overlap [start, start+cnt+tile)).
+
+    Returns (new_arena, counts[2] int32).  Writes stay within
+    align(count, FLUSH_W) columns of each stream's dst; reads overrun the
+    segment by < tile columns, so callers keep cap >= last segment + tile.
+    """
+    C, cap = arena.shape
+    sc = jnp.stack([jnp.asarray(start), jnp.asarray(cnt),
+                    jnp.asarray(dstA), jnp.asarray(dstB)]).astype(jnp.int32)
+    kernel = functools.partial(_partition_kernel, C=C, tile=tile)
+    arena_out, counts = pl.pallas_call(
+        kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)),
+        out_shape=(jax.ShapeDtypeStruct((C, cap), jnp.float32),
+                   jax.ShapeDtypeStruct((2,), jnp.int32)),
+        scratch_shapes=[
+            pltpu.VMEM((2, C, tile), jnp.float32),
+            pltpu.VMEM((2, 1, tile), jnp.float32),
+            pltpu.VMEM((C, CARRY_W), jnp.float32),
+            pltpu.VMEM((C, CARRY_W), jnp.float32),
+            pltpu.VMEM((2, 2, C, FLUSH_W), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+        input_output_aliases={1: 0},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(sc, arena, pred)
+    return arena_out, counts
+
+
+def _seg_hist_kernel(sc_ref, arena_any, out_ref, in_buf, read_sems,
+                     *, C: int, F: int,
+                     n_blocks: int, k: int, m: int, lo_n: int, hi_n: int,
+                     tile: int):
+    """sc_ref (SMEM [2] i32): start, cnt.  out_ref VMEM [n_blocks*k*M, N]."""
+    s, cnt = sc_ref[0], sc_ref[1]
+    n_tiles = jax.lax.div(cnt + jnp.int32(tile - 1), jnp.int32(tile))
+    M, N = 3 * hi_n * m, lo_n * m
+    f_blk = k * m
+
+    def read_dma(j, slot):
+        src = pl.multiple_of(s + j * tile, 128)
+        return pltpu.make_async_copy(
+            arena_any.at[:, pl.ds(src, tile)],
+            in_buf.at[slot], read_sems.at[slot])
+
+    out_ref[:] = jnp.zeros_like(out_ref)
+
+    @pl.when(n_tiles > 0)
+    def _():
+        read_dma(0, 0).start()
+        read_dma(0, 0).wait()
+
+    def loop(j, _):
+        slot = jax.lax.rem(j, jnp.int32(2))
+
+        @pl.when(j + 1 < n_tiles)
+        def _():
+            read_dma(j + 1, jax.lax.rem(j + jnp.int32(1), jnp.int32(2))).start()
+
+        block = in_buf[slot]                              # [C, T]
+        valid = (jax.lax.broadcasted_iota(jnp.int32, (1, tile), 1)
+                 < (cnt - j * tile)).astype(jnp.float32)
+        Fp = n_blocks * f_blk
+        g = block[Fp:Fp + 1, :] * valid
+        h = block[Fp + 1:Fp + 2, :] * valid
+        gh = jnp.concatenate([g, h, valid], axis=0)       # [3, T]
+
+        for b in range(n_blocks):
+            bins = block[b * f_blk:(b + 1) * f_blk, :]    # [f_blk, T]
+            hi = jnp.floor(bins * (1.0 / lo_n))
+            lo = bins - hi * lo_n
+            hih = jnp.where(
+                hi.astype(jnp.int32)[:, None, :]
+                == jax.lax.broadcasted_iota(jnp.int32, (1, hi_n, 1), 1),
+                jnp.float32(1.0), jnp.float32(0.0))                                 # [f_blk,hi_n,T]
+            loh = jnp.where(
+                lo.astype(jnp.int32)[:, None, :]
+                == jax.lax.broadcasted_iota(jnp.int32, (1, lo_n, 1), 1),
+                jnp.float32(1.0), jnp.float32(0.0))                                 # [f_blk,lo_n,T]
+            lhs = (gh[None, :, None, :] * hih[:, None, :, :]).reshape(
+                k, M, tile)
+            rhs = loh.reshape(k, N, tile)
+            part = jax.lax.dot_general(
+                lhs, rhs, dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)      # [k, M, N]
+            out_ref[b * k * M:(b + 1) * k * M, :] = (
+                out_ref[b * k * M:(b + 1) * k * M, :]
+                + part.reshape(k * M, N))
+
+        @pl.when(j + 1 < n_tiles)
+        def _():
+            read_dma(j + 1, jax.lax.rem(j + jnp.int32(1), jnp.int32(2))).wait()
+        return 0
+
+    jax.lax.fori_loop(0, n_tiles, loop, 0)
+
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_features", "max_bin", "tile",
+                                    "interpret"))
+def segment_histogram(arena, start, cnt, num_features: int, max_bin: int,
+                      tile: int = TILE, interpret: bool = False):
+    """[F, max_bin, 3] f32 histogram of arena columns [start, start+cnt)."""
+    C, cap = arena.shape
+    F = num_features
+    lo_n, hi_n, m = _radix_plan(max_bin)
+    f_blk = max(m, 8)
+    k = f_blk // m
+    n_blocks = feature_channels(F) // f_blk
+    if n_blocks * f_blk + N_AUX > C:
+        raise ValueError("arena channels too small for feature layout")
+    M, N = 3 * hi_n * m, lo_n * m
+    sc = jnp.stack([jnp.asarray(start), jnp.asarray(cnt)]).astype(jnp.int32)
+    kernel = functools.partial(
+        _seg_hist_kernel, C=C, F=F, n_blocks=n_blocks, k=k, m=m,
+        lo_n=lo_n, hi_n=hi_n, tile=tile)
+    out = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n_blocks * k * M, N), jnp.float32),
+        scratch_shapes=[
+            pltpu.VMEM((2, C, tile), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(sc, arena)
+    hist = radix_epilogue(out, n_blocks * k, m, lo_n=lo_n, hi_n=hi_n)
+    return hist[:F, :max_bin, :]

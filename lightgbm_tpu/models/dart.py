@@ -18,6 +18,7 @@ class DART(GBDT):
     def __init__(self, config, train_set, objective, metrics=()):
         super().__init__(config, train_set, objective, metrics)
         self._drop_rng = np.random.RandomState(config.drop_seed)
+        self._allow_deferred = False  # _normalize reads host trees per iter
         self.tree_weight: List[float] = []
         self.sum_weight = 0.0
         self._drop_index: List[int] = []

@@ -76,6 +76,11 @@ class GBDT:
         self.best_iteration = 0
         self._bag_rng = np.random.RandomState(config.bagging_seed)
         self._feat_rng = np.random.RandomState(config.feature_fraction_seed)
+        # deferred-tree pipeline state (train_one_iter/_drain_inflight);
+        # subclasses that need host trees within the iteration opt out
+        self._allow_deferred = True
+        self._inflight: List[dict] = []
+        self._deferred_stopped = False
 
         if train_set is not None:
             self._setup_train(train_set)
@@ -134,6 +139,7 @@ class GBDT:
 
     def add_valid(self, name: str, valid_set: BinnedDataset,
                   metrics: Sequence[Metric]) -> None:
+        self._sync_model()
         state = _DatasetState(valid_set, self.num_tree_per_iteration, self.dtype)
         if valid_set.metadata.init_score is not None:
             init = _expand_init_score(valid_set.metadata.init_score,
@@ -183,6 +189,15 @@ class GBDT:
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
         """Returns True when training cannot continue (no splittable leaves)."""
+        # Materialize the previous iteration's trees first: their packed
+        # device->host copies have been in flight during the gap, so the
+        # blocking wait is short (the ~100ms fetch round-trip per iteration
+        # otherwise dominates on remote-attached TPUs).  If that iteration
+        # turned out degenerate, stop exactly like the eager path would.
+        if self._drain_inflight() or self._deferred_stopped:
+            self._deferred_stopped = True
+            return True
+
         k = self.num_tree_per_iteration
         init_scores = [0.0] * k
         if gradients is None or hessians is None:
@@ -200,8 +215,15 @@ class GBDT:
         # here (goss.hpp:87-135); default is identity
         grad, hess = self._sample_gradients(grad, hess)
         row_init = self._bagging(self.iter)
+        # deferred (pipelined) tree materialization: only when nothing needs
+        # the host tree inside this iteration
+        deferred_ok = (self._allow_deferred and not self.valid_states
+                       and not self.train_metrics
+                       and (self.objective is None
+                            or not self.objective.is_renew_tree_output()))
 
         should_continue = False
+        deferred_any = False
         for kk in range(k):
             new_tree = Tree(1)
             class_ok = (self.objective is None
@@ -209,6 +231,19 @@ class GBDT:
             if class_ok and self.train_set.num_features > 0:
                 arrays, leaf_ids = self._grow_one_tree(grad[kk], hess[kk],
                                                        row_init)
+                if deferred_ok:
+                    packed = grow_ops.pack_tree_arrays(arrays)
+                    for p in packed:
+                        p.copy_to_host_async()
+                    self._update_train_score_device(arrays, kk, leaf_ids)
+                    self.models.append(None)       # placeholder; drained next
+                    self._inflight.append(dict(
+                        packed=packed, max_leaves=arrays.max_leaves,
+                        cat_bins=arrays.cat_mask.shape[1],
+                        init_score=init_scores[kk],
+                        slot=len(self.models) - 1))
+                    deferred_any = True
+                    continue
                 # ONE bulk device->host fetch per tree; per-field reads
                 # would pay a host round-trip each (remote-attached TPUs)
                 host_arrays = grow_ops.fetch_tree_arrays(arrays)
@@ -235,6 +270,10 @@ class GBDT:
                         vs.add_constant(output, kk)
             self.models.append(new_tree)
 
+        if deferred_any:
+            # continuation decided when this iteration drains
+            self.iter += 1
+            return False
         if not should_continue:
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
@@ -242,6 +281,56 @@ class GBDT:
                 del self.models[-k:]
             return True
         self.iter += 1
+        return False
+
+    def _update_train_score_device(self, arrays, class_id: int, leaf_ids):
+        """Score update straight from device TreeArrays (deferred path) —
+        equivalent to shrink + _update_train_score on the host tree."""
+        lv = arrays.leaf_value * jnp.asarray(self.shrinkage_rate, self.dtype)
+        lids = leaf_ids
+        if self._bag_mask is not None:
+            walked = grow_ops.predict_leaf_inner(
+                self.train_state.bins, arrays, self.train_state.num_bins,
+                self.train_state.default_bins)
+            lids = jnp.where(lids >= 0, lids, walked)
+        self.train_state.score = self.train_state.score.at[class_id].add(
+            lv[jnp.clip(lids, 0, arrays.max_leaves - 1)])
+
+    def _drain_inflight(self) -> bool:
+        """Materialize pending deferred trees.  Returns True when the
+        drained iteration was degenerate (no splittable leaves): its model
+        entries are removed and the iteration rolled back, mirroring the
+        eager stop (its device score updates added all-zero leaf values,
+        so scores need no undo)."""
+        if not self._inflight:
+            return False
+        pending, self._inflight = self._inflight, []
+        any_grew = False
+        for ent in pending:
+            ivec, fvec = (np.asarray(ent["packed"][0]),
+                          np.asarray(ent["packed"][1]))
+            host_arrays = grow_ops.unpack_tree_vectors(
+                ivec, fvec, ent["max_leaves"], ent["cat_bins"])
+            new_tree = Tree(1)
+            if int(host_arrays.num_leaves) > 1:
+                new_tree = Tree.from_arrays(host_arrays, self.train_set)
+                new_tree.shrink(self.shrinkage_rate)
+                if abs(ent["init_score"]) > K_EPSILON:
+                    new_tree.add_bias(ent["init_score"])
+                any_grew = True
+            self.models[ent["slot"]] = new_tree
+        if not any_grew:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+            # roll the WHOLE iteration back (its k trees are the last ones
+            # appended — deferred placeholders plus any eagerly-added
+            # constant trees), mirroring the eager stop; like the eager
+            # path, the very first iteration's constant trees are kept
+            k = self.num_tree_per_iteration
+            if len(self.models) > k:
+                del self.models[-k:]
+            self.iter -= 1
+            return True
         return False
 
     def _setup_tree_engine(self) -> None:
@@ -394,10 +483,18 @@ class GBDT:
     # ------------------------------------------------------------------ #
     # Evaluation (gbdt.cpp:476-533)
     # ------------------------------------------------------------------ #
+    def _sync_model(self) -> None:
+        """Materialize any deferred trees before the model is read; a stop
+        detected here must still end training on the next update."""
+        if self._drain_inflight():
+            self._deferred_stopped = True
+
     def eval_train(self) -> Dict[str, List[float]]:
+        self._sync_model()
         return self._eval_state(self.train_state, self.train_metrics)
 
     def eval_valid(self) -> Dict[str, Dict[str, List[float]]]:
+        self._sync_model()
         return {name: self._eval_state(vs, metrics)
                 for name, vs, metrics in self.valid_states}
 
@@ -415,6 +512,7 @@ class GBDT:
     # Prediction on raw features (gbdt_prediction.cpp)
     # ------------------------------------------------------------------ #
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        self._sync_model()
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         if X.ndim != 2 or X.shape[1] <= self.max_feature_idx:
             log.fatal("The number of features in data (%d) is not the same as "
@@ -444,10 +542,12 @@ class GBDT:
         return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
 
     def predict_contrib(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        self._sync_model()
         from .shap import predict_contrib as _shap
         return _shap(self, X, num_iteration)
 
     def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        self._sync_model()
         X = np.asarray(X, np.float64)
         k = self.num_tree_per_iteration
         total_iters = len(self.models) // max(k, 1)
@@ -462,6 +562,7 @@ class GBDT:
     # ------------------------------------------------------------------ #
     def feature_importance(self, importance_type: str = "split",
                            num_iteration: int = -1) -> np.ndarray:
+        self._sync_model()
         n_feat = self.max_feature_idx + 1
         imp = np.zeros(n_feat, np.float64)
         k = max(self.num_tree_per_iteration, 1)
@@ -477,6 +578,7 @@ class GBDT:
 
     def save_model_to_string(self, start_iteration: int = 0,
                              num_iteration: int = -1) -> str:
+        self._sync_model()
         ss = [self.sub_model_name, "version=v2",
               "num_class=%d" % self.num_class,
               "num_tree_per_iteration=%d" % self.num_tree_per_iteration,
@@ -572,6 +674,7 @@ class GBDT:
     # ------------------------------------------------------------------ #
     def refit(self, X: np.ndarray, label: np.ndarray,
               weight=None, group=None) -> None:
+        self._sync_model()
         """Renew every tree's leaf values on new data while keeping the
         structure (GBDT::RefitTree, gbdt.cpp:263-286 +
         SerialTreeLearner::FitByExistingTree, serial_tree_learner.cpp:235-265).
@@ -619,12 +722,14 @@ class GBDT:
                     jnp.asarray(tree.leaf_value[lp], self.dtype))
 
     def model_to_if_else(self) -> str:
+        self._sync_model()
         """Standalone C++ if-else prediction code for the trained model
         (ModelToIfElse, src/boosting/gbdt_model_text.cpp:60-242)."""
         from .codegen import model_to_if_else
         return model_to_if_else(self)
 
     def rollback_one_iter(self) -> None:
+        self._sync_model()
         if self.iter <= 0:
             return
         k = self.num_tree_per_iteration

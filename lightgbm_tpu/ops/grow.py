@@ -436,14 +436,13 @@ def pack_tree_arrays(t: TreeArrays):
     return jnp.concatenate(ints), jnp.concatenate(floats)
 
 
-def fetch_tree_arrays(t: TreeArrays) -> TreeArrays:
-    """Device TreeArrays -> host (numpy) TreeArrays in one bulk transfer."""
+def unpack_tree_vectors(ivec, fvec, max_leaves: int,
+                        cat_bins: int) -> TreeArrays:
+    """Host-side inverse of pack_tree_arrays (numpy in, numpy out)."""
     import numpy as np
 
-    ivec, fvec = jax.device_get(pack_tree_arrays(t))
-    cat_bins = t.cat_mask.shape[1]
     out, ioff, foff = {}, 0, 0
-    for name, shape, dtype in _tree_field_spec(t.max_leaves, cat_bins):
+    for name, shape, dtype in _tree_field_spec(max_leaves, cat_bins):
         size = int(np.prod(shape)) if shape else 1
         if name in _TREE_FLOAT_FIELDS:
             out[name] = fvec[foff:foff + size].reshape(shape)
@@ -453,6 +452,12 @@ def fetch_tree_arrays(t: TreeArrays) -> TreeArrays:
                          .astype(dtype))
             ioff += size
     return TreeArrays(**out)
+
+
+def fetch_tree_arrays(t: TreeArrays) -> TreeArrays:
+    """Device TreeArrays -> host (numpy) TreeArrays in one bulk transfer."""
+    ivec, fvec = jax.device_get(pack_tree_arrays(t))
+    return unpack_tree_vectors(ivec, fvec, t.max_leaves, t.cat_mask.shape[1])
 
 
 grow_tree = partial(jax.jit, static_argnames=(

@@ -29,8 +29,8 @@ import jax
 import jax.numpy as jnp
 
 from . import partition_pallas as pp
-from .grow import (MISSING_NAN, MISSING_ZERO, TreeArrays,
-                   _index_split, _stack_split, empty_tree)
+from .grow import (TreeArrays, _index_split, _stack_split,
+                   empty_tree)
 from .split import (K_MIN_SCORE, SplitParams, SplitResult,
                     best_split_per_feature, select_best_feature)
 
@@ -177,22 +177,16 @@ def grow_tree_partition_impl(
         cntP = jnp.where(no_split, 0, tree.leaf_count[best_leaf])
         dstB = state.cursor
 
-        # go-left decision on the feature row (NumericalDecision,
-        # tree.h:429-465: missing routed by default_left)
-        col = jax.lax.dynamic_index_in_dim(
-            state.arena, feat, axis=0, keepdims=False).astype(jnp.int32)
-        mt = missing_types[feat]
-        db = default_bins[feat]
-        mb = num_bins[feat] - 1
-        is_missing = ((mt == MISSING_ZERO) & (col == db)) | \
-                     ((mt == MISSING_NAN) & (col == mb))
-        go_left = jnp.where(is_missing, sp.default_left, col <= thr)
-        # stream A (in place over the parent) takes the LARGER child:
-        # go_left XOR left_smaller == "this row goes to the larger side"
-        predA = jnp.where(go_left ^ left_smaller, jnp.float32(1.0),
-                          jnp.float32(0.0))[None, :]
-
-        arena, counts = part(state.arena, predA, s0, cntP, s0, dstB)
+        # the go-left decision (NumericalDecision, tree.h:429-465, with
+        # missing routed by default_left) is evaluated INSIDE the kernel —
+        # an XLA-side predicate would cost an O(cap) pass per split.
+        # Stream A (in place over the parent) takes the LARGER child:
+        # go_left XOR left_smaller == "row goes to the larger side".
+        decision = (feat, thr, sp.default_left.astype(jnp.int32),
+                    missing_types[feat], default_bins[feat],
+                    num_bins[feat] - 1, left_smaller.astype(jnp.int32))
+        arena, counts = part(state.arena, pred0, s0, cntP, s0, dstB,
+                             decision=decision)
 
         start_small = dstB
         small_hist = seg(arena, start_small,

@@ -97,14 +97,23 @@ def _compact_subblock(block_k, pred_k, fill):
     return comp, cnt_k
 
 
-def _partition_kernel(sc_ref, arena_any, pred_any, out_any, cnt_ref,
+def _partition_kernel(sc_ref, feat_onehot_ref, arena_any, pred_any,
+                      out_any, cnt_ref,
                       in_buf, pred_buf, carryA, carryB, flush_buf,
                       read_sems, pred_sems, write_sems,
                       *, C: int, tile: int):
-    """sc_ref (SMEM [4] i32): start, cnt, dstA, dstB — start, dstA and dstB
-    must be multiples of `tile` resp. FLUSH_W (the bump allocator aligns).
+    """sc_ref (SMEM [11] i32): start, cnt, dstA, dstB, mode, thr, dl, mt,
+    db, mb, xr — start, dstA and dstB must be multiples of `tile` resp.
+    FLUSH_W (the bump allocator aligns).
     arena_any/out_any: [C, cap] f32 in HBM, aliased (same buffer).
-    pred_any: [1, cap] f32 — 1.0 routes a row to stream A, 0.0 to B.
+    Routing: mode=0 reads pred_any ([1, cap] f32, 1.0 -> stream A); mode=1
+    computes the split decision in-kernel — the feature row is extracted
+    with a one-hot matvec (feat_onehot_ref [1, C], bins < 256 are
+    bf16-exact) and a row goes to stream A when the reference's
+    NumericalDecision (tree.h:429-465) XOR'd with dl says "larger child":
+    dl is the node's default_left, xr is XOR'd in (1 when the left child
+    is the smaller/bump-allocated side), and missing bins are identified
+    via mt (missing type), db (default bin), mb (last bin).
     cnt_ref (SMEM out [2] i32): rows written to A and B.
 
     Each SUB-lane sub-block is compacted with an MXU permutation matmul
@@ -118,12 +127,19 @@ def _partition_kernel(sc_ref, arena_any, pred_any, out_any, cnt_ref,
     """
     s, cnt = sc_ref[0], sc_ref[1]
     dstA, dstB = sc_ref[2], sc_ref[3]
+    mode, thr = sc_ref[4], sc_ref[5]
+    dl, mt, db, mb = sc_ref[6], sc_ref[7], sc_ref[8], sc_ref[9]
+    xr = sc_ref[10]   # XOR'd into the decision: 1 when the left child is
+    #                   the smaller (stream-B) side
     n_tiles = jax.lax.div(cnt + jnp.int32(tile - 1), jnp.int32(tile))
     K = tile // SUB
     lane_w = jax.lax.broadcasted_iota(jnp.int32, (C, CARRY_W), 1)
 
     def read_dmas(j, slot):
         src = pl.multiple_of(s + j * tile, 128)
+        # the pred stream is only consumed in mode 0 but always read —
+        # [1, tile] is ~3% of the arena tile and keeps the DMA plumbing
+        # uniform
         return (pltpu.make_async_copy(
                     arena_any.at[:, pl.ds(src, tile)],
                     in_buf.at[slot], read_sems.at[slot]),
@@ -181,10 +197,24 @@ def _partition_kernel(sc_ref, arena_any, pred_any, out_any, cnt_ref,
 
         valid = jax.lax.broadcasted_iota(
             jnp.int32, (1, tile), 1) < (cnt - j * tile)
-        on = pred_buf[slot] > 0.5
+        block = in_buf[slot]
+        # in-kernel split decision (mode 1): feature row via one-hot
+        # matvec, then pure f32 arithmetic (scalar-broadcast bool selects
+        # crash the Mosaic compiler)
+        col = jnp.round(jax.lax.dot(feat_onehot_ref[:], block,
+                                    preferred_element_type=jnp.float32)
+                        ).astype(jnp.int32)                   # [1, T]
+        f = lambda c: jnp.where(c, jnp.float32(1.0), jnp.float32(0.0))
+        missing_f = f(((mt == 1) & (col == db)) | ((mt == 2) & (col == mb)))
+        dl_f = jnp.float32(dl)
+        go_left_f = missing_f * dl_f + (1.0 - missing_f) * f(col <= thr)
+        xr_f = jnp.float32(xr)
+        decide_f = go_left_f + xr_f - 2.0 * go_left_f * xr_f   # xor
+        mode_f = jnp.float32(mode)
+        on_f = mode_f * decide_f + (1.0 - mode_f) * pred_buf[slot]
+        on = on_f > 0.5
         predA = jnp.where(valid & on, jnp.float32(1.0), jnp.float32(0.0))
         predB = jnp.where(valid & ~on, jnp.float32(1.0), jnp.float32(0.0))
-        block = in_buf[slot]
 
         for k in range(K):
             blk = block[:, k * SUB:(k + 1) * SUB]
@@ -238,23 +268,41 @@ def _partition_kernel(sc_ref, arena_any, pred_any, out_any, cnt_ref,
 
 @functools.partial(jax.jit, static_argnames=("tile", "interpret"))
 def partition_segment(arena, pred, start, cnt, dstA, dstB,
+                      decision=None,
                       tile: int = TILE, interpret: bool = False):
-    """Partition arena columns [start, start+cnt) by pred into stream A at
-    dstA (dstA == start allowed: in-place with lagging writes) and stream B
-    at dstB (must not overlap [start, start+cnt+tile)).
+    """Partition arena columns [start, start+cnt) into stream A at dstA
+    (dstA == start allowed: in-place with lagging writes) and stream B at
+    dstB (must not overlap [start, start+cnt+tile)).
+
+    Routing: by `pred` ([1, cap] f32, 1.0 -> A) when decision is None,
+    else by the in-kernel split decision — decision = (feat_channel, thr,
+    default_left, missing_type, default_bin, max_bin_idx, xor_flag)
+    scalars; pred is then ignored (pass any [1, cap] array).
 
     Returns (new_arena, counts[2] int32).  Writes stay within
     align(count, FLUSH_W) columns of each stream's dst; reads overrun the
     segment by < tile columns, so callers keep cap >= last segment + tile.
     """
     C, cap = arena.shape
+    z = jnp.int32(0)
+    if decision is None:
+        tail = [z] * 7
+        feat_onehot = jnp.zeros((1, C), jnp.float32)
+    else:
+        feat, thr, dlft, mt, db, mb, xr = [
+            jnp.asarray(v, jnp.int32) for v in decision]
+        tail = [jnp.int32(1), thr, dlft, mt, db, mb, xr]
+        feat_onehot = (jnp.arange(C, dtype=jnp.int32)[None, :]
+                       == feat).astype(jnp.float32)
     sc = jnp.stack([jnp.asarray(start), jnp.asarray(cnt),
-                    jnp.asarray(dstA), jnp.asarray(dstB)]).astype(jnp.int32)
+                    jnp.asarray(dstA), jnp.asarray(dstB)]
+                   + tail).astype(jnp.int32)
     kernel = functools.partial(_partition_kernel, C=C, tile=tile)
     arena_out, counts = pl.pallas_call(
         kernel,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
@@ -272,10 +320,10 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
-        input_output_aliases={1: 0},
+        input_output_aliases={2: 0},
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
         interpret=interpret,
-    )(sc, arena, pred)
+    )(sc, feat_onehot, arena, pred)
     return arena_out, counts
 
 

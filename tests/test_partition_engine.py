@@ -169,3 +169,24 @@ def test_partition_kernel_stability(rng):
         ref = ref[:, goA]
         cnt = nA
         cursor += ((nB + pp.FLUSH_W - 1) // pp.FLUSH_W) * pp.FLUSH_W
+
+
+def test_deferred_stop_matches_eager(rng):
+    """The deferred-tree pipeline must stop training on degenerate
+    iterations exactly like the eager path (same model length and
+    predictions)."""
+    import lightgbm_tpu as lgb
+
+    n, F = 400, 4
+    X = rng.randn(n, F).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    preds = {}
+    for eng in ("label", "partition"):
+        params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+                  # min_data so large that no split is ever possible
+                  "min_data_in_leaf": n, "verbose": -1,
+                  "tpu_tree_engine": eng}
+        bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=10)
+        preds[eng] = bst.predict(X)
+        assert bst.num_trees() <= 1
+    np.testing.assert_allclose(preds["label"], preds["partition"], rtol=1e-6)

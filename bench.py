@@ -21,19 +21,30 @@ BASELINE_ROWS_ITER_PER_S = 10_500_000 * 500 / 238.505  # reference CPU Higgs
 
 def main():
     import jax
+    import jax.numpy as jnp
 
     import lightgbm_tpu as lgb
     from lightgbm_tpu.utils import log as lgb_log
 
     lgb_log.set_level(-1)  # keep stdout to the single JSON line
 
+    @jax.jit
+    def _scalar(x):
+        return jnp.sum(x)
+
+    def sync(booster):
+        # dispatch is async (and block_until_ready is unreliable through
+        # remote device attachments): force a device-side reduction to a
+        # scalar and fetch it
+        return float(_scalar(booster._gbdt.train_state.score))
+
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
-    n = 2_000_000 if on_tpu else 100_000
+    n = 4_000_000 if on_tpu else 100_000
     F = 28
     num_leaves = 255
     warmup_iters = 2
-    timed_iters = 30 if on_tpu else 5
+    timed_iters = 40 if on_tpu else 5
 
     rng = np.random.RandomState(7)
     X = rng.randn(n, F).astype(np.float32)
@@ -51,10 +62,12 @@ def main():
     ds = lgb.Dataset(X, y)
     # warmup: dataset construction + first compiles
     booster = lgb.train(params, ds, num_boost_round=warmup_iters)
+    sync(booster)
 
     t0 = time.perf_counter()
     for _ in range(timed_iters):
         booster.update()
+    sync(booster)
     elapsed = time.perf_counter() - t0
 
     rows_iter_per_s = n * timed_iters / elapsed

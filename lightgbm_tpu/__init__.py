@@ -16,6 +16,13 @@ __version__ = "2.2.4.tpu0"
 try:  # pragma: no cover - import cycle guard during early construction
     from .basic import Booster, Dataset  # noqa: F401
     from .engine import cv, train  # noqa: F401
-    __all__ = ["Config", "Dataset", "Booster", "train", "cv", "log"]
+    from .plotting import (create_tree_digraph, plot_importance,  # noqa: F401
+                           plot_metric, plot_tree)
+    from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: F401
+                          LGBMRanker, LGBMRegressor)
+    __all__ = ["Config", "Dataset", "Booster", "train", "cv", "log",
+               "plot_importance", "plot_metric", "plot_tree",
+               "create_tree_digraph", "LGBMModel", "LGBMClassifier",
+               "LGBMRegressor", "LGBMRanker"]
 except ImportError:  # modules not built yet
     __all__ = ["Config", "log"]

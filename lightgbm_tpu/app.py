@@ -73,6 +73,7 @@ class Application:
                          and cfg.tree_learner in ("data", "voting")
                          and cfg.pre_partition)
         d = loader_mod.load_data_file(cfg, cfg.data,
+                                      rank=cfg.machine_rank,
                                       num_machines=cfg.num_machines,
                                       pre_partition=pre_partition)
         ds = basic.Dataset(d.X, label=d.label, weight=d.weight, group=d.group,
@@ -92,11 +93,23 @@ class Application:
                 reference=train_set))
             name = vf.split("/")[-1]
             valid_names.append(name)
+        callbacks = []
+        if cfg.snapshot_freq > 0:
+            # model snapshots every snapshot_freq iterations
+            # (GBDT::Train, gbdt.cpp:255-259)
+            def snapshot_cb(env):
+                i = env.iteration + 1
+                if i % cfg.snapshot_freq == 0:
+                    path = "%s.snapshot_iter_%d" % (cfg.output_model, i)
+                    env.model.save_model(path)
+                    log.info("Saved snapshot to %s", path)
+            callbacks.append(snapshot_cb)
         booster = engine.train(
             dict(self.raw_params), train_set,
             num_boost_round=cfg.num_iterations,
             valid_sets=valid_sets, valid_names=valid_names,
-            init_model=cfg.input_model or None)
+            init_model=cfg.input_model or None,
+            callbacks=callbacks or None)
         booster.save_model(cfg.output_model)
         log.info("Finished training; model saved to %s", cfg.output_model)
 
@@ -109,6 +122,9 @@ class Application:
         out = booster.predict(
             d.X, num_iteration=cfg.num_iteration_predict,
             raw_score=cfg.predict_raw_score,
+            pred_early_stop=cfg.pred_early_stop,
+            pred_early_stop_freq=cfg.pred_early_stop_freq,
+            pred_early_stop_margin=cfg.pred_early_stop_margin,
             pred_leaf=cfg.predict_leaf_index,
             pred_contrib=cfg.predict_contrib)
         out = np.atleast_2d(np.asarray(out))

@@ -336,13 +336,18 @@ class Booster:
     # -- prediction --------------------------------------------------------
     def predict(self, data, num_iteration: int = -1, raw_score: bool = False,
                 pred_leaf: bool = False, pred_contrib: bool = False,
-                **kwargs) -> np.ndarray:
+                pred_early_stop: bool = False, pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0, **kwargs) -> np.ndarray:
         mat, _, _ = _to_matrix(data)
         if pred_leaf:
             return self._gbdt.predict_leaf_index(mat, num_iteration)
         if pred_contrib:
             return self._gbdt.predict_contrib(mat, num_iteration)
-        return self._gbdt.predict(mat, num_iteration, raw_score=raw_score)
+        return self._gbdt.predict(
+            mat, num_iteration, raw_score=raw_score,
+            early_stop=pred_early_stop,
+            early_stop_freq=pred_early_stop_freq,
+            early_stop_margin=pred_early_stop_margin)
 
     def refit(self, data, label, decay_rate: float = 0.9, **kwargs) -> "Booster":
         """New Booster with leaf values refit on (data, label)
@@ -367,6 +372,14 @@ class Booster:
                    start_iteration: int = 0) -> "Booster":
         self._gbdt.save_model_to_file(filename, start_iteration, num_iteration)
         return self
+
+    def dump_model(self, num_iteration: int = -1) -> dict:
+        """JSON-style dict dump (Booster.dump_model, python-package
+        basic.py:2076-2110 -> GBDT::DumpModel)."""
+        return self._gbdt.dump_model(num_iteration)
+
+    def feature_name(self) -> List[str]:
+        return list(self._gbdt.feature_names)
 
     def model_to_string(self, num_iteration: int = -1,
                         start_iteration: int = 0) -> str:

@@ -127,6 +127,7 @@ _SCHEMA = [
     ("eval_at", "vec_int", [1, 2, 3, 4, 5]),
     # --- network parameters (config.h:757-777)
     ("num_machines", int, 1),
+    ("machine_rank", int, 0),   # this process's rank for pre-partition loading
     ("local_listen_port", int, 12400),
     ("time_out", int, 120),
     ("machine_list_filename", str, ""),

@@ -78,12 +78,30 @@ def load_data_file(config, filename: str,
         label_idx = _resolve_column(config.label_column, names, "label")
         if label_idx < 0:
             label_idx = 0     # default: first column (dataset_loader.cpp:33)
-        weight_idx = _resolve_column(config.weight_column, names, "weight")
-        group_idx = _resolve_column(config.group_column, names, "group")
-        ignore = set(_resolve_list(config.ignore_column, names,
-                                   "ignore_column"))
-        cat_raw = _resolve_list(config.categorical_feature, names,
-                                "categorical_feature")
+
+        def skip_label(i):
+            # integer specs do not count the label column (reference
+            # SetHeader: "index ... doesn't count the label column",
+            # dataset_loader.cpp:46-115); name: specs resolve directly
+            return i + 1 if 0 <= label_idx <= i else i
+
+        def adj(spec, what):
+            idx = _resolve_column(spec, names, what)
+            if idx >= 0 and not spec.startswith("name:"):
+                idx = skip_label(idx)
+            return idx
+
+        weight_idx = adj(config.weight_column, "weight")
+        group_idx = adj(config.group_column, "group")
+
+        def adj_list(spec, what):
+            idxs = _resolve_list(spec, names, what)
+            if not spec.startswith("name:"):
+                idxs = [skip_label(i) for i in idxs]
+            return idxs
+
+        ignore = set(adj_list(config.ignore_column, "ignore_column"))
+        cat_raw = adj_list(config.categorical_feature, "categorical_feature")
 
         special = {label_idx} | {i for i in (weight_idx, group_idx) if i >= 0}
         keep = [i for i in range(ncol) if i not in special and i not in ignore]

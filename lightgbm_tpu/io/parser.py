@@ -106,6 +106,24 @@ def load_text_file(filename: str, header: bool = False,
     if header and head:
         head = head[1:]  # sniff data lines, not the header (parser.cpp:101-105)
     fmt = file_format or detect_format(head)
+
+    # native C++ parser fast path (native/fast_parser.cpp; the reference's
+    # parser is native too, src/io/parser.cpp) — python fallback below
+    if file_format is None:
+        from . import native
+        res = native.parse_file(filename, header=header,
+                                num_features_hint=num_features_hint)
+        if res is not None:
+            mat, libsvm_labels, nfmt = res
+            if nfmt == 2:
+                return mat, libsvm_labels, None
+            names = None
+            if header:
+                raw = _read_head(filename, 1)[0].rstrip("\r\n")
+                sep = "\t" if nfmt == 1 else ","
+                names = [t.strip() for t in raw.split(sep)]
+            return mat, None, names
+
     if fmt == LIBSVM:
         X, y = parse_libsvm(filename, num_features_hint)
         return X, y, None

@@ -305,6 +305,7 @@ class GBDT:
         if not self._inflight:
             return False
         pending, self._inflight = self._inflight, []
+        k = self.num_tree_per_iteration
         any_grew = False
         for ent in pending:
             ivec, fvec = (np.asarray(ent["packed"][0]),
@@ -318,6 +319,12 @@ class GBDT:
                 if abs(ent["init_score"]) > K_EPSILON:
                     new_tree.add_bias(ent["init_score"])
                 any_grew = True
+            elif ent["slot"] < k:
+                # degenerate FIRST iteration keeps the boost-from-average
+                # prior as a constant tree, like the eager else-branch
+                new_tree.as_constant(ent["init_score"])
+                self.train_state.add_constant(ent["init_score"],
+                                              ent["slot"] % max(k, 1))
             self.models[ent["slot"]] = new_tree
         if not any_grew:
             log.warning("Stopped training because there are no more leaves "
@@ -326,7 +333,6 @@ class GBDT:
             # appended — deferred placeholders plus any eagerly-added
             # constant trees), mirroring the eager stop; like the eager
             # path, the very first iteration's constant trees are kept
-            k = self.num_tree_per_iteration
             if len(self.models) > k:
                 del self.models[-k:]
             self.iter -= 1
@@ -511,7 +517,9 @@ class GBDT:
     # ------------------------------------------------------------------ #
     # Prediction on raw features (gbdt_prediction.cpp)
     # ------------------------------------------------------------------ #
-    def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+    def predict_raw(self, X: np.ndarray, num_iteration: int = -1,
+                    early_stop: bool = False, early_stop_freq: int = 10,
+                    early_stop_margin: float = 10.0) -> np.ndarray:
         self._sync_model()
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         if X.ndim != 2 or X.shape[1] <= self.max_feature_idx:
@@ -522,10 +530,35 @@ class GBDT:
         k = self.num_tree_per_iteration
         total_iters = len(self.models) // max(k, 1)
         iters = total_iters if num_iteration <= 0 else min(num_iteration, total_iters)
-        out = np.zeros((k, X.shape[0]), np.float64)
+        n = X.shape[0]
+        out = np.zeros((k, n), np.float64)
+        # margin-based prediction early stop (prediction_early_stop.cpp:
+        # 14-89): rows whose margin clears the threshold stop traversing
+        # further trees, checked every early_stop_freq iterations
+        use_es = early_stop and not self.average_output and k >= 1
+        active = np.ones(n, bool) if use_es else None
         for it in range(iters):
+            if use_es and it > 0 and it % max(early_stop_freq, 1) == 0 \
+               and active.any():
+                if k == 1:
+                    # binary margin is 2*|score| (prediction_early_stop
+                    # .cpp:30-41)
+                    margin = 2.0 * np.abs(out[0])
+                else:
+                    part = np.partition(out, k - 2, axis=0)
+                    margin = part[k - 1] - part[k - 2]  # top1 - top2
+                active &= margin < early_stop_margin
+                if not active.any():
+                    break
+            rows = X[active] if use_es else X
+            if rows.shape[0] == 0:
+                break
             for kk in range(k):
-                out[kk] += self.models[it * k + kk].predict(X)
+                pred = self.models[it * k + kk].predict(rows)
+                if use_es:
+                    out[kk, active] += pred
+                else:
+                    out[kk] += pred
         if self.average_output:
             # RF semantics survive model reload (gbdt_model_text.cpp writes
             # the average_output token; rf.hpp averages tree outputs)
@@ -533,8 +566,12 @@ class GBDT:
         return out[0] if k == 1 else out.T  # [n] or [n, k]
 
     def predict(self, X: np.ndarray, num_iteration: int = -1,
-                raw_score: bool = False) -> np.ndarray:
-        raw = self.predict_raw(X, num_iteration)
+                raw_score: bool = False, early_stop: bool = False,
+                early_stop_freq: int = 10,
+                early_stop_margin: float = 10.0) -> np.ndarray:
+        raw = self.predict_raw(X, num_iteration, early_stop=early_stop,
+                               early_stop_freq=early_stop_freq,
+                               early_stop_margin=early_stop_margin)
         if raw_score or self.objective is None:
             return raw
         if self.num_tree_per_iteration > 1:
@@ -575,6 +612,30 @@ class GBDT:
                 else:
                     imp[tree.split_feature[node]] += max(tree.split_gain[node], 0)
         return imp
+
+    def dump_model(self, num_iteration: int = -1) -> dict:
+        """JSON-style model dump (GBDT::DumpModel,
+        src/boosting/gbdt_model_text.cpp:15-58)."""
+        self._sync_model()
+        k = max(self.num_tree_per_iteration, 1)
+        total_iters = len(self.models) // k
+        iters = total_iters if num_iteration <= 0 else min(num_iteration,
+                                                           total_iters)
+        return {
+            "name": "tree",
+            "version": "v2",
+            "num_class": self.num_class,
+            "num_tree_per_iteration": self.num_tree_per_iteration,
+            "label_index": self.label_idx,
+            "max_feature_idx": self.max_feature_idx,
+            "objective": (self.objective.to_string()
+                          if self.objective is not None else "none"),
+            "average_output": self.average_output,
+            "feature_names": list(self.feature_names),
+            "feature_infos": list(self.feature_infos),
+            "tree_info": [self.models[i].to_json(i)
+                          for i in range(iters * k)],
+        }
 
     def save_model_to_string(self, start_iteration: int = 0,
                              num_iteration: int = -1) -> str:

@@ -126,6 +126,51 @@ class Tree:
         return t
 
     # ------------------------------------------------------------------ #
+    def to_json(self, index: int = 0) -> dict:
+        """Recursive JSON structure (Tree::ToJSON, src/io/tree.cpp:
+        NodeToJSON): internal nodes carry split metadata, leaves carry
+        value/count; children keys are left_child/right_child."""
+        def node(i):
+            if i < 0:
+                leaf = ~i
+                return {"leaf_index": int(leaf),
+                        "leaf_value": float(self.leaf_value[leaf]),
+                        "leaf_count": int(self.leaf_count[leaf])}
+            dt = int(self.decision_type[i])
+            is_cat = bool(dt & K_CATEGORICAL_MASK)
+            mt = (dt >> 2) & 3
+            d = {"split_index": int(i),
+                 "split_feature": int(self.split_feature[i]),
+                 "split_gain": float(self.split_gain[i]),
+                 "threshold": (int(self.threshold[i]) if is_cat
+                               else float(self.threshold[i])),
+                 "decision_type": "==" if is_cat else "<=",
+                 "default_left": bool(dt & K_DEFAULT_LEFT_MASK),
+                 "missing_type": ("None", "Zero", "NaN")[min(mt, 2)],
+                 "internal_value": float(self.internal_value[i]),
+                 "internal_count": int(self.internal_count[i]),
+                 "left_child": node(int(self.left_child[i])),
+                 "right_child": node(int(self.right_child[i]))}
+            if is_cat:
+                ci = int(self.threshold[i])
+                lo, hi = self.cat_boundaries[ci], self.cat_boundaries[ci + 1]
+                cats = []
+                for w_i, w in enumerate(self.cat_threshold[lo:hi]):
+                    for b in range(32):
+                        if (w >> b) & 1:
+                            cats.append(w_i * 32 + b)
+                d["cat_threshold"] = cats
+            return d
+
+        out = {"tree_index": int(index),
+               "num_leaves": int(self.num_leaves),
+               "num_cat": int(self.num_cat),
+               "shrinkage": float(self.shrinkage)}
+        out["tree_structure"] = (node(0) if self.num_leaves > 1
+                                 else {"leaf_value": float(self.leaf_value[0])})
+        return out
+
+    # ------------------------------------------------------------------ #
     def shrink(self, rate: float) -> None:
         """Tree::Shrinkage (tree.h:150-161)."""
         self.leaf_value *= rate

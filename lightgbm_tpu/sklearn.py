@@ -31,21 +31,38 @@ except ImportError:  # pragma: no cover
     _SKLEARN = False
 
 
+def _call_with_dataset(func: Callable, preds, dataset, what: str):
+    """Dispatch a user callback taking (y_true, y_pred[, weight[, group]]).
+
+    The arity is taken from inspect.signature so functools.partial and
+    bound methods work; errors raised inside the callback propagate
+    unchanged (the reference wrappers, sklearn.py:24-214)."""
+    import inspect
+
+    labels = dataset.get_label()
+    argsets = {2: (labels, preds),
+               3: (labels, preds, dataset.get_weight()),
+               4: (labels, preds, dataset.get_weight(), dataset.get_group())}
+    try:
+        params = inspect.signature(func).parameters.values()
+        if any(p.kind == inspect.Parameter.VAR_POSITIONAL for p in params):
+            argc = 4
+        else:
+            argc = sum(p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                                  inspect.Parameter.POSITIONAL_OR_KEYWORD)
+                       for p in params)
+    except (TypeError, ValueError):
+        argc = 2
+    if argc not in argsets:
+        raise TypeError("Self-defined %s should have 2-4 arguments" % what)
+    return func(*argsets[argc])
+
+
 def _objective_from_callable(func: Callable):
     """Wrap sklearn-style fobj(y_true, y_pred[, weight[, group]]) into the
     engine's fobj(preds, dataset) (sklearn.py:24-118 _ObjectiveFunctionWrapper)."""
     def wrapped(preds, dataset):
-        labels = dataset.get_label()
-        argc = func.__code__.co_argcount
-        if argc == 2:
-            grad, hess = func(labels, preds)
-        elif argc == 3:
-            grad, hess = func(labels, preds, dataset.get_weight())
-        elif argc == 4:
-            grad, hess = func(labels, preds, dataset.get_weight(),
-                              dataset.get_group())
-        else:
-            raise TypeError("Self-defined objective should have 2-4 arguments")
+        grad, hess = _call_with_dataset(func, preds, dataset, "objective")
         return grad, hess
     return wrapped
 
@@ -54,16 +71,7 @@ def _eval_from_callable(func: Callable):
     """sklearn-style feval(y_true, y_pred[, weight[, group]]) ->
     engine feval(preds, dataset) (sklearn.py:120-214)."""
     def wrapped(preds, dataset):
-        labels = dataset.get_label()
-        argc = func.__code__.co_argcount
-        if argc == 2:
-            return func(labels, preds)
-        if argc == 3:
-            return func(labels, preds, dataset.get_weight())
-        if argc == 4:
-            return func(labels, preds, dataset.get_weight(),
-                        dataset.get_group())
-        raise TypeError("Self-defined eval function should have 2-4 arguments")
+        return _call_with_dataset(func, preds, dataset, "eval function")
     return wrapped
 
 
@@ -144,13 +152,19 @@ class LGBMModel(BaseEstimator):
             params.pop("seed", None)
         if self.silent:
             params.setdefault("verbose", -1)
-        if callable(self.objective):
-            self._fobj = _objective_from_callable(self.objective)
+        obj = (self.objective if self.objective is not None
+               else getattr(self, "_objective_resolved", None))
+        if callable(obj):
+            self._fobj = _objective_from_callable(obj)
             params["objective"] = "none"
         else:
             self._fobj = None
-            if self.objective is not None:
-                params["objective"] = self.objective
+            if obj is not None:
+                params["objective"] = obj
+        # per-fit overrides (num_class etc.) — kept out of the constructor
+        # params so refitting on different data re-derives them (sklearn
+        # estimators must not mutate __init__ params in fit)
+        params.update(getattr(self, "_fit_param_overrides", {}))
         return params
 
     # -- fit ---------------------------------------------------------------
@@ -246,8 +260,8 @@ class LGBMRegressor(LGBMModel, RegressorMixin):
     """sklearn.py:619-658."""
 
     def fit(self, X, y, **kwargs):
-        if self.objective is None:
-            self.objective = "regression"
+        self._objective_resolved = "regression"
+        self._fit_param_overrides = {}
         return super().fit(X, y, **kwargs)
 
 
@@ -264,12 +278,10 @@ class LGBMClassifier(LGBMModel, ClassifierMixin):
             self._classes = np.unique(y)
             y_enc = np.searchsorted(self._classes, y)
         self._n_classes = len(self._classes)
-        if self.objective is None:
-            self.objective = ("binary" if self._n_classes <= 2
-                              else "multiclass")
-        if self._n_classes > 2:
-            self._other_params["num_class"] = self._n_classes
-            self.num_class = self._n_classes
+        self._objective_resolved = ("binary" if self._n_classes <= 2
+                                    else "multiclass")
+        self._fit_param_overrides = (
+            {"num_class": self._n_classes} if self._n_classes > 2 else {})
         return super().fit(X, y_enc, **kwargs)
 
     def predict(self, X, raw_score=False, num_iteration=-1,
@@ -312,8 +324,7 @@ class LGBMRanker(LGBMModel):
             raise ValueError("Should set group for ranking task")
         if kwargs.get("eval_set") is not None and eval_group is None:
             raise ValueError("Eval_group cannot be None when eval_set is not None")
-        if self.objective is None:
-            self.objective = "lambdarank"
-        self._other_params["ndcg_eval_at"] = list(eval_at)
+        self._objective_resolved = "lambdarank"
+        self._fit_param_overrides = {"ndcg_eval_at": list(eval_at)}
         self.eval_at = list(eval_at)
         return super().fit(X, y, group=group, eval_group=eval_group, **kwargs)

@@ -102,13 +102,9 @@ def load_text_file(filename: str, header: bool = False,
     appear (validation-vs-train width mismatch, the reference passes
     num_total_features to CreateParser).
     """
-    head = _read_head(filename)
-    if header and head:
-        head = head[1:]  # sniff data lines, not the header (parser.cpp:101-105)
-    fmt = file_format or detect_format(head)
-
     # native C++ parser fast path (native/fast_parser.cpp; the reference's
-    # parser is native too, src/io/parser.cpp) — python fallback below
+    # parser is native too, src/io/parser.cpp) — it sniffs the format
+    # itself, so the python-side sniff only runs on the fallback path
     if file_format is None:
         from . import native
         res = native.parse_file(filename, header=header,
@@ -124,6 +120,10 @@ def load_text_file(filename: str, header: bool = False,
                 names = [t.strip() for t in raw.split(sep)]
             return mat, None, names
 
+    head = _read_head(filename)
+    if header and head:
+        head = head[1:]  # sniff data lines, not the header (parser.cpp:101-105)
+    fmt = file_format or detect_format(head)
     if fmt == LIBSVM:
         X, y = parse_libsvm(filename, num_features_hint)
         return X, y, None

@@ -111,7 +111,9 @@ class GBDT:
             max_cat_to_onehot=self.config.max_cat_to_onehot,
             cat_smooth=self.config.cat_smooth,
             cat_l2=self.config.cat_l2,
-            min_data_per_group=self.config.min_data_per_group)
+            min_data_per_group=self.config.min_data_per_group,
+            cegb_split_penalty=(self.config.cegb_tradeoff
+                                * self.config.cegb_penalty_split))
         # [F] bin-type vector; None when the dataset is purely numerical so
         # the grow loop skips the categorical scan entirely
         cat_flags = np.array([m.bin_type == 1 for m in train_set.bin_mappers],
@@ -122,6 +124,26 @@ class GBDT:
                          if train_set.monotone_constraints is not None else None)
         self.penalty = (jnp.asarray(train_set.feature_penalty, self.dtype)
                         if train_set.feature_penalty is not None else None)
+        # CEGB coupled feature penalties (config.h:427-431): indexed by real
+        # (total) feature id in the config, mapped to used features here;
+        # feature_used lives for the whole ensemble like the reference's
+        # SerialTreeLearner member (serial_tree_learner.cpp:534-536)
+        self._cegb_coupled = None
+        coupled = self.config.cegb_penalty_feature_coupled
+        if coupled:
+            if len(coupled) != train_set.num_total_features:
+                log.fatal("cegb_penalty_feature_coupled size (%d) must equal "
+                          "num_total_features (%d)"
+                          % (len(coupled), train_set.num_total_features))
+            vec = np.array([coupled[train_set.real_feature_index[f]]
+                            for f in range(F)], np.float64)
+            self._cegb_coupled = jnp.asarray(
+                self.config.cegb_tradeoff * vec, self.dtype)
+        self._cegb_used = np.zeros(F, bool)
+        if self.config.cegb_penalty_feature_lazy:
+            log.warning("cegb_penalty_feature_lazy is not supported yet; "
+                        "ignoring it")
+        self._forced_splits = self._load_forced_splits()
         # distributed learner selection (TreeLearner::CreateTreeLearner,
         # src/treelearner/tree_learner.cpp:9-33): None = serial
         from ..parallel import learners as par_learners
@@ -219,6 +241,7 @@ class GBDT:
         # the host tree inside this iteration
         deferred_ok = (self._allow_deferred and not self.valid_states
                        and not self.train_metrics
+                       and self._cegb_coupled is None
                        and (self.objective is None
                             or not self.objective.is_renew_tree_output()))
 
@@ -252,6 +275,9 @@ class GBDT:
 
             if new_tree.num_leaves > 1:
                 should_continue = True
+                if self._cegb_coupled is not None:
+                    self._cegb_used[new_tree.split_feature_inner[
+                        :new_tree.num_leaves - 1]] = True
                 self._renew_tree_output(new_tree, kk, leaf_ids)
                 new_tree.shrink(self.shrinkage_rate)
                 self._update_train_score(new_tree, kk, arrays, leaf_ids)
@@ -339,6 +365,45 @@ class GBDT:
             return True
         return False
 
+    def _load_forced_splits(self) -> tuple:
+        """forcedsplits_filename JSON -> static BFS plan of
+        (leaf_id, inner_feature, threshold_bin, default_left) tuples
+        (ForceSplits, serial_tree_learner.cpp:593-751).  Real-valued
+        thresholds are mapped to bins host-side with the BinMapper."""
+        fname = self.config.forcedsplits_filename
+        if not fname:
+            return ()
+        import json
+        from collections import deque
+
+        with open(fname) as f:
+            root = json.load(f)
+        if not root:
+            return ()
+        raw_to_inner = {raw: inner for inner, raw in
+                        enumerate(self.train_set.real_feature_index)}
+        plan = []
+        num_leaves = 1
+        q = deque([(0, root)])
+        while q:
+            leaf, node = q.popleft()
+            raw_f = int(node["feature"])
+            if raw_f not in raw_to_inner:
+                log.warning("forced split on unused feature %d skipped", raw_f)
+                continue
+            inner = raw_to_inner[raw_f]
+            mapper = self.train_set.bin_mappers[inner]
+            thr_bin = int(mapper.value_to_bin(float(node["threshold"])))
+            plan.append((leaf, inner, thr_bin,
+                         bool(node.get("default_left", False))))
+            right_leaf = num_leaves
+            num_leaves += 1
+            if "left" in node and node["left"]:
+                q.append((leaf, node["left"]))
+            if "right" in node and node["right"]:
+                q.append((right_leaf, node["right"]))
+        return tuple(plan)
+
     def _setup_tree_engine(self) -> None:
         """Choose label vs partition growth engine (config.tpu_tree_engine).
 
@@ -351,6 +416,7 @@ class GBDT:
                     and self.is_categorical is None
                     and self.dtype == jnp.float32
                     and self.max_bin <= 256
+                    and not self._forced_splits
                     and self.train_set.num_features > 0
                     and self.num_data < (1 << 24))
         if eng == "partition" and not eligible:
@@ -384,6 +450,8 @@ class GBDT:
     def _grow_one_tree(self, grad, hess, row_init):
         """Grow one tree via the selected learner (serial or distributed) —
         the single dispatch point shared by GBDT/DART/GOSS/RF."""
+        cegb_used = (jnp.asarray(self._cegb_used)
+                     if self._cegb_coupled is not None else None)
         if self._use_partition_engine:
             arrays, leaf_ids, self._arena = self._grow_partition(
                 self._arena, self._bins_t, grad, hess, row_init,
@@ -391,6 +459,7 @@ class GBDT:
                 self.train_state.num_bins, self.train_state.default_bins,
                 self.train_state.missing_types,
                 self.split_params, self.monotone, self.penalty,
+                self._cegb_coupled, cegb_used,
                 max_leaves=self.config.num_leaves,
                 max_depth=self.config.max_depth,
                 max_bin=self.max_bin,
@@ -398,6 +467,12 @@ class GBDT:
             return arrays, leaf_ids
         grow_fn = (self._grower if self._grower is not None
                    else grow_ops.grow_tree)
+        from functools import partial as _partial
+        if self._grower is None and self._cegb_coupled is not None:
+            grow_fn = _partial(grow_fn, cegb_coupled=self._cegb_coupled,
+                               cegb_used_init=cegb_used)
+        if self._grower is None and self._forced_splits:
+            grow_fn = _partial(grow_fn, forced_splits=self._forced_splits)
         return grow_fn(
             self.train_state.bins, grad, hess, row_init,
             self._feature_sample(),

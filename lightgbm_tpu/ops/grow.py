@@ -86,6 +86,9 @@ class GrowState(NamedTuple):
     hist_cache: jnp.ndarray        # [L, F, B, 3]
     split_cache: SplitResult       # stacked [L]
     done: jnp.ndarray              # bool scalar
+    cegb_used: jnp.ndarray         # [F] bool — features used so far (CEGB
+    #                                coupled penalty, feature_used in
+    #                                serial_tree_learner.cpp:534-536)
 
 
 def _stack_split(res: SplitResult, cache: SplitResult, idx) -> SplitResult:
@@ -109,7 +112,16 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
               monotone: Optional[jnp.ndarray] = None,   # [F] int8 or None
               penalty: Optional[jnp.ndarray] = None,    # [F] or None
               is_categorical: Optional[jnp.ndarray] = None,  # [F] bool or None
+              cegb_coupled: Optional[jnp.ndarray] = None,    # [F] or None:
+              #   tradeoff * cegb_penalty_feature_coupled, charged while the
+              #   feature is unused
+              cegb_used_init: Optional[jnp.ndarray] = None,  # [F] bool
               *,
+              forced_splits: tuple = (),   # static BFS list of
+              #   (leaf_id, inner_feature, threshold_bin, default_left) from
+              #   forcedsplits_filename (ForceSplits,
+              #   serial_tree_learner.cpp:593-751); applied before the
+              #   best-first loop by injecting +inf-gain cache entries
               max_leaves: int,
               max_depth: int = -1,
               max_bin: int,
@@ -179,25 +191,31 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
         return h
 
     def local_scan(hist, sum_g, sum_h, cnt, nb, db, mt, mono, pen, fmask,
-                   icat, findex=None):
+                   icat, findex=None, used=None):
         """Per-feature scan (numerical or bin-type-dispatched) + argmax."""
+        cegb_pen = None
+        if cegb_coupled is not None and used is not None:
+            cegb_pen = jnp.where(used, 0.0, cegb_coupled)
         if icat is None:
             pf = best_split_per_feature(hist, sum_g, sum_h, cnt, nb, db, mt,
                                         params, monotone=mono, penalty=pen,
-                                        feature_mask=fmask)
+                                        feature_mask=fmask,
+                                        cegb_feature_penalty=cegb_pen)
         else:
             pf = best_split_per_feature_mixed(
                 hist, sum_g, sum_h, cnt, nb, db, mt, icat, params,
                 monotone=mono, penalty=pen, feature_mask=fmask,
+                cegb_feature_penalty=cegb_pen,
                 max_cat_threshold=max_cat_threshold)
         return select_best_feature(pf, feature_index=findex)
 
-    def leaf_best_split(hist, sum_g, sum_h, cnt, depth):
+    def leaf_best_split(hist, sum_g, sum_h, cnt, depth, used=None):
         if distributed and learner == "feature":
             local = local_scan(
                 hist, sum_g, sum_h, cnt,
                 l_num_bins, l_default_bins, l_missing,
-                l_monotone, l_penalty, l_feature_mask, l_is_categorical)
+                l_monotone, l_penalty, l_feature_mask, l_is_categorical,
+                used=None)
             # map the local winner to its global feature id
             local = local._replace(feature=jnp.where(
                 local.feature >= 0, l_feature_index[local.feature],
@@ -241,7 +259,8 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
         else:
             res = local_scan(hist, sum_g, sum_h, cnt,
                              num_bins, default_bins, missing_types,
-                             monotone, penalty, feature_mask, is_categorical)
+                             monotone, penalty, feature_mask, is_categorical,
+                             used=used)
         depth_ok = (max_depth <= 0) | (depth < max_depth)
         blocked = (res.feature < 0) | ~depth_ok
         return res._replace(gain=jnp.where(blocked, K_MIN_SCORE, res.gain),
@@ -264,8 +283,10 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
         root_c = jax.lax.psum(root_c, axis_name)
     tree = tree._replace(leaf_count=tree.leaf_count.at[0].set(root_c))
 
+    cegb_used0 = (cegb_used_init if cegb_used_init is not None
+                  else jnp.zeros(F, bool))
     root_split = leaf_best_split(root_hist, root_g, root_h, root_c,
-                                 jnp.asarray(0, jnp.int32))
+                                 jnp.asarray(0, jnp.int32), used=cegb_used0)
 
     L = max_leaves
     hist_cache = jnp.zeros((L,) + root_hist.shape, dtype).at[0].set(root_hist)
@@ -279,7 +300,8 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
         gain=split_cache.gain.at[1:].set(K_MIN_SCORE))
 
     state = GrowState(tree=tree, leaf_ids=row_leaf_init, hist_cache=hist_cache,
-                      split_cache=split_cache, done=jnp.asarray(False))
+                      split_cache=split_cache, done=jnp.asarray(False),
+                      cegb_used=cegb_used0)
 
     def cond(state: GrowState):
         return (~state.done) & (state.tree.num_leaves < max_leaves)
@@ -376,20 +398,59 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
             )
 
             # -- children best splits ---------------------------------------
+            used2 = state.cegb_used.at[feat].set(True)
             lsp = leaf_best_split(left_hist, sp.left_sum_gradient,
-                                  sp.left_sum_hessian, sp.left_count, depth + 1)
+                                  sp.left_sum_hessian, sp.left_count,
+                                  depth + 1, used=used2)
             rsp = leaf_best_split(right_hist, sp.right_sum_gradient,
-                                  sp.right_sum_hessian, sp.right_count, depth + 1)
+                                  sp.right_sum_hessian, sp.right_count,
+                                  depth + 1, used=used2)
             split_cache = _stack_split(lsp, state.split_cache, best_leaf)
             split_cache = _stack_split(rsp, split_cache, new_leaf)
 
             return GrowState(tree=tree, leaf_ids=leaf_ids,
                              hist_cache=hist_cache, split_cache=split_cache,
-                             done=jnp.asarray(False))
+                             done=jnp.asarray(False), cegb_used=used2)
 
         return jax.lax.cond(no_split,
                             lambda s: s._replace(done=jnp.asarray(True)),
                             do_split, state)
+
+    # Forced splits first (trace-time unrolled: the BFS plan is static):
+    # overwrite the target leaf's cache entry with a +inf-gain forced
+    # result and run one standard body step to apply it.  An invalid
+    # forced split (empty child) must be a NO-OP — otherwise later plan
+    # entries would address the wrong leaf ids — so the stepped state is
+    # selected against the untouched one under the validity flag.
+    from .split import forced_split_result
+    for i, (f_leaf, f_feat, f_thr, f_dl) in enumerate(forced_splits):
+        if i >= max_leaves - 1:
+            break      # each applied split adds one leaf; bound the count
+        f_hist = state.hist_cache[f_leaf]
+        fsp = forced_split_result(
+            f_hist, jnp.int32(f_feat), jnp.int32(f_thr),
+            jnp.sum(f_hist[0, :, 0]), jnp.sum(f_hist[0, :, 1]),
+            state.tree.leaf_count[f_leaf],
+            num_bins, default_bins, missing_types, params,
+            jnp.asarray(bool(f_dl)))
+        if state.split_cache.cat_mask is not None:
+            fsp = fsp._replace(
+                cat_mask=jnp.zeros(state.split_cache.cat_mask.shape[1], bool))
+        valid = (fsp.gain > K_MIN_SCORE) & \
+                (state.tree.num_leaves < max_leaves)
+        prev_entry = _index_split(state.split_cache, f_leaf)
+        injected = state._replace(
+            split_cache=_stack_split(fsp, state.split_cache, f_leaf))
+        stepped = body(injected)._replace(done=jnp.asarray(False))
+
+        def _sel(a, b):
+            if a is None:
+                return None
+            return jnp.where(valid, a, b)
+
+        state = jax.tree_util.tree_map(
+            _sel, stepped, state,
+            is_leaf=lambda x: x is None)
 
     state = jax.lax.while_loop(cond, body, state)
     return state.tree, state.leaf_ids
@@ -463,7 +524,7 @@ def fetch_tree_arrays(t: TreeArrays) -> TreeArrays:
 grow_tree = partial(jax.jit, static_argnames=(
     "max_leaves", "max_depth", "max_bin", "hist_impl", "rows_per_chunk",
     "learner", "axis_name", "num_machines", "top_k",
-    "max_cat_threshold"))(grow_tree_impl)
+    "max_cat_threshold", "forced_splits"))(grow_tree_impl)
 
 
 def _voting_best_split(local_hist, sum_g, sum_h, cnt,

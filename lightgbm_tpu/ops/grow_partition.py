@@ -49,6 +49,7 @@ class PartState(NamedTuple):
     hist_cache: jnp.ndarray        # [L, F, B, 3]
     split_cache: SplitResult
     done: jnp.ndarray
+    cegb_used: jnp.ndarray         # [F] bool (CEGB coupled feature_used)
 
 
 def grow_tree_partition_impl(
@@ -64,6 +65,8 @@ def grow_tree_partition_impl(
         params: SplitParams,
         monotone: Optional[jnp.ndarray] = None,
         penalty: Optional[jnp.ndarray] = None,
+        cegb_coupled: Optional[jnp.ndarray] = None,
+        cegb_used_init: Optional[jnp.ndarray] = None,
         *,
         max_leaves: int,
         max_depth: int = -1,
@@ -113,11 +116,15 @@ def grow_tree_partition_impl(
     root_g = jnp.sum(root_hist[0, :, 0])
     root_h = jnp.sum(root_hist[0, :, 1])
 
-    def leaf_best_split(hist, sum_g, sum_h, cnt, depth):
+    def leaf_best_split(hist, sum_g, sum_h, cnt, depth, used=None):
+        cegb_pen = None
+        if cegb_coupled is not None and used is not None:
+            cegb_pen = jnp.where(used, 0.0, cegb_coupled)
         pf = best_split_per_feature(hist, sum_g, sum_h, cnt, num_bins,
                                     default_bins, missing_types, params,
                                     monotone=monotone, penalty=penalty,
-                                    feature_mask=feature_mask)
+                                    feature_mask=feature_mask,
+                                    cegb_feature_penalty=cegb_pen)
         res = select_best_feature(pf)
         depth_ok = (max_depth <= 0) | (depth < max_depth)
         blocked = (res.feature < 0) | ~depth_ok
@@ -126,8 +133,10 @@ def grow_tree_partition_impl(
 
     tree = empty_tree(L, dtype, cat_bins=0)
     tree = tree._replace(leaf_count=tree.leaf_count.at[0].set(root_c))
+    cegb_used0 = (cegb_used_init if cegb_used_init is not None
+                  else jnp.zeros(F, bool))
     root_split = leaf_best_split(root_hist, root_g, root_h, root_c,
-                                 jnp.asarray(0, jnp.int32))
+                                 jnp.asarray(0, jnp.int32), used=cegb_used0)
 
     hist_cache = jnp.zeros((L,) + root_hist.shape, dtype).at[0].set(root_hist)
     split_cache = SplitResult(*[
@@ -142,7 +151,7 @@ def grow_tree_partition_impl(
         tree=tree, arena=arena,
         leaf_start=jnp.zeros(L, jnp.int32), cursor=cursor0,
         hist_cache=hist_cache, split_cache=split_cache,
-        done=jnp.asarray(False))
+        done=jnp.asarray(False), cegb_used=cegb_used0)
 
     def cond(state: PartState):
         return (~state.done) & (state.tree.num_leaves < L)
@@ -241,12 +250,13 @@ def grow_tree_partition_impl(
             num_leaves=nl + 1,
         )
 
+        used2 = state.cegb_used.at[feat].set(True)
         lsp = leaf_best_split(left_hist, sp.left_sum_gradient,
                               sp.left_sum_hessian, sp.left_count,
-                              depth + 1)
+                              depth + 1, used=used2)
         rsp = leaf_best_split(right_hist, sp.right_sum_gradient,
                               sp.right_sum_hessian, sp.right_count,
-                              depth + 1)
+                              depth + 1, used=used2)
         split_cache = _stack_split(lsp, state.split_cache, best_leaf)
         split_cache = _stack_split(rsp, split_cache, new_leaf)
 
@@ -269,7 +279,7 @@ def grow_tree_partition_impl(
             cursor=sel(state.cursor, cursor),
             hist_cache=sel(state.hist_cache, hist_cache),
             split_cache=split_cache,
-            done=keep)
+            done=keep, cegb_used=sel(state.cegb_used, used2))
 
     state = jax.lax.while_loop(cond, body, state)
 

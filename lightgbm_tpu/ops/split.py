@@ -47,6 +47,10 @@ class SplitParams(NamedTuple):
     cat_smooth: float = 10.0
     cat_l2: float = 10.0
     min_data_per_group: int = 100
+    # CEGB (cost-effective gradient boosting): gain -= cegb_split_penalty *
+    # num_data_in_leaf, applied after the per-feature threshold search like
+    # the reference (serial_tree_learner.cpp:533)
+    cegb_split_penalty: float = 0.0
 
 
 class SplitResult(NamedTuple):
@@ -140,7 +144,8 @@ def best_split_per_feature(hist: jnp.ndarray,
                            penalty: Optional[jnp.ndarray] = None,
                            min_constraints: Optional[jnp.ndarray] = None,
                            max_constraints: Optional[jnp.ndarray] = None,
-                           feature_mask: Optional[jnp.ndarray] = None
+                           feature_mask: Optional[jnp.ndarray] = None,
+                           cegb_feature_penalty: Optional[jnp.ndarray] = None
                            ) -> PerFeatureSplit:
     """Best numerical split of *every* feature of one leaf (fields [F]).
 
@@ -259,7 +264,17 @@ def best_split_per_feature(hist: jnp.ndarray,
     rel_gain = best_gain - min_gain_shift
     if penalty is not None:
         rel_gain = rel_gain * penalty
-    feat_gain = jnp.where(best_gain > K_MIN_SCORE, rel_gain, K_MIN_SCORE)
+    # CEGB penalties are subtracted AFTER the threshold search
+    # (serial_tree_learner.cpp:533-539): they shift whole features/leaves,
+    # not individual thresholds
+    rel_gain = rel_gain - jnp.asarray(params.cegb_split_penalty,
+                                      dtype) * num_data
+    if cegb_feature_penalty is not None:
+        rel_gain = rel_gain - cegb_feature_penalty
+    # penalties can push the gain non-positive: such splits never apply
+    # (the reference's gain <= 0 stop, serial_tree_learner.cpp:220-223)
+    feat_gain = jnp.where((best_gain > K_MIN_SCORE) & (rel_gain > 0),
+                          rel_gain, K_MIN_SCORE)
     if feature_mask is not None:
         feat_gain = jnp.where(feature_mask, feat_gain, K_MIN_SCORE)
 
@@ -333,6 +348,7 @@ def best_split_per_feature_mixed(hist: jnp.ndarray,
                                  penalty: Optional[jnp.ndarray] = None,
                                  min_constraints=None, max_constraints=None,
                                  feature_mask: Optional[jnp.ndarray] = None,
+                                 cegb_feature_penalty=None,
                                  *, max_cat_threshold: int = 32
                                  ) -> PerFeatureSplit:
     """Per-feature best split with the numerical/categorical scan selected
@@ -343,13 +359,14 @@ def best_split_per_feature_mixed(hist: jnp.ndarray,
         num_bins, default_bins, missing_types, params,
         monotone=monotone, penalty=penalty,
         min_constraints=min_constraints, max_constraints=max_constraints,
-        feature_mask=feature_mask)
+        feature_mask=feature_mask, cegb_feature_penalty=cegb_feature_penalty)
     pf_cat = best_split_categorical_per_feature(
         hist, sum_gradient, sum_hessian, num_data,
         num_bins, missing_types, params,
         penalty=penalty,
         min_constraints=min_constraints, max_constraints=max_constraints,
-        feature_mask=feature_mask, max_cat_threshold=max_cat_threshold)
+        feature_mask=feature_mask, cegb_feature_penalty=cegb_feature_penalty,
+        max_cat_threshold=max_cat_threshold)
 
     def sel(num_v, cat_v):
         ic = is_categorical
@@ -373,6 +390,7 @@ def best_split_categorical_per_feature(hist: jnp.ndarray,
                                        min_constraints=None,
                                        max_constraints=None,
                                        feature_mask: Optional[jnp.ndarray] = None,
+                                       cegb_feature_penalty=None,
                                        *, max_cat_threshold: int = 32
                                        ) -> PerFeatureSplit:
     """Categorical optimal split of every feature (FindBestThresholdCategorical,
@@ -554,7 +572,12 @@ def best_split_categorical_per_feature(hist: jnp.ndarray,
     rel_gain = gain - min_gain_shift
     if penalty is not None:
         rel_gain = rel_gain * penalty
-    feat_gain = jnp.where(gain > K_MIN_SCORE, rel_gain, K_MIN_SCORE)
+    rel_gain = rel_gain - jnp.asarray(params.cegb_split_penalty,
+                                      dtype) * num_data
+    if cegb_feature_penalty is not None:
+        rel_gain = rel_gain - cegb_feature_penalty
+    feat_gain = jnp.where((gain > K_MIN_SCORE) & (rel_gain > 0),
+                          rel_gain, K_MIN_SCORE)
     if feature_mask is not None:
         feat_gain = jnp.where(feature_mask, feat_gain, K_MIN_SCORE)
     cat_mask = res["mask"] & (feat_gain > K_MIN_SCORE)[:, None]
@@ -573,6 +596,61 @@ def best_split_categorical_per_feature(hist: jnp.ndarray,
         right_output=ro,
         cat_mask=cat_mask,
     )
+
+
+def forced_split_result(hist, feat, thr_bin, sum_gradient, sum_hessian,
+                        num_data, num_bins, default_bins, missing_types,
+                        params: SplitParams, default_left) -> SplitResult:
+    """Stats of the numerical split (feat, thr_bin) on this leaf — the
+    forced-split analogue of FeatureHistogram::GatherInfoForThreshold
+    (feature_histogram.hpp:273-411).  Returns a SplitResult whose gain is
+    +inf when both children are nonempty (forced splits apply regardless
+    of gain) and K_MIN_SCORE otherwise."""
+    dtype = hist.dtype
+    B = hist.shape[1]
+    l1 = jnp.asarray(params.lambda_l1, dtype)
+    l2 = jnp.asarray(params.lambda_l2, dtype)
+    mds = jnp.asarray(params.max_delta_step, dtype)
+    sum_gradient = jnp.asarray(sum_gradient, dtype)
+    sum_hessian = jnp.asarray(sum_hessian, dtype) + 2 * K_EPSILON
+    num_data = jnp.asarray(num_data, jnp.int32)
+
+    h_f = hist[feat]                                           # [B, 3]
+    bins = jnp.arange(B, dtype=jnp.int32)
+    nb = num_bins[feat]
+    in_range = bins < nb
+    mt = missing_types[feat]
+    excl = (((mt == MISSING_ZERO) & (bins == default_bins[feat])) |
+            ((mt == MISSING_NAN) & (bins == nb - 1))) & in_range & (nb > 2)
+    take_left = in_range & ~excl & (bins <= thr_bin)
+    lg = jnp.sum(jnp.where(take_left, h_f[:, 0], 0.0))
+    lh = jnp.sum(jnp.where(take_left, h_f[:, 1], 0.0))
+    lc = jnp.sum(jnp.where(take_left, h_f[:, 2], 0.0))
+    excl_g = jnp.sum(jnp.where(excl, h_f[:, 0], 0.0))
+    excl_h = jnp.sum(jnp.where(excl, h_f[:, 1], 0.0))
+    excl_c = jnp.sum(jnp.where(excl, h_f[:, 2], 0.0))
+    dl = jnp.asarray(default_left, bool)
+    lg = lg + jnp.where(dl, excl_g, 0.0)
+    lh = lh + jnp.where(dl, excl_h, 0.0)
+    lc = lc + jnp.where(dl, excl_c, 0.0)
+    rg = sum_gradient - lg
+    rh = sum_hessian - lh
+    rc = num_data - jnp.round(lc).astype(jnp.int32)
+    lc_i = jnp.round(lc).astype(jnp.int32)
+    lo = calculate_splitted_leaf_output(lg, lh, l1, l2, mds)
+    ro = calculate_splitted_leaf_output(rg, rh, l1, l2, mds)
+    valid = (lc_i > 0) & (rc > 0)
+    return SplitResult(
+        feature=jnp.where(valid, feat, -1).astype(jnp.int32),
+        threshold=jnp.asarray(thr_bin, jnp.int32),
+        gain=jnp.where(valid, jnp.asarray(jnp.inf, dtype),
+                       jnp.asarray(K_MIN_SCORE, dtype)),
+        default_left=dl,
+        left_sum_gradient=lg, left_sum_hessian=lh - K_EPSILON,
+        left_count=lc_i, left_output=lo,
+        right_sum_gradient=rg, right_sum_hessian=rh - K_EPSILON,
+        right_count=rc, right_output=ro,
+        cat_mask=None)
 
 
 def best_split_for_leaf(hist: jnp.ndarray,

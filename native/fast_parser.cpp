@@ -116,7 +116,8 @@ void parse_rows_delim(const Lines& lines, size_t row0, size_t row1,
     double* dst = out + r * ncol;
     for (int c = 0; c < ncol; ++c) {
       if (p >= end) {
-        dst[c] = 0.0;
+        // short row: trailing fields are missing -> NaN (pandas parity)
+        dst[c] = std::nan("");
         continue;
       }
       double v = 0.0;

@@ -143,3 +143,70 @@ def test_native_parser_matches_python(tmp_path, rng):
     m3, lab3, names3 = parser.load_text_file(str(p2), header=True)
     assert names3 == ["c0", "c1", "c2"]
     np.testing.assert_allclose(m3, mat[:, :3], rtol=1e-12, atol=1e-12)
+
+
+def test_cegb_split_penalty_prunes(rng):
+    """cegb_penalty_split shifts every gain down by penalty*leaf_count, so a
+    large enough penalty stops growth entirely."""
+    n, F = 600, 4
+    X = rng.randn(n, F).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    base = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+            "verbose": -1}
+    bst0 = lgb.train(dict(base), lgb.Dataset(X, y), num_boost_round=3)
+    bst1 = lgb.train(dict(base, cegb_penalty_split=1e6),
+                     lgb.Dataset(X, y), num_boost_round=3)
+    assert bst0.num_trees() >= 1
+    d = bst0.dump_model()
+    assert d["tree_info"][0]["num_leaves"] > 1
+    # prohibitive split penalty -> no splits at all
+    assert bst1.num_trees() <= 1
+
+
+def test_cegb_coupled_feature_penalty(rng):
+    """A huge coupled penalty on the informative feature makes trees avoid
+    it; penalizing everything else makes trees keep using it."""
+    n, F = 600, 4
+    X = rng.randn(n, F).astype(np.float32)
+    y = (X[:, 2] > 0).astype(np.float32)      # only feature 2 informative
+    base = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+            "verbose": -1}
+    pen = [0.0, 0.0, 1e9, 0.0]
+    bst = lgb.train(dict(base, cegb_penalty_feature_coupled=pen),
+                    lgb.Dataset(X, y), num_boost_round=2)
+    used = set()
+    for t in bst.dump_model()["tree_info"]:
+        def walk(node):
+            if "split_feature" in node:
+                used.add(node["split_feature"])
+                walk(node["left_child"])
+                walk(node["right_child"])
+        walk(t["tree_structure"])
+    assert 2 not in used, used
+
+
+def test_forced_splits(tmp_path, rng):
+    """forcedsplits_filename drives the first splits of every tree
+    regardless of gain (ForceSplits BFS)."""
+    import json as _json
+
+    n, F = 800, 4
+    X = rng.randn(n, F).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)      # informative: feature 0
+    fs = tmp_path / "forced.json"
+    # force a (useless) split on feature 3 at 0.0, then on its left child
+    # another on feature 2
+    fs.write_text(_json.dumps({
+        "feature": 3, "threshold": 0.0,
+        "left": {"feature": 2, "threshold": 0.0}}))
+    params = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+              "verbose": -1, "forcedsplits_filename": str(fs)}
+    bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=2)
+    for t in bst.dump_model()["tree_info"]:
+        root = t["tree_structure"]
+        assert root["split_feature"] == 3
+        assert abs(root["threshold"] - 0.0) < 0.5
+        assert root["left_child"]["split_feature"] == 2
+    # quality sanity: remaining best-first splits still learn feature 0
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, bst.predict(X)) > 0.9

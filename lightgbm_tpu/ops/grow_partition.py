@@ -284,12 +284,13 @@ def grow_tree_partition_impl(
     state = jax.lax.while_loop(cond, body, state)
 
     # ---- recover row -> leaf labels from the final segments --------------
-    # Per arena position we need (leaf, leaf_start, leaf_cnt) of the segment
-    # covering it.  All three are piecewise-constant step functions of the
-    # position changing only at (address-)sorted segment starts, so each is
-    # materialized by scattering per-segment DELTAS at the starts and
-    # prefix-summing — no [cap]-sized gather or searchsorted (a TPU gather
-    # here costs ~100x more than three cumsums).
+    # Per arena position we need the covering segment's leaf id and
+    # whether the position is inside it.  Both the leaf id and the covering segment's
+    # end are piecewise-constant step functions of the position changing
+    # only at (address-)sorted segment starts, so each is materialized by
+    # scattering per-segment DELTAS at the starts and prefix-summing — no
+    # [cap]-sized gather or searchsorted (a TPU gather here costs ~100x
+    # more than these cumsums).
     tree = state.tree
     live = jnp.arange(L, dtype=jnp.int32) < tree.num_leaves
     starts_eff = jnp.where(live, state.leaf_start, cap)  # dead slots last
@@ -305,11 +306,11 @@ def grow_tree_partition_impl(
         return jnp.cumsum(buf)
 
     leaf_of = step_fn(order)
-    start_of = step_fn(s_sorted)
-    cnt_of = step_fn(jnp.where(live, tree.leaf_count, 0)[order])
+    # validity needs only the covering segment's END: pos is >= its start
+    # by construction, so two step functions (not three) suffice
+    end_of = step_fn(s_sorted + jnp.where(live, tree.leaf_count, 0)[order])
     pos = jnp.arange(cap, dtype=jnp.int32)
-    rel = pos - start_of
-    valid = (rel >= 0) & (rel < cnt_of)
+    valid = pos < end_of
     Fp_row = pp.feature_channels(F)
     rowids = state.arena[Fp_row + 2].astype(jnp.int32)
     leaf_ids = jnp.full(n, -1, jnp.int32)

@@ -19,34 +19,69 @@ CSV, TSV, LIBSVM = "csv", "tsv", "libsvm"
 
 
 def detect_format(sample_lines: List[str]) -> str:
-    """Sniff the delimiter from the first data lines (parser.cpp behavior:
-    ':' pairs -> libsvm, tabs -> tsv, commas -> csv)."""
+    """Sniff the delimiter from the first data lines (parser.cpp:136
+    precedence: any ':' after the first token -> libsvm, regardless of
+    commas/tabs; then tabs -> tsv, commas -> csv).  Must stay identical
+    to the sniff in native/fast_parser.cpp so results don't depend on
+    which parse path ran."""
     for line in sample_lines:
         line = line.strip()
-        if not line:
+        if not line or line.startswith("#"):
+            # blank/comment lines never reach the native sniff either
+            # (split_lines drops them)
             continue
-        tokens = line.split("\t") if "\t" in line else line.split(",")
-        if any(":" in t for t in tokens[1:]):
+        seps = [i for i in (line.find(c) for c in "\t, ") if i >= 0]
+        first_sep = min(seps) if seps else -1
+        if first_sep < 0:
+            # separator-less line (e.g. a featureless libsvm row: bare
+            # label): inconclusive, look at the next line
+            continue
+        if ":" in line[first_sep:]:
             return LIBSVM
         if "\t" in line:
             return TSV
         if "," in line:
             return CSV
-        # single column or space separated; libsvm rows with no features
-        if " " in line:
-            return LIBSVM if any(":" in t for t in line.split()[1:]) else TSV
+        return TSV   # space-separated
     return TSV
 
 
-def _read_head(filename: str, n: int = 32) -> List[str]:
+def _read_head(filename: str, n: int = 32,
+               skip_comments: bool = False) -> List[str]:
+    """First n lines; with skip_comments, first n RELEVANT lines (blank
+    and '#' lines dropped), so a long comment preamble cannot exhaust the
+    sniffing budget the way it cannot on the native path."""
     lines = []
     with open(filename, "r") as f:
-        for _ in range(n):
-            line = f.readline()
-            if not line:
-                break
+        for line in f:
+            if skip_comments:
+                s = line.strip()
+                if not s or s.startswith("#"):
+                    continue
             lines.append(line)
+            if len(lines) >= n:
+                break
     return lines
+
+
+def _float_prefix(tok: str, full: bool = False) -> float:
+    """float(tok), or the longest parseable leading float (strtod
+    semantics, like the native parser); NaN when nothing parses (or,
+    with full=True, when the float does not consume the whole token).
+    Forms float() accepts but strtod does not (underscore grouping,
+    non-ASCII digits) are routed to the prefix match so both parse
+    paths yield the same value."""
+    if "_" not in tok and tok.isascii():
+        try:
+            return float(tok)
+        except ValueError:
+            pass
+    import re
+    m = re.match(r"[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?|[+-]?(inf(inity)?|nan)",
+                 tok, re.ASCII | re.IGNORECASE)
+    if m is None or (full and m.end() != len(tok)):
+        return float("nan")
+    return float(m.group(0))
 
 
 def parse_libsvm(filename: str, num_features_hint: int = 0
@@ -63,12 +98,25 @@ def parse_libsvm(filename: str, num_features_hint: int = 0
             if not line:
                 continue
             toks = line.split()
-            labels.append(float(toks[0]))
+            labels.append(_float_prefix(toks[0]))
             pairs = []
             for t in toks[1:]:
-                k, v = t.split(":", 1)
-                idx = int(k)
-                pairs.append((idx, float(v)))
+                # malformed tokens (no ':', unparsable index) are skipped
+                # and indices/values keep only their leading float (the
+                # index truncated like static_cast<int>), matching the
+                # native parser's fast_atof recovery behavior
+                k, sep, v = t.partition(":")
+                if not sep:
+                    continue
+                fk = _float_prefix(k, full=True)
+                if fk != fk:      # NaN: index didn't parse up to the ':'
+                    # (native drops such tokens: its scanner stops before
+                    # the ':' and treats the remainder as a bare token)
+                    continue
+                idx = int(fk)
+                if idx < 0:
+                    continue
+                pairs.append((idx, _float_prefix(v)))
                 if idx > max_idx:
                     max_idx = idx
             rows.append(pairs)
@@ -120,7 +168,7 @@ def load_text_file(filename: str, header: bool = False,
                 names = [t.strip() for t in raw.split(sep)]
             return mat, None, names
 
-    head = _read_head(filename)
+    head = _read_head(filename, skip_comments=True)
     if header and head:
         head = head[1:]  # sniff data lines, not the header (parser.cpp:101-105)
     fmt = file_format or detect_format(head)

@@ -255,7 +255,7 @@ class GBDT:
                 arrays, leaf_ids = self._grow_one_tree(grad[kk], hess[kk],
                                                        row_init)
                 if deferred_ok:
-                    packed = grow_ops.pack_tree_arrays(arrays)
+                    packed = self._pack_tree_with_flag(arrays)
                     for p in packed:
                         p.copy_to_host_async()
                     self._update_train_score_device(arrays, kk, leaf_ids)
@@ -264,12 +264,19 @@ class GBDT:
                         packed=packed, max_leaves=arrays.max_leaves,
                         cat_bins=arrays.cat_mask.shape[1],
                         init_score=init_scores[kk],
+                        has_trunc_flag=self._last_truncated is not None,
                         slot=len(self.models) - 1))
                     deferred_any = True
                     continue
                 # ONE bulk device->host fetch per tree; per-field reads
-                # would pay a host round-trip each (remote-attached TPUs)
-                host_arrays = grow_ops.fetch_tree_arrays(arrays)
+                # would pay a host round-trip each (remote-attached TPUs).
+                # The arena-truncation flag rides the same fetch.
+                packed = self._pack_tree_with_flag(arrays)
+                ivec, fvec = jax.device_get(packed)   # ONE bulk transfer
+                host_arrays = grow_ops.unpack_tree_vectors(
+                    ivec, fvec, arrays.max_leaves, arrays.cat_mask.shape[1])
+                if self._last_truncated is not None and ivec[-1]:
+                    self._emit_truncation_warning(int(host_arrays.num_leaves))
                 if int(host_arrays.num_leaves) > 1:
                     new_tree = Tree.from_arrays(host_arrays, self.train_set)
 
@@ -309,6 +316,25 @@ class GBDT:
         self.iter += 1
         return False
 
+    def _pack_tree_with_flag(self, arrays):
+        """Pack TreeArrays into (ivec, fvec) for one bulk host fetch; the
+        partition engine's arena-truncation bool rides the int vector (a
+        separate scalar read would pay a full host round-trip per tree)."""
+        packed = grow_ops.pack_tree_arrays(arrays)
+        if self._last_truncated is not None:
+            packed = (jnp.concatenate(
+                [packed[0], self._last_truncated.astype(jnp.int32)[None]]),
+                packed[1])
+        return packed
+
+    def _emit_truncation_warning(self, num_leaves: int) -> None:
+        if self._truncation_warned:
+            return
+        self._truncation_warned = True
+        log.warning("Tree growth truncated at %d leaves by partition-"
+                    "arena overflow; raise tpu_arena_factor (or use "
+                    "tpu_tree_engine=label)", num_leaves)
+
     def _update_train_score_device(self, arrays, class_id: int, leaf_ids):
         """Score update straight from device TreeArrays (deferred path) —
         equivalent to shrink + _update_train_score on the host tree."""
@@ -338,6 +364,8 @@ class GBDT:
                           np.asarray(ent["packed"][1]))
             host_arrays = grow_ops.unpack_tree_vectors(
                 ivec, fvec, ent["max_leaves"], ent["cat_bins"])
+            if ent.get("has_trunc_flag") and ivec[-1]:
+                self._emit_truncation_warning(int(host_arrays.num_leaves))
             new_tree = Tree(1)
             if int(host_arrays.num_leaves) > 1:
                 new_tree = Tree.from_arrays(host_arrays, self.train_set)
@@ -440,6 +468,8 @@ class GBDT:
                    and jax.default_backend() == "tpu" else "label")
         self._use_partition_engine = eng == "partition"
         self._bins_t = None
+        self._last_truncated = None     # device bool from the last grown tree
+        self._truncation_warned = False
         if self._use_partition_engine:
             from ..ops import grow_partition as gp
             self._bins_t = jnp.asarray(
@@ -453,7 +483,8 @@ class GBDT:
         cegb_used = (jnp.asarray(self._cegb_used)
                      if self._cegb_coupled is not None else None)
         if self._use_partition_engine:
-            arrays, leaf_ids, self._arena = self._grow_partition(
+            arrays, leaf_ids, self._arena, self._last_truncated = \
+                self._grow_partition(
                 self._arena, self._bins_t, grad, hess, row_init,
                 self._feature_sample(),
                 self.train_state.num_bins, self.train_state.default_bins,
@@ -609,12 +640,16 @@ class GBDT:
         out = np.zeros((k, n), np.float64)
         # margin-based prediction early stop (prediction_early_stop.cpp:
         # 14-89): rows whose margin clears the threshold stop traversing
-        # further trees, checked every early_stop_freq iterations
+        # further trees.  The reference counts individual TREES between
+        # checks (round_period, gbdt_prediction.cpp traversal loop), so
+        # with k trees per iteration the counter advances by k per step.
         use_es = early_stop and not self.average_output and k >= 1
         active = np.ones(n, bool) if use_es else None
+        es_counter = 0
         for it in range(iters):
-            if use_es and it > 0 and it % max(early_stop_freq, 1) == 0 \
+            if use_es and es_counter >= max(early_stop_freq, 1) \
                and active.any():
+                es_counter = 0
                 if k == 1:
                     # binary margin is 2*|score| (prediction_early_stop
                     # .cpp:30-41)
@@ -634,6 +669,7 @@ class GBDT:
                     out[kk, active] += pred
                 else:
                     out[kk] += pred
+            es_counter += k
         if self.average_output:
             # RF semantics survive model reload (gbdt_model_text.cpp writes
             # the average_output token; rf.hpp averages tree outputs)

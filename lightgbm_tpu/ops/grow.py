@@ -418,29 +418,37 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
 
     # Forced splits first (trace-time unrolled: the BFS plan is static):
     # overwrite the target leaf's cache entry with a +inf-gain forced
-    # result and run one standard body step to apply it.  An invalid
-    # forced split (empty child) must be a NO-OP — otherwise later plan
-    # entries would address the wrong leaf ids — so the stepped state is
-    # selected against the untouched one under the validity flag.
+    # result and run one standard body step to apply it.  The plan's
+    # static leaf numbering assumes every entry applies (entry i targets
+    # static leaf plan[i][0] and creates static leaf i+1), but an entry
+    # can be invalid at runtime (empty child, leaf budget).  A traced
+    # static->dynamic leaf map keeps later entries addressed correctly
+    # regardless: an invalid entry leaves its created leaf mapped to -1,
+    # so its whole forced subtree is abandoned (ForceSplits,
+    # serial_tree_learner.cpp:593-751) while siblings from other branches
+    # still resolve to the right dynamic leaf ids.
     from .split import forced_split_result
+    leafmap = jnp.full((len(forced_splits) + 1,), -1, jnp.int32).at[0].set(0)
     for i, (f_leaf, f_feat, f_thr, f_dl) in enumerate(forced_splits):
         if i >= max_leaves - 1:
             break      # each applied split adds one leaf; bound the count
-        f_hist = state.hist_cache[f_leaf]
+        dyn_leaf = leafmap[f_leaf]
+        safe_leaf = jnp.maximum(dyn_leaf, 0)
+        f_hist = state.hist_cache[safe_leaf]
         fsp = forced_split_result(
             f_hist, jnp.int32(f_feat), jnp.int32(f_thr),
             jnp.sum(f_hist[0, :, 0]), jnp.sum(f_hist[0, :, 1]),
-            state.tree.leaf_count[f_leaf],
+            state.tree.leaf_count[safe_leaf],
             num_bins, default_bins, missing_types, params,
             jnp.asarray(bool(f_dl)))
         if state.split_cache.cat_mask is not None:
             fsp = fsp._replace(
                 cat_mask=jnp.zeros(state.split_cache.cat_mask.shape[1], bool))
-        valid = (fsp.gain > K_MIN_SCORE) & \
+        valid = (dyn_leaf >= 0) & (fsp.gain > K_MIN_SCORE) & \
                 (state.tree.num_leaves < max_leaves)
-        prev_entry = _index_split(state.split_cache, f_leaf)
         injected = state._replace(
-            split_cache=_stack_split(fsp, state.split_cache, f_leaf))
+            split_cache=_stack_split(fsp, state.split_cache, safe_leaf))
+        dyn_new = state.tree.num_leaves    # right-child leaf id body assigns
         stepped = body(injected)._replace(done=jnp.asarray(False))
 
         def _sel(a, b):
@@ -451,6 +459,12 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
         state = jax.tree_util.tree_map(
             _sel, stepped, state,
             is_leaf=lambda x: x is None)
+        leafmap = leafmap.at[i + 1].set(jnp.where(valid, dyn_new, -1))
+        # on failure also unmap the target: the only later entry that
+        # references static id f_leaf is this entry's LEFT-child entry
+        # (each static leaf is split at most once), which must be
+        # abandoned along with the right subtree
+        leafmap = leafmap.at[f_leaf].set(jnp.where(valid, dyn_leaf, -1))
 
     state = jax.lax.while_loop(cond, body, state)
     return state.tree, state.leaf_ids

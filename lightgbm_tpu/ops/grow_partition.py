@@ -12,9 +12,10 @@ recovered by subtraction, feature_histogram.hpp:67-73).
 
 Segment allocation is a device-side bump allocator in 256-column units:
 the larger child overwrites the parent segment in place, the smaller
-child is appended at the cursor.  On overflow the live segments are
-compacted to the front with one XLA gather (rare; the default arena
-budget covers a balanced 255-leaf tree).
+child is appended at the cursor.  On overflow the tree simply stops
+growing (a debug print fires; raise tpu_arena_factor) — the default
+arena budget covers a balanced 255-leaf tree, and the GBDT driver falls
+back to the label engine for configs that need full generality.
 
 Restrictions vs the label engine (the GBDT driver auto-selects): serial
 learner only (no collectives), f32 only, max_bin <= 256, no categorical
@@ -50,6 +51,7 @@ class PartState(NamedTuple):
     split_cache: SplitResult
     done: jnp.ndarray
     cegb_used: jnp.ndarray         # [F] bool (CEGB coupled feature_used)
+    truncated: jnp.ndarray         # bool: growth stopped by arena overflow
 
 
 def grow_tree_partition_impl(
@@ -74,9 +76,11 @@ def grow_tree_partition_impl(
         interpret: bool = False):
     """Grow one leaf-wise tree.
 
-    Returns (TreeArrays, leaf_ids [n] int32, arena) — the arena scratch is
-    returned so the caller can thread (and donate) it across trees instead
-    of re-materializing a multi-GB zero buffer per iteration.
+    Returns (TreeArrays, leaf_ids [n] int32, arena, truncated) — the arena
+    scratch is returned so the caller can thread (and donate) it across
+    trees instead of re-materializing a multi-GB zero buffer per
+    iteration; `truncated` (bool scalar) reports growth stopped early by
+    arena overflow so the driver can warn (raise tpu_arena_factor).
     """
     F, n = bins_t.shape
     C, cap = arena_buf.shape
@@ -151,7 +155,8 @@ def grow_tree_partition_impl(
         tree=tree, arena=arena,
         leaf_start=jnp.zeros(L, jnp.int32), cursor=cursor0,
         hist_cache=hist_cache, split_cache=split_cache,
-        done=jnp.asarray(False), cegb_used=cegb_used0)
+        done=jnp.asarray(False), cegb_used=cegb_used0,
+        truncated=jnp.asarray(False))
 
     def cond(state: PartState):
         return (~state.done) & (state.tree.num_leaves < L)
@@ -179,8 +184,11 @@ def grow_tree_partition_impl(
         need = _align(small_cnt, ALLOC)
 
         # bump-allocator overflow: stop growing this tree (the arena
-        # budget covers balanced trees; pathological shapes truncate)
-        no_split = no_split | (state.cursor + need + pp.TILE > cap)
+        # budget covers balanced trees; pathological shapes truncate —
+        # the flag is surfaced so the driver can warn the user to raise
+        # tpu_arena_factor)
+        overflow = (~no_split) & (state.cursor + need + pp.TILE > cap)
+        no_split = no_split | overflow
 
         s0 = state.leaf_start[best_leaf]
         cntP = jnp.where(no_split, 0, tree.leaf_count[best_leaf])
@@ -279,7 +287,8 @@ def grow_tree_partition_impl(
             cursor=sel(state.cursor, cursor),
             hist_cache=sel(state.hist_cache, hist_cache),
             split_cache=split_cache,
-            done=keep, cegb_used=sel(state.cegb_used, used2))
+            done=keep, cegb_used=sel(state.cegb_used, used2),
+            truncated=state.truncated | overflow)
 
     state = jax.lax.while_loop(cond, body, state)
 
@@ -316,7 +325,7 @@ def grow_tree_partition_impl(
     leaf_ids = jnp.full(n, -1, jnp.int32)
     leaf_ids = leaf_ids.at[jnp.where(valid, rowids, n)].set(
         leaf_of, mode="drop")
-    return tree, leaf_ids, state.arena
+    return tree, leaf_ids, state.arena, state.truncated
 
 
 grow_tree_partition = partial(jax.jit, static_argnames=(

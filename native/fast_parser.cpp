@@ -10,6 +10,7 @@
 //            fast_parser.cpp -lpthread
 #include <algorithm>
 #include <cctype>
+#include <charconv>
 #include <cstdio>
 #include <functional>
 #include <cstdint>
@@ -22,60 +23,70 @@
 
 namespace {
 
-// fast float parse (reference uses its own Atof, utils/common.h); falls
-// back to strtod for exotic forms (exponents, inf/nan hit the slow path)
-inline const char* fast_atof(const char* p, double* out) {
-  while (*p == ' ') ++p;
+// locale-independent, correctly-rounded double parse: strtod obeys
+// LC_NUMERIC (a host app's setlocale(LC_NUMERIC, "de_DE") would silently
+// stop every "3.14" at the '.'), std::from_chars never does, and it
+// matches Python float() bit-for-bit.  Accepts inf/nan (general fmt).
+// Returns the end of the consumed token, or `first` on failure.
+inline const char* parse_double(const char* first, const char* last,
+                                double* out) {
+  auto res = std::from_chars(first, last, *out);
+  if (res.ec == std::errc::result_out_of_range)
+    return res.ptr;   // strtod semantics: +-inf / +-0, token consumed
+  if (res.ec != std::errc())
+    return first;
+  return res.ptr;
+}
+
+// fast float parse: short integers on the fast path; anything with a
+// fraction, exponent, or >15 digits goes through from_chars so the
+// result is bit-identical to the Python fallback's float() (binning is
+// boundary-sensitive, so the two parse paths must agree exactly, not to
+// within a few ULP).  `lend` bounds the scan (line end; the file buffer
+// is not NUL-terminated).
+inline const char* fast_atof(const char* p, const char* lend, double* out) {
+  while (p < lend && *p == ' ') ++p;
   bool neg = false;
-  if (*p == '-') { neg = true; ++p; }
-  else if (*p == '+') { ++p; }
-  if (std::isdigit(static_cast<unsigned char>(*p)) || *p == '.') {
+  if (p < lend && (*p == '-' || *p == '+')) {
+    neg = (*p == '-');
+    ++p;
+  }
+  if (p < lend &&
+      (std::isdigit(static_cast<unsigned char>(*p)) || *p == '.')) {
+    const char* digs = p;   // from_chars takes '-' but not '+': re-sign
     double v = 0.0;
-    while (std::isdigit(static_cast<unsigned char>(*p))) {
+    int digits = 0;
+    while (p < lend && std::isdigit(static_cast<unsigned char>(*p))) {
       v = v * 10.0 + (*p - '0');
+      ++digits;
       ++p;
     }
-    if (*p == '.') {
-      ++p;
-      double scale = 0.1;
-      while (std::isdigit(static_cast<unsigned char>(*p))) {
-        v += (*p - '0') * scale;
-        scale *= 0.1;
-        ++p;
+    // >15 digits: v*10+d double-rounds past 2^53; from_chars rounds once
+    if ((p < lend && (*p == '.' || *p == 'e' || *p == 'E'))
+        || digits > 15) {
+      double d = 0.0;
+      const char* q = parse_double(digs, lend, &d);
+      if (q == digs) {
+        *out = std::nan("");
+        return p;
       }
-    }
-    if (*p == 'e' || *p == 'E') {  // exponent: redo with strtod for accuracy
-      char* end = nullptr;
-      // back up: we do not track the token start here, so scan forward
-      // from the exponent with a manual pow10
-      ++p;
-      bool eneg = false;
-      if (*p == '-') { eneg = true; ++p; }
-      else if (*p == '+') { ++p; }
-      int ex = 0;
-      while (std::isdigit(static_cast<unsigned char>(*p))) {
-        ex = ex * 10 + (*p - '0');
-        ++p;
-      }
-      double scale = 1.0;
-      for (int i = 0; i < ex; ++i) scale *= 10.0;
-      v = eneg ? v / scale : v * scale;
-      (void)end;
+      *out = neg ? -d : d;
+      return q;
     }
     *out = neg ? -v : v;
     return p;
   }
-  // nan / inf / NA / empty field: strtod handles nan/inf; anything it
+  // nan / inf / NA / empty field: from_chars handles nan/inf; anything it
   // cannot consume (NA, empty before a separator) becomes NaN so missing
   // values match the pandas fallback (NaN), not silently 0.0
-  char* end = nullptr;
-  double v = std::strtod(p, &end);
-  if (end == p) {
+  double d = 0.0;
+  const char* q = parse_double(p, lend, &d);
+  if (q == p) {
     *out = std::nan("");
     return p;
   }
-  *out = neg ? -v : v;
-  return end;
+  *out = neg ? -d : d;
+  return q;
 }
 
 struct Lines {
@@ -121,7 +132,7 @@ void parse_rows_delim(const Lines& lines, size_t row0, size_t row1,
         continue;
       }
       double v = 0.0;
-      p = fast_atof(p, &v);
+      p = fast_atof(p, end, &v);
       dst[c] = v;
       while (p < end && *p != sep) ++p;
       if (p < end) ++p;  // skip separator
@@ -137,17 +148,17 @@ void parse_rows_libsvm(const Lines& lines, size_t row0, size_t row1,
     double* dst = out + r * ncol;
     std::memset(dst, 0, sizeof(double) * ncol);
     double lab = 0.0;
-    p = fast_atof(p, &lab);
+    p = fast_atof(p, end, &lab);
     labels[r] = lab;
     while (p < end) {
       while (p < end && *p == ' ') ++p;
       if (p >= end || *p == '#') break;
       double idx = 0.0;
-      p = fast_atof(p, &idx);
+      p = fast_atof(p, end, &idx);
       if (p < end && *p == ':') {
         ++p;
         double v = 0.0;
-        p = fast_atof(p, &v);
+        p = fast_atof(p, end, &v);
         int i = static_cast<int>(idx);
         if (i >= 0 && i < ncol) dst[i] = v;
       } else {
@@ -163,16 +174,16 @@ int libsvm_max_index(const Lines& lines, size_t row0, size_t row1) {
     const char* p = lines.data + lines.offsets[r];
     const char* end = lines.data + lines.ends[r];
     double lab;
-    p = fast_atof(p, &lab);
+    p = fast_atof(p, end, &lab);
     while (p < end) {
       while (p < end && *p == ' ') ++p;
       if (p >= end || *p == '#') break;
       double idx = 0.0;
-      p = fast_atof(p, &idx);
+      p = fast_atof(p, end, &idx);
       if (p < end && *p == ':') {
         ++p;
         double v;
-        p = fast_atof(p, &v);
+        p = fast_atof(p, end, &v);
         if (static_cast<int>(idx) > mx) mx = static_cast<int>(idx);
       } else {
         while (p < end && *p != ' ') ++p;
@@ -234,20 +245,36 @@ int tpugbdt_parse_file(const char* path, int skip_header, int num_threads,
   body.offsets.assign(lines.offsets.begin() + first, lines.offsets.end());
   body.ends.assign(lines.ends.begin() + first, lines.ends.end());
 
-  // format sniff on the first data line (parser.cpp CreateParser)
+  // format sniff: colon takes precedence over the delimiters (reference
+  // parser.cpp:136; parser.py detect_format implements the same rule), so
+  // both parse paths agree no matter which one ran.  A colon inside the
+  // first token (the label) is ignored, lines are stripped of surrounding
+  // whitespace first, and separator-less lines (featureless libsvm rows)
+  // are inconclusive — look at the next line, up to 32 like _read_head.
+  bool has_tab = false, has_comma = false, has_colon = false;
+  for (size_t r = 0; r < nrows && r < 32; ++r) {
+    const char* q0 = body.data + body.offsets[r];
+    const char* qe = body.data + body.ends[r];
+    while (q0 < qe && (*q0 == ' ' || *q0 == '\t')) ++q0;   // strip, like
+    while (qe > q0 && (qe[-1] == ' ' || qe[-1] == '\t')) --qe;  // .strip()
+    bool tab = false, comma = false, colon = false, past_first = false;
+    for (const char* q = q0; q < qe; ++q) {
+      if (*q == '\t') { tab = true; past_first = true; }
+      else if (*q == ',') { comma = true; past_first = true; }
+      else if (*q == ' ') { past_first = true; }
+      else if (*q == ':' && past_first) { colon = true; }
+    }
+    if (!past_first) continue;   // single token: inconclusive
+    has_tab = tab; has_comma = comma; has_colon = colon;
+    break;
+  }
   const char* p = body.data + body.offsets[0];
   const char* end = body.data + body.ends[0];
-  bool has_tab = false, has_comma = false, has_colon = false;
-  for (const char* q = p; q < end; ++q) {
-    if (*q == '\t') has_tab = true;
-    else if (*q == ',') has_comma = true;
-    else if (*q == ':') has_colon = true;
-  }
   int threads = num_threads > 0
       ? num_threads
       : static_cast<int>(std::thread::hardware_concurrency());
 
-  if (has_colon && !has_comma) {
+  if (has_colon) {
     // libsvm
     std::vector<int> maxes(threads > 0 ? threads : 1, -1);
     {
@@ -270,7 +297,11 @@ int tpugbdt_parse_file(const char* path, int skip_header, int num_threads,
     double* data =
         static_cast<double*>(std::malloc(sizeof(double) * nrows * ncol));
     double* labels = static_cast<double*>(std::malloc(sizeof(double) * nrows));
-    if (!data || !labels) return 4;
+    if (!data || !labels) {
+      std::free(data);
+      std::free(labels);
+      return 4;
+    }
     parallel_for(nrows, threads, [&](size_t a, size_t b) {
       parse_rows_libsvm(body, a, b, ncol, data, labels);
     });

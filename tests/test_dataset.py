@@ -105,3 +105,72 @@ def test_query_metadata():
     meta2.set_label(np.zeros(6))
     meta2.set_query_from_ids([5, 5, 7, 7, 7, 9])
     np.testing.assert_array_equal(meta2.query_boundaries, [0, 2, 5, 6])
+
+
+class TestNativeParserParity:
+    """Native fast_parser must agree exactly with the Python fallback:
+    same format sniff (colon precedence) and bit-identical floats."""
+
+    def test_libsvm_with_comma_in_line(self, tmp_path):
+        # a colon-bearing line that also contains a comma must still sniff
+        # as libsvm on BOTH paths (reference parser.cpp:136 precedence)
+        from lightgbm_tpu.io import native, parser
+        p = tmp_path / "x.txt"
+        p.write_text("1 0:1.5 2:2,5\n0 1:3.25\n")
+        res = native.parse_file(str(p))
+        assert res is not None, "native lib unavailable"
+        mat, labels, fmt = res
+        assert fmt == 2  # libsvm
+        assert parser.detect_format(["1 0:1.5 2:2,5"]) == parser.LIBSVM
+        np.testing.assert_array_equal(labels, [1.0, 0.0])
+        # the Python fallback must parse the same file to the same values
+        # (malformed value keeps its leading float, like fast_atof)
+        Xp, yp = parser.parse_libsvm(str(p))
+        np.testing.assert_array_equal(yp, labels)
+        np.testing.assert_array_equal(Xp, mat)
+
+    def test_featureless_first_libsvm_row(self, tmp_path):
+        # a bare-label first row is inconclusive: both sniffs must look at
+        # the next line and classify the file as libsvm
+        from lightgbm_tpu.io import native, parser
+        p = tmp_path / "s.txt"
+        p.write_text("1\n0 1:3.5 4:2\n")
+        assert parser.detect_format(["1", "0 1:3.5 4:2"]) == parser.LIBSVM
+        res = native.parse_file(str(p))
+        assert res is not None, "native lib unavailable"
+        mat, labels, fmt = res
+        assert fmt == 2
+        np.testing.assert_array_equal(labels, [1.0, 0.0])
+        assert mat.shape == (2, 5) and mat[1, 1] == 3.5 and mat[1, 4] == 2.0
+
+    def test_float_parity_with_python(self, tmp_path):
+        from lightgbm_tpu.io import native
+        rows = []
+        vals = ["229607991558730021", "1e-7", "3.141592653589793",
+                "-0.1", "2.5e300", "123456789012345678901234567890",
+                "0.30000000000000004", "7", "-9007199254740993"]
+        for i in range(0, len(vals), 3):
+            rows.append("\t".join(vals[i:i + 3]))
+        p = tmp_path / "f.tsv"
+        p.write_text("\n".join(rows) + "\n")
+        res = native.parse_file(str(p))
+        assert res is not None, "native lib unavailable"
+        mat, _, fmt = res
+        expect = np.array([[float(v) for v in vals[i:i + 3]]
+                           for i in range(0, len(vals), 3)])
+        np.testing.assert_array_equal(mat, expect)  # bitwise
+
+    def test_exotic_libsvm_indices_parity(self, tmp_path):
+        # strtod-parsable indices ('1e2', '2.7') truncate like the native
+        # static_cast<int>; float()-only forms ('1_0') are rejected on both
+        from lightgbm_tpu.io import native, parser
+        p = tmp_path / "e.txt"
+        p.write_text("1 1e1:7 2.7:5 1_0:9\n0 0:1\n")
+        res = native.parse_file(str(p))
+        assert res is not None, "native lib unavailable"
+        mat, labels, fmt = res
+        assert fmt == 2
+        Xp, yp = parser.parse_libsvm(str(p), num_features_hint=mat.shape[1])
+        np.testing.assert_array_equal(yp, labels)
+        np.testing.assert_array_equal(Xp, mat)
+        assert mat[0, 10] == 7.0 and mat[0, 2] == 5.0

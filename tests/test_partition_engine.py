@@ -26,7 +26,7 @@ def _grow_both(bins, grad, hess, row0, nb, db, mt, params, max_leaves,
         jnp.asarray(mt), params, max_leaves=max_leaves, max_bin=max_bin,
         max_depth=max_depth, hist_impl="scatter")
     arena = jnp.zeros((pp.arena_channels(F), 8 * pp.TILE), jnp.float32)
-    t2, l2, _ = gp.grow_tree_partition(
+    t2, l2, _, _ = gp.grow_tree_partition(
         arena, jnp.asarray(bins.T.astype(np.float32)),
         jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(row0), fmask,
         jnp.asarray(nb), jnp.asarray(db), jnp.asarray(mt), params,

@@ -117,3 +117,52 @@ class TestGossInitScore:
                          "objective": "none", "verbose": -1},
                         ds, num_boost_round=8, fobj=fobj)
         assert bst.num_trees() == 8
+
+
+class TestForcedSplitAbandonment:
+    """An invalid forced split must abandon its whole forced subtree
+    (ForceSplits, serial_tree_learner.cpp:593-751) without desyncing the
+    leaf addressing of entries from other branches."""
+
+    def _grow(self, plan, rng):
+        from lightgbm_tpu.ops.grow import grow_tree
+        from lightgbm_tpu.ops.split import SplitParams
+        import jax.numpy as jnp
+        n, B = 256, 16
+        bins = np.zeros((n, 3), np.uint8)
+        bins[:, 0] = np.arange(n) % 16          # valid split anywhere
+        bins[:, 1] = 9                          # constant: any split invalid
+        bins[:, 2] = np.where(np.arange(n) % 2 == 0, 3, 12)
+        grad = rng.randn(n)
+        return grow_tree(
+            jnp.asarray(bins), jnp.asarray(grad, jnp.float32),
+            jnp.ones(n, jnp.float32), jnp.zeros(n, jnp.int32),
+            jnp.ones(3, bool), jnp.full(3, B, jnp.int32),
+            jnp.zeros(3, jnp.int32), jnp.zeros(3, jnp.int32),
+            SplitParams(min_data_in_leaf=1, min_sum_hessian_in_leaf=0.0),
+            forced_splits=plan, max_leaves=8, max_bin=B,
+            hist_impl="scatter")
+
+    def test_invalid_root_abandons_descendants(self, rng):
+        # root entry forces constant feature 1 (empty child -> invalid);
+        # its child entries must NOT be applied to the unsplit root
+        plan = ((0, 1, 4, False), (0, 2, 7, False), (1, 2, 7, False))
+        t_forced, _ = self._grow(plan, np.random.RandomState(7))
+        t_plain, _ = self._grow((), np.random.RandomState(7))
+        assert int(t_forced.num_leaves) == int(t_plain.num_leaves)
+        np.testing.assert_array_equal(np.asarray(t_forced.split_feature),
+                                      np.asarray(t_plain.split_feature))
+        np.testing.assert_array_equal(np.asarray(t_forced.threshold_bin),
+                                      np.asarray(t_plain.threshold_bin))
+
+    def test_invalid_left_child_keeps_right_sibling(self, rng):
+        # valid root; invalid left-child entry; valid right-child entry:
+        # the right sibling must still land on the root's right child
+        plan = ((0, 0, 7, False), (0, 1, 4, False), (1, 2, 7, False))
+        tree, _ = self._grow(plan, np.random.RandomState(7))
+        sf = np.asarray(tree.split_feature)
+        thr = np.asarray(tree.threshold_bin)
+        assert (sf[0], thr[0]) == (0, 7)
+        assert (sf[1], thr[1]) == (2, 7)
+        # node 1 must be the root's right child (leaf 1 was split)
+        assert int(np.asarray(tree.right_child)[0]) == 1

@@ -75,8 +75,10 @@ class Application:
         d = loader_mod.load_data_file(cfg, cfg.data,
                                       rank=cfg.machine_rank,
                                       num_machines=cfg.num_machines,
-                                      pre_partition=pre_partition)
+                                      pre_partition=pre_partition,
+                                      initscore_filename=cfg.initscore_filename)
         ds = basic.Dataset(d.X, label=d.label, weight=d.weight, group=d.group,
+                           init_score=d.init_score,
                            params=dict(self.raw_params),
                            feature_name=d.feature_names or "auto",
                            categorical_feature=d.categorical or "auto")
@@ -87,10 +89,13 @@ class Application:
         train_set = self._load_train_data()
         valid_sets, valid_names = [], []
         for i, vf in enumerate(cfg.valid):
-            vd = loader_mod.load_data_file(cfg, vf)
+            # per-valid-set initscore files (application.cpp:138)
+            vis = (cfg.valid_data_initscores[i]
+                   if i < len(cfg.valid_data_initscores) else "")
+            vd = loader_mod.load_data_file(cfg, vf, initscore_filename=vis)
             valid_sets.append(basic.Dataset(
                 vd.X, label=vd.label, weight=vd.weight, group=vd.group,
-                reference=train_set))
+                init_score=vd.init_score, reference=train_set))
             name = vf.split("/")[-1]
             valid_names.append(name)
         callbacks = []

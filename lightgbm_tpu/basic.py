@@ -3,8 +3,7 @@
 Python-API mirror of python-package/lightgbm/basic.py: lazily-constructed
 Dataset with reference alignment, pandas/categorical handling, field get/set;
 Booster with update (incl. custom fobj), eval, save/load, predict.  The ctypes
-C-ABI hop of the reference is replaced by direct calls into the framework —
-the C API shim (c_api.py) re-exposes the same behavior for ABI parity.
+C-ABI hop of the reference is replaced by direct calls into the framework.
 """
 from __future__ import annotations
 
@@ -50,7 +49,10 @@ def _to_matrix(data, label=None):
     try:
         import scipy.sparse as sp
         if sp.issparse(data):
-            return np.asarray(data.todense(), np.float64), label, None
+            # stays sparse: BinnedDataset.construct bins column-wise from
+            # the stored entries (no dense materialization, c_api.cpp
+            # CSR/CSC ingestion analogue)
+            return data.tocsr(), label, None
     except ImportError:
         pass
     arr = np.asarray(data, np.float64)
@@ -109,7 +111,7 @@ class Dataset:
             label = self.label
         cfg = Config(self.params)
 
-        meta = Metadata(len(mat))
+        meta = Metadata(mat.shape[0])
         if label is not None:
             meta.set_label(np.asarray(label))
         self._set_fields(meta)
@@ -254,6 +256,15 @@ class Booster:
         if train_set is not None:
             if not isinstance(train_set, Dataset):
                 raise LightGBMError("Training data should be Dataset instance")
+            # merge training params into the dataset before construction
+            # (Dataset._update_params, basic.py:843: train params override
+            # dataset params so dataset-relevant keys like max_bin /
+            # monotone_constraints passed to train() take effect); a
+            # dataset that was already constructed keeps its binning
+            if train_set._binned is None and self.params:
+                merged = dict(train_set.params)
+                merged.update(self.params)
+                train_set.params = merged
             train_set.construct()
             cfg = Config(self.params)
             objective = None

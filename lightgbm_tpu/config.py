@@ -350,6 +350,23 @@ def alias_transform(params: Dict[str, Any]) -> Dict[str, Any]:
     return out
 
 
+# Params parsed for conf-file compatibility but without effect in this
+# build (warned once per process when set to a non-default value).  Keep
+# this in sync as features land: a key must leave this table the moment
+# it starts acting.
+_INERT_PARAMS: Dict[str, str] = {
+    "two_round": "the whole text file is parsed in memory "
+                 "(no two-round/streaming ingest yet)",
+    "histogram_pool_size": "the per-leaf histogram cache is a fixed "
+                           "[num_leaves, F, bins, 3] device tensor sized "
+                           "by num_leaves, not by a memory budget",
+    "is_enable_sparse": "bin storage is always dense on TPU (EFB bundles "
+                        "sparse features into dense groups instead)",
+    "sparse_threshold": "bin storage is always dense on TPU",
+}
+_INERT_WARNED: set = set()
+
+
 class Config:
     """Flat parameter struct; fields mirror the reference Config
     (include/LightGBM/config.h:98-799)."""
@@ -370,6 +387,13 @@ class Config:
         for k, v in params.items():
             if k in PARAMETER_SET and v is not None:
                 setattr(self, k, _coerce(k, PARAMETER_TYPES[k], v))
+                if k in _INERT_PARAMS and k not in _INERT_WARNED \
+                        and getattr(self, k) != PARAMETER_DEFAULTS[k]:
+                    # accepted-but-inert knobs must warn, not silently
+                    # no-op (the reference either acts on or rejects them)
+                    _INERT_WARNED.add(k)
+                    log.warning("%s is accepted but has no effect: %s",
+                                k, _INERT_PARAMS[k])
         self._resolve_names()
         self.check_param_conflict()
 

@@ -6,8 +6,8 @@ BinMappers into a dense device-resident bin matrix `[num_data, num_features]`
 (uint8 when every feature has <=256 bins, else uint16).  Histograms are flat
 `[total_bins, 3]` arrays addressed by per-feature offsets — the dense layout
 replaces the reference's FeatureGroup/sparse-bin machinery, which does not map
-to TPU (the reference's own GPU learner also densifies; EFB bundling keeps the
-width down for sparse data).
+to TPU (the reference's own GPU learner also densifies sparse groups); EFB
+bundling (io/efb.py) keeps the column count down for sparse-wide data.
 """
 from __future__ import annotations
 
@@ -23,6 +23,14 @@ from .metadata import Metadata
 _BINARY_MAGIC = "lightgbm_tpu_dataset_v1"
 
 
+def _issparse(X) -> bool:
+    try:
+        import scipy.sparse as sp
+        return sp.issparse(X)
+    except ImportError:
+        return False
+
+
 class BinnedDataset:
     """Binned feature matrix + per-feature mappers + metadata."""
 
@@ -33,6 +41,9 @@ class BinnedDataset:
         self.real_feature_index: List[int] = []    # inner idx -> raw idx
         self.bin_mappers: List[BinMapper] = []     # per inner feature
         self.bins: Optional[np.ndarray] = None     # [n, F_used] uint8/16 host
+        #   (with EFB bundling active: [n, num_groups] bundled columns —
+        #    see io/efb.py for the encoding; self.bundle holds the layout)
+        self.bundle = None                         # Optional[efb.BundleInfo]
         self.feature_offsets: Optional[np.ndarray] = None  # [F_used+1] i32
         self.metadata = Metadata()
         self.feature_names: List[str] = []
@@ -54,10 +65,19 @@ class BinnedDataset:
 
         With `reference` given, reuse its bin mappers (validation-set path,
         dataset.h CreateValid / basic.py reference alignment).
+
+        X may be a scipy.sparse matrix: binning then works column-wise on
+        the stored entries only (the CSR/CSC ingestion of c_api.cpp:
+        602-747) — the dense [n, F] float matrix is never materialized,
+        and with EFB the binned output is [n, num_groups] directly.
         """
-        X = np.asarray(X)
-        if X.ndim != 2:
-            log.fatal("Input data must be 2-dimensional")
+        if _issparse(X):
+            import scipy.sparse as sp
+            X = X.tocsr()
+        else:
+            X = np.asarray(X)
+            if X.ndim != 2:
+                log.fatal("Input data must be 2-dimensional")
         n, num_raw = X.shape
         ds = cls()
         ds.num_data = n
@@ -78,6 +98,7 @@ class BinnedDataset:
             ds.monotone_constraints = reference.monotone_constraints
             ds.feature_penalty = reference.feature_penalty
             ds.max_bin = reference.max_bin
+            ds.bundle = reference.bundle     # same bundled layout
             ds._bin_all(X)
             return ds
 
@@ -90,6 +111,8 @@ class BinnedDataset:
             sample_indices = (np.arange(n) if sample_cnt >= n else
                               np.sort(rng.choice(n, sample_cnt, replace=False)))
         Xs = X[sample_indices]
+        if _issparse(Xs):
+            Xs = Xs.tocsc()   # column access for find-bin / bundling
 
         # --- find bins per raw feature ------------------------------------
         # trivial-feature filter count scales with the sampling fraction
@@ -97,10 +120,15 @@ class BinnedDataset:
         filter_cnt = max(1, int(config.min_data_in_leaf * len(sample_indices) / n))
         mappers: List[Optional[BinMapper]] = []
         for f in range(num_raw):
-            col = np.asarray(Xs[:, f], dtype=np.float64)
+            if _issparse(Xs):
+                # stored entries only — implicit zeros are not "nonzero"
+                col = np.asarray(
+                    Xs.data[Xs.indptr[f]:Xs.indptr[f + 1]], np.float64)
+            else:
+                col = np.asarray(Xs[:, f], dtype=np.float64)
             nonzero = col[(np.abs(col) > 1e-35) | np.isnan(col)]
             m = BinMapper()
-            m.find_bin(nonzero, len(col),
+            m.find_bin(nonzero, Xs.shape[0],
                        config.max_bin, config.min_data_in_bin,
                        filter_cnt,
                        CATEGORICAL if f in cat_set else NUMERICAL,
@@ -121,8 +149,42 @@ class BinnedDataset:
                             else ["Column_%d" % i for i in range(num_raw)])
         ds._set_offsets()
         ds._resolve_constraints(config)
+        ds._find_bundles(Xs, config)
         ds._bin_all(X)
         return ds
+
+    def _find_bundles(self, Xs: np.ndarray, config) -> None:
+        """EFB grouping from the sampled rows (FastFeatureBundling,
+        dataset.cpp:139-212).  Decided on the sample so the full
+        per-feature matrix never needs materializing for wide data."""
+        if not config.enable_bundle or self.num_features <= 1:
+            return
+        if config.tree_learner == "feature":
+            # feature-parallel shards scan units by raw feature; bundled
+            # columns would shard groups instead — keep features separate
+            log.debug("EFB disabled for feature-parallel tree learner")
+            return
+        from . import efb
+        F = self.num_features
+        S = Xs.shape[0]
+        nonzero_rows = []
+        for inner, raw in enumerate(self.real_feature_index):
+            m = self.bin_mappers[inner]
+            if _issparse(Xs):
+                j0, j1 = Xs.indptr[raw], Xs.indptr[raw + 1]
+                rows = Xs.indices[j0:j1]
+                b = m.values_to_bins(np.asarray(Xs.data[j0:j1], np.float64))
+                nonzero_rows.append(rows[b != m.default_bin])
+            else:
+                b = m.values_to_bins(np.asarray(Xs[:, raw], np.float64))
+                nonzero_rows.append(np.flatnonzero(b != m.default_bin))
+        self.bundle = efb.fast_feature_bundling(
+            nonzero_rows, S, [m.num_bin for m in self.bin_mappers],
+            [m.default_bin for m in self.bin_mappers],
+            config.max_conflict_rate, config.min_data_in_leaf, self.num_data)
+        if self.bundle is not None:
+            log.info("EFB bundled %d features into %d groups",
+                     F, self.bundle.num_groups)
 
     def _set_offsets(self) -> None:
         nb = [m.num_bin for m in self.bin_mappers]
@@ -146,15 +208,95 @@ class BinnedDataset:
                 [config.feature_contri[raw] for raw in self.real_feature_index],
                 dtype=np.float64)
 
-    def _bin_all(self, X: np.ndarray) -> None:
+    def _bin_all(self, X) -> None:
+        if _issparse(X):
+            self._bin_all_sparse(X)
+            return
         n = X.shape[0]
         F = self.num_features
+        if self.bundle is not None:
+            # bundled build: one column at a time straight into its group
+            # column (later features of a group win conflicts, matching
+            # sequential FeatureGroup::PushData) — the full [n, F] matrix
+            # is never materialized
+            info = self.bundle
+            dtype = (np.uint8 if int(info.group_num_bins.max()) <= 256
+                     else np.uint16)
+            bins = np.zeros((n, info.num_groups), dtype)
+            for g, feats in enumerate(info.groups):
+                if len(feats) == 1:
+                    inner = feats[0]
+                    raw = self.real_feature_index[inner]
+                    bins[:, g] = self.bin_mappers[inner].values_to_bins(
+                        np.asarray(X[:, raw], np.float64)).astype(dtype)
+                    continue
+                col = np.zeros(n, np.int64)
+                for inner in feats:
+                    raw = self.real_feature_index[inner]
+                    b = self.bin_mappers[inner].values_to_bins(
+                        np.asarray(X[:, raw], np.float64)).astype(np.int64)
+                    nz = b != int(info.feature_default[inner])
+                    col = np.where(nz, b + int(info.feature_shift[inner]), col)
+                bins[:, g] = col.astype(dtype)
+            self.bins = bins
+            self._device_cache.clear()
+            return
         max_nb = max((m.num_bin for m in self.bin_mappers), default=2)
         dtype = np.uint8 if max_nb <= 256 else np.uint16
         bins = np.empty((n, F), dtype=dtype)
         for inner, raw in enumerate(self.real_feature_index):
             bins[:, inner] = self.bin_mappers[inner].values_to_bins(
                 np.asarray(X[:, raw], dtype=np.float64)).astype(dtype)
+        self.bins = bins
+        self._device_cache.clear()
+
+    def _bin_all_sparse(self, X) -> None:
+        """Column-wise binning from CSC stored entries (c_api.cpp:602-747
+        CSR/CSC ingestion): implicit zeros land in each feature's default
+        bin (== ValueToBin(0), bin.h GetDefaultBin) without materializing
+        the dense matrix."""
+        Xc = X.tocsc()
+        n = Xc.shape[0]
+        info = self.bundle
+
+        def col_entries(inner):
+            raw = self.real_feature_index[inner]
+            j0, j1 = Xc.indptr[raw], Xc.indptr[raw + 1]
+            rows = Xc.indices[j0:j1]
+            b = self.bin_mappers[inner].values_to_bins(
+                np.asarray(Xc.data[j0:j1], np.float64))
+            return rows, b
+
+        if info is not None:
+            dtype = (np.uint8 if int(info.group_num_bins.max()) <= 256
+                     else np.uint16)
+            bins = np.zeros((n, info.num_groups), dtype)
+            for g, feats in enumerate(info.groups):
+                if len(feats) == 1:
+                    inner = feats[0]
+                    rows, b = col_entries(inner)
+                    col = np.full(n, self.bin_mappers[inner].default_bin,
+                                  dtype)
+                    col[rows] = b.astype(dtype)
+                    bins[:, g] = col
+                    continue
+                col = np.zeros(n, np.int64)      # 0 = all defaults
+                for inner in feats:              # later features win
+                    rows, b = col_entries(inner)
+                    nz = b != int(info.feature_default[inner])
+                    col[rows[nz]] = b[nz].astype(np.int64) \
+                        + int(info.feature_shift[inner])
+                bins[:, g] = col.astype(dtype)
+        else:
+            F = self.num_features
+            max_nb = max((m.num_bin for m in self.bin_mappers), default=2)
+            dtype = np.uint8 if max_nb <= 256 else np.uint16
+            bins = np.empty((n, F), dtype)
+            for inner in range(F):
+                rows, b = col_entries(inner)
+                col = np.full(n, self.bin_mappers[inner].default_bin, dtype)
+                col[rows] = b.astype(dtype)
+                bins[:, inner] = col
         self.bins = bins
         self._device_cache.clear()
 
@@ -202,6 +344,8 @@ class BinnedDataset:
             "max_bin": np.array(self.max_bin),
             "mapper_states": np.array([_json.dumps(m.to_state()) for m in self.bin_mappers]),
         }
+        if self.bundle is not None:
+            d["bundle_state"] = np.array(self.bundle.to_state())
         if self.monotone_constraints is not None:
             d["monotone_constraints"] = self.monotone_constraints
         if self.feature_penalty is not None:
@@ -227,6 +371,12 @@ class BinnedDataset:
         ds.max_bin = int(d["max_bin"])
         ds.bin_mappers = [BinMapper.from_state(_json.loads(str(s)))
                           for s in d["mapper_states"]]
+        if "bundle_state" in d:
+            from .efb import BundleInfo
+            ds.bundle = BundleInfo.from_state(
+                str(d["bundle_state"]),
+                [m.num_bin for m in ds.bin_mappers],
+                [m.default_bin for m in ds.bin_mappers])
         if "monotone_constraints" in d:
             ds.monotone_constraints = d["monotone_constraints"]
         if "feature_penalty" in d:
@@ -248,5 +398,6 @@ class BinnedDataset:
         out.monotone_constraints = self.monotone_constraints
         out.feature_penalty = self.feature_penalty
         out.max_bin = self.max_bin
+        out.bundle = self.bundle
         out.metadata = self.metadata.subset(np.asarray(indices))
         return out

@@ -51,18 +51,40 @@ def _resolve_list(spec: str, names: Optional[List[str]], what: str) -> List[int]
 class LoadedData:
     """Raw parse result ready for Dataset construction."""
 
-    def __init__(self, X, label, weight, group, feature_names, categorical):
+    def __init__(self, X, label, weight, group, feature_names, categorical,
+                 init_score=None):
         self.X = X
         self.label = label
         self.weight = weight
         self.group = group
         self.feature_names = feature_names
         self.categorical = categorical
+        self.init_score = init_score
+
+
+def load_init_score_file(data_filename: str,
+                         initscore_filename: str = "") -> Optional[np.ndarray]:
+    """Initial scores for a data file (Metadata::LoadInitialScore,
+    src/io/metadata.cpp:391-436): the explicit initscore file, else the
+    `<data>.init` side file; tab-separated columns = classes, returned
+    class-major flattened [k * n] like the reference stores them."""
+    import os
+    path = initscore_filename or (data_filename + ".init")
+    if not os.path.exists(path):
+        if initscore_filename:
+            log.fatal("Could not open initscore file %s" % path)
+        return None
+    scores = np.loadtxt(path, dtype=np.float64, delimiter="\t", ndmin=2)
+    if scores.size == 0:
+        return None
+    log.info("Loading initial scores...")
+    return scores.reshape(-1, order="F")  # [k * n] class-major
 
 
 def load_data_file(config, filename: str,
                    rank: int = 0, num_machines: int = 1,
-                   pre_partition: bool = False) -> LoadedData:
+                   pre_partition: bool = False,
+                   initscore_filename: str = "") -> LoadedData:
     """Parse a CSV/TSV/LibSVM file and resolve config columns."""
     mat, libsvm_labels, names = parser_mod.load_text_file(
         filename, header=config.header)
@@ -131,6 +153,7 @@ def load_data_file(config, filename: str,
         group = counts.astype(np.int32)
     if weight is None and os.path.exists(filename + ".weight"):
         weight = np.loadtxt(filename + ".weight", dtype=np.float64, ndmin=1)
+    init_score = load_init_score_file(filename, initscore_filename)
 
     if pre_partition and num_machines > 1:
         # random row pre-partition for data-parallel training
@@ -146,5 +169,10 @@ def load_data_file(config, filename: str,
         X, label = X[keep_rows], label[keep_rows]
         if weight is not None:
             weight = weight[keep_rows]
+        if init_score is not None:
+            k = len(init_score) // max(1, len(keep_rows))
+            init_score = np.concatenate(
+                [init_score[c * len(keep_rows):][:len(keep_rows)][keep_rows]
+                 for c in range(k)])
 
-    return LoadedData(X, label, weight, group, feature_names, cat)
+    return LoadedData(X, label, weight, group, feature_names, cat, init_score)

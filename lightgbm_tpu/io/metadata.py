@@ -81,7 +81,15 @@ class Metadata:
         arr = np.asarray(init_score, dtype=np.float64)
         # class-major blocks of length num_data (reference layout); (n, k)
         # input is therefore flattened in Fortran order
-        self.init_score = arr.reshape(-1, order="F") if arr.ndim == 2 else arr.reshape(-1)
+        flat = arr.reshape(-1, order="F") if arr.ndim == 2 else arr.reshape(-1)
+        if self.num_data > 0 and (flat.size == 0
+                                  or flat.size % self.num_data != 0):
+            # a stale <data>.init side file must fail loudly, not as a
+            # shape-broadcast error deep in training
+            # (Metadata::SetInitScore, metadata.cpp:175-188)
+            log.fatal("Initial score size doesn't match data size "
+                      "(%d scores for %d rows)" % (flat.size, self.num_data))
+        self.init_score = flat
 
     def _update_query_weights(self) -> None:
         if self.weights is None or self.query_boundaries is None:

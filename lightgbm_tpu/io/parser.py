@@ -109,13 +109,12 @@ def parse_libsvm(filename: str, num_features_hint: int = 0
                 if not sep:
                     continue
                 fk = _float_prefix(k, full=True)
-                if fk != fk:      # NaN: index didn't parse up to the ':'
-                    # (native drops such tokens: its scanner stops before
-                    # the ':' and treats the remainder as a bare token)
+                if not (0 <= fk < 2 ** 31 - 1):
+                    # NaN (index didn't parse up to the ':'), negative,
+                    # inf, or beyond int32 — the native path drops these
+                    # tokens too (its scanner bounds before the cast)
                     continue
                 idx = int(fk)
-                if idx < 0:
-                    continue
                 pairs.append((idx, _float_prefix(v)))
                 if idx > max_idx:
                     max_idx = idx

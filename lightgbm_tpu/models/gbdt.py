@@ -29,6 +29,15 @@ from .tree import Tree
 K_EPSILON = 1e-15
 
 
+def _dense_matrix(X) -> np.ndarray:
+    """Raw-feature prediction inputs as a dense f64 matrix (scipy sparse
+    accepted; the hot predict path chunk-densifies instead, predict_raw)."""
+    from ..io.dataset import _issparse
+    if _issparse(X):
+        return np.asarray(X.todense(), np.float64)
+    return np.asarray(X, np.float64)
+
+
 class _DatasetState:
     """Device-side per-dataset state (ScoreUpdater, score_updater.hpp:17-120)."""
 
@@ -41,9 +50,44 @@ class _DatasetState:
         self.missing_types = jnp.asarray(
             np.array([m.missing_type for m in ds.bin_mappers], np.int32))
         self.score = jnp.zeros((num_classes, ds.num_data), dtype)
+        self.bundle = _bundle_maps(ds)
+
+    @property
+    def hist_max_bin(self) -> int:
+        """Bins per histogram column: bundled group columns can carry up
+        to 256 bins regardless of config max_bin."""
+        if self.ds.bundle is not None:
+            return int(self.ds.bundle.group_num_bins.max())
+        return (int(self.ds.feature_num_bins().max())
+                if self.ds.num_features else 2)
 
     def add_constant(self, val: float, class_id: int) -> None:
         self.score = self.score.at[class_id].add(val)
+
+
+def _bundle_maps(ds: BinnedDataset):
+    """Host BundleInfo -> device BundleMaps for the grow loop (or None)."""
+    info = ds.bundle
+    if info is None:
+        return None
+    F = ds.num_features
+    G = info.num_groups
+    B = int(info.group_num_bins.max())
+    nbf = ds.feature_num_bins()
+    db = info.feature_default
+    b = np.arange(B, dtype=np.int64)[None, :]
+    g = info.feature_group.astype(np.int64)[:, None]
+    shift = np.where(info.needs_fix, info.feature_shift, 0)[:, None]
+    valid = b < nbf[:, None]
+    is_def = info.needs_fix[:, None] & (b == db[:, None])
+    idx = np.where(valid & ~is_def, g * B + b + shift, G * B)
+    return grow_ops.BundleMaps(
+        unbundle_idx=jnp.asarray(idx.astype(np.int32)),
+        feat_col=jnp.asarray(info.feature_group),
+        feat_lo=jnp.asarray(info.feature_lo),
+        feat_hi=jnp.asarray(info.feature_hi),
+        feat_shift=jnp.asarray(info.feature_shift),
+        needs_fix=jnp.asarray(info.needs_fix))
 
 
 class GBDT:
@@ -98,8 +142,7 @@ class GBDT:
             self.objective.init(train_set.metadata, self.num_data)
         for m in self.train_metrics:
             m.init(train_set.metadata, self.num_data)
-        self.max_bin = int(train_set.feature_num_bins().max()) \
-            if train_set.num_features else 2
+        self.max_bin = self.train_state.hist_max_bin
         F = max(train_set.num_features, 1)
         self._feature_mask_all = jnp.ones(F, bool)
         self.split_params = SplitParams(
@@ -343,7 +386,7 @@ class GBDT:
         if self._bag_mask is not None:
             walked = grow_ops.predict_leaf_inner(
                 self.train_state.bins, arrays, self.train_state.num_bins,
-                self.train_state.default_bins)
+                self.train_state.default_bins, self.train_state.bundle)
             lids = jnp.where(lids >= 0, lids, walked)
         self.train_state.score = self.train_state.score.at[class_id].add(
             lv[jnp.clip(lids, 0, arrays.max_leaves - 1)])
@@ -445,6 +488,7 @@ class GBDT:
                     and self.dtype == jnp.float32
                     and self.max_bin <= 256
                     and not self._forced_splits
+                    and self.train_set.bundle is None
                     and self.train_set.num_features > 0
                     and self.num_data < (1 << 24))
         if eng == "partition" and not eligible:
@@ -511,6 +555,7 @@ class GBDT:
             self.train_state.missing_types,
             self.split_params, self.monotone, self.penalty,
             self.is_categorical,
+            bundle=self.train_state.bundle,
             max_leaves=self.config.num_leaves,
             max_depth=self.config.max_depth,
             max_bin=self.max_bin,
@@ -583,7 +628,7 @@ class GBDT:
             # out-of-bag rows need a traversal (gbdt.cpp UpdateScore OOB path)
             walked = grow_ops.predict_leaf_inner(
                 self.train_state.bins, arrays, self.train_state.num_bins,
-                self.train_state.default_bins)
+                self.train_state.default_bins, self.train_state.bundle)
             lids = jnp.where(lids >= 0, lids, walked)
         self.train_state.score = self.train_state.score.at[class_id].add(
             leaf_values[jnp.clip(lids, 0, tree.num_leaves - 1)])
@@ -627,6 +672,17 @@ class GBDT:
                     early_stop: bool = False, early_stop_freq: int = 10,
                     early_stop_margin: float = 10.0) -> np.ndarray:
         self._sync_model()
+        from ..io.dataset import _issparse
+        if _issparse(X):
+            # chunked densify: sparse inputs predict without ever holding
+            # the full dense matrix (c_api.cpp CSR predict analogue)
+            step = max(1, (1 << 24) // max(X.shape[1], 1))
+            parts = [self.predict_raw(
+                np.asarray(X[i:i + step].todense()), num_iteration,
+                early_stop=early_stop, early_stop_freq=early_stop_freq,
+                early_stop_margin=early_stop_margin)
+                for i in range(0, X.shape[0], step)]
+            return np.concatenate(parts, axis=0)
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         if X.ndim != 2 or X.shape[1] <= self.max_feature_idx:
             log.fatal("The number of features in data (%d) is not the same as "
@@ -696,7 +752,7 @@ class GBDT:
 
     def predict_leaf_index(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
         self._sync_model()
-        X = np.asarray(X, np.float64)
+        X = _dense_matrix(X)
         k = self.num_tree_per_iteration
         total_iters = len(self.models) // max(k, 1)
         iters = total_iters if num_iteration <= 0 else min(num_iteration, total_iters)
@@ -854,7 +910,7 @@ class GBDT:
         from ..io.metadata import Metadata
         from ..ops.split import calculate_splitted_leaf_output
 
-        X = np.asarray(X, np.float64)
+        X = _dense_matrix(X)
         n = len(X)
         k = max(self.num_tree_per_iteration, 1)
         if self.objective is None:
@@ -966,7 +1022,7 @@ def _add_tree_score(state: _DatasetState, tree: Tree, class_id: int, gbdt: GBDT)
         return
     arrays = _tree_to_device(tree, gbdt.dtype, gbdt.max_bin)
     leaf = grow_ops.predict_leaf_inner(state.bins, arrays, state.num_bins,
-                                       state.default_bins)
+                                       state.default_bins, state.bundle)
     leaf_values = jnp.asarray(tree.leaf_value[:tree.num_leaves], gbdt.dtype)
     state.score = state.score.at[class_id].add(leaf_values[leaf])
 

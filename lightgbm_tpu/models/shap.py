@@ -158,7 +158,8 @@ def _tree_shap(tree: Tree, x: np.ndarray, phi: np.ndarray, node: int,
 def predict_contrib(gbdt, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
     """[n, (F+1)] (or [n, (F+1)*k] multiclass) SHAP contributions; last slot
     per class is the model expected value (PredictContrib semantics)."""
-    X = np.asarray(X, np.float64)
+    from .gbdt import _dense_matrix
+    X = _dense_matrix(X)
     n = X.shape[0]
     F = gbdt.max_feature_idx + 1
     k = max(gbdt.num_tree_per_iteration, 1)

@@ -35,6 +35,33 @@ MISSING_ZERO = 1
 MISSING_NAN = 2
 
 
+class BundleMaps(NamedTuple):
+    """Device-side EFB layout (io/efb.py BundleInfo): the bin matrix holds
+    [n, G] bundled group columns; scans and splits address original
+    features through these maps (FeatureGroup::SubFeatureIterator +
+    Dataset::FixHistogram, feature_group.h:146-152, dataset.cpp:928-949)."""
+    unbundle_idx: jnp.ndarray   # [F, B] int32 into flat [G*B] (+1 sentinel)
+    feat_col: jnp.ndarray       # [F] int32 group column of each feature
+    feat_lo: jnp.ndarray        # [F] int32 group-bin range of the feature's
+    feat_hi: jnp.ndarray        #          mapped (non-default) bins
+    feat_shift: jnp.ndarray     # [F] int32 group_bin = feature_bin + shift
+    needs_fix: jnp.ndarray      # [F] bool default bin reconstructed at scan
+
+
+def feature_bin_of(bins, feat, default_bins, bundle: Optional[BundleMaps]):
+    """[n] feature-bin values of `feat` from the (possibly bundled) bin
+    matrix: identity without EFB; otherwise the group column decoded back
+    to feature bins, rows outside the feature's range -> its default bin."""
+    if bundle is None:
+        return jax.lax.dynamic_index_in_dim(
+            bins, feat, axis=1, keepdims=False).astype(jnp.int32)
+    col = jax.lax.dynamic_index_in_dim(
+        bins, bundle.feat_col[feat], axis=1, keepdims=False).astype(jnp.int32)
+    inside = (col >= bundle.feat_lo[feat]) & (col < bundle.feat_hi[feat])
+    return jnp.where(inside, col - bundle.feat_shift[feat],
+                     default_bins[feat])
+
+
 class TreeArrays(NamedTuple):
     """SoA tree storage (tree.h:318-374).  Node arrays sized [max_leaves-1],
     leaf arrays [max_leaves]; children encode leaves as ~leaf_index."""
@@ -89,6 +116,10 @@ class GrowState(NamedTuple):
     cegb_used: jnp.ndarray         # [F] bool — features used so far (CEGB
     #                                coupled penalty, feature_used in
     #                                serial_tree_learner.cpp:534-536)
+    leaf_min: jnp.ndarray          # [L] per-leaf output lower bound (monotone
+    #                                mid-constraint propagation, serial_tree_
+    #                                learner.cpp:837-846 + leaf_splits.hpp)
+    leaf_max: jnp.ndarray          # [L] per-leaf output upper bound
 
 
 def _stack_split(res: SplitResult, cache: SplitResult, idx) -> SplitResult:
@@ -116,6 +147,8 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
               #   tradeoff * cegb_penalty_feature_coupled, charged while the
               #   feature is unused
               cegb_used_init: Optional[jnp.ndarray] = None,  # [F] bool
+              bundle: Optional[BundleMaps] = None,  # EFB layout; bins is
+              #   then [n, G] group columns (io/efb.py)
               *,
               forced_splits: tuple = (),   # static BFS list of
               #   (leaf_id, inner_feature, threshold_bin, default_left) from
@@ -151,9 +184,14 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
       .cpp): rows sharded; local top-k feature vote → global top-2k elected
       features → psum of elected histograms only → global best split.
     """
-    n, F = bins.shape
+    n = bins.shape[0]
+    F = num_bins.shape[0]        # scan features (== bins columns sans EFB)
     dtype = grad.dtype
     distributed = axis_name is not None and learner != "serial"
+    if bundle is not None and learner == "feature":
+        raise ValueError("EFB-bundled datasets do not support the "
+                         "feature-parallel learner (bundling is disabled "
+                         "at dataset construction for it)")
     if learner == "feature" and distributed:
         # contiguous per-shard feature slice (deterministic sharding, the
         # analogue of the bin-count-balanced shuffle at
@@ -190,32 +228,63 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
             return jax.lax.psum(h, axis_name)
         return h
 
+    def unbundle(hist, sum_g, sum_h, cnt):
+        """[G, B, 3] group histogram -> [F, B, 3] per-feature view.
+
+        Each feature's non-default bins are a gather from its group's
+        bins; bundled features' default-bin entries are reconstructed as
+        leaf totals minus the gathered sums (Dataset::FixHistogram,
+        dataset.cpp:928-949).  Identity without EFB."""
+        if bundle is None:
+            return hist
+        flat = jnp.concatenate(
+            [hist.reshape(-1, 3), jnp.zeros((1, 3), hist.dtype)], axis=0)
+        hf = flat[bundle.unbundle_idx]                      # [F, B, 3]
+        tot = jnp.stack([jnp.asarray(sum_g, hist.dtype),
+                         jnp.asarray(sum_h, hist.dtype),
+                         jnp.asarray(cnt, hist.dtype)])
+        fix = tot[None, :] - jnp.sum(hf, axis=1)            # [F, 3]
+        upd = jnp.where(bundle.needs_fix[:, None], fix, 0.0)
+        return hf.at[jnp.arange(F), default_bins].add(upd)
+
+    def _bounds(minc, maxc, nf):
+        """Per-leaf scalar output bounds -> per-feature arrays for the
+        scans, or None when no monotone constraints exist (zero cost)."""
+        if monotone is None or minc is None:
+            return None, None
+        return (jnp.broadcast_to(jnp.asarray(minc, dtype), (nf,)),
+                jnp.broadcast_to(jnp.asarray(maxc, dtype), (nf,)))
+
     def local_scan(hist, sum_g, sum_h, cnt, nb, db, mt, mono, pen, fmask,
-                   icat, findex=None, used=None):
+                   icat, findex=None, used=None, minc=None, maxc=None):
         """Per-feature scan (numerical or bin-type-dispatched) + argmax."""
         cegb_pen = None
         if cegb_coupled is not None and used is not None:
             cegb_pen = jnp.where(used, 0.0, cegb_coupled)
+        mn, mx = _bounds(minc, maxc, hist.shape[0])
         if icat is None:
             pf = best_split_per_feature(hist, sum_g, sum_h, cnt, nb, db, mt,
                                         params, monotone=mono, penalty=pen,
+                                        min_constraints=mn, max_constraints=mx,
                                         feature_mask=fmask,
                                         cegb_feature_penalty=cegb_pen)
         else:
             pf = best_split_per_feature_mixed(
                 hist, sum_g, sum_h, cnt, nb, db, mt, icat, params,
                 monotone=mono, penalty=pen, feature_mask=fmask,
+                min_constraints=mn, max_constraints=mx,
                 cegb_feature_penalty=cegb_pen,
                 max_cat_threshold=max_cat_threshold)
         return select_best_feature(pf, feature_index=findex)
 
-    def leaf_best_split(hist, sum_g, sum_h, cnt, depth, used=None):
+    def leaf_best_split(hist, sum_g, sum_h, cnt, depth, used=None,
+                        minc=None, maxc=None):
         if distributed and learner == "feature":
             local = local_scan(
                 hist, sum_g, sum_h, cnt,
                 l_num_bins, l_default_bins, l_missing,
                 l_monotone, l_penalty, l_feature_mask, l_is_categorical,
-                used=None)
+                used=None, minc=minc, maxc=maxc)
             # map the local winner to its global feature id
             local = local._replace(feature=jnp.where(
                 local.feature >= 0, l_feature_index[local.feature],
@@ -250,17 +319,26 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
                 cat_mask=(None if local.cat_mask is None
                           else iw[4:] > 0))
         elif distributed and learner == "voting":
+            # voting scans LOCAL histograms first: the unbundle fix needs
+            # local leaf totals, recovered from group 0's bins (each
+            # in-leaf local row lands in exactly one of them)
+            if bundle is not None:
+                loc = jnp.sum(hist[0], axis=0)
+                hist = unbundle(hist, loc[0], loc[1], loc[2])
+            mn, mx = _bounds(minc, maxc, F)
             res = _voting_best_split(
                 hist, sum_g, sum_h, cnt,
                 num_bins, default_bins, missing_types, params,
                 monotone, penalty, feature_mask, is_categorical,
                 axis_name=axis_name, num_machines=num_machines,
-                top_k=top_k, max_cat_threshold=max_cat_threshold)
+                top_k=top_k, max_cat_threshold=max_cat_threshold,
+                min_constraints=mn, max_constraints=mx)
         else:
-            res = local_scan(hist, sum_g, sum_h, cnt,
+            res = local_scan(unbundle(hist, sum_g, sum_h, cnt),
+                             sum_g, sum_h, cnt,
                              num_bins, default_bins, missing_types,
                              monotone, penalty, feature_mask, is_categorical,
-                             used=used)
+                             used=used, minc=minc, maxc=maxc)
         depth_ok = (max_depth <= 0) | (depth < max_depth)
         blocked = (res.feature < 0) | ~depth_ok
         return res._replace(gain=jnp.where(blocked, K_MIN_SCORE, res.gain),
@@ -285,8 +363,11 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
 
     cegb_used0 = (cegb_used_init if cegb_used_init is not None
                   else jnp.zeros(F, bool))
+    ninf = jnp.asarray(-jnp.inf, dtype)
+    pinf = jnp.asarray(jnp.inf, dtype)
     root_split = leaf_best_split(root_hist, root_g, root_h, root_c,
-                                 jnp.asarray(0, jnp.int32), used=cegb_used0)
+                                 jnp.asarray(0, jnp.int32), used=cegb_used0,
+                                 minc=ninf, maxc=pinf)
 
     L = max_leaves
     hist_cache = jnp.zeros((L,) + root_hist.shape, dtype).at[0].set(root_hist)
@@ -301,7 +382,9 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
 
     state = GrowState(tree=tree, leaf_ids=row_leaf_init, hist_cache=hist_cache,
                       split_cache=split_cache, done=jnp.asarray(False),
-                      cegb_used=cegb_used0)
+                      cegb_used=cegb_used0,
+                      leaf_min=jnp.full(L, ninf, dtype),
+                      leaf_max=jnp.full(L, pinf, dtype))
 
     def cond(state: GrowState):
         return (~state.done) & (state.tree.num_leaves < max_leaves)
@@ -321,8 +404,7 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
             feat = sp.feature
             thr = sp.threshold
             # -- relabel rows (DataPartition::Split, data_partition.hpp:108) --
-            col = jax.lax.dynamic_index_in_dim(
-                bins, feat, axis=1, keepdims=False).astype(jnp.int32)
+            col = feature_bin_of(bins, feat, default_bins, bundle)
             mt = missing_types[feat]
             db = default_bins[feat]
             mb = num_bins[feat] - 1
@@ -397,20 +479,42 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
                 num_leaves=nl + 1,
             )
 
+            # -- monotone mid-constraint propagation ------------------------
+            # (serial_tree_learner.cpp:837-846): children inherit the
+            # parent's [min, max] output bounds; a NUMERICAL split on a
+            # monotone feature pins the shared boundary at the mid of the
+            # two child outputs so every descendant respects the ancestor
+            minP = state.leaf_min[best_leaf]
+            maxP = state.leaf_max[best_leaf]
+            minL, maxL, minR, maxR = minP, maxP, minP, maxP
+            leaf_min, leaf_max = state.leaf_min, state.leaf_max
+            if monotone is not None:
+                mono_t = monotone[feat].astype(jnp.int32)
+                if is_categorical is not None:
+                    mono_t = jnp.where(is_categorical[feat], 0, mono_t)
+                mid = ((sp.left_output + sp.right_output) / 2).astype(dtype)
+                maxL = jnp.where(mono_t > 0, mid, maxP)
+                minR = jnp.where(mono_t > 0, mid, minP)
+                minL = jnp.where(mono_t < 0, mid, minP)
+                maxR = jnp.where(mono_t < 0, mid, maxP)
+                leaf_min = leaf_min.at[best_leaf].set(minL).at[new_leaf].set(minR)
+                leaf_max = leaf_max.at[best_leaf].set(maxL).at[new_leaf].set(maxR)
+
             # -- children best splits ---------------------------------------
             used2 = state.cegb_used.at[feat].set(True)
             lsp = leaf_best_split(left_hist, sp.left_sum_gradient,
                                   sp.left_sum_hessian, sp.left_count,
-                                  depth + 1, used=used2)
+                                  depth + 1, used=used2, minc=minL, maxc=maxL)
             rsp = leaf_best_split(right_hist, sp.right_sum_gradient,
                                   sp.right_sum_hessian, sp.right_count,
-                                  depth + 1, used=used2)
+                                  depth + 1, used=used2, minc=minR, maxc=maxR)
             split_cache = _stack_split(lsp, state.split_cache, best_leaf)
             split_cache = _stack_split(rsp, split_cache, new_leaf)
 
             return GrowState(tree=tree, leaf_ids=leaf_ids,
                              hist_cache=hist_cache, split_cache=split_cache,
-                             done=jnp.asarray(False), cegb_used=used2)
+                             done=jnp.asarray(False), cegb_used=used2,
+                             leaf_min=leaf_min, leaf_max=leaf_max)
 
         return jax.lax.cond(no_split,
                             lambda s: s._replace(done=jnp.asarray(True)),
@@ -435,10 +539,12 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
         dyn_leaf = leafmap[f_leaf]
         safe_leaf = jnp.maximum(dyn_leaf, 0)
         f_hist = state.hist_cache[safe_leaf]
+        f_g = jnp.sum(f_hist[0, :, 0])
+        f_h = jnp.sum(f_hist[0, :, 1])
+        f_cnt = state.tree.leaf_count[safe_leaf]
         fsp = forced_split_result(
-            f_hist, jnp.int32(f_feat), jnp.int32(f_thr),
-            jnp.sum(f_hist[0, :, 0]), jnp.sum(f_hist[0, :, 1]),
-            state.tree.leaf_count[safe_leaf],
+            unbundle(f_hist, f_g, f_h, f_cnt),
+            jnp.int32(f_feat), jnp.int32(f_thr), f_g, f_h, f_cnt,
             num_bins, default_bins, missing_types, params,
             jnp.asarray(bool(f_dl)))
         if state.split_cache.cat_mask is not None:
@@ -546,7 +652,9 @@ def _voting_best_split(local_hist, sum_g, sum_h, cnt,
                        params: SplitParams,
                        monotone, penalty, feature_mask, is_categorical,
                        *, axis_name: str, num_machines: int, top_k: int,
-                       max_cat_threshold: int = 32) -> SplitResult:
+                       max_cat_threshold: int = 32,
+                       min_constraints=None,
+                       max_constraints=None) -> SplitResult:
     """PV-tree best split (voting_parallel_tree_learner.cpp:257-460).
 
     local_hist [F, B, 3] holds *local-shard* rows only.  Protocol:
@@ -570,14 +678,18 @@ def _voting_best_split(local_hist, sum_g, sum_h, cnt,
     loc_h = jnp.sum(local_hist[0, :, 1])
     loc_c = jnp.round(jnp.sum(local_hist[0, :, 2])).astype(jnp.int32)
 
-    def scan(hist, sg, sh, sc, nb, db, mt, mono, pen, fmask, icat, p):
+    def scan(hist, sg, sh, sc, nb, db, mt, mono, pen, fmask, icat, p,
+             mn=None, mx=None):
         if icat is None:
             return best_split_per_feature(hist, sg, sh, sc, nb, db, mt, p,
                                           monotone=mono, penalty=pen,
+                                          min_constraints=mn,
+                                          max_constraints=mx,
                                           feature_mask=fmask)
         return best_split_per_feature_mixed(
             hist, sg, sh, sc, nb, db, mt, icat, p,
             monotone=mono, penalty=pen, feature_mask=fmask,
+            min_constraints=mn, max_constraints=mx,
             max_cat_threshold=max_cat_threshold)
 
     # params leaves may be tracers (SplitParams rides the jit pytree)
@@ -587,7 +699,7 @@ def _voting_best_split(local_hist, sum_g, sum_h, cnt,
     pf_local = scan(local_hist, loc_g, loc_h, loc_c,
                     num_bins, default_bins, missing_types,
                     monotone, penalty, feature_mask, is_categorical,
-                    local_params)
+                    local_params, min_constraints, max_constraints)
 
     _, top_idx = jax.lax.top_k(pf_local.gain, k)                # [k]
     top_valid = jnp.take(pf_local.gain, top_idx) > K_MIN_SCORE
@@ -610,19 +722,21 @@ def _voting_best_split(local_hist, sum_g, sum_h, cnt,
     pf_glob = scan(glob_hist, sum_g, sum_h, cnt,
                    take(num_bins), take(default_bins), take(missing_types),
                    take(monotone), take(penalty), take(feature_mask),
-                   take(is_categorical), params)
+                   take(is_categorical), params,
+                   take(min_constraints), take(max_constraints))
     return select_best_feature(pf_glob, feature_index=elected)
 
 
 @jax.jit
 def predict_leaf_inner(bins: jnp.ndarray, tree: TreeArrays,
-                       num_bins: jnp.ndarray, default_bins: jnp.ndarray
-                       ) -> jnp.ndarray:
+                       num_bins: jnp.ndarray, default_bins: jnp.ndarray,
+                       bundle: Optional[BundleMaps] = None) -> jnp.ndarray:
     """Leaf index per row by walking the tree over *inner* bin values
     (Tree::GetLeafAt + DecisionInner, tree.h:233-248, 289-296).
 
     Vectorized node walk: every row holds a current node (>=0 internal,
-    negative = ~leaf); iterate until all rows rest at leaves.
+    negative = ~leaf); iterate until all rows rest at leaves.  With EFB
+    `bins` holds group columns decoded per node through `bundle`.
     """
     n = bins.shape[0]
     start = jnp.where(tree.num_leaves > 1, 0, ~0)
@@ -634,8 +748,17 @@ def predict_leaf_inner(bins: jnp.ndarray, tree: TreeArrays,
     def body(node):
         nd = jnp.maximum(node, 0)
         feat = tree.split_feature[nd]
-        col = jnp.take_along_axis(bins, feat[:, None].astype(jnp.int32),
+        if bundle is None:
+            gcol = feat
+        else:
+            gcol = bundle.feat_col[feat]
+        col = jnp.take_along_axis(bins, gcol[:, None].astype(jnp.int32),
                                   axis=1)[:, 0].astype(jnp.int32)
+        if bundle is not None:
+            inside = (col >= bundle.feat_lo[feat]) & \
+                     (col < bundle.feat_hi[feat])
+            col = jnp.where(inside, col - bundle.feat_shift[feat],
+                            default_bins[feat])
         mt = tree.missing_type[nd]
         db = default_bins[tree.split_feature[nd]]
         mb = num_bins[tree.split_feature[nd]] - 1
@@ -654,7 +777,7 @@ def predict_leaf_inner(bins: jnp.ndarray, tree: TreeArrays,
 
 
 def predict_value_inner(bins: jnp.ndarray, tree: TreeArrays,
-                        num_bins: jnp.ndarray, default_bins: jnp.ndarray
-                        ) -> jnp.ndarray:
-    leaf = predict_leaf_inner(bins, tree, num_bins, default_bins)
+                        num_bins: jnp.ndarray, default_bins: jnp.ndarray,
+                        bundle: Optional[BundleMaps] = None) -> jnp.ndarray:
+    leaf = predict_leaf_inner(bins, tree, num_bins, default_bins, bundle)
     return tree.leaf_value[leaf]

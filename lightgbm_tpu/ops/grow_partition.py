@@ -52,6 +52,8 @@ class PartState(NamedTuple):
     done: jnp.ndarray
     cegb_used: jnp.ndarray         # [F] bool (CEGB coupled feature_used)
     truncated: jnp.ndarray         # bool: growth stopped by arena overflow
+    leaf_min: jnp.ndarray          # [L] monotone output bounds per leaf
+    leaf_max: jnp.ndarray          # (serial_tree_learner.cpp:837-846)
 
 
 def grow_tree_partition_impl(
@@ -120,13 +122,19 @@ def grow_tree_partition_impl(
     root_g = jnp.sum(root_hist[0, :, 0])
     root_h = jnp.sum(root_hist[0, :, 1])
 
-    def leaf_best_split(hist, sum_g, sum_h, cnt, depth, used=None):
+    def leaf_best_split(hist, sum_g, sum_h, cnt, depth, used=None,
+                        minc=None, maxc=None):
         cegb_pen = None
         if cegb_coupled is not None and used is not None:
             cegb_pen = jnp.where(used, 0.0, cegb_coupled)
+        mn = mx = None
+        if monotone is not None and minc is not None:
+            mn = jnp.broadcast_to(jnp.asarray(minc, dtype), (F,))
+            mx = jnp.broadcast_to(jnp.asarray(maxc, dtype), (F,))
         pf = best_split_per_feature(hist, sum_g, sum_h, cnt, num_bins,
                                     default_bins, missing_types, params,
                                     monotone=monotone, penalty=penalty,
+                                    min_constraints=mn, max_constraints=mx,
                                     feature_mask=feature_mask,
                                     cegb_feature_penalty=cegb_pen)
         res = select_best_feature(pf)
@@ -139,8 +147,11 @@ def grow_tree_partition_impl(
     tree = tree._replace(leaf_count=tree.leaf_count.at[0].set(root_c))
     cegb_used0 = (cegb_used_init if cegb_used_init is not None
                   else jnp.zeros(F, bool))
+    ninf = jnp.asarray(-jnp.inf, dtype)
+    pinf = jnp.asarray(jnp.inf, dtype)
     root_split = leaf_best_split(root_hist, root_g, root_h, root_c,
-                                 jnp.asarray(0, jnp.int32), used=cegb_used0)
+                                 jnp.asarray(0, jnp.int32), used=cegb_used0,
+                                 minc=ninf, maxc=pinf)
 
     hist_cache = jnp.zeros((L,) + root_hist.shape, dtype).at[0].set(root_hist)
     split_cache = SplitResult(*[
@@ -156,7 +167,9 @@ def grow_tree_partition_impl(
         leaf_start=jnp.zeros(L, jnp.int32), cursor=cursor0,
         hist_cache=hist_cache, split_cache=split_cache,
         done=jnp.asarray(False), cegb_used=cegb_used0,
-        truncated=jnp.asarray(False))
+        truncated=jnp.asarray(False),
+        leaf_min=jnp.full(L, ninf, dtype),
+        leaf_max=jnp.full(L, pinf, dtype))
 
     def cond(state: PartState):
         return (~state.done) & (state.tree.num_leaves < L)
@@ -258,13 +271,29 @@ def grow_tree_partition_impl(
             num_leaves=nl + 1,
         )
 
+        # monotone mid-constraint propagation (serial_tree_learner.cpp:
+        # 837-846): numerical splits only in this engine, so a monotone
+        # split always pins the shared boundary at mid
+        minP, maxP = state.leaf_min[best_leaf], state.leaf_max[best_leaf]
+        minL, maxL, minR, maxR = minP, maxP, minP, maxP
+        leaf_min, leaf_max = state.leaf_min, state.leaf_max
+        if monotone is not None:
+            mono_t = monotone[feat].astype(jnp.int32)
+            mid = ((sp.left_output + sp.right_output) / 2).astype(dtype)
+            maxL = jnp.where(mono_t > 0, mid, maxP)
+            minR = jnp.where(mono_t > 0, mid, minP)
+            minL = jnp.where(mono_t < 0, mid, minP)
+            maxR = jnp.where(mono_t < 0, mid, maxP)
+            leaf_min = leaf_min.at[best_leaf].set(minL).at[new_leaf].set(minR)
+            leaf_max = leaf_max.at[best_leaf].set(maxL).at[new_leaf].set(maxR)
+
         used2 = state.cegb_used.at[feat].set(True)
         lsp = leaf_best_split(left_hist, sp.left_sum_gradient,
                               sp.left_sum_hessian, sp.left_count,
-                              depth + 1, used=used2)
+                              depth + 1, used=used2, minc=minL, maxc=maxL)
         rsp = leaf_best_split(right_hist, sp.right_sum_gradient,
                               sp.right_sum_hessian, sp.right_count,
-                              depth + 1, used=used2)
+                              depth + 1, used=used2, minc=minR, maxc=maxR)
         split_cache = _stack_split(lsp, state.split_cache, best_leaf)
         split_cache = _stack_split(rsp, split_cache, new_leaf)
 
@@ -288,7 +317,9 @@ def grow_tree_partition_impl(
             hist_cache=sel(state.hist_cache, hist_cache),
             split_cache=split_cache,
             done=keep, cegb_used=sel(state.cegb_used, used2),
-            truncated=state.truncated | overflow)
+            truncated=state.truncated | overflow,
+            leaf_min=sel(state.leaf_min, leaf_min),
+            leaf_max=sel(state.leaf_max, leaf_max))
 
     state = jax.lax.while_loop(cond, body, state)
 

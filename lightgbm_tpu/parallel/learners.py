@@ -82,10 +82,11 @@ class ParallelGrower:
         if self.mode in ("data", "voting"):
             row = P(AXIS)
             in_specs = (P(AXIS, None), row, row, row,
-                        P(), P(), P(), P(), P(), P(), P(), P())
+                        P(), P(), P(), P(), P(), P(), P(), P(),
+                        P(), P(), P())
             out_specs = (P(), P(AXIS))
         else:  # feature: everything replicated, search sharded internally
-            in_specs = tuple(P() for _ in range(12))
+            in_specs = tuple(P() for _ in range(15))
             out_specs = (P(), P())
         fn = jax.jit(jax.shard_map(inner, mesh=self.mesh,
                                    in_specs=in_specs, out_specs=out_specs,
@@ -96,11 +97,15 @@ class ParallelGrower:
     # ------------------------------------------------------------------ #
     def __call__(self, bins, grad, hess, row_leaf_init, feature_mask,
                  num_bins, default_bins, missing_types, params,
-                 monotone=None, penalty=None, is_categorical=None, *,
+                 monotone=None, penalty=None, is_categorical=None,
+                 bundle=None, *,
                  max_leaves: int, max_depth: int = -1, max_bin: int,
                  hist_impl: str = "auto", rows_per_chunk: int = 16384,
                  max_cat_threshold: int = 32):
         n, F = bins.shape
+        if bundle is not None and self.mode == "feature":
+            raise ValueError("feature-parallel learner does not support "
+                             "EFB-bundled datasets")
         d = self.d
         if self.mode in ("data", "voting"):
             pad = (-n) % d
@@ -130,7 +135,8 @@ class ParallelGrower:
                           rows_per_chunk, max_cat_threshold))
         tree, leaf_ids = fn(bins, grad, hess, row_leaf_init, feature_mask,
                             num_bins, default_bins, missing_types, params,
-                            monotone, penalty, is_categorical)
+                            monotone, penalty, is_categorical,
+                            None, None, bundle)
         if self.mode in ("data", "voting") and leaf_ids.shape[0] != n:
             leaf_ids = leaf_ids[:n]
         return tree, leaf_ids

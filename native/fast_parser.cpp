@@ -23,6 +23,43 @@
 
 namespace {
 
+// from_chars leaves *out unmodified on result_out_of_range; recover the
+// strtod/Python-float() result (+-inf on overflow, +-0 on underflow) from
+// the token's decimal exponent — any out-of-range token is far beyond the
+// +-308 boundary, so the sign of the estimate decides.
+inline double out_of_range_value(const char* first, const char* last) {
+  bool neg = (first < last && *first == '-');
+  if (first < last && (*first == '-' || *first == '+')) ++first;
+  long intdig = 0, fraczeros = 0;
+  bool seen_nonzero = false;
+  const char* p = first;
+  while (p < last && *p >= '0' && *p <= '9') {
+    if (*p != '0' || seen_nonzero) { seen_nonzero = true; ++intdig; }
+    ++p;
+  }
+  if (p < last && *p == '.') {
+    ++p;
+    while (p < last && *p >= '0' && *p <= '9') {
+      if (!seen_nonzero) {
+        if (*p == '0') ++fraczeros; else seen_nonzero = true;
+      }
+      ++p;
+    }
+  }
+  long ex = 0;
+  if (p < last && (*p == 'e' || *p == 'E')) {
+    ++p;
+    bool eneg = false;
+    if (p < last && (*p == '-' || *p == '+')) { eneg = (*p == '-'); ++p; }
+    while (p < last && *p >= '0' && *p <= '9' && ex < 1000000)
+      ex = ex * 10 + (*p - '0');
+    if (eneg) ex = -ex;
+  }
+  long dec = ex + (intdig > 0 ? intdig : -fraczeros);
+  double v = dec > 0 ? HUGE_VAL : 0.0;
+  return neg ? -v : v;
+}
+
 // locale-independent, correctly-rounded double parse: strtod obeys
 // LC_NUMERIC (a host app's setlocale(LC_NUMERIC, "de_DE") would silently
 // stop every "3.14" at the '.'), std::from_chars never does, and it
@@ -31,8 +68,10 @@ namespace {
 inline const char* parse_double(const char* first, const char* last,
                                 double* out) {
   auto res = std::from_chars(first, last, *out);
-  if (res.ec == std::errc::result_out_of_range)
-    return res.ptr;   // strtod semantics: +-inf / +-0, token consumed
+  if (res.ec == std::errc::result_out_of_range) {
+    *out = out_of_range_value(first, res.ptr);
+    return res.ptr;
+  }
   if (res.ec != std::errc())
     return first;
   return res.ptr;
@@ -159,8 +198,12 @@ void parse_rows_libsvm(const Lines& lines, size_t row0, size_t row1,
         ++p;
         double v = 0.0;
         p = fast_atof(p, end, &v);
-        int i = static_cast<int>(idx);
-        if (i >= 0 && i < ncol) dst[i] = v;
+        // bound BEFORE the cast: double->int of an out-of-range value
+        // (huge index, inf, nan) is undefined behavior
+        if (idx >= 0.0 && idx < 2147483647.0) {
+          int i = static_cast<int>(idx);
+          if (i < ncol) dst[i] = v;
+        }
       } else {
         while (p < end && *p != ' ') ++p;
       }
@@ -184,7 +227,8 @@ int libsvm_max_index(const Lines& lines, size_t row0, size_t row1) {
         ++p;
         double v;
         p = fast_atof(p, end, &v);
-        if (static_cast<int>(idx) > mx) mx = static_cast<int>(idx);
+        if (idx >= 0.0 && idx < 2147483647.0 && static_cast<int>(idx) > mx)
+          mx = static_cast<int>(idx);
       } else {
         while (p < end && *p != ' ') ++p;
       }

@@ -55,3 +55,53 @@ def test_conflict_checks():
         Config({"bagging_fraction": 0.0})
     with pytest.raises(LightGBMError):
         Config({"boosting": "goss", "top_rate": 0.9, "other_rate": 0.5})
+
+
+def test_inert_params_warn_once(capsys):
+    """Accepted-but-inert knobs must warn, not silently no-op."""
+    import lightgbm_tpu.config as config_mod
+    config_mod._INERT_WARNED.clear()
+    Config({"two_round": True, "histogram_pool_size": 512.0,
+            "sparse_threshold": 0.5})
+    out = capsys.readouterr().out
+    assert "two_round" in out and "histogram_pool_size" in out \
+        and "sparse_threshold" in out
+    # once per process only
+    Config({"two_round": True})
+    assert "two_round" not in capsys.readouterr().out
+    # default values stay silent
+    config_mod._INERT_WARNED.clear()
+    Config({"two_round": False})
+    assert "two_round" not in capsys.readouterr().out
+
+
+def test_initscore_file_loading(tmp_path):
+    import numpy as np
+    from lightgbm_tpu.io.loader import load_init_score_file
+    d = tmp_path / "data.csv"
+    d.write_text("1,2\n0,3\n")
+    # side-file fallback <data>.init (metadata.cpp:391-397)
+    (tmp_path / "data.csv.init").write_text("0.5\n-0.25\n")
+    s = load_init_score_file(str(d))
+    np.testing.assert_allclose(s, [0.5, -0.25])
+    # explicit file, multiclass columns -> class-major flatten
+    f = tmp_path / "scores.tsv"
+    f.write_text("1\t10\n2\t20\n3\t30\n")
+    s = load_init_score_file(str(d), str(f))
+    np.testing.assert_allclose(s, [1, 2, 3, 10, 20, 30])
+    # absent side file -> None
+    d2 = tmp_path / "other.csv"
+    d2.write_text("1,2\n")
+    assert load_init_score_file(str(d2)) is None
+
+
+def test_init_score_size_mismatch_fatal():
+    import numpy as np
+    import pytest
+    from lightgbm_tpu.io.metadata import Metadata
+    from lightgbm_tpu.utils.log import LightGBMError
+    meta = Metadata(5)
+    meta.set_label(np.zeros(5))
+    with pytest.raises(LightGBMError):
+        meta.set_init_score(np.arange(3.0))
+    meta.set_init_score(np.arange(10.0))  # k=2 blocks: fine

@@ -1,0 +1,309 @@
+"""EFB (exclusive feature bundling) tests — io/efb.py + the bundled
+grow/predict paths (reference FindGroups/FastFeatureBundling,
+src/io/dataset.cpp:67-212, FeatureGroup feature_group.h:18-255)."""
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io import efb
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+
+def _onehot_data(rng, n=4000, C=40, dense=2, tie_free=False):
+    cat = rng.randint(0, C, n)
+    X = np.zeros((n, C + dense))
+    X[np.arange(n), cat] = 1.0
+    for j in range(dense):
+        X[:, C + j] = rng.randn(n)
+    if tie_free:
+        # distinct per-category effects: no two features have bit-equal
+        # gains, so reconstruction rounding cannot flip the argmax and
+        # bundled/unbundled trees must agree exactly
+        w = rng.randn(C) * 3
+        y = w[cat] + X[:, C] * 0.5 + rng.randn(n) * 0.01
+    else:
+        y = ((cat % 3 == 0).astype(float) * 2
+             + X[:, C] * 0.5 + rng.randn(n) * 0.1)
+    return X, y, cat
+
+
+class TestFindGroups:
+    def test_exclusive_features_bundle_together(self):
+        # 6 perfectly exclusive indicators -> one group
+        n = 600
+        bins = np.zeros((n, 6), np.uint8)
+        owner = np.arange(n) % 6
+        bins[np.arange(n), owner] = 1
+        info = efb.bundling_from_sample_bins(
+            bins, [2] * 6, [0] * 6, max_conflict_rate=0.0,
+            min_data_in_leaf=1, num_data=n)
+        assert info is not None and info.num_groups == 1
+        assert sorted(info.groups[0]) == list(range(6))
+
+    def test_conflicting_features_stay_apart(self):
+        # two dense (always nonzero) features can never share a group
+        n = 500
+        bins = np.ones((n, 2), np.uint8)
+        info = efb.bundling_from_sample_bins(
+            bins, [3, 3], [0, 0], max_conflict_rate=0.0,
+            min_data_in_leaf=1, num_data=n)
+        assert info is None  # all singleton -> no bundling
+
+    def test_conflict_budget(self):
+        # 5% overlap bundles under rate 0.2 but not under 0.0
+        n = 1000
+        bins = np.zeros((n, 2), np.uint8)
+        bins[:520, 0] = 1
+        bins[480:, 1] = 1          # rows 480..520 conflict (4%)
+        args = dict(min_data_in_leaf=1, num_data=n)
+        assert efb.bundling_from_sample_bins(
+            bins, [2, 2], [0, 0], max_conflict_rate=0.0, **args) is None
+        info = efb.bundling_from_sample_bins(
+            bins, [2, 2], [0, 0], max_conflict_rate=0.2, **args)
+        assert info is not None and info.num_groups == 1
+
+    def test_bundle_bin_cap(self):
+        # 3 exclusive features x 200 bins each cannot fit one 256-bin group
+        n = 900
+        bins = np.zeros((n, 3), np.uint8)
+        owner = np.arange(n) % 3
+        bins[np.arange(n), owner] = (np.arange(n) % 199 + 1).astype(np.uint8)
+        info = efb.bundling_from_sample_bins(
+            bins, [200] * 3, [0] * 3, max_conflict_rate=0.0,
+            min_data_in_leaf=1, num_data=n)
+        if info is not None:
+            assert int(info.group_num_bins.max()) <= 256
+
+
+class TestBundleLayout:
+    def test_offsets_and_decode_roundtrip(self):
+        # mixed default bins: db==0 drops a slot, db!=0 keeps a hole
+        num_bins = [4, 3, 5]
+        default_bins = [0, 2, 0]
+        info = efb.BundleInfo([[0, 1, 2]], num_bins, default_bins)
+        # feature 0: bins 1..3 -> 1..3 (shift 0 == lo-1)
+        assert (info.feature_lo[0], info.feature_hi[0],
+                info.feature_shift[0]) == (1, 4, 0)
+        # feature 1 (db=2): bins 0..2 -> 4..6 with a hole at 4+2=6
+        assert (info.feature_lo[1], info.feature_hi[1],
+                info.feature_shift[1]) == (4, 7, 4)
+        # feature 2: bins 1..4 -> 7..10
+        assert (info.feature_lo[2], info.feature_hi[2],
+                info.feature_shift[2]) == (7, 11, 6)
+        assert info.group_num_bins[0] == 11
+
+        rng = np.random.RandomState(0)
+        n = 300
+        bins = np.zeros((n, 3), np.uint8)
+        owner = rng.randint(0, 3, n)
+        bins[:, 1] = 2                      # feature 1 at its default
+        rows0 = owner == 0
+        bins[rows0, 0] = rng.randint(1, 4, rows0.sum())
+        rows1 = owner == 1
+        bins[rows1, 1] = rng.choice([0, 1], rows1.sum())
+        rows2 = owner == 2
+        bins[rows2, 2] = rng.randint(1, 5, rows2.sum())
+        out = efb.build_bundled_matrix(bins, info)
+        # decode back and compare
+        col = out[:, 0].astype(np.int64)
+        for f in range(3):
+            inside = (col >= info.feature_lo[f]) & (col < info.feature_hi[f])
+            dec = np.where(inside, col - info.feature_shift[f],
+                           default_bins[f])
+            np.testing.assert_array_equal(dec, bins[:, f])
+
+    def test_state_roundtrip(self):
+        info = efb.BundleInfo([[0, 2], [1]], [4, 6, 3], [0, 0, 1])
+        info2 = efb.BundleInfo.from_state(info.to_state(), [4, 6, 3],
+                                          [0, 0, 1])
+        np.testing.assert_array_equal(info.feature_shift, info2.feature_shift)
+        np.testing.assert_array_equal(info.group_num_bins,
+                                      info2.group_num_bins)
+
+
+def _assert_trees_structurally_equal(t0, t1, rtol=1e-4):
+    """Same split structure (features, thresholds, routing, counts);
+    float stats (gains, outputs) to tolerance — EFB's default-bin
+    reconstruction legitimately differs in the last ulp (the reference's
+    FixHistogram has the same property, dataset.cpp:928-949)."""
+    if "leaf_value" in t0 or "leaf_value" in t1:
+        assert ("leaf_value" in t0) == ("leaf_value" in t1), (t0, t1)
+        assert t0.get("leaf_count") == t1.get("leaf_count")
+        np.testing.assert_allclose(t0["leaf_value"], t1["leaf_value"],
+                                   rtol=rtol, atol=1e-6)
+        return
+    for k in ("split_feature", "threshold", "decision_type",
+              "default_left", "missing_type", "internal_count"):
+        assert t0[k] == t1[k], (k, t0[k], t1[k])
+    np.testing.assert_allclose(t0["split_gain"], t1["split_gain"],
+                               rtol=rtol, atol=1e-6)
+    _assert_trees_structurally_equal(t0["left_child"], t1["left_child"], rtol)
+    _assert_trees_structurally_equal(t0["right_child"], t1["right_child"],
+                                     rtol)
+
+
+class TestEndToEnd:
+    def test_wide_onehot_bundles_small(self, rng):
+        n, C = 3000, 500
+        cat = rng.randint(0, C, n)
+        X = np.zeros((n, C))
+        X[np.arange(n), cat] = 1.0
+        ds = BinnedDataset.construct(X, Config({"min_data_in_bin": 1,
+                                                "min_data_in_leaf": 1}))
+        assert ds.bundle is not None
+        # ~500 indicator features (2 usable bins each) pack ~255 per group
+        assert ds.bundle.num_groups <= 8
+        assert ds.bins.shape[1] == ds.bundle.num_groups
+
+    def test_bundled_trees_match_unbundled_f64(self, rng):
+        X, y, _ = _onehot_data(rng, tie_free=True)
+        common = {"objective": "regression", "num_leaves": 31,
+                  "min_data_in_leaf": 5, "verbose": -1,
+                  "tpu_double_precision": True}
+        b0 = lgb.train(dict(common, enable_bundle=False),
+                       lgb.Dataset(X, label=y), num_boost_round=5)
+        b1 = lgb.train(dict(common, enable_bundle=True),
+                       lgb.Dataset(X, label=y), num_boost_round=5)
+        assert b1._gbdt.train_set.bundle is not None
+        for t0, t1 in zip(b0.dump_model()["tree_info"],
+                          b1.dump_model()["tree_info"]):
+            _assert_trees_structurally_equal(t0["tree_structure"],
+                                             t1["tree_structure"])
+
+    def test_binary_objective_quality(self, rng):
+        X, y, cat = _onehot_data(rng)
+        yb = (y > np.median(y)).astype(float)
+        bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                         "min_data_in_leaf": 5, "verbose": -1},
+                        lgb.Dataset(X, label=yb), num_boost_round=30)
+        assert bst._gbdt.train_set.bundle is not None
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(yb, bst.predict(X)) > 0.97
+
+    def test_valid_set_and_predict_roundtrip(self, rng, tmp_path):
+        X, y, _ = _onehot_data(rng)
+        ds = lgb.Dataset(X[:3000], label=y[:3000])
+        vs = lgb.Dataset(X[3000:], label=y[3000:], reference=ds)
+        ev = {}
+        bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "metric": "l2", "verbose": -1},
+                        ds, num_boost_round=20, valid_sets=[vs],
+                        valid_names=["v"],
+                        callbacks=[lgb.callback.record_evaluation(ev)])
+        assert ev["v"]["l2"][-1] < ev["v"]["l2"][0]
+        # model text round trip predicts identically
+        path = str(tmp_path / "m.txt")
+        bst.save_model(path)
+        loaded = lgb.Booster(model_file=path)
+        np.testing.assert_allclose(loaded.predict(X), bst.predict(X),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_dataset_binary_cache_roundtrip(self, rng, tmp_path):
+        X, y, _ = _onehot_data(rng, n=1000)
+        from lightgbm_tpu.io.metadata import Metadata
+        meta = Metadata(1000)
+        meta.set_label(y)
+        ds = BinnedDataset.construct(X, Config({}), metadata=meta)
+        assert ds.bundle is not None
+        p = str(tmp_path / "c.npz")
+        ds.save_binary(p)
+        ds2 = BinnedDataset.load_binary(p)
+        assert ds2.bundle is not None
+        np.testing.assert_array_equal(ds.bins, ds2.bins)
+        np.testing.assert_array_equal(ds.bundle.feature_shift,
+                                      ds2.bundle.feature_shift)
+
+
+class TestBundledParallel:
+    def test_data_parallel_matches_serial(self, rng):
+        import jax
+        if jax.device_count() < 2:
+            pytest.skip("needs multi-device mesh")
+        X, y, _ = _onehot_data(rng, n=2048, tie_free=True)
+        common = {"objective": "regression", "num_leaves": 15,
+                  "min_data_in_leaf": 5, "verbose": -1,
+                  "tpu_double_precision": True}
+        bs = lgb.train(dict(common),
+                       lgb.Dataset(X, label=y), num_boost_round=3)
+        bd = lgb.train(dict(common, tree_learner="data", num_machines=4),
+                       lgb.Dataset(X, label=y), num_boost_round=3)
+        assert bd._gbdt.train_set.bundle is not None
+        for t0, t1 in zip(bs.dump_model()["tree_info"],
+                          bd.dump_model()["tree_info"]):
+            _assert_trees_structurally_equal(t0["tree_structure"],
+                                             t1["tree_structure"])
+
+
+class TestSparseIngestion:
+    """CSR/CSC construction without densifying (c_api.cpp:602-747)."""
+
+    def test_sparse_matches_dense_exactly(self, rng):
+        import scipy.sparse as sp
+        X, y, _ = _onehot_data(rng, n=2000, tie_free=True)
+        Xs = sp.csr_matrix(X)
+        common = {"objective": "regression", "num_leaves": 15,
+                  "verbose": -1, "tpu_double_precision": True}
+        bd = lgb.train(dict(common), lgb.Dataset(X, label=y),
+                       num_boost_round=5)
+        bs = lgb.train(dict(common), lgb.Dataset(Xs, label=y),
+                       num_boost_round=5)
+        # identical binning -> identical bundled matrix -> identical trees
+        np.testing.assert_array_equal(bd._gbdt.train_set.bins,
+                                      bs._gbdt.train_set.bins)
+        for t0, t1 in zip(bd.dump_model()["tree_info"],
+                          bs.dump_model()["tree_info"]):
+            assert json.dumps(t0) == json.dumps(t1)
+        # sparse predict (chunked densify) equals dense predict
+        np.testing.assert_allclose(bs.predict(Xs), bs.predict(X),
+                                   rtol=1e-12)
+
+    def test_sparse_with_explicit_zeros_and_nan(self, rng):
+        import scipy.sparse as sp
+        n = 800
+        X = np.zeros((n, 3))
+        X[:n // 2, 0] = rng.randn(n // 2)
+        X[::3, 1] = rng.randn(len(range(0, n, 3)))
+        X[::7, 2] = np.nan                    # stored NaNs
+        # CSR with the same values plus an explicit STORED zero at a
+        # position whose value is genuinely 0 (must bin like an implicit 0)
+        r, c = np.nonzero(np.nan_to_num(X, nan=1.0))
+        v = X[r, c]
+        r = np.append(r, n - 1)
+        c = np.append(c, 0)
+        v = np.append(v, 0.0)
+        assert X[n - 1, 0] == 0.0
+        Xs = sp.csr_matrix((v, (r, c)), shape=X.shape)
+        bd = BinnedDataset.construct(np.asarray(X), Config({"verbose": -1}))
+        bs = BinnedDataset.construct(Xs, Config({"verbose": -1}))
+        np.testing.assert_array_equal(bd.bins, bs.bins)
+
+    def test_wide_sparse_never_densified(self, rng):
+        # 200k x 3000 one-hot CSR: dense would be 4.8 GB f64; construction
+        # must stay within the sparse footprint
+        import scipy.sparse as sp
+        n, C = 200_000, 3000
+        cat = rng.randint(0, C, n)
+        Xs = sp.csr_matrix(
+            (np.ones(n), (np.arange(n), cat)), shape=(n, C))
+        ds = BinnedDataset.construct(Xs, Config({"verbose": -1}))
+        assert ds.bundle is not None
+        assert ds.bins.shape[1] == ds.bundle.num_groups
+        assert ds.bundle.num_groups <= 40
+
+    def test_sparse_leaf_index_contrib_refit(self, rng):
+        import scipy.sparse as sp
+        X, y, _ = _onehot_data(rng, n=600, C=10)
+        Xs = sp.csr_matrix(X)
+        bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "verbose": -1}, lgb.Dataset(Xs, label=y),
+                        num_boost_round=3)
+        li_d = bst.predict(X, pred_leaf=True)
+        li_s = bst.predict(Xs, pred_leaf=True)
+        np.testing.assert_array_equal(li_d, li_s)
+        c_d = bst.predict(X, pred_contrib=True)
+        c_s = bst.predict(Xs, pred_contrib=True)
+        np.testing.assert_allclose(c_d, c_s)
+        bst._gbdt.refit(Xs, y)               # must not crash on sparse

@@ -1,0 +1,81 @@
+"""Monotone-constraint propagation tests.
+
+Port of the reference's behavioral oracle (tests/python_package_test/
+test_engine.py:679 test_monotone_constraint) plus a structural walk:
+with mid-constraint propagation (serial_tree_learner.cpp:837-846) every
+node splitting on a +1 feature must have max(left-subtree leaves) <=
+min(right-subtree leaves) — a depth>2 guarantee that local monotone
+zeroing alone cannot provide.
+"""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _train_constrained(rng, num_leaves=20, iters=30):
+    n = 2000
+    x1 = rng.random(n)
+    x2 = rng.random(n)
+    zs = rng.normal(0.0, 0.01, n)
+    y = (5 * x1 + np.sin(10 * np.pi * x1)
+         - 5 * x2 - np.cos(10 * np.pi * x2) + zs)
+    X = np.column_stack([x1, x2])
+    params = {"min_data": 20, "num_leaves": num_leaves,
+              "monotone_constraints": "1,-1", "verbose": -1}
+    return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=iters)
+
+
+def _is_correctly_constrained(learner, n=100):
+    variable_x = np.linspace(0, 1, n).reshape((n, 1))
+    for fx in np.linspace(0, 1, 20):
+        fixed = fx * np.ones((n, 1))
+        inc = learner.predict(np.column_stack([variable_x, fixed]))
+        dec = learner.predict(np.column_stack([fixed, variable_x]))
+        if not (np.diff(inc) >= 0.0).all():
+            return False
+        if not (np.diff(dec) <= 0.0).all():
+            return False
+    return True
+
+
+def test_monotone_constraint_behavioral():
+    rng = np.random.RandomState(3)
+    bst = _train_constrained(rng)
+    assert _is_correctly_constrained(bst)
+
+
+def _subtree_leaf_values(node):
+    if "leaf_value" in node:
+        return [node["leaf_value"]]
+    return (_subtree_leaf_values(node["left_child"])
+            + _subtree_leaf_values(node["right_child"]))
+
+
+def test_monotone_constraint_structural():
+    # every split on the +1 feature: left subtree max <= right subtree min
+    # (and mirrored for the -1 feature), at EVERY depth
+    rng = np.random.RandomState(5)
+    bst = _train_constrained(rng)
+    model = bst.dump_model()
+    checked = 0
+
+    def walk(node):
+        nonlocal checked
+        if "leaf_value" in node:
+            return
+        lv = max(_subtree_leaf_values(node["left_child"]))
+        rv = min(_subtree_leaf_values(node["right_child"]))
+        if node["split_feature"] == 0:       # monotone +1
+            assert lv <= rv + 1e-12, (lv, rv)
+            checked += 1
+        elif node["split_feature"] == 1:     # monotone -1
+            lv2 = min(_subtree_leaf_values(node["left_child"]))
+            rv2 = max(_subtree_leaf_values(node["right_child"]))
+            assert lv2 >= rv2 - 1e-12, (lv2, rv2)
+            checked += 1
+        walk(node["left_child"])
+        walk(node["right_child"])
+
+    for ti in model["tree_info"]:
+        walk(ti["tree_structure"])
+    assert checked > 0

@@ -103,8 +103,16 @@ class NDCGMetric(_RankMetric):
             for j, k in enumerate(self.eval_at):
                 m = self.dcg.cal_maxdcg_at_k(k, self.label[a:b])
                 self.inverse_max_dcgs[q, j] = 1.0 / m if m > 0.0 else -1.0
+        from .ops.ranking import DeviceNDCG
+        self._device = DeviceNDCG(
+            self.query_boundaries, self.label, self.dcg.label_gain_np,
+            self.eval_at, self.inverse_max_dcgs, self.query_weights)
 
     def eval(self, score, objective=None) -> List[float]:
+        return self._device(np.asarray(score, np.float64))
+
+    def eval_host(self, score, objective=None) -> List[float]:
+        """Numpy per-query path (parity oracle for DeviceNDCG)."""
         score = np.asarray(score, np.float64)
         result = np.zeros(len(self.eval_at))
         for q in range(self.num_queries):

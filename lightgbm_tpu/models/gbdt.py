@@ -22,6 +22,7 @@ from ..io.dataset import BinnedDataset
 from ..metric import Metric
 from ..objective import ObjectiveFunction
 from ..ops import grow as grow_ops
+from ..ops import predict as predict_ops
 from ..ops.split import SplitParams
 from ..utils import log
 from .tree import Tree
@@ -593,12 +594,35 @@ class GBDT:
     def _renew_tree_output(self, tree: Tree, class_id: int,
                            leaf_ids) -> None:
         """Percentile leaf refits for L1-family objectives
-        (serial_tree_learner.cpp:850-928)."""
+        (serial_tree_learner.cpp:850-928), all leaves in one device pass
+        (ops/quantile.py) — the reference scans rows per leaf on host."""
+        obj = self.objective
+        if obj is None or not obj.is_renew_tree_output():
+            return
+        from ..ops.quantile import renew_leaf_percentiles
+        label = jnp.asarray(self.train_set.metadata.label, self.dtype)
+        residual = label - jnp.asarray(
+            self._renew_baseline_score(class_id), self.dtype)
+        weights = (jnp.asarray(self.train_set.metadata.weights, self.dtype)
+                   if self.train_set.metadata.weights is not None else None)
+        if obj.name == "mape":
+            weights = jnp.asarray(obj.label_weight, self.dtype)
+        alpha = float(getattr(obj, "alpha", 0.5))
+        vals = renew_leaf_percentiles(
+            residual, jnp.asarray(leaf_ids), jnp.asarray(alpha, self.dtype),
+            L=self.config.num_leaves, weights=weights)
+        nl = tree.num_leaves
+        tree.leaf_value[:nl] = np.asarray(vals, np.float64)[:nl]
+
+    def _renew_tree_output_host(self, tree: Tree, class_id: int,
+                                leaf_ids) -> None:
+        """Numpy per-leaf path (parity oracle for renew_leaf_percentiles)."""
         obj = self.objective
         if obj is None or not obj.is_renew_tree_output():
             return
         label = np.asarray(self.train_set.metadata.label, np.float64)
-        residual = label - self._renew_baseline_score(class_id)
+        residual = label - np.asarray(self._renew_baseline_score(class_id),
+                                      np.float64)
         lids = np.asarray(leaf_ids)
         weights = (np.asarray(self.train_set.metadata.weights, np.float64)
                    if self.train_set.metadata.weights is not None else None)
@@ -612,10 +636,11 @@ class GBDT:
             w = weights[rows] if weights is not None else None
             tree.leaf_value[leaf] = obj._renew_percentile(res, w)
 
-    def _renew_baseline_score(self, class_id: int) -> np.ndarray:
-        """Score baseline for percentile leaf refits; RF overrides with its
-        constant init score (rf.hpp:126 passes init_scores_[class])."""
-        return np.asarray(self.train_state.score[class_id], np.float64)
+    def _renew_baseline_score(self, class_id: int):
+        """Score baseline for percentile leaf refits (device array; no
+        host transfer); RF overrides with its constant init score
+        (rf.hpp:126 passes init_scores_[class])."""
+        return self.train_state.score[class_id]
 
     # ------------------------------------------------------------------ #
     # Score updates (ScoreUpdater::AddScore paths)
@@ -693,6 +718,17 @@ class GBDT:
         total_iters = len(self.models) // max(k, 1)
         iters = total_iters if num_iteration <= 0 else min(num_iteration, total_iters)
         n = X.shape[0]
+        # batched device walk for real workloads (gbdt_prediction.cpp
+        # redesign, ops/predict.py): all (tree, row) pairs in parallel;
+        # the host loop below keeps early-stop and small-input duty
+        if not early_stop and n * max(len(self.models), 1) \
+                >= predict_ops.MIN_DEVICE_WORK:
+            ens = self._device_ensemble()
+            if ens is not None:
+                out = ens.predict_sum(X, iters)
+                if self.average_output:
+                    out /= max(iters, 1)
+                return out[0] if k == 1 else out.T
         out = np.zeros((k, n), np.float64)
         # margin-based prediction early stop (prediction_early_stop.cpp:
         # 14-89): rows whose margin clears the threshold stop traversing
@@ -731,6 +767,23 @@ class GBDT:
             # the average_output token; rf.hpp averages tree outputs)
             out /= max(iters, 1)
         return out[0] if k == 1 else out.T  # [n] or [n, k]
+
+    def _device_ensemble(self):
+        """Cached stacked-ensemble device arrays (rebuilt when the model
+        grows or leaf values mutate in place, e.g. refit); None when the
+        ensemble cannot run on device (giant categorical ids / node
+        counts)."""
+        key = (len(self.models), getattr(self, "_model_gen", 0),
+               id(self.models[-1]) if self.models else 0)
+        cached = getattr(self, "_dev_ens_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        ens = predict_ops.DeviceEnsemble(self.models,
+                                         self.num_tree_per_iteration)
+        if not ens.ok:
+            ens = None
+        self._dev_ens_cache = (key, ens)
+        return ens
 
     def predict(self, X: np.ndarray, num_iteration: int = -1,
                 raw_score: bool = False, early_stop: bool = False,
@@ -902,11 +955,13 @@ class GBDT:
     # ------------------------------------------------------------------ #
     def refit(self, X: np.ndarray, label: np.ndarray,
               weight=None, group=None) -> None:
-        self._sync_model()
         """Renew every tree's leaf values on new data while keeping the
         structure (GBDT::RefitTree, gbdt.cpp:263-286 +
         SerialTreeLearner::FitByExistingTree, serial_tree_learner.cpp:235-265).
         """
+        self._sync_model()
+        # leaf values mutate in place: invalidate the device ensemble
+        self._model_gen = getattr(self, "_model_gen", 0) + 1
         from ..io.metadata import Metadata
         from ..ops.split import calculate_splitted_leaf_output
 

@@ -1,11 +1,34 @@
 """GOSS: gradient-based one-side sampling (src/boosting/goss.hpp:26-213)."""
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from ..utils import log
 from .gbdt import GBDT
+
+
+@partial(jax.jit, static_argnames=("top_k", "other_k"))
+def _goss_sample(grad, hess, key, multiply, *, top_k: int, other_k: int):
+    """Device one-side sampling (goss.hpp:87-135): keep the top_k rows by
+    |g*h|, a uniform other_k of the rest with amplified gradients.  No
+    gradient round-trips to the host — the reference's host-side
+    BaggingHelper becomes one top_k + one masked top_k on device."""
+    score = jnp.sum(jnp.abs(grad * hess), axis=0)          # [n]
+    n = score.shape[0]
+    thr = jax.lax.top_k(score, top_k)[0][-1]
+    is_top = score >= thr                                   # ties keep all,
+    #                                      like the >= threshold host rule
+    u = jax.random.uniform(key, (n,))
+    u = jnp.where(is_top, 2.0, u)          # top rows never sampled as other
+    _, idx = jax.lax.top_k(-u, other_k)    # other_k smallest u
+    sel = jnp.zeros(n, bool).at[idx].set(True) & ~is_top
+    mask = jnp.where(is_top | sel, 0, -1).astype(jnp.int32)
+    amp = jnp.where(sel, multiply, 1.0).astype(grad.dtype)
+    return grad * amp[None, :], hess * amp[None, :], mask
 
 
 class GOSS(GBDT):
@@ -22,7 +45,7 @@ class GOSS(GBDT):
         if config.bagging_freq > 0 and config.bagging_fraction < 1.0:
             log.fatal("Cannot use bagging in GOSS")
         log.info("Using GOSS")
-        self._goss_rng = np.random.RandomState(config.bagging_seed)
+        self._goss_key = jax.random.PRNGKey(config.bagging_seed)
 
     def _bagging(self, it: int):
         # GOSS replaces bagging; the row mask was computed from gradients in
@@ -30,28 +53,19 @@ class GOSS(GBDT):
         return self._bag_mask if self._bag_mask is not None else self._row_all_in
 
     def _sample_gradients(self, grad, hess):
-        """BaggingHelper logic (goss.hpp:87-135), vectorized over all rows."""
+        """BaggingHelper logic (goss.hpp:87-135), fully on device."""
         cfg = self.config
         n = self.num_data
         if self.iter < int(1.0 / max(cfg.learning_rate, 1e-12)):
             self._bag_mask = None  # warm-up: use all rows
             return grad, hess
-        gnp = np.asarray(grad, np.float64)
-        hnp = np.asarray(hess, np.float64)
-        score = np.abs(gnp * hnp).sum(axis=0)  # sum over classes
         top_k = max(1, int(n * cfg.top_rate))
         other_k = max(1, int(n * cfg.other_rate))
-        threshold = np.partition(score, n - top_k)[n - top_k]
-        is_top = score >= threshold
-        rest = np.flatnonzero(~is_top)
         multiply = (n - top_k) / other_k
-        sampled = self._goss_rng.choice(
-            rest, size=min(other_k, len(rest)), replace=False) \
-            if len(rest) else np.array([], int)
-        mask = np.full(n, -1, np.int32)
-        mask[is_top] = 0
-        mask[sampled] = 0
-        self._bag_mask = jnp.asarray(mask)
-        gnp[:, sampled] *= multiply
-        hnp[:, sampled] *= multiply
-        return (jnp.asarray(gnp, grad.dtype), jnp.asarray(hnp, hess.dtype))
+        self._goss_key, sub = jax.random.split(self._goss_key)
+        grad, hess, mask = _goss_sample(
+            jnp.asarray(grad), jnp.asarray(hess), sub,
+            jnp.asarray(multiply, grad.dtype),
+            top_k=top_k, other_k=other_k)
+        self._bag_mask = mask
+        return grad, hess

@@ -234,23 +234,24 @@ class Tree:
         return (~node).astype(np.int32)
 
     def _categorical_go_left(self, fv: np.ndarray, nd: np.ndarray) -> np.ndarray:
-        """CategoricalDecision (tree.h:249-267): bitset membership."""
-        out = np.zeros(len(fv), bool)
-        for i in range(len(fv)):
-            if not (self.decision_type[nd[i]] & K_CATEGORICAL_MASK):
-                continue
-            v = fv[i]
-            if np.isnan(v):
-                out[i] = False
-                continue
-            iv = int(v)
-            if iv < 0:
-                out[i] = False
-                continue
-            cat_idx = int(self.threshold[nd[i]])
-            lo, hi = self.cat_boundaries[cat_idx], self.cat_boundaries[cat_idx + 1]
-            out[i] = _find_in_bitset(self.cat_threshold[lo:hi], iv)
-        return out
+        """CategoricalDecision (tree.h:249-267): bitset membership,
+        vectorized over rows."""
+        is_cat = (self.decision_type[nd] & K_CATEGORICAL_MASK) > 0
+        # int truncation toward zero like static_cast<int>: -0.5 tests
+        # category 0, values <= -1 are non-members
+        iv = np.where(is_cat & ~np.isnan(fv), fv, 0).astype(np.int64)
+        valid = is_cat & ~np.isnan(fv) & (iv >= 0)
+        ci = np.where(is_cat, self.threshold[nd], 0).astype(np.int64)
+        cb = np.asarray(self.cat_boundaries, np.int64)
+        lo = cb[np.clip(ci, 0, len(cb) - 2)]
+        hi = cb[np.clip(ci, 0, len(cb) - 2) + 1]
+        word = lo + iv // 32
+        in_bounds = word < hi
+        bits = np.asarray(self.cat_threshold, np.uint32)[
+            np.clip(word, 0, max(len(self.cat_threshold) - 1, 0))] \
+            if len(self.cat_threshold) else np.zeros(len(fv), np.uint32)
+        member = ((bits >> (iv % 32).astype(np.uint32)) & 1) > 0
+        return valid & in_bounds & member
 
     def predict_leaf_index_binned(self, bins: np.ndarray, dataset) -> np.ndarray:
         """DecisionInner walk over inner bin values (host variant)."""

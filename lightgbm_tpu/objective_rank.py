@@ -1,10 +1,10 @@
 """Lambdarank objective.
 
 Re-design of src/objective/rank_objective.hpp:19-237 (LambdarankNDCG): the
-reference's per-query O(n^2) pairwise OMP loop becomes a vectorized pairwise
-matrix per query.  Gradients are computed on host (numpy) — ranking datasets
-have many small queries, so per-query dense [cnt, cnt] pair matrices are
-cheap; a padded Pallas segment kernel is the planned device path.
+reference's per-query O(n^2) pairwise OMP loop runs fully on device as
+padded size-bucketed query blocks (ops/ranking.py DeviceLambdarank) — a
+handful of jitted dispatches per iteration regardless of query count.
+The numpy per-query path (`_one_query`) is kept as the parity oracle.
 
 The 1M-entry sigmoid lookup table (rank_objective.hpp:181-194) is replaced
 by the exact expression it approximates: GetSigmoid(d) = 2/(1+exp(2*sigmoid*d)).
@@ -50,8 +50,25 @@ class LambdarankNDCG(ObjectiveFunction):
             a, b = self.query_boundaries[q], self.query_boundaries[q + 1]
             mdcg = self.dcg.cal_maxdcg_at_k(self.optimize_pos_at, self.label_np[a:b])
             self.inverse_max_dcgs[q] = 1.0 / mdcg if mdcg > 0.0 else 0.0
+        from .ops.ranking import DeviceLambdarank
+        import jax.numpy as jnp
+        import jax
+        dtype = (jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+        self._device = DeviceLambdarank(
+            self.query_boundaries, self.label_np, self.dcg.label_gain_np,
+            self.inverse_max_dcgs, self.sigmoid, dtype=dtype)
+        self._weights_dev = (jnp.asarray(self.weights_np, dtype)
+                            if self.weights_np is not None else None)
 
     def get_gradients(self, score):
+        grad, hess = self._device(score)
+        if self._weights_dev is not None:
+            grad = grad * self._weights_dev
+            hess = hess * self._weights_dev
+        return grad, hess
+
+    def get_gradients_host(self, score):
+        """Numpy reference path (parity oracle for the device kernels)."""
         score = np.asarray(score, np.float64).reshape(-1)
         grad = np.zeros(self.num_data)
         hess = np.zeros(self.num_data)

@@ -102,3 +102,62 @@ def test_shap_sums_to_prediction(data):
     contrib = bst.predict(sub, pred_contrib=True)
     raw = bst.predict(sub, raw_score=True)
     np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6)
+
+
+def test_goss_device_sampling_semantics(rng):
+    """_goss_sample: top rows kept unamplified, exactly other_k of the
+    rest amplified by (n-top_k)/other_k, mask covers only selected rows."""
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.models.goss import _goss_sample
+    n, top_k, other_k = 1000, 200, 100
+    g = jnp.asarray(rng.randn(2, n), jnp.float32)
+    h = jnp.asarray(np.abs(rng.randn(2, n)) + 0.1, jnp.float32)
+    mult = (n - top_k) / other_k
+    g2, h2, mask = _goss_sample(g, h, jax.random.PRNGKey(0),
+                                jnp.float32(mult), top_k=top_k,
+                                other_k=other_k)
+    score = np.abs(np.asarray(g) * np.asarray(h)).sum(axis=0)
+    thr = np.partition(score, n - top_k)[n - top_k]
+    is_top = score >= thr
+    mask = np.asarray(mask)
+    amp = np.asarray(g2)[0] / np.asarray(g)[0]
+    # top rows: kept, not amplified
+    assert (mask[is_top] == 0).all()
+    np.testing.assert_allclose(amp[is_top], 1.0, rtol=1e-6)
+    # sampled others: amplified by mult and in the bag
+    sampled = (~is_top) & (mask == 0)
+    assert sampled.sum() == other_k
+    np.testing.assert_allclose(amp[sampled], mult, rtol=1e-5)
+    # dropped rows: out of bag
+    assert (mask[(~is_top) & ~sampled] == -1).all()
+
+
+def test_l1_renew_device_matches_host(rng):
+    """renew_leaf_percentiles vs the per-leaf numpy oracle, weighted and
+    unweighted, several alphas."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.objective import percentile, weighted_percentile
+    from lightgbm_tpu.ops.quantile import renew_leaf_percentiles
+    n, L = 3000, 12
+    residual = rng.randn(n)
+    lids = rng.randint(-1, L, n)     # -1 = out of bag
+    weights = rng.rand(n) + 0.05
+    for alpha in (0.5, 0.1, 0.9):
+        dev = np.asarray(renew_leaf_percentiles(
+            jnp.asarray(residual), jnp.asarray(lids, jnp.int32),
+            jnp.asarray(alpha), L=L))
+        devw = np.asarray(renew_leaf_percentiles(
+            jnp.asarray(residual), jnp.asarray(lids, jnp.int32),
+            jnp.asarray(alpha), L=L, weights=jnp.asarray(weights)))
+        for leaf in range(L):
+            rows = np.flatnonzero(lids == leaf)
+            if len(rows) == 0:
+                continue
+            np.testing.assert_allclose(
+                dev[leaf], percentile(residual[rows], alpha),
+                rtol=1e-5, atol=1e-7)
+            np.testing.assert_allclose(
+                devw[leaf], weighted_percentile(residual[rows],
+                                                weights[rows], alpha),
+                rtol=1e-5, atol=1e-7)

@@ -1,0 +1,140 @@
+"""Batched device ensemble prediction (ops/predict.py) vs the host walk."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops import predict as predict_ops
+
+
+@pytest.fixture(autouse=True)
+def _force_device_path(monkeypatch):
+    # small test inputs must still exercise the device walk
+    monkeypatch.setattr(predict_ops, "MIN_DEVICE_WORK", 0)
+
+
+def _host_predict(bst, X, **kw):
+    g = bst._gbdt
+    import unittest.mock as mock
+    with mock.patch.object(predict_ops, "MIN_DEVICE_WORK", 1 << 62):
+        return g.predict_raw(X, **kw)
+
+
+def test_regression_matches_host(rng):
+    X = rng.randn(500, 6)
+    y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.05 * rng.randn(500)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbose": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=20)
+    Xt = rng.randn(300, 6)
+    dev = bst._gbdt.predict_raw(Xt)
+    host = _host_predict(bst, Xt)
+    np.testing.assert_allclose(dev, host, rtol=1e-6, atol=1e-7)
+
+
+def test_multiclass_and_num_iteration(rng):
+    X = rng.randn(600, 5)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int) + (X[:, 2] > 0.5).astype(int)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    Xt = rng.randn(200, 5)
+    for ni in (-1, 3):
+        dev = bst._gbdt.predict_raw(Xt, num_iteration=ni)
+        host = _host_predict(bst, Xt, num_iteration=ni)
+        np.testing.assert_allclose(dev, host, rtol=1e-6, atol=1e-7)
+
+
+def test_missing_values_match_host(rng):
+    X = rng.randn(800, 4)
+    X[rng.rand(800, 4) < 0.2] = np.nan
+    y = np.where(np.isnan(X[:, 0]), 2.0, X[:, 0]) + 0.1 * rng.randn(800)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "use_missing": True, "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=10)
+    Xt = rng.randn(300, 4)
+    Xt[rng.rand(300, 4) < 0.3] = np.nan
+    np.testing.assert_allclose(bst._gbdt.predict_raw(Xt),
+                               _host_predict(bst, Xt),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_zero_as_missing(rng):
+    X = rng.randn(500, 3)
+    X[rng.rand(500, 3) < 0.3] = 0.0
+    y = X[:, 0] + 0.05 * rng.randn(500)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "zero_as_missing": True, "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    Xt = rng.randn(200, 3)
+    Xt[rng.rand(200, 3) < 0.4] = 0.0
+    np.testing.assert_allclose(bst._gbdt.predict_raw(Xt),
+                               _host_predict(bst, Xt),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_categorical_matches_host(rng):
+    n = 1000
+    c1 = rng.randint(0, 12, n).astype(float)
+    c2 = rng.randint(0, 40, n).astype(float)
+    x3 = rng.randn(n)
+    X = np.column_stack([c1, c2, x3])
+    w = rng.randn(40)
+    y = (c1 % 3) + w[c2.astype(int)] + 0.1 * x3
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbose": -1, "min_data_per_group": 5},
+                    lgb.Dataset(X, label=y, categorical_feature=[0, 1]),
+                    num_boost_round=10)
+    Xt = np.column_stack([rng.randint(0, 15, 300).astype(float),
+                          rng.randint(0, 45, 300).astype(float),
+                          rng.randn(300)])   # incl. unseen categories
+    np.testing.assert_allclose(bst._gbdt.predict_raw(Xt),
+                               _host_predict(bst, Xt),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_rf_average_and_reload(rng, tmp_path):
+    X = rng.randn(500, 4)
+    y = X[:, 0] + 0.1 * rng.randn(500)
+    bst = lgb.train({"objective": "regression", "boosting": "rf",
+                     "bagging_freq": 1, "bagging_fraction": 0.7,
+                     "num_leaves": 7, "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=9)
+    Xt = rng.randn(200, 4)
+    np.testing.assert_allclose(bst._gbdt.predict_raw(Xt),
+                               _host_predict(bst, Xt),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_categorical_edge_values(rng):
+    # -0.5 truncates to category 0; huge unseen ids are non-members;
+    # device and host must agree on all of them
+    n = 600
+    c = rng.randint(0, 8, n).astype(float)
+    x = rng.randn(n)
+    X = np.column_stack([c, x])
+    y = (c % 2) * 2 + 0.1 * x
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbose": -1, "min_data_per_group": 5},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]),
+                    num_boost_round=8)
+    Xt = np.column_stack([
+        np.array([-0.5, -1.5, 0.0, 7.0, 4000.0, np.nan, 31.0, 2.5]),
+        np.zeros(8)])
+    np.testing.assert_allclose(bst._gbdt.predict_raw(Xt),
+                               _host_predict(bst, Xt),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_refit_invalidates_device_cache(rng):
+    X = rng.randn(400, 4)
+    y = X[:, 0] + 0.1 * rng.randn(400)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbose": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=5)
+    Xt = rng.randn(100, 4)
+    before = bst._gbdt.predict_raw(Xt)       # device path (forced fixture)
+    bst._gbdt.refit(X, y + 10.0)             # leaf values change in place
+    after = bst._gbdt.predict_raw(Xt)
+    host_after = _host_predict(bst, Xt)
+    np.testing.assert_allclose(after, host_after, rtol=1e-6, atol=1e-7)
+    assert np.abs(after - before).max() > 1.0

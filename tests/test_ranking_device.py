@@ -1,0 +1,95 @@
+"""Device ranking ops (ops/ranking.py) vs the numpy per-query oracles."""
+import numpy as np
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.metadata import Metadata
+from lightgbm_tpu.metric_rank import NDCGMetric
+from lightgbm_tpu.objective_rank import LambdarankNDCG
+
+
+def _rank_data(rng, num_queries=60, max_docs=40):
+    sizes = rng.randint(1, max_docs, num_queries)
+    n = int(sizes.sum())
+    labels = rng.randint(0, 5, n).astype(np.float64)
+    meta = Metadata(n)
+    meta.set_label(labels)
+    meta.set_query(sizes)
+    return meta, n, labels
+
+
+def test_lambdarank_device_matches_host(rng):
+    meta, n, _ = _rank_data(rng)
+    obj = LambdarankNDCG(Config({"objective": "lambdarank"}))
+    obj.init(meta, n)
+    score = rng.randn(n)
+    gd, hd = (np.asarray(a, np.float64) for a in obj.get_gradients(score))
+    gh, hh = obj.get_gradients_host(score)
+    np.testing.assert_allclose(gd, gh, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(hd, hh, rtol=2e-4, atol=2e-5)
+
+
+def test_lambdarank_device_with_weights(rng):
+    meta, n, _ = _rank_data(rng, num_queries=20)
+    meta.set_weights(rng.rand(n) + 0.5)
+    obj = LambdarankNDCG(Config({"objective": "lambdarank"}))
+    obj.init(meta, n)
+    score = rng.randn(n)
+    gd, hd = (np.asarray(a, np.float64) for a in obj.get_gradients(score))
+    gh, hh = obj.get_gradients_host(score)
+    np.testing.assert_allclose(gd, gh, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(hd, hh, rtol=2e-4, atol=2e-5)
+
+
+def test_lambdarank_singleton_and_allnegative_queries(rng):
+    # size-1 queries and all-zero-label queries produce zero lambdas
+    sizes = np.array([1, 5, 1, 7])
+    n = int(sizes.sum())
+    labels = np.zeros(n)
+    labels[1] = 3        # only query 1 has signal
+    meta = Metadata(n)
+    meta.set_label(labels)
+    meta.set_query(sizes)
+    obj = LambdarankNDCG(Config({"objective": "lambdarank"}))
+    obj.init(meta, n)
+    score = rng.randn(n)
+    gd, hd = (np.asarray(a, np.float64) for a in obj.get_gradients(score))
+    gh, hh = obj.get_gradients_host(score)
+    np.testing.assert_allclose(gd, gh, rtol=1e-4, atol=1e-6)
+    assert np.all(gd[sizes[0] + sizes[1]:] == 0)   # queries 2,3: no signal
+
+
+def test_ndcg_device_matches_host(rng):
+    meta, n, _ = _rank_data(rng, num_queries=80)
+    m = NDCGMetric(Config({"metric": "ndcg", "eval_at": [1, 3, 5, 10]}))
+    m.init(meta, n)
+    score = rng.randn(n)
+    np.testing.assert_allclose(m.eval(score), m.eval_host(score),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ndcg_device_weighted_and_allnegative(rng):
+    sizes = np.array([4, 6, 3])
+    n = int(sizes.sum())
+    labels = np.zeros(n)
+    labels[:4] = rng.randint(1, 4, 4)    # query 0 has signal; 1,2 all-neg
+    meta = Metadata(n)
+    meta.set_label(labels)
+    meta.set_weights(rng.rand(n) + 0.1)  # induces query weights
+    meta.set_query(sizes)
+    m = NDCGMetric(Config({"metric": "ndcg", "eval_at": [2, 4]}))
+    m.init(meta, n)
+    score = rng.randn(n)
+    np.testing.assert_allclose(m.eval(score), m.eval_host(score),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_ndcg_empty_query_counts_as_one(rng):
+    # a zero-row query contributes NDCG=1 (maxDCG<=0 rule); device and
+    # host must agree
+    meta = Metadata(4)
+    meta.set_label(np.array([1.0, 0.0, 2.0, 1.0]))
+    meta.set_query(np.array([2, 0, 2]))
+    m = NDCGMetric(Config({"metric": "ndcg", "eval_at": [2]}))
+    m.init(meta, 4)
+    score = rng.randn(4)
+    np.testing.assert_allclose(m.eval(score), m.eval_host(score), rtol=1e-6)

@@ -3,7 +3,9 @@
 Python-API mirror of python-package/lightgbm/basic.py: lazily-constructed
 Dataset with reference alignment, pandas/categorical handling, field get/set;
 Booster with update (incl. custom fobj), eval, save/load, predict.  The ctypes
-C-ABI hop of the reference is replaced by direct calls into the framework.
+C-ABI hop of the reference is replaced by direct calls into the framework;
+c_api.py re-exposes the same behavior as the LGBM_* ctypes surface for ABI
+parity.
 """
 from __future__ import annotations
 

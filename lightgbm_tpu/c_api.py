@@ -1,0 +1,520 @@
+"""C API shim: the LGBM_* surface as a pure-Python ctypes-compatible ABI.
+
+Mirror of src/c_api.cpp / include/LightGBM/c_api.h (the handle-based ABI
+every reference binding goes through): this module object can stand in
+for the loaded `lib_lightgbm` DLL — functions take the same ctypes
+arguments (c_char_p strings, byref out-params, raw data pointers plus
+dtype/shape descriptors), return int status codes, and keep a
+LGBM_GetLastError string.  Handles are integer keys into a registry of
+framework objects instead of heap pointers.
+
+Drivable by the reference's own ctypes test patterns
+(tests/c_api_test/test_.py: dataset create from file/mat/CSR/CSC,
+save-binary round trip, booster train/eval/save/reload/predict).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .utils import log
+
+# dtype codes (c_api.h C_API_DTYPE_*)
+C_API_DTYPE_FLOAT32 = 0
+C_API_DTYPE_FLOAT64 = 1
+C_API_DTYPE_INT32 = 2
+C_API_DTYPE_INT64 = 3
+
+# predict type codes (c_api.h C_API_PREDICT_*)
+C_API_PREDICT_NORMAL = 0
+C_API_PREDICT_RAW_SCORE = 1
+C_API_PREDICT_LEAF_INDEX = 2
+C_API_PREDICT_CONTRIB = 3
+
+_NP_DTYPE = {C_API_DTYPE_FLOAT32: np.float32,
+             C_API_DTYPE_FLOAT64: np.float64,
+             C_API_DTYPE_INT32: np.int32,
+             C_API_DTYPE_INT64: np.int64}
+_CTYPES_PTR = {C_API_DTYPE_FLOAT32: ctypes.c_float,
+               C_API_DTYPE_FLOAT64: ctypes.c_double,
+               C_API_DTYPE_INT32: ctypes.c_int32,
+               C_API_DTYPE_INT64: ctypes.c_int64}
+
+_handles: Dict[int, Any] = {}
+_next_handle = [1]
+_last_error = [b"everything is fine"]
+
+
+class _CApiError(Exception):
+    pass
+
+
+def _new_handle(obj) -> int:
+    h = _next_handle[0]
+    _next_handle[0] += 1
+    _handles[h] = obj
+    return h
+
+
+def _resolve(handle):
+    """ctypes.c_void_p (or raw int) handle -> registered object."""
+    key = handle.value if hasattr(handle, "value") else handle
+    if key is None or key not in _handles:
+        raise _CApiError("invalid handle")
+    return _handles[key]
+
+
+def _out(p):
+    """byref(x) / POINTER argument -> the underlying ctypes object."""
+    if hasattr(p, "_obj"):
+        return p._obj
+    if hasattr(p, "contents"):
+        return p.contents
+    return p
+
+
+def _to_str(s) -> str:
+    if s is None:
+        return ""
+    v = s.value if hasattr(s, "value") else s
+    if isinstance(v, bytes):
+        return v.decode("utf-8")
+    return str(v or "")
+
+
+def _parse_params(s) -> Dict[str, str]:
+    """'k1=v1 k2=v2' -> dict (Config::Str2Map, config.h:74)."""
+    out: Dict[str, str] = {}
+    for tok in _to_str(s).replace("\n", " ").split(" "):
+        tok = tok.strip()
+        if not tok or "=" not in tok:
+            continue
+        k, v = tok.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+def _as_np(ptr, dtype_code: int, count: int) -> np.ndarray:
+    """Raw data pointer (any ctypes flavor) + dtype code -> numpy view."""
+    if isinstance(ptr, np.ndarray):
+        return ptr.astype(_NP_DTYPE[dtype_code], copy=False)
+    if isinstance(ptr, ctypes.Array):
+        return np.ctypeslib.as_array(ptr).astype(_NP_DTYPE[dtype_code],
+                                                 copy=False)
+    ct = _CTYPES_PTR[dtype_code]
+    addr = ctypes.cast(ptr, ctypes.POINTER(ct))
+    return np.ctypeslib.as_array(addr, shape=(count,))
+
+
+def _wrap(fn):
+    """API_BEGIN/API_END (c_api.cpp): exceptions -> -1 + last-error."""
+    def inner(*args):
+        try:
+            fn(*args)
+            return 0
+        except Exception as e:   # noqa: BLE001 — ABI boundary
+            _last_error[0] = str(e).encode("utf-8", "replace")
+            return -1
+    inner.__name__ = fn.__name__
+    inner.__doc__ = fn.__doc__
+    return inner
+
+
+def LGBM_GetLastError():
+    return _last_error[0]
+
+
+# --------------------------------------------------------------------- #
+# Dataset (c_api.cpp:382-868)
+# --------------------------------------------------------------------- #
+def _finish_dataset(ds: Dataset, ref, out):
+    if ref is not None and (getattr(ref, "value", ref) or None) is not None:
+        ds.reference = _resolve(ref)
+    ds.construct()
+    _out(out).value = _new_handle(ds)
+
+
+@_wrap
+def LGBM_DatasetCreateFromFile(filename, parameters, reference, out):
+    from .io.dataset import BinnedDataset
+    path = _to_str(filename)
+    params = _parse_params(parameters)
+    try:     # binary cache fast path (dataset_loader.cpp:267)
+        binned = BinnedDataset.load_binary(path)
+        ds = Dataset(None, params=params)
+        ds._binned = binned
+        _out(out).value = _new_handle(ds)
+        return
+    except Exception:
+        pass
+    from .config import Config
+    from .io import loader as loader_mod
+    cfg = Config(params)
+    d = loader_mod.load_data_file(cfg, path,
+                                  initscore_filename=cfg.initscore_filename)
+    ds = Dataset(d.X, label=d.label, weight=d.weight, group=d.group,
+                 init_score=d.init_score, params=params,
+                 feature_name=d.feature_names or "auto",
+                 categorical_feature=d.categorical or "auto")
+    _finish_dataset(ds, reference, out)
+
+
+@_wrap
+def LGBM_DatasetCreateFromMat(data, data_type, nrow, ncol, is_row_major,
+                              parameters, reference, out):
+    nrow, ncol = int(getattr(nrow, "value", nrow)), \
+        int(getattr(ncol, "value", ncol))
+    flat = _as_np(data, int(getattr(data_type, "value", data_type)),
+                  nrow * ncol)
+    rm = int(getattr(is_row_major, "value", is_row_major))
+    X = (flat.reshape(nrow, ncol) if rm
+         else flat.reshape(ncol, nrow).T).astype(np.float64)
+    ds = Dataset(X, params=_parse_params(parameters))
+    _finish_dataset(ds, reference, out)
+
+
+@_wrap
+def LGBM_DatasetCreateFromCSR(indptr, indptr_type, indices, data, data_type,
+                              nindptr, nelem, num_col, parameters,
+                              reference, out):
+    import scipy.sparse as sp
+    nindptr = int(getattr(nindptr, "value", nindptr))
+    nelem = int(getattr(nelem, "value", nelem))
+    num_col = int(getattr(num_col, "value", num_col))
+    ip = _as_np(indptr, int(getattr(indptr_type, "value", indptr_type)),
+                nindptr)
+    idx = _as_np(indices, C_API_DTYPE_INT32, nelem)
+    vals = _as_np(data, int(getattr(data_type, "value", data_type)), nelem)
+    X = sp.csr_matrix((vals, idx, ip), shape=(nindptr - 1, num_col))
+    ds = Dataset(X, params=_parse_params(parameters))
+    _finish_dataset(ds, reference, out)
+
+
+@_wrap
+def LGBM_DatasetCreateFromCSC(col_ptr, col_ptr_type, indices, data,
+                              data_type, ncol_ptr, nelem, num_row,
+                              parameters, reference, out):
+    import scipy.sparse as sp
+    ncol_ptr = int(getattr(ncol_ptr, "value", ncol_ptr))
+    nelem = int(getattr(nelem, "value", nelem))
+    num_row = int(getattr(num_row, "value", num_row))
+    cp = _as_np(col_ptr, int(getattr(col_ptr_type, "value", col_ptr_type)),
+                ncol_ptr)
+    idx = _as_np(indices, C_API_DTYPE_INT32, nelem)
+    vals = _as_np(data, int(getattr(data_type, "value", data_type)), nelem)
+    X = sp.csc_matrix((vals, idx, cp), shape=(num_row, ncol_ptr - 1)).tocsr()
+    ds = Dataset(X, params=_parse_params(parameters))
+    _finish_dataset(ds, reference, out)
+
+
+@_wrap
+def LGBM_DatasetFree(handle):
+    key = handle.value if hasattr(handle, "value") else handle
+    _handles.pop(key, None)
+
+
+@_wrap
+def LGBM_DatasetGetNumData(handle, out):
+    ds = _resolve(handle)
+    ds.construct()
+    _out(out).value = ds._binned.num_data
+
+
+@_wrap
+def LGBM_DatasetGetNumFeature(handle, out):
+    ds = _resolve(handle)
+    ds.construct()
+    _out(out).value = ds._binned.num_total_features
+
+
+@_wrap
+def LGBM_DatasetSaveBinary(handle, filename):
+    ds = _resolve(handle)
+    ds.construct()
+    ds._binned.save_binary(_to_str(filename))
+
+
+@_wrap
+def LGBM_DatasetSetField(handle, field_name, data, num_element, dtype=None):
+    ds = _resolve(handle)
+    ds.construct()
+    name = _to_str(field_name)
+    num = int(getattr(num_element, "value", num_element))
+    if dtype is None:
+        dtype = C_API_DTYPE_FLOAT32
+    code = int(getattr(dtype, "value", dtype))
+    if isinstance(data, ctypes.Array):
+        # reference test passes c_array(...) whose element type wins
+        arr = np.ctypeslib.as_array(data)[:num]
+    else:
+        arr = _as_np(data, code, num)
+    meta = ds._binned.metadata
+    if name == "label":
+        meta.set_label(np.asarray(arr, np.float64))
+    elif name == "weight":
+        meta.set_weights(np.asarray(arr, np.float64))
+    elif name in ("group", "query"):
+        meta.set_query(np.asarray(arr, np.int64))
+    elif name == "init_score":
+        meta.set_init_score(np.asarray(arr, np.float64))
+    else:
+        raise _CApiError("Unknown field name: %s" % name)
+
+
+@_wrap
+def LGBM_DatasetGetField(handle, field_name, out_len, out_ptr, out_type):
+    ds = _resolve(handle)
+    ds.construct()
+    meta = ds._binned.metadata
+    name = _to_str(field_name)
+    if name == "label":
+        arr, code = meta.label, C_API_DTYPE_FLOAT32
+    elif name == "weight":
+        arr, code = meta.weights, C_API_DTYPE_FLOAT32
+    elif name in ("group", "query"):
+        arr, code = meta.query_boundaries, C_API_DTYPE_INT32
+    elif name == "init_score":
+        arr, code = meta.init_score, C_API_DTYPE_FLOAT64
+    else:
+        raise _CApiError("Unknown field name: %s" % name)
+    if arr is None:
+        _out(out_len).value = 0
+        return
+    arr = np.ascontiguousarray(np.asarray(arr, _NP_DTYPE[code]))
+    hold = getattr(ds, "_field_holds", {})
+    hold[name] = arr     # keep alive while the caller reads the pointer
+    ds._field_holds = hold
+    _out(out_len).value = len(arr)
+    _out(out_type).value = code
+    ptr = arr.ctypes.data_as(ctypes.POINTER(_CTYPES_PTR[code]))
+    _out(out_ptr).contents = ptr.contents
+
+
+# --------------------------------------------------------------------- #
+# Booster (c_api.cpp:924-1348)
+# --------------------------------------------------------------------- #
+@_wrap
+def LGBM_BoosterCreate(train_data, parameters, out):
+    ds = _resolve(train_data)
+    bst = Booster(params=_parse_params(parameters), train_set=ds)
+    _out(out).value = _new_handle(bst)
+
+
+@_wrap
+def LGBM_BoosterCreateFromModelfile(filename, out_num_iterations, out):
+    bst = Booster(model_file=_to_str(filename))
+    _out(out_num_iterations).value = bst.num_trees()
+    _out(out).value = _new_handle(bst)
+
+
+@_wrap
+def LGBM_BoosterLoadModelFromString(model_str, out_num_iterations, out):
+    bst = Booster(model_str=_to_str(model_str))
+    _out(out_num_iterations).value = bst.num_trees()
+    _out(out).value = _new_handle(bst)
+
+
+@_wrap
+def LGBM_BoosterFree(handle):
+    key = handle.value if hasattr(handle, "value") else handle
+    _handles.pop(key, None)
+
+
+@_wrap
+def LGBM_BoosterAddValidData(handle, valid_data):
+    bst = _resolve(handle)
+    ds = _resolve(valid_data)
+    bst.add_valid(ds, "valid_%d" % len(bst.name_valid_sets))
+
+
+@_wrap
+def LGBM_BoosterGetNumClasses(handle, out):
+    _out(out).value = _resolve(handle)._gbdt.num_class
+
+
+@_wrap
+def LGBM_BoosterUpdateOneIter(handle, is_finished):
+    bst = _resolve(handle)
+    _out(is_finished).value = int(bool(bst.update()))
+
+
+@_wrap
+def LGBM_BoosterRollbackOneIter(handle):
+    _resolve(handle)._gbdt.rollback_one_iter()
+
+
+@_wrap
+def LGBM_BoosterGetCurrentIteration(handle, out):
+    _out(out).value = _resolve(handle)._gbdt.iter
+
+
+@_wrap
+def LGBM_BoosterGetEvalCounts(handle, out):
+    bst = _resolve(handle)
+    _out(out).value = len(bst._gbdt.train_metrics)
+
+
+@_wrap
+def LGBM_BoosterGetEvalNames(handle, out_len, out_strs):
+    bst = _resolve(handle)
+    names = [m.name for m in bst._gbdt.train_metrics]
+    _out(out_len).value = len(names)
+    for i, name in enumerate(names):
+        ctypes.memmove(out_strs[i], name.encode("utf-8") + b"\0",
+                       len(name) + 1)
+
+
+def _eval_values(gbdt, data_idx: int):
+    if data_idx == 0:
+        res = gbdt.eval_train()
+        return [v for m in gbdt.train_metrics for v in _aslist(res[m.name])]
+    name, state, metrics = gbdt.valid_states[data_idx - 1]
+    res = gbdt._eval_state(state, metrics)
+    return [v for m in metrics for v in _aslist(res[m.name])]
+
+
+def _aslist(v):
+    return v if isinstance(v, (list, tuple)) else [v]
+
+
+@_wrap
+def LGBM_BoosterGetEval(handle, data_idx, out_len, out_results):
+    bst = _resolve(handle)
+    vals = _eval_values(bst._gbdt, int(getattr(data_idx, "value", data_idx)))
+    _out(out_len).value = len(vals)
+    ptr = ctypes.cast(out_results, ctypes.POINTER(ctypes.c_double))
+    for i, v in enumerate(vals):
+        ptr[i] = float(v)
+
+
+@_wrap
+def LGBM_BoosterSaveModel(handle, start_iteration, num_iteration, filename):
+    bst = _resolve(handle)
+    bst.save_model(_to_str(filename),
+                   num_iteration=int(getattr(num_iteration, "value",
+                                             num_iteration)),
+                   start_iteration=int(getattr(start_iteration, "value",
+                                               start_iteration)))
+
+
+@_wrap
+def LGBM_BoosterSaveModelToString(handle, start_iteration, num_iteration,
+                                  buffer_len, out_len, out_str):
+    bst = _resolve(handle)
+    s = bst.model_to_string(
+        num_iteration=int(getattr(num_iteration, "value", num_iteration)),
+        start_iteration=int(getattr(start_iteration, "value",
+                                    start_iteration)))
+    raw = s.encode("utf-8") + b"\0"
+    _out(out_len).value = len(raw)
+    blen = int(getattr(buffer_len, "value", buffer_len))
+    if out_str and blen >= len(raw):
+        ctypes.memmove(out_str, raw, len(raw))
+
+
+def _predict(bst: Booster, X, predict_type: int, num_iteration: int):
+    pt = int(predict_type)
+    ni = int(num_iteration)
+    if pt == C_API_PREDICT_LEAF_INDEX:
+        return bst.predict(X, num_iteration=ni, pred_leaf=True)
+    if pt == C_API_PREDICT_CONTRIB:
+        return bst.predict(X, num_iteration=ni, pred_contrib=True)
+    raw = pt == C_API_PREDICT_RAW_SCORE
+    return bst.predict(X, num_iteration=ni, raw_score=raw)
+
+
+@_wrap
+def LGBM_BoosterPredictForMat(handle, data, data_type, nrow, ncol,
+                              is_row_major, predict_type, num_iteration,
+                              parameter, out_len, out_result):
+    bst = _resolve(handle)
+    nrow = int(getattr(nrow, "value", nrow))
+    ncol = int(getattr(ncol, "value", ncol))
+    flat = _as_np(data, int(getattr(data_type, "value", data_type)),
+                  nrow * ncol)
+    rm = int(getattr(is_row_major, "value", is_row_major))
+    X = (flat.reshape(nrow, ncol) if rm
+         else flat.reshape(ncol, nrow).T).astype(np.float64)
+    pred = np.asarray(_predict(
+        bst, X, getattr(predict_type, "value", predict_type),
+        getattr(num_iteration, "value", num_iteration)), np.float64)
+    flatp = pred.reshape(-1)
+    _out(out_len).value = len(flatp)
+    ptr = ctypes.cast(out_result, ctypes.POINTER(ctypes.c_double))
+    for i, v in enumerate(flatp):
+        ptr[i] = float(v)
+
+
+@_wrap
+def LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices, data,
+                              data_type, nindptr, nelem, num_col,
+                              predict_type, num_iteration, parameter,
+                              out_len, out_result):
+    import scipy.sparse as sp
+    bst = _resolve(handle)
+    nindptr = int(getattr(nindptr, "value", nindptr))
+    nelem = int(getattr(nelem, "value", nelem))
+    num_col = int(getattr(num_col, "value", num_col))
+    ip = _as_np(indptr, int(getattr(indptr_type, "value", indptr_type)),
+                nindptr)
+    idx = _as_np(indices, C_API_DTYPE_INT32, nelem)
+    vals = _as_np(data, int(getattr(data_type, "value", data_type)), nelem)
+    X = sp.csr_matrix((vals, idx, ip), shape=(nindptr - 1, num_col))
+    pred = np.asarray(_predict(
+        bst, X, getattr(predict_type, "value", predict_type),
+        getattr(num_iteration, "value", num_iteration)), np.float64)
+    flatp = pred.reshape(-1)
+    _out(out_len).value = len(flatp)
+    ptr = ctypes.cast(out_result, ctypes.POINTER(ctypes.c_double))
+    for i, v in enumerate(flatp):
+        ptr[i] = float(v)
+
+
+@_wrap
+def LGBM_BoosterPredictForFile(handle, data_filename, data_has_header,
+                               predict_type, num_iteration, parameter,
+                               result_filename):
+    bst = _resolve(handle)
+    from .config import Config
+    from .io import loader as loader_mod
+    cfg = Config({"header": bool(getattr(data_has_header, "value",
+                                         data_has_header))})
+    d = loader_mod.load_data_file(cfg, _to_str(data_filename))
+    pred = np.asarray(_predict(
+        bst, d.X, getattr(predict_type, "value", predict_type),
+        getattr(num_iteration, "value", num_iteration)), np.float64)
+    with open(_to_str(result_filename), "w") as f:
+        if pred.ndim == 1:
+            for v in pred:
+                f.write("%.18g\n" % v)
+        else:
+            for row in pred:
+                f.write("\t".join("%.18g" % v for v in row) + "\n")
+
+
+@_wrap
+def LGBM_BoosterGetNumPredict(handle, data_idx, out):
+    """Prediction count for a training/validation dataset: num_data of
+    that dataset times num_model_per_iteration (c_api.cpp GetNumPredict)."""
+    gbdt = _resolve(handle)._gbdt
+    idx = int(getattr(data_idx, "value", data_idx))
+    if idx == 0:
+        n = gbdt.num_data
+    else:
+        n = gbdt.valid_states[idx - 1][1].score.shape[1]
+    _out(out).value = n * max(gbdt.num_tree_per_iteration, 1)
+
+
+@_wrap
+def LGBM_NetworkInit(machines, local_listen_port, listen_time_out,
+                     num_machines):
+    log.warning("LGBM_NetworkInit is a no-op: distributed training uses "
+                "the JAX device mesh (parallel/learners.py), not sockets")
+
+
+@_wrap
+def LGBM_NetworkFree():
+    pass

@@ -773,8 +773,7 @@ class GBDT:
         grows or leaf values mutate in place, e.g. refit); None when the
         ensemble cannot run on device (giant categorical ids / node
         counts)."""
-        key = (len(self.models), getattr(self, "_model_gen", 0),
-               id(self.models[-1]) if self.models else 0)
+        key = (len(self.models), getattr(self, "_model_gen", 0))
         cached = getattr(self, "_dev_ens_cache", None)
         if cached is not None and cached[0] == key:
             return cached[1]
@@ -902,6 +901,8 @@ class GBDT:
         log.info("Saved model to %s", filename)
 
     def load_model_from_string(self, text: str) -> None:
+        # replacing the model invalidates any cached device ensemble
+        self._model_gen = getattr(self, "_model_gen", 0) + 1
         """LoadModelFromString (gbdt_model_text.cpp:343+)."""
         lines = text.split("\n")
         header: Dict[str, str] = {}
@@ -1013,6 +1014,8 @@ class GBDT:
 
     def rollback_one_iter(self) -> None:
         self._sync_model()
+        # dropping trees invalidates any cached device ensemble
+        self._model_gen = getattr(self, "_model_gen", 0) + 1
         if self.iter <= 0:
             return
         k = self.num_tree_per_iteration

@@ -70,6 +70,11 @@ class DeviceEnsemble:
             # the host walk for deep-leaf x many-tree ensembles
             self.ok = False
             return
+        if any(t.num_cat > 0 for t in trees) \
+                and T * N * _MAX_CAT_W > _MAX_SIG_ELEMS:
+            # the categorical bitset tensor [T*N, W] has its own budget
+            self.ok = False
+            return
 
         sf = np.zeros((T, N), np.int64)
         thr = np.zeros((T, N), np.float64)
@@ -131,9 +136,20 @@ class DeviceEnsemble:
                     member = (bits[vals // 32] >> (vals % 32)) & 1
                     cat[ti * N + nd, :len(vals)] = member.astype(bool)
 
-        fdt = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        self.x64 = bool(jax.config.jax_enable_x64)
+        fdt = jnp.float64 if self.x64 else jnp.float32
         self.sf_flat = jnp.asarray(sf.reshape(-1).astype(np.int32))
         self.thr_flat = jnp.asarray(thr.reshape(-1), fdt)
+        if self.x64:
+            self.thr_lo = None
+        else:
+            # double-single threshold split: comparisons against the f64
+            # thresholds stay ~2^-48-exact in f32 (the host walk compares
+            # in f64; a plain f32 downcast would flip boundary rows)
+            t_hi = thr.reshape(-1).astype(np.float32)
+            self.thr_lo = jnp.asarray(
+                (thr.reshape(-1) - t_hi.astype(np.float64))
+                .astype(np.float32))
         self.dl_flat = jnp.asarray(dl.reshape(-1))
         self.mt_flat = jnp.asarray(mt.reshape(-1).astype(np.int32))
         self.ic_flat = jnp.asarray(ic.reshape(-1)) if any_cat else None
@@ -151,15 +167,26 @@ class DeviceEnsemble:
         tmask = (np.arange(self.T) < use_T)
         lv = self.lv * jnp.asarray(tmask[:, None], self.lv.dtype)
         chunk = max(256, _CHUNK_BUDGET // max(self.T * self.N, 1))
-        Xd = jnp.asarray(X, self.thr_flat.dtype)
+        X64 = np.asarray(X, np.float64)
+        if self.x64:
+            Xd = jnp.asarray(X64)
+            Xlo = None
+        else:
+            hi = X64.astype(np.float32)
+            Xd = jnp.asarray(hi)
+            Xlo = jnp.asarray((X64 - hi.astype(np.float64))
+                              .astype(np.float32))
         parts = []
         for a in range(0, n, chunk):
             b = min(n, a + chunk)
             xc = Xd[a:b]
+            xl = None if Xlo is None else Xlo[a:b]
             if b - a < chunk and n > chunk:
                 xc = jnp.pad(xc, ((0, chunk - (b - a)), (0, 0)))
+                if xl is not None:
+                    xl = jnp.pad(xl, ((0, chunk - (b - a)), (0, 0)))
             parts.append(_chunk_scores(
-                xc, self.sf_flat, self.thr_flat,
+                xc, xl, self.sf_flat, self.thr_flat, self.thr_lo,
                 self.dl_flat, self.mt_flat, self.ic_flat,
                 self.cat, self.sig, self.path_len, lv,
                 k=k, T=self.T, N=self.N))
@@ -170,19 +197,28 @@ class DeviceEnsemble:
 
 
 @partial(jax.jit, static_argnames=("k", "T", "N"))
-def _chunk_scores(X, sf_flat, thr_flat, dl_flat, mt_flat, ic_flat, cat,
-                  sig, path_len, lv, *, k: int, T: int, N: int):
+def _chunk_scores(X, X_lo, sf_flat, thr_flat, thr_lo, dl_flat, mt_flat,
+                  ic_flat, cat, sig, path_len, lv, *, k: int, T: int, N: int):
     """[k, rows] summed scores for one row chunk."""
     rows = X.shape[0]
     # dense decisions for every node: contiguous column take, elementwise
     # missing handling (NumericalDecision, tree.h:429-465)
     fv = jnp.take(X, sf_flat, axis=1)                    # [rows, T*N]
     nan_mask = jnp.isnan(fv)
-    fv_num = jnp.where(nan_mask & (mt_flat != MISSING_NAN)[None, :], 0.0, fv)
+    zero_nan = nan_mask & (mt_flat != MISSING_NAN)[None, :]
+    fv_num = jnp.where(zero_nan, 0.0, fv)
     is_zero = jnp.abs(fv_num) <= K_ZERO_THRESHOLD
     missing = ((mt_flat == MISSING_ZERO)[None, :] & is_zero) | \
               ((mt_flat == MISSING_NAN)[None, :] & jnp.isnan(fv_num))
-    go_left = jnp.where(missing, dl_flat[None, :], fv_num <= thr_flat[None, :])
+    if X_lo is None:
+        le = fv_num <= thr_flat[None, :]
+    else:
+        # double-single comparison: lexicographic on (hi, lo) pairs keeps
+        # the f64 threshold semantics without x64
+        fv_lo = jnp.where(zero_nan, 0.0, jnp.take(X_lo, sf_flat, axis=1))
+        th = thr_flat[None, :]
+        le = (fv_num < th) | ((fv_num == th) & (fv_lo <= thr_lo[None, :]))
+    go_left = jnp.where(missing, dl_flat[None, :], le)
     if ic_flat is not None:
         # categorical membership: per-(row, cat-node) bitset lookup
         # (CategoricalDecision, tree.h:249-267).  int truncation like

@@ -1,0 +1,172 @@
+"""C API shim tests — the reference's tests/c_api_test/test_.py flow
+driven against lightgbm_tpu.c_api as the LIB."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu.c_api as LIB
+
+BINARY_TRAIN = "/root/reference/examples/binary_classification/binary.train"
+BINARY_TEST = "/root/reference/examples/binary_classification/binary.test"
+
+
+def c_array(ctype, values):
+    return (ctype * len(values))(*values)
+
+
+def c_str(string):
+    return ctypes.c_char_p(string.encode("ascii"))
+
+
+def _load_from_file(filename, reference):
+    handle = ctypes.c_void_p()
+    rc = LIB.LGBM_DatasetCreateFromFile(
+        c_str(filename), c_str("max_bin=15"), reference,
+        ctypes.byref(handle))
+    assert rc == 0, LIB.LGBM_GetLastError()
+    return handle
+
+
+def _read_mat(filename):
+    data, label = [], []
+    with open(filename) as inp:
+        for line in inp.readlines():
+            data.append([float(x) for x in line.split("\t")[1:]])
+            label.append(float(line.split("\t")[0]))
+    return np.array(data), np.array(label, dtype=np.float32)
+
+
+def _load_from_mat(filename, reference):
+    mat, label = _read_mat(filename)
+    flat = np.array(mat.reshape(mat.size), copy=False)
+    handle = ctypes.c_void_p()
+    rc = LIB.LGBM_DatasetCreateFromMat(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)),
+        LIB.C_API_DTYPE_FLOAT64, mat.shape[0], mat.shape[1], 1,
+        c_str("max_bin=15"), reference, ctypes.byref(handle))
+    assert rc == 0, LIB.LGBM_GetLastError()
+    rc = LIB.LGBM_DatasetSetField(handle, c_str("label"),
+                                  c_array(ctypes.c_float, label),
+                                  len(label), 0)
+    assert rc == 0, LIB.LGBM_GetLastError()
+    return handle
+
+
+def test_dataset_roundtrip(tmp_path):
+    from scipy import sparse
+    train = _load_from_file(BINARY_TRAIN, None)
+    num_data = ctypes.c_long()
+    assert LIB.LGBM_DatasetGetNumData(train, ctypes.byref(num_data)) == 0
+    assert num_data.value == 7000
+    num_feature = ctypes.c_long()
+    assert LIB.LGBM_DatasetGetNumFeature(train,
+                                         ctypes.byref(num_feature)) == 0
+    assert num_feature.value == 28
+
+    # mat / CSR / CSC against the train reference
+    test = _load_from_mat(BINARY_TEST, train)
+    LIB.LGBM_DatasetFree(test)
+    mat, label = _read_mat(BINARY_TEST)
+    for maker, args in (("CSR", sparse.csr_matrix(mat)),
+                        ("CSC", sparse.csc_matrix(mat))):
+        m = args
+        handle = ctypes.c_void_p()
+        if maker == "CSR":
+            rc = LIB.LGBM_DatasetCreateFromCSR(
+                c_array(ctypes.c_int, m.indptr), LIB.C_API_DTYPE_INT32,
+                c_array(ctypes.c_int, m.indices),
+                m.data.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)),
+                LIB.C_API_DTYPE_FLOAT64, len(m.indptr), len(m.data),
+                m.shape[1], c_str("max_bin=15"), train,
+                ctypes.byref(handle))
+        else:
+            rc = LIB.LGBM_DatasetCreateFromCSC(
+                c_array(ctypes.c_int, m.indptr), LIB.C_API_DTYPE_INT32,
+                c_array(ctypes.c_int, m.indices),
+                m.data.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)),
+                LIB.C_API_DTYPE_FLOAT64, len(m.indptr), len(m.data),
+                m.shape[0], c_str("max_bin=15"), train,
+                ctypes.byref(handle))
+        assert rc == 0, (maker, LIB.LGBM_GetLastError())
+        rc = LIB.LGBM_DatasetSetField(handle, c_str("label"),
+                                      c_array(ctypes.c_float, label),
+                                      len(label), 0)
+        assert rc == 0
+        nd = ctypes.c_long()
+        LIB.LGBM_DatasetGetNumData(handle, ctypes.byref(nd))
+        assert nd.value == 500
+        LIB.LGBM_DatasetFree(handle)
+
+    # save-binary round trip (auto-detected on load, dataset_loader.cpp:267)
+    binpath = str(tmp_path / "train.binary.bin")
+    assert LIB.LGBM_DatasetSaveBinary(train, c_str(binpath)) == 0
+    LIB.LGBM_DatasetFree(train)
+    train2 = _load_from_file(binpath, None)
+    nd = ctypes.c_long()
+    LIB.LGBM_DatasetGetNumData(train2, ctypes.byref(nd))
+    assert nd.value == 7000
+    LIB.LGBM_DatasetFree(train2)
+
+
+def test_booster_train_eval_save_predict(tmp_path):
+    train = _load_from_mat(BINARY_TRAIN, None)
+    test = _load_from_mat(BINARY_TEST, train)
+    booster = ctypes.c_void_p()
+    rc = LIB.LGBM_BoosterCreate(
+        train, c_str("app=binary metric=auc num_leaves=31 verbose=-1"),
+        ctypes.byref(booster))
+    assert rc == 0, LIB.LGBM_GetLastError()
+    assert LIB.LGBM_BoosterAddValidData(booster, test) == 0
+    is_finished = ctypes.c_int(0)
+    aucs = []
+    for i in range(1, 31):
+        assert LIB.LGBM_BoosterUpdateOneIter(
+            booster, ctypes.byref(is_finished)) == 0
+        result = np.array([0.0], dtype=np.float64)
+        out_len = ctypes.c_ulong(0)
+        rc = LIB.LGBM_BoosterGetEval(
+            booster, 1, ctypes.byref(out_len),
+            result.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        assert rc == 0 and out_len.value == 1
+        aucs.append(result[0])
+    # valid-set AUC with max_bin=15 (reference oracle: ~0.83 test AUC)
+    assert aucs[-1] > 0.78 and aucs[-1] > aucs[0]
+
+    model_path = str(tmp_path / "model.txt")
+    assert LIB.LGBM_BoosterSaveModel(booster, 0, -1, c_str(model_path)) == 0
+    LIB.LGBM_BoosterFree(booster)
+    LIB.LGBM_DatasetFree(train)
+    LIB.LGBM_DatasetFree(test)
+
+    booster2 = ctypes.c_void_p()
+    num_total_model = ctypes.c_long()
+    rc = LIB.LGBM_BoosterCreateFromModelfile(
+        c_str(model_path), ctypes.byref(num_total_model),
+        ctypes.byref(booster2))
+    assert rc == 0 and num_total_model.value == 30
+
+    mat, label = _read_mat(BINARY_TEST)
+    flat = np.array(mat.reshape(mat.size), copy=False)
+    preb = np.zeros(mat.shape[0], dtype=np.float64)
+    num_preb = ctypes.c_long()
+    rc = LIB.LGBM_BoosterPredictForMat(
+        booster2, flat.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)),
+        LIB.C_API_DTYPE_FLOAT64, mat.shape[0], mat.shape[1], 1,
+        LIB.C_API_PREDICT_RAW_SCORE, 25, c_str(""),
+        ctypes.byref(num_preb),
+        preb.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    assert rc == 0 and num_preb.value == mat.shape[0]
+    assert np.abs(preb).max() > 0
+
+    out_file = str(tmp_path / "preb.txt")
+    rc = LIB.LGBM_BoosterPredictForFile(
+        booster2, c_str(BINARY_TEST), 0, 0, 25, c_str(""), c_str(out_file))
+    assert rc == 0
+    vals = np.loadtxt(out_file)
+    assert vals.shape == (500,)
+    assert ((vals >= 0) & (vals <= 1)).all()     # normal = probabilities
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(label, vals) > 0.78
+    LIB.LGBM_BoosterFree(booster2)

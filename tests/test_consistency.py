@@ -1,0 +1,141 @@
+"""Cross-stack consistency oracle (reference tests/python_package_test/
+test_consistency.py:41-60): each reference example's train.conf is run
+through the CLI (app.py) AND through the Python API on the same data;
+predictions must agree to 5 decimals.  Also checks file-loaded vs
+in-memory Dataset equivalence."""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.app import Application
+from lightgbm_tpu.io.parser import load_text_file
+
+EXAMPLES = "/root/reference/examples"
+
+
+class FileLoader:
+    def __init__(self, directory, prefix, tmp_path, config_file="train.conf"):
+        self.directory = os.path.join(EXAMPLES, directory)
+        self.prefix = prefix
+        self.tmp = str(tmp_path)
+        self.params = {}
+        with open(os.path.join(self.directory, config_file)) as f:
+            for line in f.readlines():
+                line = line.split("#", 1)[0].strip()
+                if line and "=" in line:
+                    k, v = [t.strip() for t in line.split("=", 1)]
+                    if "early_stopping" in k or k in ("data", "valid_data",
+                                                     "task", "output_model"):
+                        continue
+                    self.params[k] = v
+        # keep runtime sane: the oracle is about PARITY, not 100 rounds
+        self.params["num_trees"] = "20"
+        self.params["verbose"] = "-1"
+
+    def path(self, suffix):
+        return os.path.join(self.directory, self.prefix + suffix)
+
+    def load_dataset(self, suffix):
+        X, libsvm_y, _ = load_text_file(self.path(suffix))
+        if libsvm_y is not None:
+            return X, libsvm_y
+        return X[:, 1:], X[:, 0]
+
+    def train_cli(self):
+        model_path = os.path.join(self.tmp, "cli_model.txt")
+        argv = ["data=" + self.path(".train"),
+                "output_model=" + model_path,
+                "task=train", "config=/dev/null"]
+        argv += ["%s=%s" % (k, v) for k, v in self.params.items()]
+        Application(argv).run()
+        return lgb.Booster(model_file=model_path)
+
+    def _side_fields(self):
+        """weight / group / init_score side files, like the reference's
+        explicit load_field calls (test_consistency.py:73,95,108)."""
+        kwargs = {}
+        qf = self.path(".train.query")
+        if os.path.exists(qf):
+            kwargs["group"] = np.loadtxt(qf, dtype=int)
+        wf = self.path(".train.weight")
+        if os.path.exists(wf):
+            kwargs["weight"] = np.loadtxt(wf)
+        inf = self.path(".train.init")
+        if os.path.exists(inf):
+            kwargs["init_score"] = np.loadtxt(inf)
+        return kwargs
+
+    def train_python(self):
+        X, y = self.load_dataset(".train")
+        ds = lgb.Dataset(X, label=y, params=dict(self.params),
+                         **self._side_fields())
+        return lgb.train(dict(self.params), ds)
+
+    def check(self, decimal=5):
+        cli = self.train_cli()
+        py = self.train_python()
+        X_test, _ = self.load_dataset(".test")
+        p_cli = cli.predict(X_test)
+        p_py = py.predict(X_test)
+        np.testing.assert_array_almost_equal(p_cli, p_py, decimal=decimal)
+        return cli, py, X_test
+
+    def file_load_check(self):
+        """File-loaded vs in-memory Dataset equivalence
+        (test_consistency.py:48-60)."""
+        X, y = self.load_dataset(".train")
+        mem = lgb.Dataset(X, label=y, params=dict(self.params),
+                          **self._side_fields()).construct()
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.io import loader as loader_mod
+        cfg = Config(dict(self.params))
+        d = loader_mod.load_data_file(cfg, self.path(".train"),
+                                      initscore_filename=cfg.initscore_filename)
+        filed = lgb.Dataset(d.X, label=d.label, weight=d.weight,
+                            group=d.group, init_score=d.init_score,
+                            params=dict(self.params)).construct()
+        assert mem.num_data() == filed.num_data()
+        assert mem.num_feature() == filed.num_feature()
+        np.testing.assert_array_almost_equal(mem.get_label(),
+                                             filed.get_label())
+        a, b = mem.get_group(), filed.get_group()
+        if a is not None or b is not None:
+            np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(mem._binned.bins, filed._binned.bins)
+
+
+def test_binary_consistency(tmp_path):
+    fd = FileLoader("binary_classification", "binary", tmp_path)
+    cli, py, X_test = fd.check()
+    # CLI predict task must reproduce the in-process prediction
+    out = os.path.join(str(tmp_path), "preds.txt")
+    model = os.path.join(str(tmp_path), "cli_model.txt")
+    cli.save_model(model)
+    Application(["task=predict", "data=" + fd.path(".test"),
+                 "input_model=" + model, "output_result=" + out,
+                 "config=/dev/null", "verbose=-1"]).run()
+    file_pred = np.loadtxt(out)
+    np.testing.assert_array_almost_equal(file_pred, cli.predict(X_test),
+                                         decimal=5)
+    fd.file_load_check()
+
+
+def test_regression_consistency(tmp_path):
+    # regression example ships .init side files: both stacks must load them
+    fd = FileLoader("regression", "regression", tmp_path)
+    fd.check()
+    fd.file_load_check()
+
+
+def test_multiclass_consistency(tmp_path):
+    fd = FileLoader("multiclass_classification", "multiclass", tmp_path)
+    fd.check()
+    fd.file_load_check()
+
+
+def test_lambdarank_consistency(tmp_path):
+    fd = FileLoader("lambdarank", "rank", tmp_path)
+    fd.check()
+    fd.file_load_check()

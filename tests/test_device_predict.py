@@ -138,3 +138,38 @@ def test_refit_invalidates_device_cache(rng):
     host_after = _host_predict(bst, Xt)
     np.testing.assert_allclose(after, host_after, rtol=1e-6, atol=1e-7)
     assert np.abs(after - before).max() > 1.0
+
+
+def test_timestamp_thresholds_without_x64(rng):
+    """Features needing >24-bit precision must route identically on the
+    device path even when x64 is off (double-single threshold compare)."""
+    import jax
+    ts = 1.7e9 + np.arange(2000, dtype=np.float64)   # unix-timestamp scale
+    X = ts[:, None]
+    y = (ts % 2 == 1).astype(float)                  # adjacent values differ
+    bst = lgb.train({"objective": "regression", "num_leaves": 63,
+                     "min_data_in_leaf": 1, "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    host = _host_predict(bst, X)
+    was = jax.config.jax_enable_x64
+    try:
+        jax.config.update("jax_enable_x64", False)
+        bst._gbdt._dev_ens_cache = None              # rebuild in f32 mode
+        dev = bst._gbdt.predict_raw(X)
+    finally:
+        jax.config.update("jax_enable_x64", was)
+        bst._gbdt._dev_ens_cache = None
+    np.testing.assert_allclose(dev, host, rtol=1e-6, atol=1e-6)
+
+
+def test_rollback_and_reload_invalidate_cache(rng):
+    X = rng.randn(300, 4)
+    y = X[:, 0] + 0.1 * rng.randn(300)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbose": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=5)
+    p5 = bst._gbdt.predict_raw(X)                    # cache at 5 trees
+    bst._gbdt.rollback_one_iter()
+    p4 = bst._gbdt.predict_raw(X)
+    np.testing.assert_allclose(p4, _host_predict(bst, X), rtol=1e-6)
+    assert np.abs(p5 - p4).max() > 0

@@ -1,0 +1,128 @@
+"""Engine-test breadth ported from the reference's test_engine.py:
+SHAP-contribution consistency (:614), sliced/strided inputs (:629), and
+the metric-selection matrix (:841-1221, representative subset)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _binary_data(rng, n=400, f=8):
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.2 * rng.randn(n) > 0).astype(float)
+    return X, y
+
+
+class TestContribs:
+    def test_contribs_sum_to_raw_prediction(self, rng):
+        # reference test_contribs (test_engine.py:614-628)
+        X, y = _binary_data(rng)
+        bst = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                         "verbose": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=20)
+        Xt = rng.randn(60, 8)
+        raw = bst.predict(Xt, raw_score=True)
+        contrib = bst.predict(Xt, pred_contrib=True)
+        assert contrib.shape == (60, 9)
+        assert np.linalg.norm(raw - contrib.sum(axis=1)) < 1e-4
+
+    def test_contribs_multiclass(self, rng):
+        X = rng.randn(300, 5)
+        y = (X[:, 0] > 0).astype(int) + (X[:, 1] > 0.5).astype(int)
+        bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "verbose": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=8)
+        Xt = rng.randn(40, 5)
+        raw = bst.predict(Xt, raw_score=True)
+        contrib = bst.predict(Xt, pred_contrib=True)
+        assert contrib.shape == (40, 6 * 3)
+        per_class = contrib.reshape(40, 3, 6).sum(axis=2)
+        assert np.linalg.norm(raw - per_class) < 1e-4
+
+
+class TestSlicedData:
+    """Reference test_sliced_data (test_engine.py:629-678): strided views
+    must train identically to contiguous arrays."""
+
+    def _train_pred(self, features, labels):
+        ds = lgb.Dataset(features, label=labels)
+        bst = lgb.train({"application": "binary", "verbose": -1,
+                         "min_data": 5}, ds, num_boost_round=10)
+        return bst.predict(features)
+
+    def test_sliced_inputs(self, rng):
+        n = 100
+        features = rng.rand(n, 5)
+        labels = np.append(np.ones(25, np.float32), np.zeros(75, np.float32))
+        origin = self._train_pred(features, labels)
+
+        sliced_labels = np.column_stack((labels, np.ones(n)))[:, 0]
+        np.testing.assert_almost_equal(
+            origin, self._train_pred(features, sliced_labels))
+
+        stacked = np.column_stack([np.ones(n), np.ones(n), features,
+                                   np.ones(n), np.ones(n)])
+        stacked = np.concatenate([np.ones((2, 9)), stacked, np.ones((2, 9))])
+        sliced = stacked[2:102, 2:7]
+        assert np.all(sliced == features)
+        np.testing.assert_almost_equal(
+            origin, self._train_pred(sliced, sliced_labels))
+
+        from scipy.sparse import csr_matrix
+        sliced_csr = csr_matrix(stacked)[2:102, 2:7]
+        np.testing.assert_almost_equal(
+            origin, self._train_pred(sliced_csr, sliced_labels))
+
+
+class TestMetricsMatrix:
+    """Metric selection/aliasing matrix (reference test_metrics subset)."""
+
+    def _run(self, params, rng, feval=None, fobj=None):
+        X, y = _binary_data(rng, n=200)
+        ds = lgb.Dataset(X[:150], label=y[:150])
+        vs = lgb.Dataset(X[150:], label=y[150:], reference=ds)
+        ev = {}
+        p = dict(params, verbose=-1)
+        lgb.train(p, ds, num_boost_round=5, valid_sets=[vs],
+                  valid_names=["v"], fobj=fobj, feval=feval,
+                  callbacks=[lgb.callback.record_evaluation(ev)])
+        return set(ev.get("v", {}).keys())
+
+    def test_default_metric_from_objective(self, rng):
+        assert self._run({"objective": "binary"}, rng) == {"binary_logloss"}
+
+    def test_explicit_metric(self, rng):
+        assert self._run({"objective": "binary",
+                          "metric": "binary_error"}, rng) == {"binary_error"}
+
+    def test_metric_aliases(self, rng):
+        got = self._run({"objective": "binary",
+                         "metric_types": "binary_error"}, rng)
+        assert got == {"binary_error"}
+
+    def test_multiple_metrics(self, rng):
+        got = self._run({"objective": "binary",
+                         "metric": ["binary_logloss", "binary_error"]}, rng)
+        assert got == {"binary_logloss", "binary_error"}
+
+    def test_metric_none(self, rng):
+        assert self._run({"objective": "binary", "metric": "None"}, rng) \
+            == set()
+
+    def test_auc_alias(self, rng):
+        assert self._run({"objective": "binary", "metric": "auc"}, rng) \
+            == {"auc"}
+
+    def test_l2_aliases_for_regression(self, rng):
+        for alias in ("l2", "mse", "mean_squared_error"):
+            got = self._run({"objective": "regression", "metric": alias}, rng)
+            assert got == {"l2"}, (alias, got)
+        got = self._run({"objective": "regression", "metric": "rmse"}, rng)
+        assert got == {"rmse"}
+
+    def test_custom_feval_alongside(self, rng):
+        def feval(preds, ds):
+            return "always_one", 1.0, True
+        got = self._run({"objective": "binary", "metric": "binary_logloss"},
+                        rng, feval=feval)
+        assert got == {"binary_logloss", "always_one"}

@@ -28,6 +28,8 @@ from ..utils import log
 from .tree import Tree
 
 K_EPSILON = 1e-15
+# deferred-pipeline drain cadence (iterations between bulk tree fetches)
+_DRAIN_EVERY = 16
 
 
 def _dense_matrix(X) -> np.ndarray:
@@ -255,13 +257,15 @@ class GBDT:
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
         """Returns True when training cannot continue (no splittable leaves)."""
-        # Materialize the previous iteration's trees first: their packed
-        # device->host copies have been in flight during the gap, so the
-        # blocking wait is short (the ~100ms fetch round-trip per iteration
-        # otherwise dominates on remote-attached TPUs).  If that iteration
-        # turned out degenerate, stop exactly like the eager path would.
-        if self._drain_inflight() or self._deferred_stopped:
-            self._deferred_stopped = True
+        # Materialize pending deferred trees only every _DRAIN_EVERY
+        # iterations: each drain pays a host round-trip, and a degenerate
+        # iteration detected late is harmless — with unchanged scores every
+        # subsequent pending iteration is degenerate too (zero-valued
+        # trees), so the stop point is recovered exactly on drain.
+        if len(self._inflight) >= self.num_tree_per_iteration * _DRAIN_EVERY:
+            if self._drain_inflight():
+                self._deferred_stopped = True
+        if self._deferred_stopped:
             return True
 
         k = self.num_tree_per_iteration
@@ -288,6 +292,10 @@ class GBDT:
                        and self._cegb_coupled is None
                        and (self.objective is None
                             or not self.objective.is_renew_tree_output()))
+        # the partition engine can then fuse the score update into its
+        # label-recovery scatter (emit="score"), skipping the per-row
+        # leaf-value gather entirely (serial-gather cost on TPU)
+        self._score_emit_ok = deferred_ok
 
         should_continue = False
         deferred_any = False
@@ -309,6 +317,7 @@ class GBDT:
                         cat_bins=arrays.cat_mask.shape[1],
                         init_score=init_scores[kk],
                         has_trunc_flag=self._last_truncated is not None,
+                        it=self.iter,
                         slot=len(self.models) - 1))
                     deferred_any = True
                     continue
@@ -382,6 +391,11 @@ class GBDT:
     def _update_train_score_device(self, arrays, class_id: int, leaf_ids):
         """Score update straight from device TreeArrays (deferred path) —
         equivalent to shrink + _update_train_score on the host tree."""
+        if getattr(self, "_last_emit", "leaf_ids") == "score":
+            # leaf values already scattered per row by the grow kernel
+            self.train_state.score = self.train_state.score.at[class_id].add(
+                jnp.asarray(self.shrinkage_rate, self.dtype) * leaf_ids)
+            return
         lv = arrays.leaf_value * jnp.asarray(self.shrinkage_rate, self.dtype)
         lids = leaf_ids
         if self._bag_mask is not None:
@@ -393,48 +407,53 @@ class GBDT:
             lv[jnp.clip(lids, 0, arrays.max_leaves - 1)])
 
     def _drain_inflight(self) -> bool:
-        """Materialize pending deferred trees.  Returns True when the
-        drained iteration was degenerate (no splittable leaves): its model
-        entries are removed and the iteration rolled back, mirroring the
-        eager stop (its device score updates added all-zero leaf values,
-        so scores need no undo)."""
+        """Materialize pending deferred trees (possibly several
+        iterations' worth).  Returns True when a drained iteration was
+        degenerate (no splittable leaves): its models and every later
+        pending tree are removed and the iteration count rolled back,
+        mirroring the eager stop.  Later pending iterations are
+        necessarily degenerate too — the degenerate iteration added zero
+        leaf values, so they trained on identical scores — and their
+        device score updates were all zero, so scores need no undo."""
         if not self._inflight:
             return False
         pending, self._inflight = self._inflight, []
         k = self.num_tree_per_iteration
-        any_grew = False
+        groups: Dict[int, list] = {}
         for ent in pending:
-            ivec, fvec = (np.asarray(ent["packed"][0]),
-                          np.asarray(ent["packed"][1]))
-            host_arrays = grow_ops.unpack_tree_vectors(
-                ivec, fvec, ent["max_leaves"], ent["cat_bins"])
-            if ent.get("has_trunc_flag") and ivec[-1]:
-                self._emit_truncation_warning(int(host_arrays.num_leaves))
-            new_tree = Tree(1)
-            if int(host_arrays.num_leaves) > 1:
-                new_tree = Tree.from_arrays(host_arrays, self.train_set)
-                new_tree.shrink(self.shrinkage_rate)
-                if abs(ent["init_score"]) > K_EPSILON:
-                    new_tree.add_bias(ent["init_score"])
-                any_grew = True
-            elif ent["slot"] < k:
-                # degenerate FIRST iteration keeps the boost-from-average
-                # prior as a constant tree, like the eager else-branch
-                new_tree.as_constant(ent["init_score"])
-                self.train_state.add_constant(ent["init_score"],
-                                              ent["slot"] % max(k, 1))
-            self.models[ent["slot"]] = new_tree
-        if not any_grew:
-            log.warning("Stopped training because there are no more leaves "
-                        "that meet the split requirements")
-            # roll the WHOLE iteration back (its k trees are the last ones
-            # appended — deferred placeholders plus any eagerly-added
-            # constant trees), mirroring the eager stop; like the eager
-            # path, the very first iteration's constant trees are kept
-            if len(self.models) > k:
-                del self.models[-k:]
-            self.iter -= 1
-            return True
+            groups.setdefault(ent["it"], []).append(ent)
+        for it in sorted(groups):
+            any_grew = False
+            for ent in groups[it]:
+                ivec, fvec = (np.asarray(ent["packed"][0]),
+                              np.asarray(ent["packed"][1]))
+                host_arrays = grow_ops.unpack_tree_vectors(
+                    ivec, fvec, ent["max_leaves"], ent["cat_bins"])
+                if ent.get("has_trunc_flag") and ivec[-1]:
+                    self._emit_truncation_warning(int(host_arrays.num_leaves))
+                new_tree = Tree(1)
+                if int(host_arrays.num_leaves) > 1:
+                    new_tree = Tree.from_arrays(host_arrays, self.train_set)
+                    new_tree.shrink(self.shrinkage_rate)
+                    if abs(ent["init_score"]) > K_EPSILON:
+                        new_tree.add_bias(ent["init_score"])
+                    any_grew = True
+                elif ent["slot"] < k:
+                    # degenerate FIRST iteration keeps the boost-from-average
+                    # prior as a constant tree, like the eager else-branch
+                    new_tree.as_constant(ent["init_score"])
+                    self.train_state.add_constant(ent["init_score"],
+                                                  ent["slot"] % max(k, 1))
+                self.models[ent["slot"]] = new_tree
+            if not any_grew:
+                log.warning("Stopped training because there are no more "
+                            "leaves that meet the split requirements")
+                first_slot = min(e["slot"] for e in groups[it])
+                # the very first iteration's constant trees are kept,
+                # like the eager path
+                del self.models[max(first_slot, k):]
+                self.iter = it
+                return True
         return False
 
     def _load_forced_splits(self) -> tuple:
@@ -504,8 +523,8 @@ class GBDT:
         hist_cache_bytes = (self.config.num_leaves
                             * max(self.train_set.num_features, 1)
                             * max(self.max_bin, 2) * 3 * 4)
-        arena_bytes = (C * cap * 4 + self.num_data * C * 4
-                       + hist_cache_bytes)      # arena + bins_t + hist cache
+        arena_bytes = (C * cap * 2 + self.num_data * C * 2
+                       + hist_cache_bytes)      # bf16 arena + bins_t + hists
         if eng == "auto":
             # C also bounds the kernels' VMEM scratch (2 x C x TILE f32)
             fits = arena_bytes < _device_memory_budget() and C <= 512
@@ -517,9 +536,10 @@ class GBDT:
         self._truncation_warned = False
         if self._use_partition_engine:
             from ..ops import grow_partition as gp
+            from ..ops import partition_pallas as _pp
             self._bins_t = jnp.asarray(
-                self.train_state.bins, jnp.float32).T
-            self._arena = jnp.zeros((C, cap), jnp.float32)
+                self.train_state.bins, _pp.ARENA_DT).T
+            self._arena = jnp.zeros((C, cap), _pp.ARENA_DT)
             self._grow_partition = gp.grow_tree_partition
 
     def _grow_one_tree(self, grad, hess, row_init):
@@ -528,7 +548,11 @@ class GBDT:
         cegb_used = (jnp.asarray(self._cegb_used)
                      if self._cegb_coupled is not None else None)
         if self._use_partition_engine:
-            arrays, leaf_ids, self._arena, self._last_truncated = \
+            self._last_emit = ("score" if (getattr(self, "_score_emit_ok",
+                                                   False)
+                                           and self._bag_mask is None)
+                               else "leaf_ids")
+            arrays, out, self._arena, self._last_truncated = \
                 self._grow_partition(
                 self._arena, self._bins_t, grad, hess, row_init,
                 self._feature_sample(),
@@ -539,8 +563,10 @@ class GBDT:
                 max_leaves=self.config.num_leaves,
                 max_depth=self.config.max_depth,
                 max_bin=self.max_bin,
+                emit=self._last_emit,
                 interpret=jax.default_backend() != "tpu")
-            return arrays, leaf_ids
+            return arrays, out
+        self._last_emit = "leaf_ids"
         grow_fn = (self._grower if self._grower is not None
                    else grow_ops.grow_tree)
         from functools import partial as _partial

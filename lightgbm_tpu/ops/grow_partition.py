@@ -3,7 +3,7 @@
 The arena re-design of SerialTreeLearner::Train (reference
 src/treelearner/serial_tree_learner.cpp:169-233): instead of the label
 engine's per-split masked pass over all n rows (ops/grow.py), rows live
-physically grouped by leaf in the feature-major f32 arena of
+physically grouped by leaf in the feature-major bf16-plane arena of
 ops/partition_pallas.py, so each split costs O(parent) to partition and
 O(smaller_child) to histogram — the reference's asymptotics
 (DataPartition::Split data_partition.hpp:108-160 + the smaller/larger
@@ -19,7 +19,7 @@ back to the label engine for configs that need full generality.
 
 Restrictions vs the label engine (the GBDT driver auto-selects): serial
 learner only (no collectives), f32 only, max_bin <= 256, no categorical
-splits yet, n < 2^24 (rowids ride an f32 channel exactly).
+splits yet, n < 2^24 (rowids ride three byte planes exactly).
 """
 from __future__ import annotations
 
@@ -57,8 +57,8 @@ class PartState(NamedTuple):
 
 
 def grow_tree_partition_impl(
-        arena_buf: jnp.ndarray,       # [C, cap] f32 scratch (donated)
-        bins_t: jnp.ndarray,          # [F, n] f32 feature-major bins
+        arena_buf: jnp.ndarray,       # [C, cap] bf16 scratch (donated)
+        bins_t: jnp.ndarray,          # [F, n] bf16/f32 feature-major bins
         grad: jnp.ndarray,            # [n] f32
         hess: jnp.ndarray,            # [n] f32
         row_leaf_init: jnp.ndarray,   # [n] int32: 0 in-bag, -1 out
@@ -75,6 +75,7 @@ def grow_tree_partition_impl(
         max_leaves: int,
         max_depth: int = -1,
         max_bin: int,
+        emit: str = "leaf_ids",
         interpret: bool = False):
     """Grow one leaf-wise tree.
 
@@ -98,14 +99,17 @@ def grow_tree_partition_impl(
     part = partial(pp.partition_segment, interpret=interpret)
 
     # ---- arena assembly (into the reused scratch; stale columns beyond n
-    # are never read: every kernel masks by segment counts) ---------------
-    rowid = jnp.arange(n, dtype=dtype)
-    chans = [bins_t.astype(dtype)]
+    # are never read: every kernel masks by segment counts).  Payloads are
+    # split into bf16 planes (exact, see partition_pallas docstring) ------
+    adt = pp.ARENA_DT
+    chans = [bins_t.astype(adt)]
     if Fp > F:
-        chans.append(jnp.zeros((Fp - F, n), dtype))
-    chans += [grad.astype(dtype)[None], hess.astype(dtype)[None], rowid[None]]
-    if C > Fp + 3:
-        chans.append(jnp.zeros((C - Fp - 3, n), dtype))
+        chans.append(jnp.zeros((Fp - F, n), adt))
+    chans += [c[None] for c in pp.split_f32(grad)]
+    chans += [c[None] for c in pp.split_f32(hess)]
+    chans += [c[None] for c in pp.split_rowid(jnp.arange(n, dtype=jnp.int32))]
+    if C > Fp + pp.N_AUX:
+        chans.append(jnp.zeros((C - Fp - pp.N_AUX, n), adt))
     arena = jax.lax.dynamic_update_slice(
         arena_buf, jnp.concatenate(chans, axis=0), (0, 0))
 
@@ -345,14 +349,25 @@ def grow_tree_partition_impl(
             jnp.where(s_sorted < cap, deltas, 0), mode="drop")
         return jnp.cumsum(buf)
 
-    leaf_of = step_fn(order)
     # validity needs only the covering segment's END: pos is >= its start
     # by construction, so two step functions (not three) suffice
     end_of = step_fn(s_sorted + jnp.where(live, tree.leaf_count, 0)[order])
     pos = jnp.arange(cap, dtype=jnp.int32)
     valid = pos < end_of
     Fp_row = pp.feature_channels(F)
-    rowids = state.arena[Fp_row + 2].astype(jnp.int32)
+    rowids = pp.merge_rowid(state.arena[Fp_row + 6],
+                            state.arena[Fp_row + 7],
+                            state.arena[Fp_row + 8])
+    if emit == "score":
+        # fused score recovery: scatter each row's LEAF VALUE directly
+        # (piecewise-constant over segments) instead of leaf ids — the
+        # driver's separate 255-table leaf_value[leaf_ids] gather is a
+        # pure serial-gather cost on TPU and is skipped entirely
+        val_of = step_fn(tree.leaf_value[order].astype(dtype))
+        delta = jnp.zeros(n + 1, dtype).at[
+            jnp.where(valid, rowids, n)].set(val_of, mode="drop")[:n]
+        return tree, delta, state.arena, state.truncated
+    leaf_of = step_fn(order)
     leaf_ids = jnp.full(n, -1, jnp.int32)
     leaf_ids = leaf_ids.at[jnp.where(valid, rowids, n)].set(
         leaf_of, mode="drop")
@@ -360,5 +375,5 @@ def grow_tree_partition_impl(
 
 
 grow_tree_partition = partial(jax.jit, static_argnames=(
-    "max_leaves", "max_depth", "max_bin", "interpret"),
+    "max_leaves", "max_depth", "max_bin", "emit", "interpret"),
     donate_argnums=(0,))(grow_tree_partition_impl)

@@ -22,9 +22,15 @@ rows live physically grouped by leaf in a feature-major f32 "arena"
   cost is O(leaf_rows), the reference's asymptotics, with sequential HBM
   reads instead of gathers.
 
-All payloads ride f32 (bins are small integers, exact; rowid is exact to
-2^24 rows — the 16.7M-row cap is checked by the caller).  Accumulation is
-f32, matching the reference GPU learner's single-precision default.
+All arena payloads ride bf16 with EXACT semantics: bin channels hold
+integers <= 256 (bf16-exact), and each f32 payload (grad, hess) rides as
+THREE bf16 channels (hi/mid/lo residue split — 8 mantissa bits each
+reconstruct the f32 exactly); rowid rides as three 8-bit byte planes
+(2^24-row cap checked by the caller).  The permutation and histogram
+matmuls then run as single bf16 MXU passes instead of f32
+Precision.HIGHEST multi-pass emulation, and arena HBM traffic halves.
+Histogram accumulation stays f32 (MXU accumulators), matching the
+reference GPU learner's single-precision default.
 
 Pipeline invariant in both kernels: tile j's read is complete when its
 loop iteration starts; iteration j issues read j+1, computes j (overlapped
@@ -42,11 +48,14 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .histogram_pallas import _radix_plan, radix_epilogue
+from .histogram_pallas import _radix_plan
 
 SUB = 256          # compaction sub-block width (lanes per permutation matmul)
 TILE = 2048        # rows per streamed tile
-N_AUX = 3          # grad, hess, rowid channels appended after features
+N_AUX = 9          # g_hi,g_mid,g_lo, h_hi,h_mid,h_lo, r_hi,r_mid,r_lo
+ARENA_DT = jnp.bfloat16
+# sublane tiling granularity for the arena dtype (bf16 memrefs tile at 16)
+_SUBL = 16
 
 
 def feature_channels(num_features: int) -> int:
@@ -56,10 +65,34 @@ def feature_channels(num_features: int) -> int:
 
 
 def arena_channels(num_features: int) -> int:
-    """Total arena channels: padded features, then grad/hess/rowid, padded
-    for sublane tiling."""
+    """Total arena channels: padded features, then the split payload
+    planes, padded for sublane tiling."""
     c = feature_channels(num_features) + N_AUX
-    return c + (-c % 8)
+    return c + (-c % _SUBL)
+
+
+def split_f32(x):
+    """f32 [n] -> three bf16 planes whose f32 sum reconstructs x exactly
+    (8 mantissa bits each; 24 total covers the f32 significand)."""
+    x = x.astype(jnp.float32)
+    hi = x.astype(jnp.bfloat16)
+    r1 = x - hi.astype(jnp.float32)
+    mid = r1.astype(jnp.bfloat16)
+    lo = (r1 - mid.astype(jnp.float32)).astype(jnp.bfloat16)
+    return hi, mid, lo
+
+
+def split_rowid(r):
+    """int32 [n] (< 2^24) -> three byte planes as bf16 (values <= 255)."""
+    r = r.astype(jnp.int32)
+    return ((r // 65536).astype(ARENA_DT),
+            ((r // 256) % 256).astype(ARENA_DT),
+            (r % 256).astype(ARENA_DT))
+
+
+def merge_rowid(hi, mid, lo):
+    return (hi.astype(jnp.int32) * 65536 + mid.astype(jnp.int32) * 256
+            + lo.astype(jnp.int32))
 
 
 def _prefix_scan_lanes(x):
@@ -79,22 +112,23 @@ CARRY_W = FLUSH_W + SUB    # per-stream carry width (append window)
 
 
 def _compact_subblock(block_k, pred_k, fill):
-    """Place the columns of `block_k` [C, S] selected by `pred_k` [1, S]
-    (0/1 f32) contiguously starting at carry position `fill` (< FLUSH_W):
-    prefix-scan -> destination one-hot P[u, fill + pos_u] [S, CARRY_W] ->
-    one [C, S] @ [S, CARRY_W] MXU matmul.  Positioning is baked into P so
-    no dynamic roll/shift of the carry is ever needed.  Returns
-    (comp [C, CARRY_W], count); columns outside [fill, fill+count) are 0."""
+    """Place the columns of `block_k` [C, S] (bf16) selected by `pred_k`
+    [1, S] (0/1 f32) contiguously starting at carry position `fill`
+    (< FLUSH_W): prefix-scan -> destination one-hot P[u, fill + pos_u]
+    [S, CARRY_W] -> one [C, S] @ [S, CARRY_W] bf16 MXU matmul (each output
+    column copies exactly one input column, so bf16 is exact).
+    Positioning is baked into P so no dynamic roll/shift of the carry is
+    ever needed.  Returns (comp [C, CARRY_W] bf16, count); columns outside
+    [fill, fill+count) are 0."""
     prefix = _prefix_scan_lanes(pred_k)                       # [1, S]
     cnt_k = prefix[0, SUB - 1].astype(jnp.int32)
     pos_col = (prefix - 1.0).astype(jnp.int32).reshape(SUB, 1) + fill
     sel_col = pred_k.reshape(SUB, 1) > 0.5
     t_iota = jax.lax.broadcasted_iota(jnp.int32, (SUB, CARRY_W), 1)
     P = jnp.where((pos_col == t_iota) & sel_col,
-                  jnp.float32(1.0), jnp.float32(0.0))
-    comp = jax.lax.dot(block_k, P, preferred_element_type=jnp.float32,
-                       precision=jax.lax.Precision.HIGHEST)
-    return comp, cnt_k
+                  jnp.bfloat16(1.0), jnp.bfloat16(0.0))
+    comp = jax.lax.dot(block_k, P, preferred_element_type=jnp.float32)
+    return comp.astype(ARENA_DT), cnt_k
 
 
 def _partition_kernel(sc_ref, feat_onehot_ref, arena_any, pred_any,
@@ -159,8 +193,8 @@ def _partition_kernel(sc_ref, feat_onehot_ref, arena_any, pred_any,
             d.start()
         for d in read_dmas(0, 0):
             d.wait()
-    carryA[:] = jnp.zeros((C, CARRY_W), jnp.float32)
-    carryB[:] = jnp.zeros((C, CARRY_W), jnp.float32)
+    carryA[:] = jnp.zeros((C, CARRY_W), ARENA_DT)
+    carryB[:] = jnp.zeros((C, CARRY_W), ARENA_DT)
 
     def append_and_flush(carry, comp, ck, fill, written, dst, stream, fslot):
         """Add comp (already positioned at `fill`) into the carry; flush one
@@ -287,13 +321,13 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
     z = jnp.int32(0)
     if decision is None:
         tail = [z] * 7
-        feat_onehot = jnp.zeros((1, C), jnp.float32)
+        feat_onehot = jnp.zeros((1, C), ARENA_DT)
     else:
         feat, thr, dlft, mt, db, mb, xr = [
             jnp.asarray(v, jnp.int32) for v in decision]
         tail = [jnp.int32(1), thr, dlft, mt, db, mb, xr]
         feat_onehot = (jnp.arange(C, dtype=jnp.int32)[None, :]
-                       == feat).astype(jnp.float32)
+                       == feat).astype(ARENA_DT)
     sc = jnp.stack([jnp.asarray(start), jnp.asarray(cnt),
                     jnp.asarray(dstA), jnp.asarray(dstB)]
                    + tail).astype(jnp.int32)
@@ -308,14 +342,14 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
         ],
         out_specs=(pl.BlockSpec(memory_space=pl.ANY),
                    pl.BlockSpec(memory_space=pltpu.SMEM)),
-        out_shape=(jax.ShapeDtypeStruct((C, cap), jnp.float32),
+        out_shape=(jax.ShapeDtypeStruct((C, cap), ARENA_DT),
                    jax.ShapeDtypeStruct((2,), jnp.int32)),
         scratch_shapes=[
-            pltpu.VMEM((2, C, tile), jnp.float32),
+            pltpu.VMEM((2, C, tile), ARENA_DT),
             pltpu.VMEM((2, 1, tile), jnp.float32),
-            pltpu.VMEM((C, CARRY_W), jnp.float32),
-            pltpu.VMEM((C, CARRY_W), jnp.float32),
-            pltpu.VMEM((2, 2, C, FLUSH_W), jnp.float32),
+            pltpu.VMEM((C, CARRY_W), ARENA_DT),
+            pltpu.VMEM((C, CARRY_W), ARENA_DT),
+            pltpu.VMEM((2, 2, C, FLUSH_W), ARENA_DT),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2, 2)),
@@ -327,15 +361,33 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
     return arena_out, counts
 
 
+def _comp_chunks(hi_n: int, m: int):
+    """Split the 7 payload components (g_hi,g_mid,g_lo, h_hi,h_mid,h_lo,
+    cnt) into dot chunks with chunk*hi_n*m <= 128 rows each."""
+    per = max(1, 128 // (hi_n * m))
+    chunks = []
+    i = 0
+    while i < 7:
+        chunks.append(min(per, 7 - i))
+        i += chunks[-1]
+    return chunks
+
+
 def _seg_hist_kernel(sc_ref, arena_any, out_ref, in_buf, read_sems,
                      *, C: int, F: int,
                      n_blocks: int, k: int, m: int, lo_n: int, hi_n: int,
                      tile: int):
-    """sc_ref (SMEM [2] i32): start, cnt.  out_ref VMEM [n_blocks*k*M, N]."""
+    """sc_ref (SMEM [2] i32): start, cnt.  out_ref VMEM
+    [n_blocks*k*7*hi_n*m, N]: 7 split-payload components per feature —
+    every lhs entry is a bf16-exact payload plane value times a one-hot,
+    so the dots run as single bf16 MXU passes and the f32 values are
+    reconstructed exactly in the epilogue."""
     s, cnt = sc_ref[0], sc_ref[1]
     n_tiles = jax.lax.div(cnt + jnp.int32(tile - 1), jnp.int32(tile))
-    M, N = 3 * hi_n * m, lo_n * m
+    N = lo_n * m
+    Mc = 7 * hi_n * m
     f_blk = k * m
+    chunks = _comp_chunks(hi_n, m)
 
     def read_dma(j, slot):
         src = pl.multiple_of(s + j * tile, 128)
@@ -357,36 +409,51 @@ def _seg_hist_kernel(sc_ref, arena_any, out_ref, in_buf, read_sems,
         def _():
             read_dma(j + 1, jax.lax.rem(j + jnp.int32(1), jnp.int32(2))).start()
 
-        block = in_buf[slot]                              # [C, T]
+        block = in_buf[slot]                              # [C, T] bf16
         valid = (jax.lax.broadcasted_iota(jnp.int32, (1, tile), 1)
-                 < (cnt - j * tile)).astype(jnp.float32)
+                 < (cnt - j * tile)).astype(jnp.bfloat16)
         Fp = n_blocks * f_blk
-        g = block[Fp:Fp + 1, :] * valid
-        h = block[Fp + 1:Fp + 2, :] * valid
-        gh = jnp.concatenate([g, h, valid], axis=0)       # [3, T]
+        # 7 payload planes: the 6 bf16 split planes of (g, h) plus count;
+        # masking by 0/1 keeps every entry a bf16-exact plane value
+        comps = [block[Fp + i:Fp + i + 1, :] * valid for i in range(6)]
+        comps.append(valid)
+        gh = jnp.concatenate(comps, axis=0)               # [7, T] bf16
 
         for b in range(n_blocks):
-            bins = block[b * f_blk:(b + 1) * f_blk, :]    # [f_blk, T]
+            bins = block[b * f_blk:(b + 1) * f_blk, :].astype(jnp.float32)
             hi = jnp.floor(bins * (1.0 / lo_n))
             lo = bins - hi * lo_n
             hih = jnp.where(
                 hi.astype(jnp.int32)[:, None, :]
                 == jax.lax.broadcasted_iota(jnp.int32, (1, hi_n, 1), 1),
-                jnp.float32(1.0), jnp.float32(0.0))                                 # [f_blk,hi_n,T]
+                jnp.bfloat16(1.0), jnp.bfloat16(0.0))     # [f_blk,hi_n,T]
             loh = jnp.where(
                 lo.astype(jnp.int32)[:, None, :]
                 == jax.lax.broadcasted_iota(jnp.int32, (1, lo_n, 1), 1),
-                jnp.float32(1.0), jnp.float32(0.0))                                 # [f_blk,lo_n,T]
-            lhs = (gh[None, :, None, :] * hih[:, None, :, :]).reshape(
-                k, M, tile)
+                jnp.bfloat16(1.0), jnp.bfloat16(0.0))     # [f_blk,lo_n,T]
             rhs = loh.reshape(k, N, tile)
-            part = jax.lax.dot_general(
-                lhs, rhs, dimension_numbers=(((2,), (2,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST)      # [k, M, N]
-            out_ref[b * k * M:(b + 1) * k * M, :] = (
-                out_ref[b * k * M:(b + 1) * k * M, :]
-                + part.reshape(k * M, N))
+            c0 = 0
+            for csz in chunks:
+                # lhs[g, (f, c, hi), t] = gh[c, t] * hihot[g*m + f, hi, t]
+                lhs = (gh[None, c0:c0 + csz, None, :]
+                       * hih[:, None, :, :]).reshape(k, m * csz * hi_n, tile)
+                part = jax.lax.dot_general(
+                    lhs, rhs,
+                    dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)   # [k, m*csz*hi_n, N]
+                r0 = b * k * Mc
+                # part rows are (f, c_local, hi); the accumulator layout is
+                # (f, c, hi) with the FULL 7-component c axis — each
+                # feature's chunk block lands at its own strided offset
+                for kk in range(k):
+                    for f in range(m):
+                        src = (f * csz) * hi_n
+                        dst = r0 + kk * Mc + (f * 7 + c0) * hi_n
+                        sz = csz * hi_n
+                        out_ref[dst:dst + sz, :] = (
+                            out_ref[dst:dst + sz, :]
+                            + part[kk, src:src + sz, :])
+                c0 += csz
 
         @pl.when(j + 1 < n_tiles)
         def _():
@@ -395,6 +462,16 @@ def _seg_hist_kernel(sc_ref, arena_any, out_ref, in_buf, read_sems,
 
     jax.lax.fori_loop(0, n_tiles, loop, 0)
 
+
+def split_radix_epilogue(out, G: int, m: int, hi_n: int, lo_n: int):
+    """[G*7*hi_n*m, N] split-component accumulator -> [G*m, B, 3]: the f32
+    (g, h) values are the sums of their three split-plane partials."""
+    out = out.reshape(G, m, 7, hi_n, m, lo_n)
+    diag = jnp.moveaxis(jnp.diagonal(out, axis1=1, axis2=4), -1, 1)
+    comp = diag.reshape(G * m, 7, hi_n * lo_n)
+    g = comp[:, 0] + comp[:, 1] + comp[:, 2]
+    h = comp[:, 3] + comp[:, 4] + comp[:, 5]
+    return jnp.stack([g, h, comp[:, 6]], axis=-1)         # [G*m, B, 3]
 
 
 @functools.partial(jax.jit,
@@ -411,7 +488,7 @@ def segment_histogram(arena, start, cnt, num_features: int, max_bin: int,
     n_blocks = feature_channels(F) // f_blk
     if n_blocks * f_blk + N_AUX > C:
         raise ValueError("arena channels too small for feature layout")
-    M, N = 3 * hi_n * m, lo_n * m
+    Mc, N = 7 * hi_n * m, lo_n * m
     sc = jnp.stack([jnp.asarray(start), jnp.asarray(cnt)]).astype(jnp.int32)
     kernel = functools.partial(
         _seg_hist_kernel, C=C, F=F, n_blocks=n_blocks, k=k, m=m,
@@ -421,12 +498,12 @@ def segment_histogram(arena, start, cnt, num_features: int, max_bin: int,
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
                   pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((n_blocks * k * M, N), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((n_blocks * k * Mc, N), jnp.float32),
         scratch_shapes=[
-            pltpu.VMEM((2, C, tile), jnp.float32),
+            pltpu.VMEM((2, C, tile), ARENA_DT),
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=interpret,
     )(sc, arena)
-    hist = radix_epilogue(out, n_blocks * k, m, lo_n=lo_n, hi_n=hi_n)
+    hist = split_radix_epilogue(out, n_blocks * k, m, hi_n=hi_n, lo_n=lo_n)
     return hist[:F, :max_bin, :]

@@ -25,7 +25,7 @@ def _grow_both(bins, grad, hess, row0, nb, db, mt, params, max_leaves,
         jnp.asarray(row0), fmask, jnp.asarray(nb), jnp.asarray(db),
         jnp.asarray(mt), params, max_leaves=max_leaves, max_bin=max_bin,
         max_depth=max_depth, hist_impl="scatter")
-    arena = jnp.zeros((pp.arena_channels(F), 8 * pp.TILE), jnp.float32)
+    arena = jnp.zeros((pp.arena_channels(F), 8 * pp.TILE), pp.ARENA_DT)
     t2, l2, _, _ = gp.grow_tree_partition(
         arena, jnp.asarray(bins.T.astype(np.float32)),
         jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(row0), fmask,
@@ -146,10 +146,12 @@ def test_partition_kernel_stability(rng):
     n = 3000
     arena = np.zeros((C, cap), np.float32)
     arena[:F, :n] = rng.randint(0, 200, (F, n))
-    arena[Fp, :n] = rng.randn(n)
-    arena[Fp + 1, :n] = np.abs(rng.randn(n)) + 0.1
-    arena[Fp + 2, :n] = np.arange(n)
-    A = jnp.asarray(arena)
+    g3 = pp.split_f32(jnp.asarray(rng.randn(n), jnp.float32))
+    h3 = pp.split_f32(jnp.asarray(np.abs(rng.randn(n)) + 0.1, jnp.float32))
+    r3 = pp.split_rowid(jnp.arange(n))
+    for i, plane in enumerate(list(g3) + list(h3) + list(r3)):
+        arena[Fp + i, :n] = np.asarray(plane.astype(jnp.float32))
+    A = jnp.asarray(arena, pp.ARENA_DT)
     ref = arena[:, :n]
     s, cnt, cursor = 0, n, 4096
     for step in range(3):
@@ -162,7 +164,7 @@ def test_partition_kernel_stability(rng):
                                          s, cursor, interpret=True)
         nA, nB = int(goA.sum()), int((~goA).sum())
         assert list(np.asarray(counts)) == [nA, nB]
-        got = np.asarray(A)
+        got = np.asarray(A.astype(jnp.float32))
         np.testing.assert_array_equal(got[:, s:s + nA], ref[:, goA])
         np.testing.assert_array_equal(got[:, cursor:cursor + nB],
                                       ref[:, ~goA])

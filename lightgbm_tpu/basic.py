@@ -324,7 +324,7 @@ class Booster:
         return self._gbdt.num_model_per_iteration()
 
     def num_trees(self) -> int:
-        return len(self._gbdt.models)
+        return self._gbdt.num_trees()
 
     # -- eval --------------------------------------------------------------
     def eval_train(self, feval=None):

@@ -1071,7 +1071,15 @@ class GBDT:
 
     @property
     def current_iteration(self) -> int:
+        # drain first: deferred placeholders / rolled-back trees must not
+        # be counted (every public accessor derived from self.models
+        # syncs — the drain-consistency invariant)
+        self._sync_model()
         return len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def num_trees(self) -> int:
+        self._sync_model()
+        return len(self.models)
 
     def num_model_per_iteration(self) -> int:
         return self.num_tree_per_iteration

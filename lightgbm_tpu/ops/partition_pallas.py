@@ -73,13 +73,21 @@ def arena_channels(num_features: int) -> int:
 
 def split_f32(x):
     """f32 [n] -> three bf16 planes whose f32 sum reconstructs x exactly
-    (8 mantissa bits each; 24 total covers the f32 significand)."""
+    (8 mantissa bits each; 24 total covers the f32 significand).
+
+    The residue split MUST round through reduce_precision, not
+    astype(bf16).astype(f32): under --xla_allow_excess_precision (set in
+    this environment) XLA elides the cast round-trip inside jit, which
+    zeroes the mid/lo planes and silently degrades payloads to single
+    bf16 (~0.5% histogram error).  reduce_precision is semantically a
+    rounding op XLA must honor."""
     x = x.astype(jnp.float32)
-    hi = x.astype(jnp.bfloat16)
-    r1 = x - hi.astype(jnp.float32)
-    mid = r1.astype(jnp.bfloat16)
-    lo = (r1 - mid.astype(jnp.float32)).astype(jnp.bfloat16)
-    return hi, mid, lo
+    hi = jax.lax.reduce_precision(x, 8, 7)
+    r1 = x - hi
+    mid = jax.lax.reduce_precision(r1, 8, 7)
+    lo = r1 - mid
+    return (hi.astype(jnp.bfloat16), mid.astype(jnp.bfloat16),
+            lo.astype(jnp.bfloat16))
 
 
 def split_rowid(r):
@@ -125,8 +133,10 @@ def _compact_subblock(block_k, pred_k, fill):
     pos_col = (prefix - 1.0).astype(jnp.int32).reshape(SUB, 1) + fill
     sel_col = pred_k.reshape(SUB, 1) > 0.5
     t_iota = jax.lax.broadcasted_iota(jnp.int32, (SUB, CARRY_W), 1)
+    # build the one-hot in f32 then cast: an i1 mask from 32-bit compares
+    # can't relayout onto 16-bit vector selects in Mosaic
     P = jnp.where((pos_col == t_iota) & sel_col,
-                  jnp.bfloat16(1.0), jnp.bfloat16(0.0))
+                  jnp.float32(1.0), jnp.float32(0.0)).astype(jnp.bfloat16)
     comp = jax.lax.dot(block_k, P, preferred_element_type=jnp.float32)
     return comp.astype(ARENA_DT), cnt_k
 
@@ -210,8 +220,13 @@ def _partition_kernel(sc_ref, feat_onehot_ref, arena_any, pred_any,
                 flush_dma(stream, fslot, 0).wait()
             flush_buf[stream, fslot] = carry[:, 0:FLUSH_W]
             flush_dma(stream, fslot, dst + written).start()
-            shifted = pltpu.roll(carry[:], CARRY_W - FLUSH_W, axis=1)
-            carry[:] = jnp.where(lane_w < fill - FLUSH_W, shifted, 0.0)
+            # static left-shift by FLUSH_W via slice+pad (pltpu.roll only
+            # rotates 32-bit data; the carry is bf16)
+            shifted = jnp.concatenate(
+                [carry[:, FLUSH_W:CARRY_W],
+                 jnp.zeros((C, FLUSH_W), ARENA_DT)], axis=1)
+            carry[:] = jnp.where(lane_w < fill - FLUSH_W, shifted,
+                                 jnp.bfloat16(0.0))
 
         flushed = fill >= FLUSH_W
         fill = jnp.where(flushed, fill - FLUSH_W, fill)
@@ -426,17 +441,23 @@ def _seg_hist_kernel(sc_ref, arena_any, out_ref, in_buf, read_sems,
             hih = jnp.where(
                 hi.astype(jnp.int32)[:, None, :]
                 == jax.lax.broadcasted_iota(jnp.int32, (1, hi_n, 1), 1),
-                jnp.bfloat16(1.0), jnp.bfloat16(0.0))     # [f_blk,hi_n,T]
+                jnp.float32(1.0),
+                jnp.float32(0.0)).astype(jnp.bfloat16)    # [f_blk,hi_n,T]
             loh = jnp.where(
                 lo.astype(jnp.int32)[:, None, :]
                 == jax.lax.broadcasted_iota(jnp.int32, (1, lo_n, 1), 1),
-                jnp.bfloat16(1.0), jnp.bfloat16(0.0))     # [f_blk,lo_n,T]
+                jnp.float32(1.0),
+                jnp.float32(0.0)).astype(jnp.bfloat16)    # [f_blk,lo_n,T]
             rhs = loh.reshape(k, N, tile)
             c0 = 0
             for csz in chunks:
                 # lhs[g, (f, c, hi), t] = gh[c, t] * hihot[g*m + f, hi, t]
-                lhs = (gh[None, c0:c0 + csz, None, :]
-                       * hih[:, None, :, :]).reshape(k, m * csz * hi_n, tile)
+                # NB: slice-then-reshape, never `[None, c0:c0+csz, None]`
+                # indexing — a partial slice mixed with newaxes lowers via
+                # lax.gather, which Mosaic rejects in this shape
+                ghc = gh[c0:c0 + csz, :].reshape(1, csz, 1, tile)
+                lhs = (ghc * hih.reshape(f_blk, 1, hi_n, tile)
+                       ).reshape(k, m * csz * hi_n, tile)
                 part = jax.lax.dot_general(
                     lhs, rhs,
                     dimension_numbers=(((2,), (2,)), ((0,), (0,))),

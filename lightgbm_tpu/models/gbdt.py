@@ -517,9 +517,9 @@ class GBDT:
                         "max_bin<=256); using label engine")
             eng = "label"
         from ..ops import partition_pallas as pp
-        base = -(-max(self.num_data, 1) // pp.TILE) * pp.TILE
-        cap = max(cfg.tpu_arena_factor, 3) * base + 16 * pp.TILE
-        C = pp.arena_channels(max(self.train_set.num_features, 1))
+        C, cap = pp.arena_geometry(self.num_data,
+                                   self.train_set.num_features,
+                                   cfg.tpu_arena_factor)
         hist_cache_bytes = (self.config.num_leaves
                             * max(self.train_set.num_features, 1)
                             * max(self.max_bin, 2) * 3 * 4)
@@ -552,20 +552,40 @@ class GBDT:
                                                    False)
                                            and self._bag_mask is None)
                                else "leaf_ids")
-            arrays, out, self._arena, self._last_truncated = \
-                self._grow_partition(
-                self._arena, self._bins_t, grad, hess, row_init,
-                self._feature_sample(),
-                self.train_state.num_bins, self.train_state.default_bins,
-                self.train_state.missing_types,
-                self.split_params, self.monotone, self.penalty,
-                self._cegb_coupled, cegb_used,
-                max_leaves=self.config.num_leaves,
-                max_depth=self.config.max_depth,
-                max_bin=self.max_bin,
-                emit=self._last_emit,
-                interpret=jax.default_backend() != "tpu")
-            return arrays, out
+            try:
+                arrays, out, self._arena, self._last_truncated = \
+                    self._grow_partition(
+                    self._arena, self._bins_t, grad, hess, row_init,
+                    self._feature_sample(),
+                    self.train_state.num_bins, self.train_state.default_bins,
+                    self.train_state.missing_types,
+                    self.split_params, self.monotone, self.penalty,
+                    self._cegb_coupled, cegb_used,
+                    max_leaves=self.config.num_leaves,
+                    max_depth=self.config.max_depth,
+                    max_bin=self.max_bin,
+                    emit=self._last_emit,
+                    interpret=jax.default_backend() != "tpu")
+                if not getattr(self, "_partition_validated", False):
+                    # force materialization once: async dispatch would
+                    # otherwise surface a device runtime fault later at
+                    # device_get, OUTSIDE this try (one host round trip,
+                    # first tree only)
+                    int(arrays.num_leaves)
+                    self._partition_validated = True
+                return arrays, out
+            except Exception as exc:
+                # A Mosaic/XLA lowering or runtime failure in the fast path
+                # must degrade to the (slower, fully general) label engine,
+                # not kill training — the round-2 bench died exactly here.
+                log.warning(
+                    "partition engine failed (%s: %s); falling back to the "
+                    "label engine for this booster",
+                    type(exc).__name__, str(exc).split("\n")[0][:200])
+                self._use_partition_engine = False
+                self._arena = None
+                self._bins_t = None
+                self._last_truncated = None
         self._last_emit = "leaf_ids"
         grow_fn = (self._grower if self._grower is not None
                    else grow_ops.grow_tree)

@@ -71,6 +71,19 @@ def arena_channels(num_features: int) -> int:
     return c + (-c % _SUBL)
 
 
+def arena_geometry(num_data: int, num_features: int,
+                   factor: int = 3) -> tuple:
+    """(C, cap) of the arena for a dataset — the SINGLE sizing formula
+    shared by GBDT._setup_tree_engine and the driver compile check
+    (__graft_entry__.entry), so the compile check always exercises the
+    same shapes real training uses.  `factor` multiples of the row
+    footprint cover root + OOB dump + bump-allocated child segments;
+    the 16-tile tail is kernel read-overrun headroom."""
+    base = -(-max(num_data, 1) // TILE) * TILE
+    cap = max(factor, 3) * base + 16 * TILE
+    return arena_channels(max(num_features, 1)), cap
+
+
 def split_f32(x):
     """f32 [n] -> three bf16 planes whose f32 sum reconstructs x exactly
     (8 mantissa bits each; 24 total covers the f32 significand).

@@ -166,3 +166,29 @@ class TestForcedSplitAbandonment:
         assert (sf[1], thr[1]) == (2, 7)
         # node 1 must be the root's right child (leaf 1 was split)
         assert int(np.asarray(tree.right_child)[0]) == 1
+
+
+class TestEngineFallback:
+    def test_partition_failure_falls_back_to_label(self):
+        """A lowering/runtime failure in the partition fast path must
+        degrade to the label engine with a warning, not kill training
+        (the round-2 bench crash mode)."""
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(500, 6)).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float32)
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.Booster(params={"objective": "binary", "verbose": -1,
+                                  "tpu_tree_engine": "partition"},
+                          train_set=ds)
+
+        def boom(*a, **k):
+            raise RuntimeError("simulated Mosaic lowering failure")
+
+        g = bst._gbdt
+        # the guard is only meaningful when the engine is actually active
+        assert g._use_partition_engine, "partition engine not selected"
+        g._grow_partition = boom
+        for _ in range(2):
+            bst.update()
+        assert bst.num_trees() == 2
+        assert not g._use_partition_engine

@@ -145,6 +145,10 @@ _SCHEMA = [
     #   arena-resident pallas engine (O(child) per split); label = masked-pass
     #   engine (works everywhere: CPU, f64, categorical, distributed)
     ("tpu_arena_factor", int, 6),            # partition-engine arena size, x rows
+    ("tpu_profile", bool, False),            # per-phase host timers, report at teardown
+    #   (TIMETAG analogue, serial_tree_learner.cpp:15-42; adds a device
+    #   sync per phase, so only enable when measuring)
+    ("tpu_profile_trace_dir", str, ""),      # non-empty -> jax.profiler trace of training
     ("num_devices", int, 0),                 # 0 = use all local devices for parallel learners
 ]
 

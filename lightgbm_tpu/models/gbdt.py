@@ -128,9 +128,34 @@ class GBDT:
         self._allow_deferred = True
         self._inflight: List[dict] = []
         self._deferred_stopped = False
+        # per-phase timers (TIMETAG analogue); sync_fn charges async
+        # dispatch to the phase that launched it
+        from ..utils.profiling import Profiler, TraceSession
+        self.profiler = Profiler(enabled=config.tpu_profile,
+                                 sync_fn=self._profile_sync)
+        self._trace = TraceSession(config.tpu_profile_trace_dir)
 
         if train_set is not None:
             self._setup_train(train_set)
+
+    # ------------------------------------------------------------------ #
+    def _profile_sync(self):
+        """Device sync for phase timing: a dependent scalar fetch (plain
+        block_until_ready is unreliable through remote device tunnels)."""
+        if self.train_state is not None:
+            float(jnp.sum(self.train_state.score[:, :1]))
+
+    def profile_report(self):
+        return self.profiler.report(header="tpu_profile")
+
+    def __del__(self):
+        try:
+            if getattr(self, "profiler", None) is not None:
+                self.profile_report()
+            if getattr(self, "_trace", None) is not None:
+                self._trace.stop()
+        except Exception:  # noqa: BLE001 — teardown must never raise
+            pass
 
     # ------------------------------------------------------------------ #
     def _setup_train(self, train_set: BinnedDataset) -> None:
@@ -263,28 +288,19 @@ class GBDT:
         # subsequent pending iteration is degenerate too (zero-valued
         # trees), so the stop point is recovered exactly on drain.
         if len(self._inflight) >= self.num_tree_per_iteration * _DRAIN_EVERY:
-            if self._drain_inflight():
-                self._deferred_stopped = True
+            with self.profiler.phase("drain_inflight"):
+                if self._drain_inflight():
+                    self._deferred_stopped = True
         if self._deferred_stopped:
             return True
 
+        self._trace.start()
         k = self.num_tree_per_iteration
         init_scores = [0.0] * k
-        if gradients is None or hessians is None:
+        custom = gradients is not None and hessians is not None
+        if not custom:
             for kk in range(k):
                 init_scores[kk] = self._boost_from_average(kk)
-            grad, hess = self.objective.get_gradients(
-                self.train_state.score if k > 1 else self.train_state.score[0])
-            grad = jnp.reshape(grad, (k, self.num_data)).astype(self.dtype)
-            hess = jnp.reshape(hess, (k, self.num_data)).astype(self.dtype)
-        else:
-            grad = jnp.reshape(jnp.asarray(gradients, self.dtype), (k, self.num_data))
-            hess = jnp.reshape(jnp.asarray(hessians, self.dtype), (k, self.num_data))
-
-        # row-sampling hook: GOSS rescales gradients and sets the row mask
-        # here (goss.hpp:87-135); default is identity
-        grad, hess = self._sample_gradients(grad, hess)
-        row_init = self._bagging(self.iter)
         # deferred (pipelined) tree materialization: only when nothing needs
         # the host tree inside this iteration
         deferred_ok = (self._allow_deferred and not self.valid_states
@@ -297,6 +313,60 @@ class GBDT:
         # leaf-value gather entirely (serial-gather cost on TPU)
         self._score_emit_ok = deferred_ok
 
+        # single-dispatch fast path: gradients + tree + score update fused
+        no_bagging = (self.config.bagging_freq <= 0
+                      or self.config.bagging_fraction >= 1.0)
+        if no_bagging and self._fused_eligible(deferred_ok, k, custom):
+            try:
+                with self.profiler.phase("fused_iter"):
+                    packed = self._run_fused_iter()
+                for p in packed:
+                    p.copy_to_host_async()
+                self.models.append(None)
+                self._inflight.append(dict(
+                    packed=packed, max_leaves=self.config.num_leaves,
+                    cat_bins=0, init_score=init_scores[0],
+                    has_trunc_flag=True, it=self.iter,
+                    slot=len(self.models) - 1))
+                self.iter += 1
+                return False
+            except Exception as exc:
+                # same contract as the _grow_one_tree guard: a lowering
+                # or device fault on the fast path demotes to the label
+                # engine instead of killing training.  The fused call may
+                # have consumed its donated arena/score buffers, so the
+                # training scores are rebuilt from the materialized model.
+                log.warning(
+                    "fused TPU iteration failed (%s: %s); falling back to "
+                    "the label engine for this booster",
+                    type(exc).__name__, str(exc).split("\n")[0][:200])
+                self._use_partition_engine = False
+                self._arena = None
+                self._bins_t = None
+                self._last_truncated = None
+                self._fused_fn = None
+                self._sync_model()
+                self._rebuild_train_score()
+
+        with self.profiler.phase("boosting(gradients)"):
+            if not custom:
+                grad, hess = self.objective.get_gradients(
+                    self.train_state.score if k > 1
+                    else self.train_state.score[0])
+                grad = jnp.reshape(grad, (k, self.num_data)).astype(self.dtype)
+                hess = jnp.reshape(hess, (k, self.num_data)).astype(self.dtype)
+            else:
+                grad = jnp.reshape(jnp.asarray(gradients, self.dtype),
+                                   (k, self.num_data))
+                hess = jnp.reshape(jnp.asarray(hessians, self.dtype),
+                                   (k, self.num_data))
+
+        # row-sampling hook: GOSS rescales gradients and sets the row mask
+        # here (goss.hpp:87-135); default is identity
+        with self.profiler.phase("bagging/sampling"):
+            grad, hess = self._sample_gradients(grad, hess)
+            row_init = self._bagging(self.iter)
+
         should_continue = False
         deferred_any = False
         for kk in range(k):
@@ -304,13 +374,15 @@ class GBDT:
             class_ok = (self.objective is None
                         or self.objective.class_need_train(kk))
             if class_ok and self.train_set.num_features > 0:
-                arrays, leaf_ids = self._grow_one_tree(grad[kk], hess[kk],
-                                                       row_init)
+                with self.profiler.phase("tree_grow"):
+                    arrays, leaf_ids = self._grow_one_tree(grad[kk], hess[kk],
+                                                           row_init)
                 if deferred_ok:
                     packed = self._pack_tree_with_flag(arrays)
                     for p in packed:
                         p.copy_to_host_async()
-                    self._update_train_score_device(arrays, kk, leaf_ids)
+                    with self.profiler.phase("score_update"):
+                        self._update_train_score_device(arrays, kk, leaf_ids)
                     self.models.append(None)       # placeholder; drained next
                     self._inflight.append(dict(
                         packed=packed, max_leaves=arrays.max_leaves,
@@ -325,7 +397,8 @@ class GBDT:
                 # would pay a host round-trip each (remote-attached TPUs).
                 # The arena-truncation flag rides the same fetch.
                 packed = self._pack_tree_with_flag(arrays)
-                ivec, fvec = jax.device_get(packed)   # ONE bulk transfer
+                with self.profiler.phase("tree_fetch"):
+                    ivec, fvec = jax.device_get(packed)   # ONE bulk transfer
                 host_arrays = grow_ops.unpack_tree_vectors(
                     ivec, fvec, arrays.max_leaves, arrays.cat_mask.shape[1])
                 if self._last_truncated is not None and ivec[-1]:
@@ -338,10 +411,12 @@ class GBDT:
                 if self._cegb_coupled is not None:
                     self._cegb_used[new_tree.split_feature_inner[
                         :new_tree.num_leaves - 1]] = True
-                self._renew_tree_output(new_tree, kk, leaf_ids)
+                with self.profiler.phase("renew_tree_output"):
+                    self._renew_tree_output(new_tree, kk, leaf_ids)
                 new_tree.shrink(self.shrinkage_rate)
-                self._update_train_score(new_tree, kk, arrays, leaf_ids)
-                self._update_valid_scores(new_tree, kk)
+                with self.profiler.phase("score_update"):
+                    self._update_train_score(new_tree, kk, arrays, leaf_ids)
+                    self._update_valid_scores(new_tree, kk)
                 if abs(init_scores[kk]) > K_EPSILON:
                     new_tree.add_bias(init_scores[kk])
             else:
@@ -368,6 +443,100 @@ class GBDT:
             return True
         self.iter += 1
         return False
+
+    # ------------------------------------------------------------------ #
+    # Fused fast-path iteration: gradients -> tree growth -> score update
+    # in ONE compiled dispatch.  The per-iteration spine (gbdt.cpp:333-412)
+    # otherwise costs 3-4 separate device programs whose dispatch gaps
+    # dominate on remote-attached TPUs.
+    # ------------------------------------------------------------------ #
+    def _fused_eligible(self, deferred_ok: bool, k: int, custom: bool) -> bool:
+        return (deferred_ok and k == 1 and not custom
+                and getattr(self, "_use_partition_engine", False)
+                and self.objective is not None
+                and self.objective.class_need_train(0)
+                and type(self)._sample_gradients is GBDT._sample_gradients
+                and self.train_set.num_features > 0)
+
+    def _build_fused_iter(self):
+        from functools import partial as _partial
+
+        from ..ops import grow_partition as gp
+        objective = self.objective
+        interpret = jax.default_backend() != "tpu"
+
+        def fused(arena, bins_t, score_row, label, weights, row0, fmask,
+                  num_bins, default_bins, missing_types, sparams, monotone,
+                  penalty, shrink):
+            # gradients: trace the objective's device math against the
+            # ARGUMENT label/weights (a closure over the attribute arrays
+            # would ship them as compile-request constants through the
+            # device tunnel)
+            old_l, old_w = objective.label, objective.weights
+            objective.label, objective.weights = label, weights
+            try:
+                grad, hess = objective.get_gradients(score_row)
+            finally:
+                objective.label, objective.weights = old_l, old_w
+            grad = jnp.asarray(grad, jnp.float32).reshape(-1)
+            hess = jnp.asarray(hess, jnp.float32).reshape(-1)
+            arrays, delta, arena, trunc = gp.grow_tree_partition_impl(
+                arena, bins_t, grad, hess, row0, fmask, num_bins,
+                default_bins, missing_types, sparams, monotone, penalty,
+                None, None,
+                max_leaves=self.config.num_leaves,
+                max_depth=self.config.max_depth,
+                max_bin=self.max_bin, emit="score", full_bag=True,
+                interpret=interpret)
+            new_score = score_row + shrink * delta.astype(score_row.dtype)
+            ivec, fvec = grow_ops.pack_tree_arrays(arrays)
+            ivec = jnp.concatenate([ivec, trunc.astype(jnp.int32)[None]])
+            return ivec, fvec, new_score, arena
+
+        return jax.jit(fused, donate_argnums=(0, 2))
+
+    def _run_fused_iter(self):
+        """One fused iteration; returns the packed (ivec, fvec) device
+        arrays with the truncation flag appended (the _inflight payload)."""
+        # the jitted fn bakes these in at trace time; rebuild if a
+        # reset_parameter callback changed them mid-training
+        key = (self.config.num_leaves, self.config.max_depth, self.max_bin)
+        if (getattr(self, "_fused_fn", None) is None
+                or getattr(self, "_fused_key", None) != key):
+            self._fused_fn = self._build_fused_iter()
+            self._fused_key = key
+        sh = jnp.asarray(self.shrinkage_rate, self.dtype)
+        ivec, fvec, new_score, arena = self._fused_fn(
+            self._arena, self._bins_t, self.train_state.score[0],
+            self.objective.label, self.objective.weights,
+            self._row_all_in, self._feature_sample(),
+            self.train_state.num_bins, self.train_state.default_bins,
+            self.train_state.missing_types, self.split_params,
+            self.monotone, self.penalty, sh)
+        if not getattr(self, "_fused_validated", False):
+            # force materialization once so a device runtime fault raises
+            # HERE (inside the fallback guard) instead of at a later
+            # async fetch
+            int(ivec[-1])
+            self._fused_validated = True
+        self._arena = arena
+        self.train_state.score = new_score[None]
+        self._last_truncated = jnp.asarray(False)   # flag rides ivec[-1]
+        return ivec, fvec
+
+    def _rebuild_train_score(self):
+        """Recompute training scores from the materialized model — used
+        when a fused iteration dies after its donated arena/score buffers
+        were already consumed."""
+        st = self.train_state
+        st.score = jnp.zeros((max(self.num_tree_per_iteration, 1),
+                              self.num_data), self.dtype)
+        if self.train_set.metadata.init_score is not None:
+            self._apply_init_scores()
+        k = max(self.num_tree_per_iteration, 1)
+        for i, tree in enumerate(self.models):
+            if tree is not None:
+                self._update_train_score_full(tree, i % k)
 
     def _pack_tree_with_flag(self, arrays):
         """Pack TreeArrays into (ivec, fvec) for one bulk host fetch; the
@@ -565,6 +734,7 @@ class GBDT:
                     max_depth=self.config.max_depth,
                     max_bin=self.max_bin,
                     emit=self._last_emit,
+                    full_bag=self._bag_mask is None,
                     interpret=jax.default_backend() != "tpu")
                 if not getattr(self, "_partition_validated", False):
                     # force materialization once: async dispatch would
@@ -714,8 +884,9 @@ class GBDT:
     def _sync_model(self) -> None:
         """Materialize any deferred trees before the model is read; a stop
         detected here must still end training on the next update."""
-        if self._drain_inflight():
-            self._deferred_stopped = True
+        with self.profiler.phase("drain_inflight"):
+            if self._drain_inflight():
+                self._deferred_stopped = True
 
     def eval_train(self) -> Dict[str, List[float]]:
         self._sync_model()
@@ -730,7 +901,8 @@ class GBDT:
         out = {}
         if not metrics:
             return out
-        score = np.asarray(state.score, np.float64)
+        with self.profiler.phase("metric_eval(fetch)"):
+            score = np.asarray(state.score, np.float64)
         flat = score.reshape(-1) if self.num_tree_per_iteration > 1 else score[0]
         for m in metrics:
             out[m.name] = m.eval(flat, self.objective)

@@ -76,6 +76,7 @@ def grow_tree_partition_impl(
         max_depth: int = -1,
         max_bin: int,
         emit: str = "leaf_ids",
+        full_bag: bool = False,
         interpret: bool = False):
     """Grow one leaf-wise tree.
 
@@ -114,15 +115,34 @@ def grow_tree_partition_impl(
         arena_buf, jnp.concatenate(chans, axis=0), (0, 0))
 
     # ---- root: in-bag rows compacted to the segment at 0 -----------------
-    in_bag = (row_leaf_init == 0)
-    pred0 = jnp.pad(in_bag.astype(dtype), (0, cap - n))[None, :]
-    oob_dst = _align(n, pp.TILE)
-    arena, counts0 = part(arena, pred0, jnp.int32(0), jnp.int32(n),
-                          jnp.int32(0), jnp.int32(oob_dst))
-    root_c = counts0[0]
-    cursor0 = jnp.int32(oob_dst + _align(n, pp.TILE))  # oob dump space
+    # decision-mode partition calls never read the pred stream; they get
+    # a tile-sized dummy (a [1, cap] buffer would be constant-sunk into
+    # the while loop and re-materialized every split)
+    pred_dummy = jnp.zeros((1, pp.TILE), dtype)
+    if full_bag:
+        # no bagging: every row is in-bag, the root segment IS the
+        # assembled arena prefix — skip the O(n) compaction pass and the
+        # OOB dump region entirely
+        root_c = jnp.int32(n)
+        cursor0 = jnp.int32(_align(n, pp.TILE) + pp.TILE)
+    else:
+        in_bag = (row_leaf_init == 0)
+        pred0 = jnp.pad(in_bag.astype(dtype), (0, cap - n))[None, :]
+        oob_dst = _align(n, pp.TILE)
+        # fused compaction + in-bag (stream A) histogram: the root
+        # histogram covers every row the pass reads anyway, so here the
+        # fusion is pure saving (one full-n re-read + a launch)
+        arena, counts0, root_hist_b = part(
+            arena, pred0, jnp.int32(0), jnp.int32(n),
+            jnp.int32(0), jnp.int32(oob_dst), hist_stream=0,
+            num_features=F, max_bin=max_bin)
+        root_c = counts0[0]
+        cursor0 = jnp.int32(oob_dst + _align(n, pp.TILE))  # oob dump space
 
-    root_hist = seg(arena, jnp.int32(0), root_c)
+    if full_bag:
+        root_hist = seg(arena, jnp.int32(0), root_c)
+    else:
+        root_hist = root_hist_b.astype(dtype)
     root_g = jnp.sum(root_hist[0, :, 0])
     root_h = jnp.sum(root_hist[0, :, 1])
 
@@ -219,12 +239,13 @@ def grow_tree_partition_impl(
         decision = (feat, thr, sp.default_left.astype(jnp.int32),
                     missing_types[feat], default_bins[feat],
                     num_bins[feat] - 1, left_smaller.astype(jnp.int32))
-        arena, counts = part(state.arena, pred0, s0, cntP, s0, dstB,
+        # NOT fused with the histogram: a fused pass would accumulate the
+        # masked histogram over the WHOLE parent stream (O(parent) radix
+        # FLOPs); the separate kernel touches only the compacted smaller
+        # child (O(small)) — measured faster despite the extra launch
+        arena, counts = part(state.arena, pred_dummy, s0, cntP, s0, dstB,
                              decision=decision)
-
-        start_small = dstB
-        small_hist = seg(arena, start_small,
-                         jnp.where(no_split, 0, small_cnt))
+        small_hist = seg(arena, dstB, jnp.where(no_split, 0, small_cnt))
         parent_hist = state.hist_cache[best_leaf]
         large_hist = parent_hist - small_hist
         left_hist = jnp.where(left_smaller, small_hist, large_hist)
@@ -292,12 +313,20 @@ def grow_tree_partition_impl(
             leaf_max = leaf_max.at[best_leaf].set(maxL).at[new_leaf].set(maxR)
 
         used2 = state.cegb_used.at[feat].set(True)
-        lsp = leaf_best_split(left_hist, sp.left_sum_gradient,
-                              sp.left_sum_hessian, sp.left_count,
-                              depth + 1, used=used2, minc=minL, maxc=maxL)
-        rsp = leaf_best_split(right_hist, sp.right_sum_gradient,
-                              sp.right_sum_hessian, sp.right_count,
-                              depth + 1, used=used2, minc=minR, maxc=maxR)
+        # ONE vmapped scan over both children: the best-split scan is a
+        # long chain of tiny [F, B] ops whose per-op launch latency (not
+        # bandwidth) dominates inside the while loop — batching the pair
+        # halves the op count on the critical path
+        both = jax.vmap(lambda hh, gg, hs2, cc, mn, mx: leaf_best_split(
+            hh, gg, hs2, cc, depth + 1, used=used2, minc=mn, maxc=mx))(
+            jnp.stack([left_hist, right_hist]),
+            jnp.stack([sp.left_sum_gradient, sp.right_sum_gradient]),
+            jnp.stack([sp.left_sum_hessian, sp.right_sum_hessian]),
+            jnp.stack([sp.left_count, sp.right_count]),
+            jnp.stack([jnp.asarray(minL, dtype), jnp.asarray(minR, dtype)]),
+            jnp.stack([jnp.asarray(maxL, dtype), jnp.asarray(maxR, dtype)]))
+        lsp = _index_split(both, 0)
+        rsp = _index_split(both, 1)
         split_cache = _stack_split(lsp, state.split_cache, best_leaf)
         split_cache = _stack_split(rsp, split_cache, new_leaf)
 
@@ -327,53 +356,34 @@ def grow_tree_partition_impl(
 
     state = jax.lax.while_loop(cond, body, state)
 
-    # ---- recover row -> leaf labels from the final segments --------------
-    # Per arena position we need the covering segment's leaf id and
-    # whether the position is inside it.  Both the leaf id and the covering segment's
-    # end are piecewise-constant step functions of the position changing
-    # only at (address-)sorted segment starts, so each is materialized by
-    # scattering per-segment DELTAS at the starts and prefix-summing — no
-    # [cap]-sized gather or searchsorted (a TPU gather here costs ~100x
-    # more than these cumsums).
+    # ---- recover per-row outputs from the final segments -----------------
+    # The compact kernel streams ONLY the live segments (O(n) work,
+    # independent of cap — the old step-function recovery paid three
+    # cumsums plus a scatter over the whole ~6n-column arena) and emits a
+    # dense (rowid, value) stream; one n-sized scatter finishes the job.
     tree = state.tree
-    live = jnp.arange(L, dtype=jnp.int32) < tree.num_leaves
-    starts_eff = jnp.where(live, state.leaf_start, cap)  # dead slots last
-    order = jnp.argsort(starts_eff).astype(jnp.int32)
-    s_sorted = starts_eff[order]
-
-    def step_fn(values):
-        """[cap] array equal to values[r] on [s_sorted[r], s_sorted[r+1])."""
-        deltas = jnp.diff(values, prepend=0)
-        buf = jnp.zeros(cap, values.dtype)
-        buf = buf.at[jnp.clip(s_sorted, 0, cap - 1)].add(
-            jnp.where(s_sorted < cap, deltas, 0), mode="drop")
-        return jnp.cumsum(buf)
-
-    # validity needs only the covering segment's END: pos is >= its start
-    # by construction, so two step functions (not three) suffice
-    end_of = step_fn(s_sorted + jnp.where(live, tree.leaf_count, 0)[order])
-    pos = jnp.arange(cap, dtype=jnp.int32)
-    valid = pos < end_of
-    Fp_row = pp.feature_channels(F)
-    rowids = pp.merge_rowid(state.arena[Fp_row + 6],
-                            state.arena[Fp_row + 7],
-                            state.arena[Fp_row + 8])
+    capn = -(-n // pp.TILE) * pp.TILE + L * pp.TILE
+    vals = (tree.leaf_value.astype(jnp.float32) if emit == "score"
+            else jnp.arange(L, dtype=jnp.int32).astype(jnp.float32))
+    stream, used = pp.compact_segments(
+        state.arena, state.leaf_start, tree.leaf_count, vals,
+        tree.num_leaves, n, F, capn, interpret=interpret)
+    # positions >= used are never written by the kernel (garbage, not
+    # dummy) — mask them to the dummy rowid before the scatter
+    written = jnp.arange(capn, dtype=jnp.int32) < used[0]
+    rid = jnp.where(written, stream[0].astype(jnp.int32), n)
     if emit == "score":
-        # fused score recovery: scatter each row's LEAF VALUE directly
-        # (piecewise-constant over segments) instead of leaf ids — the
-        # driver's separate 255-table leaf_value[leaf_ids] gather is a
-        # pure serial-gather cost on TPU and is skipped entirely
-        val_of = step_fn(tree.leaf_value[order].astype(dtype))
-        delta = jnp.zeros(n + 1, dtype).at[
-            jnp.where(valid, rowids, n)].set(val_of, mode="drop")[:n]
+        # scatter each row's LEAF VALUE directly — the driver's separate
+        # 255-table leaf_value[leaf_ids] gather is a pure serial-gather
+        # cost on TPU and is skipped entirely
+        delta = jnp.zeros(n + 1, dtype).at[rid].set(
+            stream[1].astype(dtype), mode="drop")[:n]
         return tree, delta, state.arena, state.truncated
-    leaf_of = step_fn(order)
-    leaf_ids = jnp.full(n, -1, jnp.int32)
-    leaf_ids = leaf_ids.at[jnp.where(valid, rowids, n)].set(
-        leaf_of, mode="drop")
+    leaf_ids = jnp.full(n + 1, -1, jnp.int32).at[rid].set(
+        stream[1].astype(jnp.int32), mode="drop")[:n]
     return tree, leaf_ids, state.arena, state.truncated
 
 
 grow_tree_partition = partial(jax.jit, static_argnames=(
-    "max_leaves", "max_depth", "max_bin", "emit", "interpret"),
+    "max_leaves", "max_depth", "max_bin", "emit", "full_bag", "interpret"),
     donate_argnums=(0,))(grow_tree_partition_impl)

@@ -132,18 +132,17 @@ FLUSH_W = SUB          # flush chunk width; all HBM write offsets are
 CARRY_W = FLUSH_W + SUB    # per-stream carry width (append window)
 
 
-def _compact_subblock(block_k, pred_k, fill):
+def _compact_subblock(block_k, prefix_k, pred_k, fill):
     """Place the columns of `block_k` [C, S] (bf16) selected by `pred_k`
-    [1, S] (0/1 f32) contiguously starting at carry position `fill`
-    (< FLUSH_W): prefix-scan -> destination one-hot P[u, fill + pos_u]
-    [S, CARRY_W] -> one [C, S] @ [S, CARRY_W] bf16 MXU matmul (each output
-    column copies exactly one input column, so bf16 is exact).
-    Positioning is baked into P so no dynamic roll/shift of the carry is
-    ever needed.  Returns (comp [C, CARRY_W] bf16, count); columns outside
-    [fill, fill+count) are 0."""
-    prefix = _prefix_scan_lanes(pred_k)                       # [1, S]
-    cnt_k = prefix[0, SUB - 1].astype(jnp.int32)
-    pos_col = (prefix - 1.0).astype(jnp.int32).reshape(SUB, 1) + fill
+    [1, S] (0/1 f32, inclusive prefix sum `prefix_k` precomputed)
+    contiguously starting at carry position `fill` (< FLUSH_W):
+    destination one-hot P[u, fill + pos_u] [S, CARRY_W] -> one
+    [C, S] @ [S, CARRY_W] bf16 MXU matmul (each output column copies
+    exactly one input column, so bf16 is exact).  Positioning is baked
+    into P so no dynamic roll/shift of the carry is ever needed.
+    Returns comp [C, CARRY_W] bf16; columns outside [fill, fill+count)
+    are 0."""
+    pos_col = (prefix_k - 1.0).astype(jnp.int32).reshape(SUB, 1) + fill
     sel_col = pred_k.reshape(SUB, 1) > 0.5
     t_iota = jax.lax.broadcasted_iota(jnp.int32, (SUB, CARRY_W), 1)
     # build the one-hot in f32 then cast: an i1 mask from 32-bit compares
@@ -151,14 +150,12 @@ def _compact_subblock(block_k, pred_k, fill):
     P = jnp.where((pos_col == t_iota) & sel_col,
                   jnp.float32(1.0), jnp.float32(0.0)).astype(jnp.bfloat16)
     comp = jax.lax.dot(block_k, P, preferred_element_type=jnp.float32)
-    return comp.astype(ARENA_DT), cnt_k
+    return comp.astype(ARENA_DT)
 
 
 def _partition_kernel(sc_ref, feat_onehot_ref, arena_any, pred_any,
-                      out_any, cnt_ref,
-                      in_buf, pred_buf, carryA, carryB, flush_buf,
-                      read_sems, pred_sems, write_sems,
-                      *, C: int, tile: int):
+                      out_any, cnt_ref, *rest,
+                      C: int, tile: int, hist_plan=None):
     """sc_ref (SMEM [11] i32): start, cnt, dstA, dstB, mode, thr, dl, mt,
     db, mb, xr — start, dstA and dstB must be multiples of `tile` resp.
     FLUSH_W (the bump allocator aligns).
@@ -182,26 +179,42 @@ def _partition_kernel(sc_ref, feat_onehot_ref, arena_any, pred_any,
     because wA + FLUSH_W <= rows consumed so far <= (j+1)*tile and tile j
     is fully read before its sub-blocks are appended.
     """
+    if hist_plan is None:
+        hist_ref = None
+        (in_buf, pred_buf, carryA, carryB, flush_buf,
+         read_sems, pred_sems, write_sems) = rest
+    else:
+        # fused smaller-child histogram: one extra VMEM output, stream-B
+        # rows accumulated with the radix contraction while they are
+        # already in VMEM for compaction — saves the separate
+        # segment_histogram kernel launch AND its re-read of the child
+        (hist_ref, in_buf, pred_buf, carryA, carryB, flush_buf,
+         read_sems, pred_sems, write_sems) = rest
+        hist_ref[:] = jnp.zeros_like(hist_ref)
     s, cnt = sc_ref[0], sc_ref[1]
     dstA, dstB = sc_ref[2], sc_ref[3]
     mode, thr = sc_ref[4], sc_ref[5]
     dl, mt, db, mb = sc_ref[6], sc_ref[7], sc_ref[8], sc_ref[9]
     xr = sc_ref[10]   # XOR'd into the decision: 1 when the left child is
     #                   the smaller (stream-B) side
+    hs = sc_ref[11]   # fused-histogram stream: 1 -> B, 0 -> A
     n_tiles = jax.lax.div(cnt + jnp.int32(tile - 1), jnp.int32(tile))
     K = tile // SUB
     lane_w = jax.lax.broadcasted_iota(jnp.int32, (C, CARRY_W), 1)
 
     def read_dmas(j, slot):
         src = pl.multiple_of(s + j * tile, 128)
-        # the pred stream is only consumed in mode 0 but always read —
-        # [1, tile] is ~3% of the arena tile and keeps the DMA plumbing
-        # uniform
+        # the pred stream is only consumed in mode 0; in decision mode the
+        # caller passes a [1, tile] dummy (a full [1, cap] zeros buffer
+        # gets constant-sunk into the grow while-loop by XLA and
+        # re-materialized EVERY split — measured 75 ms/iter) and the DMA
+        # pins its read to offset 0
+        psrc = jnp.where(mode == 0, src, 0)
         return (pltpu.make_async_copy(
                     arena_any.at[:, pl.ds(src, tile)],
                     in_buf.at[slot], read_sems.at[slot]),
                 pltpu.make_async_copy(
-                    pred_any.at[:, pl.ds(src, tile)],
+                    pred_any.at[:, pl.ds(pl.multiple_of(psrc, 128), tile)],
                     pred_buf.at[slot], pred_sems.at[slot]))
 
     def flush_dma(stream, slot, dst_col):
@@ -278,12 +291,32 @@ def _partition_kernel(sc_ref, feat_onehot_ref, arena_any, pred_any,
         predA = jnp.where(valid & on, jnp.float32(1.0), jnp.float32(0.0))
         predB = jnp.where(valid & ~on, jnp.float32(1.0), jnp.float32(0.0))
 
+        if hist_plan is not None:
+            hs_f = hs.astype(jnp.float32)
+            hmask = (hs_f * predB + (1.0 - hs_f) * predA).astype(jnp.bfloat16)
+            nb_h, k_h, m_h, lo_h, hi_h = hist_plan
+            _radix_accumulate(hist_ref, block, hmask, n_blocks=nb_h, k=k_h,
+                              m=m_h, lo_n=lo_h, hi_n=hi_h, tile=tile)
+
+        # ONE batched prefix scan for all subblocks of both streams — the
+        # per-subblock scans were 2*K*log2(SUB) serial roll steps, the
+        # kernel's dominant latency.  The carry fills still thread
+        # serially through append_and_flush, but that chain is
+        # scalar-only (counts come from the batched scan), so the P
+        # builds and compaction matmuls no longer wait on each other's
+        # vector work.
+        pred2 = jnp.concatenate(
+            [predA.reshape(K, SUB), predB.reshape(K, SUB)], axis=0)
+        pref2 = _prefix_scan_lanes(pred2)                  # [2K, SUB]
+        cnt2 = pref2[:, SUB - 1].astype(jnp.int32)         # [2K]
         for k in range(K):
             blk = block[:, k * SUB:(k + 1) * SUB]
-            compA, ca = _compact_subblock(
-                blk, predA[:, k * SUB:(k + 1) * SUB], fillA)
-            compB, cb = _compact_subblock(
-                blk, predB[:, k * SUB:(k + 1) * SUB], fillB)
+            ca, cb = cnt2[k], cnt2[K + k]
+            compA = _compact_subblock(
+                blk, pref2[k:k + 1], predA[:, k * SUB:(k + 1) * SUB], fillA)
+            compB = _compact_subblock(
+                blk, pref2[K + k:K + k + 1],
+                predB[:, k * SUB:(k + 1) * SUB], fillB)
             fillA, wA, fsA = append_and_flush(
                 carryA, compA, ca, fillA, wA, dstA, 0, fsA)
             fillB, wB, fsB = append_and_flush(
@@ -328,9 +361,11 @@ def _partition_kernel(sc_ref, feat_onehot_ref, arena_any, pred_any,
     cnt_ref[1] = wB + fillB
 
 
-@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+@functools.partial(jax.jit, static_argnames=("tile", "interpret",
+                                             "num_features", "max_bin"))
 def partition_segment(arena, pred, start, cnt, dstA, dstB,
-                      decision=None,
+                      decision=None, hist_stream=None,
+                      num_features: int = 0, max_bin: int = 0,
                       tile: int = TILE, interpret: bool = False):
     """Partition arena columns [start, start+cnt) into stream A at dstA
     (dstA == start allowed: in-place with lagging writes) and stream B at
@@ -341,7 +376,12 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
     default_left, missing_type, default_bin, max_bin_idx, xor_flag)
     scalars; pred is then ignored (pass any [1, cap] array).
 
-    Returns (new_arena, counts[2] int32).  Writes stay within
+    When hist_stream is given (0 -> stream A, 1 -> stream B; requires
+    num_features/max_bin), the kernel also accumulates that stream's
+    [F, max_bin, 3] histogram in the same pass and returns it third —
+    the per-split partition + smaller-child histogram fusion.
+
+    Returns (new_arena, counts[2] int32[, hist]).  Writes stay within
     align(count, FLUSH_W) columns of each stream's dst; reads overrun the
     segment by < tile columns, so callers keep cap >= last segment + tile.
     """
@@ -356,11 +396,29 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
         tail = [jnp.int32(1), thr, dlft, mt, db, mb, xr]
         feat_onehot = (jnp.arange(C, dtype=jnp.int32)[None, :]
                        == feat).astype(ARENA_DT)
+    with_hist = hist_stream is not None
+    tail.append(jnp.asarray(hist_stream if with_hist else 0, jnp.int32))
     sc = jnp.stack([jnp.asarray(start), jnp.asarray(cnt),
                     jnp.asarray(dstA), jnp.asarray(dstB)]
                    + tail).astype(jnp.int32)
-    kernel = functools.partial(_partition_kernel, C=C, tile=tile)
-    arena_out, counts = pl.pallas_call(
+    hist_plan = None
+    out_specs = (pl.BlockSpec(memory_space=pl.ANY),
+                 pl.BlockSpec(memory_space=pltpu.SMEM))
+    out_shape = [jax.ShapeDtypeStruct((C, cap), ARENA_DT),
+                 jax.ShapeDtypeStruct((2,), jnp.int32)]
+    if with_hist:
+        lo_n, hi_n, m = _radix_plan(max_bin)
+        f_blk = max(m, 8)
+        k = f_blk // m
+        n_blocks = feature_channels(num_features) // f_blk
+        hist_plan = (n_blocks, k, m, lo_n, hi_n)
+        Mc, N = 7 * hi_n * m, lo_n * m
+        out_specs = out_specs + (pl.BlockSpec(memory_space=pltpu.VMEM),)
+        out_shape.append(
+            jax.ShapeDtypeStruct((n_blocks * k * Mc, N), jnp.float32))
+    kernel = functools.partial(_partition_kernel, C=C, tile=tile,
+                               hist_plan=hist_plan)
+    outs = pl.pallas_call(
         kernel,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -368,10 +426,8 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
-                   pl.BlockSpec(memory_space=pltpu.SMEM)),
-        out_shape=(jax.ShapeDtypeStruct((C, cap), ARENA_DT),
-                   jax.ShapeDtypeStruct((2,), jnp.int32)),
+        out_specs=out_specs,
+        out_shape=tuple(out_shape),
         scratch_shapes=[
             pltpu.VMEM((2, C, tile), ARENA_DT),
             pltpu.VMEM((2, 1, tile), jnp.float32),
@@ -386,7 +442,139 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
         interpret=interpret,
     )(sc, feat_onehot, arena, pred)
-    return arena_out, counts
+    if not with_hist:
+        return outs[0], outs[1]
+    hist = split_radix_epilogue(outs[2], n_blocks * k, m, hi_n=hi_n,
+                                lo_n=lo_n)[:num_features, :max_bin, :]
+    return outs[0], outs[1], hist
+
+
+def _compact_rows_kernel(sc_ref, starts_ref, cnts_ref, vals_ref, arena_any,
+                         out_any, used_ref, in_buf, out_buf,
+                         read_sems, write_sems, *, fp: int, tile: int):
+    """Compact the live leaf segments' (rowid, value) pairs into one
+    dense stream — the cap-independent replacement for the old
+    step-function label recovery (three O(cap) cumsums + an O(cap)
+    scatter; cap is ~6x rows, so recovery dominated the fixed per-tree
+    cost).  Only segment tiles are streamed: O(rows) work total.
+
+    sc_ref (SMEM [2] i32): num_live_leaves, dummy_rowid.
+    starts/cnts (SMEM [L] i32), vals (SMEM [L] f32): per-leaf segment
+    start, count and emitted value (leaf value or leaf index).
+    arena_any: [C, cap] bf16; rowid byte planes at rows fp+6..fp+8.
+    out_any: [2, capn] f32 — row 0 rowid (exact: n < 2^24), row 1 value.
+    used_ref (SMEM [1] i32): columns written (= Σ ceil(cnt/tile)*tile).
+
+    Each segment writes ceil(cnt/tile) full tiles at a tile-aligned
+    output cursor; slots beyond the segment count carry dummy_rowid and
+    are dropped by the consumer's scatter.  Double-buffered on both the
+    read and write sides.
+    """
+    nseg, dummy = sc_ref[0], sc_ref[1]
+    dummy_f = dummy.astype(jnp.float32)
+
+    def read_dma(start, j, slot):
+        # full channel block: a 3-row sublane slice at fp+6 may violate
+        # the (16, 128) bf16 memref tiling; the extra bandwidth is ~2 ms
+        # at 4M rows, well under what this kernel replaces
+        src = pl.multiple_of(start + j * tile, 128)
+        return pltpu.make_async_copy(
+            arena_any.at[:, pl.ds(src, tile)],
+            in_buf.at[slot], read_sems.at[slot])
+
+    def write_dma(dst_col, slot):
+        dst = pl.multiple_of(dst_col, 128)
+        return pltpu.make_async_copy(
+            out_buf.at[slot], out_any.at[:, pl.ds(dst, tile)],
+            write_sems.at[slot])
+
+    def seg_body(s, carry):
+        ocur, w_total = carry
+        start, cnt = starts_ref[s], cnts_ref[s]
+        val = vals_ref[s]
+        n_t = jax.lax.div(cnt + jnp.int32(tile - 1), jnp.int32(tile))
+
+        @pl.when(n_t > 0)
+        def _():
+            read_dma(start, 0, 0).start()
+
+        def tile_body(j, wt):
+            rslot = jax.lax.rem(j, jnp.int32(2))
+            read_dma(start, j, rslot).wait()
+
+            @pl.when(j + 1 < n_t)
+            def _():
+                read_dma(start, j + 1, 1 - rslot).start()
+
+            rid = (in_buf[rslot][fp + 6:fp + 7].astype(jnp.float32) * 65536.0
+                   + in_buf[rslot][fp + 7:fp + 8].astype(jnp.float32) * 256.0
+                   + in_buf[rslot][fp + 8:fp + 9].astype(jnp.float32))
+            lane = jax.lax.broadcasted_iota(jnp.int32, (1, tile), 1)
+            live = (lane < (cnt - j * tile)).astype(jnp.float32)
+            # write slots cycle on the GLOBAL write counter (segments
+            # restart j at 0, so per-tile parity would double-book a
+            # semaphore); wait the write that used this slot 2 writes ago
+            wslot = jax.lax.rem(wt, jnp.int32(2))
+            @pl.when(wt >= 2)
+            def _():
+                write_dma(0, wslot).wait()
+            out_buf[wslot, 0:1] = rid * live + dummy_f * (1.0 - live)
+            out_buf[wslot, 1:2] = val * live
+            write_dma(ocur + j * tile, wslot).start()
+            return wt + 1
+
+        w_total = jax.lax.fori_loop(0, n_t, tile_body, w_total)
+        return ocur + n_t * tile, w_total
+
+    ocur, w_total = jax.lax.fori_loop(0, nseg, seg_body,
+                                      (jnp.int32(0), jnp.int32(0)))
+    # drain outstanding writes: the last two used parities (w-1)%2, w%2
+    @pl.when(w_total >= 1)
+    def _():
+        write_dma(0, jax.lax.rem(w_total + jnp.int32(1), jnp.int32(2))).wait()
+
+    @pl.when(w_total >= 2)
+    def _():
+        write_dma(0, jax.lax.rem(w_total, jnp.int32(2))).wait()
+    used_ref[0] = ocur
+
+
+@functools.partial(jax.jit, static_argnames=("num_features", "capn", "tile",
+                                             "interpret"))
+def compact_segments(arena, starts, cnts, vals, num_live, dummy_rowid,
+                     num_features: int, capn: int,
+                     tile: int = TILE, interpret: bool = False):
+    """[2, capn] f32 (rowid, value) compact stream over the live leaf
+    segments + used-columns count.  Slots with rowid == dummy_rowid are
+    padding.  capn must be >= align(total_rows, tile) + num_leaves*tile."""
+    C, cap = arena.shape
+    fp = feature_channels(num_features)
+    L = starts.shape[0]
+    sc = jnp.stack([jnp.asarray(num_live), jnp.asarray(dummy_rowid)]
+                   ).astype(jnp.int32)
+    kernel = functools.partial(_compact_rows_kernel, fp=fp, tile=tile)
+    out, used = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)),
+        out_shape=(jax.ShapeDtypeStruct((2, capn), jnp.float32),
+                   jax.ShapeDtypeStruct((1,), jnp.int32)),
+        scratch_shapes=[
+            pltpu.VMEM((2, C, tile), ARENA_DT),
+            pltpu.VMEM((2, 2, tile), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(sc, jnp.asarray(starts, jnp.int32), jnp.asarray(cnts, jnp.int32),
+      jnp.asarray(vals, jnp.float32), arena)
+    return out, used
 
 
 def _comp_chunks(hi_n: int, m: int):
@@ -401,6 +589,66 @@ def _comp_chunks(hi_n: int, m: int):
     return chunks
 
 
+def _radix_accumulate(out_ref, block, mask, *, n_blocks: int, k: int,
+                      m: int, lo_n: int, hi_n: int, tile: int):
+    """Accumulate the radix-factorized split-payload histogram of `block`
+    [C, tile] bf16 rows selected by `mask` [1, tile] bf16 (0/1) into
+    out_ref [n_blocks*k*7*hi_n*m, lo_n*m] f32 — the shared inner loop of
+    the segment-histogram kernel and the fused partition+histogram pass."""
+    N = lo_n * m
+    Mc = 7 * hi_n * m
+    f_blk = k * m
+    chunks = _comp_chunks(hi_n, m)
+    Fp = n_blocks * f_blk
+    # 7 payload planes: the 6 bf16 split planes of (g, h) plus count;
+    # masking by 0/1 keeps every entry a bf16-exact plane value
+    comps = [block[Fp + i:Fp + i + 1, :] * mask for i in range(6)]
+    comps.append(mask)
+    gh = jnp.concatenate(comps, axis=0)               # [7, T] bf16
+
+    for b in range(n_blocks):
+        bins = block[b * f_blk:(b + 1) * f_blk, :].astype(jnp.float32)
+        hi = jnp.floor(bins * (1.0 / lo_n))
+        lo = bins - hi * lo_n
+        hih = jnp.where(
+            hi.astype(jnp.int32)[:, None, :]
+            == jax.lax.broadcasted_iota(jnp.int32, (1, hi_n, 1), 1),
+            jnp.float32(1.0),
+            jnp.float32(0.0)).astype(jnp.bfloat16)    # [f_blk,hi_n,T]
+        loh = jnp.where(
+            lo.astype(jnp.int32)[:, None, :]
+            == jax.lax.broadcasted_iota(jnp.int32, (1, lo_n, 1), 1),
+            jnp.float32(1.0),
+            jnp.float32(0.0)).astype(jnp.bfloat16)    # [f_blk,lo_n,T]
+        rhs = loh.reshape(k, N, tile)
+        c0 = 0
+        for csz in chunks:
+            # lhs[g, (f, c, hi), t] = gh[c, t] * hihot[g*m + f, hi, t]
+            # NB: slice-then-reshape, never `[None, c0:c0+csz, None]`
+            # indexing — a partial slice mixed with newaxes lowers via
+            # lax.gather, which Mosaic rejects in this shape
+            ghc = gh[c0:c0 + csz, :].reshape(1, csz, 1, tile)
+            lhs = (ghc * hih.reshape(f_blk, 1, hi_n, tile)
+                   ).reshape(k, m * csz * hi_n, tile)
+            part = jax.lax.dot_general(
+                lhs, rhs,
+                dimension_numbers=(((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)   # [k, m*csz*hi_n, N]
+            r0 = b * k * Mc
+            # part rows are (f, c_local, hi); the accumulator layout is
+            # (f, c, hi) with the FULL 7-component c axis — each
+            # feature's chunk block lands at its own strided offset
+            for kk in range(k):
+                for f in range(m):
+                    src = (f * csz) * hi_n
+                    dst = r0 + kk * Mc + (f * 7 + c0) * hi_n
+                    sz = csz * hi_n
+                    out_ref[dst:dst + sz, :] = (
+                        out_ref[dst:dst + sz, :]
+                        + part[kk, src:src + sz, :])
+            c0 += csz
+
+
 def _seg_hist_kernel(sc_ref, arena_any, out_ref, in_buf, read_sems,
                      *, C: int, F: int,
                      n_blocks: int, k: int, m: int, lo_n: int, hi_n: int,
@@ -412,10 +660,6 @@ def _seg_hist_kernel(sc_ref, arena_any, out_ref, in_buf, read_sems,
     reconstructed exactly in the epilogue."""
     s, cnt = sc_ref[0], sc_ref[1]
     n_tiles = jax.lax.div(cnt + jnp.int32(tile - 1), jnp.int32(tile))
-    N = lo_n * m
-    Mc = 7 * hi_n * m
-    f_blk = k * m
-    chunks = _comp_chunks(hi_n, m)
 
     def read_dma(j, slot):
         src = pl.multiple_of(s + j * tile, 128)
@@ -440,54 +684,8 @@ def _seg_hist_kernel(sc_ref, arena_any, out_ref, in_buf, read_sems,
         block = in_buf[slot]                              # [C, T] bf16
         valid = (jax.lax.broadcasted_iota(jnp.int32, (1, tile), 1)
                  < (cnt - j * tile)).astype(jnp.bfloat16)
-        Fp = n_blocks * f_blk
-        # 7 payload planes: the 6 bf16 split planes of (g, h) plus count;
-        # masking by 0/1 keeps every entry a bf16-exact plane value
-        comps = [block[Fp + i:Fp + i + 1, :] * valid for i in range(6)]
-        comps.append(valid)
-        gh = jnp.concatenate(comps, axis=0)               # [7, T] bf16
-
-        for b in range(n_blocks):
-            bins = block[b * f_blk:(b + 1) * f_blk, :].astype(jnp.float32)
-            hi = jnp.floor(bins * (1.0 / lo_n))
-            lo = bins - hi * lo_n
-            hih = jnp.where(
-                hi.astype(jnp.int32)[:, None, :]
-                == jax.lax.broadcasted_iota(jnp.int32, (1, hi_n, 1), 1),
-                jnp.float32(1.0),
-                jnp.float32(0.0)).astype(jnp.bfloat16)    # [f_blk,hi_n,T]
-            loh = jnp.where(
-                lo.astype(jnp.int32)[:, None, :]
-                == jax.lax.broadcasted_iota(jnp.int32, (1, lo_n, 1), 1),
-                jnp.float32(1.0),
-                jnp.float32(0.0)).astype(jnp.bfloat16)    # [f_blk,lo_n,T]
-            rhs = loh.reshape(k, N, tile)
-            c0 = 0
-            for csz in chunks:
-                # lhs[g, (f, c, hi), t] = gh[c, t] * hihot[g*m + f, hi, t]
-                # NB: slice-then-reshape, never `[None, c0:c0+csz, None]`
-                # indexing — a partial slice mixed with newaxes lowers via
-                # lax.gather, which Mosaic rejects in this shape
-                ghc = gh[c0:c0 + csz, :].reshape(1, csz, 1, tile)
-                lhs = (ghc * hih.reshape(f_blk, 1, hi_n, tile)
-                       ).reshape(k, m * csz * hi_n, tile)
-                part = jax.lax.dot_general(
-                    lhs, rhs,
-                    dimension_numbers=(((2,), (2,)), ((0,), (0,))),
-                    preferred_element_type=jnp.float32)   # [k, m*csz*hi_n, N]
-                r0 = b * k * Mc
-                # part rows are (f, c_local, hi); the accumulator layout is
-                # (f, c, hi) with the FULL 7-component c axis — each
-                # feature's chunk block lands at its own strided offset
-                for kk in range(k):
-                    for f in range(m):
-                        src = (f * csz) * hi_n
-                        dst = r0 + kk * Mc + (f * 7 + c0) * hi_n
-                        sz = csz * hi_n
-                        out_ref[dst:dst + sz, :] = (
-                            out_ref[dst:dst + sz, :]
-                            + part[kk, src:src + sz, :])
-                c0 += csz
+        _radix_accumulate(out_ref, block, valid, n_blocks=n_blocks, k=k,
+                          m=m, lo_n=lo_n, hi_n=hi_n, tile=tile)
 
         @pl.when(j + 1 < n_tiles)
         def _():

@@ -1,0 +1,98 @@
+"""Per-phase timing — the TIMETAG analogue.
+
+The reference accumulates per-phase std::chrono durations in the tree
+learner and prints them at destruction (serial_tree_learner.cpp:15-42)
+plus per-iteration wall clock in GBDT::Train (gbdt.cpp:251-254).  On TPU
+the compute phases live inside ONE compiled lax.while_loop, so in-graph
+phase attribution is impossible from the host; the subsystem therefore
+has two halves:
+
+- this module: host-side phase accumulators around every dispatch the
+  driver makes (gradients / grow / drain / score / eval), with an
+  optional per-phase device sync so the numbers mean device time and
+  not dispatch time.  Enabled via Config.tpu_profile; report printed at
+  booster teardown (GBDT.__del__) or on demand via profile_report().
+- tools/phase_bench.py: standalone microbenchmarks of the device
+  kernels (partition / segment-histogram / split-scan / label recovery)
+  at real workload shapes — the in-loop attribution the host cannot see.
+
+jax.profiler traces: set Config.tpu_profile_trace_dir to wrap training
+in start_trace/stop_trace for TensorBoard-level analysis.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+from . import log
+
+
+class Profiler:
+    """Named wall-clock accumulators with optional device sync.
+
+    sync_fn, when provided, is called at phase exit before the clock
+    stops (a scalar device fetch), so asynchronously dispatched work is
+    charged to the phase that launched it.  Without it, phases measure
+    dispatch time only — still useful for host-overhead attribution.
+    """
+
+    def __init__(self, enabled: bool = False, sync_fn=None):
+        self.enabled = enabled
+        self.sync_fn = sync_fn
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, int] = {}
+        self._t0 = time.perf_counter()
+
+    @contextmanager
+    def phase(self, name: str):
+        if not self.enabled:
+            yield
+            return
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            if self.sync_fn is not None:
+                try:
+                    self.sync_fn()
+                except Exception:  # noqa: BLE001 — timing must not kill train
+                    pass
+            dt = time.perf_counter() - start
+            self.totals[name] = self.totals.get(name, 0.0) + dt
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self, header: str = "profile") -> Optional[str]:
+        if not self.enabled or not self.totals:
+            return None
+        wall = time.perf_counter() - self._t0
+        tracked = sum(self.totals.values())
+        lines = ["[%s] wall %.3fs, tracked %.3fs" % (header, wall, tracked)]
+        for name, total in sorted(self.totals.items(), key=lambda kv: -kv[1]):
+            c = self.counts[name]
+            lines.append("  %-24s %8.3fs  (%6d calls, %7.2f ms/call)"
+                         % (name, total, c, 1e3 * total / max(c, 1)))
+        text = "\n".join(lines)
+        log.info(text)
+        return text
+
+
+class TraceSession:
+    """jax.profiler trace wrapper keyed off Config.tpu_profile_trace_dir."""
+
+    def __init__(self, trace_dir: Optional[str]):
+        self.trace_dir = trace_dir or None
+        self._live = False
+
+    def start(self):
+        if self.trace_dir and not self._live:
+            import jax
+            jax.profiler.start_trace(self.trace_dir)
+            self._live = True
+
+    def stop(self):
+        if self._live:
+            import jax
+            jax.profiler.stop_trace()
+            self._live = False
+            log.info("[profile] jax trace written to %s", self.trace_dir)

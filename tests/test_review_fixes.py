@@ -169,10 +169,13 @@ class TestForcedSplitAbandonment:
 
 
 class TestEngineFallback:
-    def test_partition_failure_falls_back_to_label(self):
+    def test_partition_failure_falls_back_to_label(self, monkeypatch):
         """A lowering/runtime failure in the partition fast path must
         degrade to the label engine with a warning, not kill training
-        (the round-2 bench crash mode)."""
+        (the round-2 bench crash mode).  Both partition entries are
+        broken: the fused single-dispatch iteration AND the plain
+        per-tree grow."""
+        from lightgbm_tpu.ops import grow_partition as gp_mod
         rng = np.random.default_rng(0)
         X = rng.normal(size=(500, 6)).astype(np.float32)
         y = (X[:, 0] > 0).astype(np.float32)
@@ -187,6 +190,8 @@ class TestEngineFallback:
         g = bst._gbdt
         # the guard is only meaningful when the engine is actually active
         assert g._use_partition_engine, "partition engine not selected"
+        monkeypatch.setattr(gp_mod, "grow_tree_partition_impl", boom)
+        monkeypatch.setattr(gp_mod, "grow_tree_partition", boom)
         g._grow_partition = boom
         for _ in range(2):
             bst.update()

@@ -60,7 +60,7 @@ def main() -> int:
             bst = lgb.train(p, ds, num_boost_round=2)
             nt = bst.num_trees()
             assert nt >= 1, "no trees grew"
-            if name == "default":
+            if name == "default" and backend == "tpu":
                 assert bst._gbdt._use_partition_engine, (
                     "default config fell back off the partition engine")
             bst.predict(X[:256])

@@ -325,7 +325,9 @@ class GBDT:
                 self.models.append(None)
                 self._inflight.append(dict(
                     packed=packed, max_leaves=self.config.num_leaves,
-                    cat_bins=0, init_score=init_scores[0],
+                    cat_bins=(self.max_bin if self.is_categorical is not None
+                              else 0),
+                    init_score=init_scores[0],
                     has_trunc_flag=True, it=self.iter,
                     slot=len(self.models) - 1))
                 self.iter += 1
@@ -483,10 +485,11 @@ class GBDT:
             arrays, delta, arena, trunc = gp.grow_tree_partition_impl(
                 arena, bins_t, grad, hess, row0, fmask, num_bins,
                 default_bins, missing_types, sparams, monotone, penalty,
-                None, None,
+                None, None, self.is_categorical, self.train_state.bundle,
                 max_leaves=self.config.num_leaves,
                 max_depth=self.config.max_depth,
                 max_bin=self.max_bin, emit="score", full_bag=True,
+                max_cat_threshold=self.config.max_cat_threshold,
                 interpret=interpret)
             new_score = score_row + shrink * delta.astype(score_row.dtype)
             ivec, fvec = grow_ops.pack_tree_arrays(arrays)
@@ -500,7 +503,8 @@ class GBDT:
         arrays with the truncation flag appended (the _inflight payload)."""
         # the jitted fn bakes these in at trace time; rebuild if a
         # reset_parameter callback changed them mid-training
-        key = (self.config.num_leaves, self.config.max_depth, self.max_bin)
+        key = (self.config.num_leaves, self.config.max_depth, self.max_bin,
+               self.config.max_cat_threshold)
         if (getattr(self, "_fused_fn", None) is None
                 or getattr(self, "_fused_key", None) != key):
             self._fused_fn = self._build_fused_iter()
@@ -673,21 +677,21 @@ class GBDT:
         cfg = self.config
         eng = cfg.tpu_tree_engine
         eligible = (self._grower is None
-                    and self.is_categorical is None
                     and self.dtype == jnp.float32
                     and self.max_bin <= 256
                     and not self._forced_splits
-                    and self.train_set.bundle is None
                     and self.train_set.num_features > 0
                     and self.num_data < (1 << 24))
         if eng == "partition" and not eligible:
             log.warning("tpu_tree_engine=partition not applicable here "
-                        "(needs serial learner, f32, numerical features, "
-                        "max_bin<=256); using label engine")
+                        "(needs serial learner, f32, max_bin<=256, no "
+                        "forced splits); using label engine")
             eng = "label"
         from ..ops import partition_pallas as pp
-        C, cap = pp.arena_geometry(self.num_data,
-                                   self.train_set.num_features,
+        # the arena stores the (possibly EFB-bundled) GROUP columns
+        n_groups = (self.train_state.bins.shape[1]
+                    if self.train_set.num_features else 1)
+        C, cap = pp.arena_geometry(self.num_data, n_groups,
                                    cfg.tpu_arena_factor)
         hist_cache_bytes = (self.config.num_leaves
                             * max(self.train_set.num_features, 1)
@@ -730,11 +734,13 @@ class GBDT:
                     self.train_state.missing_types,
                     self.split_params, self.monotone, self.penalty,
                     self._cegb_coupled, cegb_used,
+                    self.is_categorical, self.train_state.bundle,
                     max_leaves=self.config.num_leaves,
                     max_depth=self.config.max_depth,
                     max_bin=self.max_bin,
                     emit=self._last_emit,
                     full_bag=self._bag_mask is None,
+                    max_cat_threshold=self.config.max_cat_threshold,
                     interpret=jax.default_backend() != "tpu")
                 if not getattr(self, "_partition_validated", False):
                     # force materialization once: async dispatch would

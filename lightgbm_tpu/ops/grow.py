@@ -48,6 +48,29 @@ class BundleMaps(NamedTuple):
     needs_fix: jnp.ndarray      # [F] bool default bin reconstructed at scan
 
 
+def unbundle_hist(hist, sum_g, sum_h, cnt, bundle: Optional[BundleMaps],
+                  default_bins):
+    """[G, B, 3] group histogram -> [F, B, 3] per-feature view.
+
+    Each feature's non-default bins are a gather from its group's bins;
+    bundled features' default-bin entries are reconstructed as leaf
+    totals minus the gathered sums (Dataset::FixHistogram,
+    dataset.cpp:928-949).  Identity without EFB.  Shared by the label
+    and partition engines — the two must stay math-identical."""
+    if bundle is None:
+        return hist
+    F = bundle.feat_col.shape[0]
+    flat = jnp.concatenate(
+        [hist.reshape(-1, 3), jnp.zeros((1, 3), hist.dtype)], axis=0)
+    hf = flat[bundle.unbundle_idx]                      # [F, B, 3]
+    tot = jnp.stack([jnp.asarray(sum_g, hist.dtype),
+                     jnp.asarray(sum_h, hist.dtype),
+                     jnp.asarray(cnt, hist.dtype)])
+    fix = tot[None, :] - jnp.sum(hf, axis=1)            # [F, 3]
+    upd = jnp.where(bundle.needs_fix[:, None], fix, 0.0)
+    return hf.at[jnp.arange(F), default_bins].add(upd)
+
+
 def feature_bin_of(bins, feat, default_bins, bundle: Optional[BundleMaps]):
     """[n] feature-bin values of `feat` from the (possibly bundled) bin
     matrix: identity without EFB; otherwise the group column decoded back
@@ -229,23 +252,7 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
         return h
 
     def unbundle(hist, sum_g, sum_h, cnt):
-        """[G, B, 3] group histogram -> [F, B, 3] per-feature view.
-
-        Each feature's non-default bins are a gather from its group's
-        bins; bundled features' default-bin entries are reconstructed as
-        leaf totals minus the gathered sums (Dataset::FixHistogram,
-        dataset.cpp:928-949).  Identity without EFB."""
-        if bundle is None:
-            return hist
-        flat = jnp.concatenate(
-            [hist.reshape(-1, 3), jnp.zeros((1, 3), hist.dtype)], axis=0)
-        hf = flat[bundle.unbundle_idx]                      # [F, B, 3]
-        tot = jnp.stack([jnp.asarray(sum_g, hist.dtype),
-                         jnp.asarray(sum_h, hist.dtype),
-                         jnp.asarray(cnt, hist.dtype)])
-        fix = tot[None, :] - jnp.sum(hf, axis=1)            # [F, 3]
-        upd = jnp.where(bundle.needs_fix[:, None], fix, 0.0)
-        return hf.at[jnp.arange(F), default_bins].add(upd)
+        return unbundle_hist(hist, sum_g, sum_h, cnt, bundle, default_bins)
 
     def _bounds(minc, maxc, nf):
         """Per-leaf scalar output bounds -> per-feature arrays for the

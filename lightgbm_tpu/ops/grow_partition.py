@@ -30,10 +30,11 @@ import jax
 import jax.numpy as jnp
 
 from . import partition_pallas as pp
-from .grow import (TreeArrays, _index_split, _stack_split,
-                   empty_tree)
+from .grow import (MISSING_NAN, MISSING_ZERO, BundleMaps, TreeArrays,
+                   _index_split, _stack_split, empty_tree)
 from .split import (K_MIN_SCORE, SplitParams, SplitResult,
-                    best_split_per_feature, select_best_feature)
+                    best_split_per_feature, best_split_per_feature_mixed,
+                    select_best_feature)
 
 ALLOC = pp.FLUSH_W         # allocation granularity (columns)
 
@@ -46,6 +47,8 @@ class PartState(NamedTuple):
     tree: TreeArrays
     arena: jnp.ndarray             # [C, cap] f32
     leaf_start: jnp.ndarray        # [L] int32 segment starts
+    leaf_local: jnp.ndarray        # [L] int32 LOCAL segment lengths (==
+    #   tree.leaf_count when serial; differs under data-parallel sharding)
     cursor: jnp.ndarray            # int32 bump cursor (256-aligned)
     hist_cache: jnp.ndarray        # [L, F, B, 3]
     split_cache: SplitResult
@@ -71,14 +74,29 @@ def grow_tree_partition_impl(
         penalty: Optional[jnp.ndarray] = None,
         cegb_coupled: Optional[jnp.ndarray] = None,
         cegb_used_init: Optional[jnp.ndarray] = None,
+        is_categorical: Optional[jnp.ndarray] = None,
+        bundle: Optional[BundleMaps] = None,
         *,
         max_leaves: int,
         max_depth: int = -1,
         max_bin: int,
         emit: str = "leaf_ids",
         full_bag: bool = False,
+        max_cat_threshold: int = 32,
+        axis_name: Optional[str] = None,
         interpret: bool = False):
     """Grow one leaf-wise tree.
+
+    bins_t holds the (possibly EFB-bundled) GROUP columns [G, n]; the
+    per-feature arrays (feature_mask/num_bins/...) address ORIGINAL
+    features and scans go through the bundle unbundling, exactly like the
+    label engine (Dataset::FixHistogram, dataset.cpp:928-949).
+
+    With axis_name (inside shard_map), rows are sharded per device: each
+    device runs its own arena over local rows while histograms are
+    psum'd, so split decisions are globally identical — the reference's
+    DataParallelTreeLearner schedule (data_parallel_tree_learner.cpp:
+    116-245) with the ReduceScatter/Allreduce pair collapsed into psum.
 
     Returns (TreeArrays, leaf_ids [n] int32, arena, truncated) — the arena
     scratch is returned so the caller can thread (and donate) it across
@@ -86,16 +104,17 @@ def grow_tree_partition_impl(
     iteration; `truncated` (bool scalar) reports growth stopped early by
     arena overflow so the driver can warn (raise tpu_arena_factor).
     """
-    F, n = bins_t.shape
+    G, n = bins_t.shape               # group (arena) columns
+    F = num_bins.shape[0]             # original features
     C, cap = arena_buf.shape
     if n >= (1 << 24):
         raise ValueError("partition engine supports n < 2^24 rows")
-    if C != pp.arena_channels(F):
+    if C != pp.arena_channels(G):
         raise ValueError("arena_buf channel dim mismatch")
     dtype = jnp.float32
-    Fp = pp.feature_channels(F)
+    Fp = pp.feature_channels(G)
     L = max_leaves
-    seg = partial(pp.segment_histogram, num_features=F, max_bin=max_bin,
+    seg = partial(pp.segment_histogram, num_features=G, max_bin=max_bin,
                   interpret=interpret)
     part = partial(pp.partition_segment, interpret=interpret)
 
@@ -104,8 +123,8 @@ def grow_tree_partition_impl(
     # split into bf16 planes (exact, see partition_pallas docstring) ------
     adt = pp.ARENA_DT
     chans = [bins_t.astype(adt)]
-    if Fp > F:
-        chans.append(jnp.zeros((Fp - F, n), adt))
+    if Fp > G:
+        chans.append(jnp.zeros((Fp - G, n), adt))
     chans += [c[None] for c in pp.split_f32(grad)]
     chans += [c[None] for c in pp.split_f32(hess)]
     chans += [c[None] for c in pp.split_rowid(jnp.arange(n, dtype=jnp.int32))]
@@ -135,7 +154,7 @@ def grow_tree_partition_impl(
         arena, counts0, root_hist_b = part(
             arena, pred0, jnp.int32(0), jnp.int32(n),
             jnp.int32(0), jnp.int32(oob_dst), hist_stream=0,
-            num_features=F, max_bin=max_bin)
+            num_features=G, max_bin=max_bin)
         root_c = counts0[0]
         cursor0 = jnp.int32(oob_dst + _align(n, pp.TILE))  # oob dump space
 
@@ -143,8 +162,17 @@ def grow_tree_partition_impl(
         root_hist = seg(arena, jnp.int32(0), root_c)
     else:
         root_hist = root_hist_b.astype(dtype)
+    root_c_local = root_c
+    if axis_name is not None:
+        # DP: one histogram allreduce; global sums/counts fall out of it
+        root_hist = jax.lax.psum(root_hist, axis_name)
+        root_c = jax.lax.psum(root_c, axis_name)
     root_g = jnp.sum(root_hist[0, :, 0])
     root_h = jnp.sum(root_hist[0, :, 1])
+
+    def unbundle(hist, sum_g, sum_h, cnt):
+        from .grow import unbundle_hist
+        return unbundle_hist(hist, sum_g, sum_h, cnt, bundle, default_bins)
 
     def leaf_best_split(hist, sum_g, sum_h, cnt, depth, used=None,
                         minc=None, maxc=None):
@@ -155,19 +183,32 @@ def grow_tree_partition_impl(
         if monotone is not None and minc is not None:
             mn = jnp.broadcast_to(jnp.asarray(minc, dtype), (F,))
             mx = jnp.broadcast_to(jnp.asarray(maxc, dtype), (F,))
-        pf = best_split_per_feature(hist, sum_g, sum_h, cnt, num_bins,
-                                    default_bins, missing_types, params,
-                                    monotone=monotone, penalty=penalty,
-                                    min_constraints=mn, max_constraints=mx,
-                                    feature_mask=feature_mask,
-                                    cegb_feature_penalty=cegb_pen)
+        hist = unbundle(hist, sum_g, sum_h, cnt)
+        if is_categorical is None:
+            pf = best_split_per_feature(hist, sum_g, sum_h, cnt, num_bins,
+                                        default_bins, missing_types, params,
+                                        monotone=monotone, penalty=penalty,
+                                        min_constraints=mn,
+                                        max_constraints=mx,
+                                        feature_mask=feature_mask,
+                                        cegb_feature_penalty=cegb_pen)
+        else:
+            pf = best_split_per_feature_mixed(
+                hist, sum_g, sum_h, cnt, num_bins, default_bins,
+                missing_types, is_categorical, params,
+                monotone=monotone, penalty=penalty,
+                feature_mask=feature_mask,
+                min_constraints=mn, max_constraints=mx,
+                cegb_feature_penalty=cegb_pen,
+                max_cat_threshold=max_cat_threshold)
         res = select_best_feature(pf)
         depth_ok = (max_depth <= 0) | (depth < max_depth)
         blocked = (res.feature < 0) | ~depth_ok
         return res._replace(gain=jnp.where(blocked, K_MIN_SCORE, res.gain),
                             feature=jnp.where(depth_ok, res.feature, -1))
 
-    tree = empty_tree(L, dtype, cat_bins=0)
+    tree = empty_tree(L, dtype,
+                      cat_bins=(max_bin if is_categorical is not None else 0))
     tree = tree._replace(leaf_count=tree.leaf_count.at[0].set(root_c))
     cegb_used0 = (cegb_used_init if cegb_used_init is not None
                   else jnp.zeros(F, bool))
@@ -188,7 +229,9 @@ def grow_tree_partition_impl(
 
     state = PartState(
         tree=tree, arena=arena,
-        leaf_start=jnp.zeros(L, jnp.int32), cursor=cursor0,
+        leaf_start=jnp.zeros(L, jnp.int32),
+        leaf_local=jnp.zeros(L, jnp.int32).at[0].set(root_c_local),
+        cursor=cursor0,
         hist_cache=hist_cache, split_cache=split_cache,
         done=jnp.asarray(False), cegb_used=cegb_used0,
         truncated=jnp.asarray(False),
@@ -218,34 +261,72 @@ def grow_tree_partition_impl(
 
         left_smaller = sp.left_count <= sp.right_count
         small_cnt = jnp.minimum(sp.left_count, sp.right_count)
-        need = _align(small_cnt, ALLOC)
 
+        s0 = state.leaf_start[best_leaf]
+        cntP_local = state.leaf_local[best_leaf]
         # bump-allocator overflow: stop growing this tree (the arena
         # budget covers balanced trees; pathological shapes truncate —
         # the flag is surfaced so the driver can warn the user to raise
-        # tpu_arena_factor)
-        overflow = (~no_split) & (state.cursor + need + pp.TILE > cap)
+        # tpu_arena_factor).  Serial: the smaller-child count is exact.
+        # Data-parallel: the LOCAL smaller-child size is only known after
+        # the kernel runs, so the bound is the local parent size; the
+        # flag is all-reduced so every shard truncates together.
+        if axis_name is None:
+            need_bound = _align(small_cnt, ALLOC)
+        else:
+            need_bound = _align(cntP_local, ALLOC)
+        overflow = (~no_split) & (state.cursor + need_bound + pp.TILE > cap)
+        if axis_name is not None:
+            overflow = jax.lax.psum(overflow.astype(jnp.int32),
+                                    axis_name) > 0
         no_split = no_split | overflow
 
-        s0 = state.leaf_start[best_leaf]
-        cntP = jnp.where(no_split, 0, tree.leaf_count[best_leaf])
+        cntP = jnp.where(no_split, 0, cntP_local)
         dstB = state.cursor
 
-        # the go-left decision (NumericalDecision, tree.h:429-465, with
-        # missing routed by default_left) is evaluated INSIDE the kernel —
-        # an XLA-side predicate would cost an O(cap) pass per split.
+        # the go-left decision is evaluated INSIDE the kernel via a
+        # [1, B] mask vector over arena bin values — built here to encode
+        # numerical threshold + missing direction (NumericalDecision,
+        # tree.h:429-465), categorical bitsets (CategoricalDecision,
+        # tree.h:259-273) and EFB bundle-local ranges uniformly.  An
+        # XLA-side per-row predicate would cost an O(cap) pass per split.
         # Stream A (in place over the parent) takes the LARGER child:
         # go_left XOR left_smaller == "row goes to the larger side".
-        decision = (feat, thr, sp.default_left.astype(jnp.int32),
-                    missing_types[feat], default_bins[feat],
-                    num_bins[feat] - 1, left_smaller.astype(jnp.int32))
+        bv = jnp.arange(256, dtype=jnp.int32)
+        if bundle is None:
+            chan = feat
+            fbin = bv
+        else:
+            chan = bundle.feat_col[feat]
+            inside = (bv >= bundle.feat_lo[feat]) & (bv < bundle.feat_hi[feat])
+            fbin = jnp.where(inside, bv - bundle.feat_shift[feat],
+                             default_bins[feat])
+        mt = missing_types[feat]
+        db = default_bins[feat]
+        mb = num_bins[feat] - 1
+        is_missing = ((mt == MISSING_ZERO) & (fbin == db)) | \
+                     ((mt == MISSING_NAN) & (fbin == mb))
+        go_left = jnp.where(is_missing, sp.default_left,
+                            fbin <= thr)
+        if is_categorical is not None:
+            cm = jnp.pad(sp.cat_mask.astype(bool),
+                         (0, 256 - sp.cat_mask.shape[0]))
+            go_left = jnp.where(is_categorical[feat],
+                                cm[jnp.clip(fbin, 0, 255)], go_left)
+        decision = (chan, go_left.astype(jnp.float32),
+                    left_smaller.astype(jnp.int32))
         # NOT fused with the histogram: a fused pass would accumulate the
         # masked histogram over the WHOLE parent stream (O(parent) radix
         # FLOPs); the separate kernel touches only the compacted smaller
         # child (O(small)) — measured faster despite the extra launch
         arena, counts = part(state.arena, pred_dummy, s0, cntP, s0, dstB,
                              decision=decision)
-        small_hist = seg(arena, dstB, jnp.where(no_split, 0, small_cnt))
+        small_hist = seg(arena, dstB,
+                         jnp.where(no_split, 0, counts[1]))
+        if axis_name is not None:
+            # DP: ONE collective per split — the smaller child's histogram
+            # allreduce; the sibling still comes from subtraction (§3.4.2)
+            small_hist = jax.lax.psum(small_hist, axis_name)
         parent_hist = state.hist_cache[best_leaf]
         large_hist = parent_hist - small_hist
         left_hist = jnp.where(left_smaller, small_hist, large_hist)
@@ -257,7 +338,11 @@ def grow_tree_partition_impl(
             jnp.where(left_smaller, dstB, s0))
         leaf_start = leaf_start.at[new_leaf].set(
             jnp.where(left_smaller, s0, dstB))
-        cursor = dstB + need
+        leaf_local = state.leaf_local.at[best_leaf].set(
+            jnp.where(left_smaller, counts[1], counts[0]))
+        leaf_local = leaf_local.at[new_leaf].set(
+            jnp.where(left_smaller, counts[0], counts[1]))
+        cursor = dstB + _align(counts[1], ALLOC)
 
         # -- tree bookkeeping (Tree::Split, tree.h:393-423) -------------
         parent_of = tree.leaf_parent[best_leaf]
@@ -271,7 +356,14 @@ def grow_tree_partition_impl(
             (parent_of >= 0) & ~was_left,
             tree.right_child.at[parent_of].set(node), tree.right_child)
         depth = tree.leaf_depth[best_leaf]
+        new_is_cat = tree.is_cat
+        new_cat_mask = tree.cat_mask
+        if is_categorical is not None:
+            new_is_cat = new_is_cat.at[node].set(is_categorical[feat])
+            new_cat_mask = new_cat_mask.at[node].set(sp.cat_mask)
         tree = tree._replace(
+            is_cat=new_is_cat,
+            cat_mask=new_cat_mask,
             split_feature=tree.split_feature.at[node].set(feat),
             threshold_bin=tree.threshold_bin.at[node].set(thr),
             default_left=tree.default_left.at[node].set(sp.default_left),
@@ -297,13 +389,14 @@ def grow_tree_partition_impl(
         )
 
         # monotone mid-constraint propagation (serial_tree_learner.cpp:
-        # 837-846): numerical splits only in this engine, so a monotone
-        # split always pins the shared boundary at mid
+        # 837-846); categorical splits never carry monotone constraints
         minP, maxP = state.leaf_min[best_leaf], state.leaf_max[best_leaf]
         minL, maxL, minR, maxR = minP, maxP, minP, maxP
         leaf_min, leaf_max = state.leaf_min, state.leaf_max
         if monotone is not None:
             mono_t = monotone[feat].astype(jnp.int32)
+            if is_categorical is not None:
+                mono_t = jnp.where(is_categorical[feat], 0, mono_t)
             mid = ((sp.left_output + sp.right_output) / 2).astype(dtype)
             maxL = jnp.where(mono_t > 0, mid, maxP)
             minR = jnp.where(mono_t > 0, mid, minP)
@@ -346,6 +439,7 @@ def grow_tree_partition_impl(
         return PartState(
             tree=tree, arena=arena,
             leaf_start=sel(state.leaf_start, leaf_start),
+            leaf_local=sel(state.leaf_local, leaf_local),
             cursor=sel(state.cursor, cursor),
             hist_cache=sel(state.hist_cache, hist_cache),
             split_cache=split_cache,
@@ -366,8 +460,8 @@ def grow_tree_partition_impl(
     vals = (tree.leaf_value.astype(jnp.float32) if emit == "score"
             else jnp.arange(L, dtype=jnp.int32).astype(jnp.float32))
     stream, used = pp.compact_segments(
-        state.arena, state.leaf_start, tree.leaf_count, vals,
-        tree.num_leaves, n, F, capn, interpret=interpret)
+        state.arena, state.leaf_start, state.leaf_local, vals,
+        tree.num_leaves, n, G, capn, interpret=interpret)
     # positions >= used are never written by the kernel (garbage, not
     # dummy) — mask them to the dummy rowid before the scatter
     written = jnp.arange(capn, dtype=jnp.int32) < used[0]
@@ -385,5 +479,6 @@ def grow_tree_partition_impl(
 
 
 grow_tree_partition = partial(jax.jit, static_argnames=(
-    "max_leaves", "max_depth", "max_bin", "emit", "full_bag", "interpret"),
+    "max_leaves", "max_depth", "max_bin", "emit", "full_bag",
+    "max_cat_threshold", "axis_name", "interpret"),
     donate_argnums=(0,))(grow_tree_partition_impl)

@@ -153,21 +153,21 @@ def _compact_subblock(block_k, prefix_k, pred_k, fill):
     return comp.astype(ARENA_DT)
 
 
-def _partition_kernel(sc_ref, feat_onehot_ref, arena_any, pred_any,
+def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
                       out_any, cnt_ref, *rest,
                       C: int, tile: int, hist_plan=None):
-    """sc_ref (SMEM [11] i32): start, cnt, dstA, dstB, mode, thr, dl, mt,
-    db, mb, xr — start, dstA and dstB must be multiples of `tile` resp.
-    FLUSH_W (the bump allocator aligns).
-    arena_any/out_any: [C, cap] f32 in HBM, aliased (same buffer).
-    Routing: mode=0 reads pred_any ([1, cap] f32, 1.0 -> stream A); mode=1
-    computes the split decision in-kernel — the feature row is extracted
-    with a one-hot matvec (feat_onehot_ref [1, C], bins < 256 are
-    bf16-exact) and a row goes to stream A when the reference's
-    NumericalDecision (tree.h:429-465) XOR'd with dl says "larger child":
-    dl is the node's default_left, xr is XOR'd in (1 when the left child
-    is the smaller/bump-allocated side), and missing bins are identified
-    via mt (missing type), db (default bin), mb (last bin).
+    """sc_ref (SMEM [7] i32): start, cnt, dstA, dstB, mode, xr, hs —
+    start, dstA and dstB must be multiples of `tile` resp. FLUSH_W (the
+    bump allocator aligns).
+    arena_any/out_any: [C, cap] bf16 in HBM, aliased (same buffer).
+    Routing: mode=0 reads pred_any ([1, cap] f32, 1.0 -> stream A);
+    mode=1 computes the split decision in-kernel — the feature row is
+    extracted with a one-hot matvec (feat_onehot_ref [1, C], bins < 256
+    are bf16-exact) and routed through mask_ref ([1, 256] bf16 0/1:
+    mask[v] == 1 -> arena value v goes left), XOR'd with xr (1 when the
+    left child is the smaller/bump-allocated stream-B side).  The caller
+    bakes ALL decision semantics (numerical threshold, missing
+    direction, categorical bitsets, EFB ranges) into the mask.
     cnt_ref (SMEM out [2] i32): rows written to A and B.
 
     Each SUB-lane sub-block is compacted with an MXU permutation matmul
@@ -193,11 +193,10 @@ def _partition_kernel(sc_ref, feat_onehot_ref, arena_any, pred_any,
         hist_ref[:] = jnp.zeros_like(hist_ref)
     s, cnt = sc_ref[0], sc_ref[1]
     dstA, dstB = sc_ref[2], sc_ref[3]
-    mode, thr = sc_ref[4], sc_ref[5]
-    dl, mt, db, mb = sc_ref[6], sc_ref[7], sc_ref[8], sc_ref[9]
-    xr = sc_ref[10]   # XOR'd into the decision: 1 when the left child is
+    mode = sc_ref[4]
+    xr = sc_ref[5]    # XOR'd into the decision: 1 when the left child is
     #                   the smaller (stream-B) side
-    hs = sc_ref[11]   # fused-histogram stream: 1 -> B, 0 -> A
+    hs = sc_ref[6]    # fused-histogram stream: 1 -> B, 0 -> A
     n_tiles = jax.lax.div(cnt + jnp.int32(tile - 1), jnp.int32(tile))
     K = tile // SUB
     lane_w = jax.lax.broadcasted_iota(jnp.int32, (C, CARRY_W), 1)
@@ -273,16 +272,24 @@ def _partition_kernel(sc_ref, feat_onehot_ref, arena_any, pred_any,
         valid = jax.lax.broadcasted_iota(
             jnp.int32, (1, tile), 1) < (cnt - j * tile)
         block = in_buf[slot]
-        # in-kernel split decision (mode 1): feature row via one-hot
-        # matvec, then pure f32 arithmetic (scalar-broadcast bool selects
-        # crash the Mosaic compiler)
+        # in-kernel split decision (mode 1): the arena column is read with
+        # a one-hot matvec over channels, then routed through the go-left
+        # MASK VECTOR (mask_ref [1, MB]: mask[v] == 1 -> bin value v goes
+        # left).  The mask is built in XLA per split and encodes ALL
+        # decision semantics — numerical threshold + missing direction
+        # (NumericalDecision, tree.h:429-465), categorical bitsets
+        # (CategoricalDecision, tree.h:259-273) and EFB bundle-local bin
+        # ranges — so the kernel needs no per-kind logic.
         col = jnp.round(jax.lax.dot(feat_onehot_ref[:], block,
                                     preferred_element_type=jnp.float32)
                         ).astype(jnp.int32)                   # [1, T]
-        f = lambda c: jnp.where(c, jnp.float32(1.0), jnp.float32(0.0))
-        missing_f = f(((mt == 1) & (col == db)) | ((mt == 2) & (col == mb)))
-        dl_f = jnp.float32(dl)
-        go_left_f = missing_f * dl_f + (1.0 - missing_f) * f(col <= thr)
+        MB = mask_ref.shape[1]
+        col_onehot = jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, (MB, tile), 0)
+            == col.reshape(1, tile),
+            jnp.float32(1.0), jnp.float32(0.0)).astype(jnp.bfloat16)
+        go_left_f = jax.lax.dot(mask_ref[:], col_onehot,
+                                preferred_element_type=jnp.float32)
         xr_f = jnp.float32(xr)
         decide_f = go_left_f + xr_f - 2.0 * go_left_f * xr_f   # xor
         mode_f = jnp.float32(mode)
@@ -372,14 +379,16 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
     dstB (must not overlap [start, start+cnt+tile)).
 
     Routing: by `pred` ([1, cap] f32, 1.0 -> A) when decision is None,
-    else by the in-kernel split decision — decision = (feat_channel, thr,
-    default_left, missing_type, default_bin, max_bin_idx, xor_flag)
-    scalars; pred is then ignored (pass any [1, cap] array).
+    else by the in-kernel split decision — decision = (feat_channel,
+    goleft_mask [MB] 0/1, xor_flag): a row whose arena value on the
+    feature channel is v follows goleft_mask[v] (XOR xor_flag); the mask
+    encodes numerical/missing/categorical/EFB semantics uniformly.  pred
+    is then ignored (pass a [1, tile] dummy).
 
     When hist_stream is given (0 -> stream A, 1 -> stream B; requires
     num_features/max_bin), the kernel also accumulates that stream's
     [F, max_bin, 3] histogram in the same pass and returns it third —
-    the per-split partition + smaller-child histogram fusion.
+    the partition + histogram fusion (used for the bagging root pass).
 
     Returns (new_arena, counts[2] int32[, hist]).  Writes stay within
     align(count, FLUSH_W) columns of each stream's dst; reads overrun the
@@ -387,15 +396,20 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
     """
     C, cap = arena.shape
     z = jnp.int32(0)
+    MB = 256   # mask lane width (any bin value < 256 fits)
     if decision is None:
-        tail = [z] * 7
+        tail = [z, z]
         feat_onehot = jnp.zeros((1, C), ARENA_DT)
+        goleft = jnp.zeros((1, MB), ARENA_DT)
     else:
-        feat, thr, dlft, mt, db, mb, xr = [
-            jnp.asarray(v, jnp.int32) for v in decision]
-        tail = [jnp.int32(1), thr, dlft, mt, db, mb, xr]
+        feat, mask_vec, xr = decision
+        feat = jnp.asarray(feat, jnp.int32)
+        tail = [jnp.int32(1), jnp.asarray(xr, jnp.int32)]
         feat_onehot = (jnp.arange(C, dtype=jnp.int32)[None, :]
                        == feat).astype(ARENA_DT)
+        mv = jnp.asarray(mask_vec, jnp.float32).reshape(1, -1)
+        goleft = jnp.pad(mv, ((0, 0), (0, MB - mv.shape[1]))
+                         ).astype(ARENA_DT)
     with_hist = hist_stream is not None
     tail.append(jnp.asarray(hist_stream if with_hist else 0, jnp.int32))
     sc = jnp.stack([jnp.asarray(start), jnp.asarray(cnt),
@@ -423,6 +437,7 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
@@ -438,10 +453,10 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
-        input_output_aliases={2: 0},
+        input_output_aliases={3: 0},
         compiler_params=pltpu.CompilerParams(has_side_effects=True),
         interpret=interpret,
-    )(sc, feat_onehot, arena, pred)
+    )(sc, feat_onehot, goleft, arena, pred)
     if not with_hist:
         return outs[0], outs[1]
     hist = split_radix_epilogue(outs[2], n_blocks * k, m, hi_n=hi_n,

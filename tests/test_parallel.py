@@ -96,3 +96,65 @@ def test_voting_differs_only_in_election(rng):
     tp, _ = ParallelGrower("voting", 8, top_k=6)(*args, **kw)
     np.testing.assert_array_equal(np.asarray(ts.split_feature),
                                   np.asarray(tp.split_feature))
+
+
+def test_partition_engine_data_parallel(rng):
+    """The partition (arena) engine under shard_map with rows sharded:
+    psum'd histograms must reproduce the serial partition trees."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from lightgbm_tpu.ops import grow_partition as gp
+    from lightgbm_tpu.ops import partition_pallas as pp_mod
+    from lightgbm_tpu.parallel.learners import AXIS
+
+    n, F, B = 1024, 6, 24
+    bins = rng.randint(0, B, (n, F)).astype(np.float32)
+    grad = rng.randn(n).astype(np.float32)
+    hess = (np.abs(rng.randn(n)) + 0.1).astype(np.float32)
+    row0 = jnp.zeros(n, jnp.int32)
+    fm = jnp.ones(F, bool)
+    nb = jnp.full(F, B, jnp.int32)
+    db = jnp.zeros(F, jnp.int32)
+    mt = jnp.zeros(F, jnp.int32)
+    params = SplitParams(min_data_in_leaf=5)
+    statics = dict(max_leaves=15, max_bin=B, emit="leaf_ids",
+                   full_bag=True, interpret=True)
+
+    # serial reference
+    C, cap = pp_mod.arena_geometry(n, F)
+    arena = jnp.zeros((C, cap), pp_mod.ARENA_DT)
+    ts, ls, _, _ = gp.grow_tree_partition(
+        arena, jnp.asarray(bins.T, pp_mod.ARENA_DT), jnp.asarray(grad),
+        jnp.asarray(hess), row0, fm, nb, db, mt, params, **statics)
+
+    # 8-way data parallel: rows sharded, one local arena per device
+    d = 8
+    n_loc = n // d
+    C2, cap_loc = pp_mod.arena_geometry(n_loc, F)
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:d]), (AXIS,))
+
+    def shard_fn(bins_t, g, h, r0):
+        arena_l = jnp.zeros((C2, cap_loc), pp_mod.ARENA_DT)
+        t, l, _, _ = gp.grow_tree_partition_impl(
+            arena_l, bins_t, g, h, r0, fm, nb, db, mt, params,
+            axis_name=AXIS, **statics)
+        return t, l
+
+    fn = jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(), P(AXIS)), check_vma=False))
+    tp, lp = fn(jnp.asarray(bins.T, pp_mod.ARENA_DT), jnp.asarray(grad),
+                jnp.asarray(hess), row0)
+
+    assert int(ts.num_leaves) == int(tp.num_leaves)
+    np.testing.assert_array_equal(np.asarray(ts.split_feature),
+                                  np.asarray(tp.split_feature))
+    np.testing.assert_array_equal(np.asarray(ts.threshold_bin),
+                                  np.asarray(tp.threshold_bin))
+    np.testing.assert_array_equal(np.asarray(ls), np.asarray(lp))
+    np.testing.assert_allclose(np.asarray(ts.leaf_value),
+                               np.asarray(tp.leaf_value),
+                               rtol=1e-3, atol=1e-5)

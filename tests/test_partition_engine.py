@@ -192,3 +192,80 @@ def test_deferred_stop_matches_eager(rng):
         preds[eng] = bst.predict(X)
         assert bst.num_trees() <= 1
     np.testing.assert_allclose(preds["label"], preds["partition"], rtol=1e-6)
+
+
+def _model_structure(bst):
+    """(feature, threshold, count, kind) tuples in DFS order — the
+    float-noise-free skeleton both engines must agree on."""
+    out = []
+
+    def walk(nd):
+        if "leaf_value" in nd:
+            out.append(("leaf", nd["leaf_count"]))
+        else:
+            out.append((nd["split_feature"], str(nd.get("threshold")),
+                        nd["internal_count"], nd["decision_type"]))
+            walk(nd["left_child"])
+            walk(nd["right_child"])
+
+    for t in bst.dump_model()["tree_info"]:
+        walk(t["tree_structure"])
+    return out
+
+
+def _train_both(X, y, extra=None, rounds=3, **ds_kw):
+    import lightgbm_tpu as lgb
+    outs = {}
+    for eng in ("partition", "label"):
+        ds = lgb.Dataset(X, label=y, **ds_kw)
+        p = {"objective": "binary", "num_leaves": 8, "verbose": -1,
+             "min_data_in_leaf": 20, "tpu_tree_engine": eng}
+        p.update(extra or {})
+        bst = lgb.train(p, ds, num_boost_round=rounds)
+        assert (bst._gbdt._use_partition_engine == (eng == "partition")), eng
+        outs[eng] = _model_structure(bst)
+    return outs
+
+
+def test_categorical_parity():
+    """Partition engine handles categorical (bitset) splits via the
+    go-left mask decision; trees must match the label engine."""
+    rng = np.random.RandomState(3)
+    n = 3000
+    Xn = rng.randn(n, 4).astype(np.float32)
+    cat = rng.randint(0, 12, n)
+    # noisy target: pure leaves would leave only ~0-gain tie splits,
+    # which the engines break differently (both validly)
+    flip = rng.rand(n) < 0.2
+    y = (((Xn[:, 0] > 0).astype(int) ^ (cat % 3 == 1) ^ flip)
+         .astype(np.float32))
+    X = np.column_stack([Xn, cat.astype(np.float32)])
+    outs = _train_both(X, y, categorical_feature=[4])
+    assert any(k[3] == "==" for k in outs["label"] if len(k) == 4), \
+        "test setup: no categorical split chosen"
+    assert outs["partition"] == outs["label"]
+
+
+def test_efb_bundle_parity():
+    """EFB-bundled datasets run on the partition engine through the
+    bundle-aware mask build + unbundled scans."""
+    rng = np.random.RandomState(5)
+    n = 4000
+    dense = rng.randn(n, 3).astype(np.float32)
+    # mutually exclusive one-hot-ish columns -> EFB bundles them
+    group = rng.randint(0, 4, n)
+    onehots = np.zeros((n, 4), np.float32)
+    # constant nonzero value: keeps each column at 2 bins so the bundle
+    # stays under the 256-bins-per-group cap
+    onehots[np.arange(n), group] = 1.0
+    X = np.column_stack([dense, onehots])
+    # noisy target — pure leaves would leave only ~0-gain tie splits,
+    # which the engines break differently (both validly)
+    flip = rng.rand(n) < 0.2
+    y = ((((dense[:, 0] + (group == 2)) > 0.5) ^ flip).astype(np.float32))
+    import lightgbm_tpu as lgb
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    assert ds._binned.bundle is not None, "test setup: EFB did not bundle"
+    outs = _train_both(X, y)
+    assert outs["partition"] == outs["label"]

@@ -81,14 +81,14 @@ def main():
     pred = jnp.ones((1, cap), jnp.float32)
     dstB = -(-n // pp.TILE) * pp.TILE
 
+    goleft = (jnp.arange(256) <= B // 2).astype(jnp.float32)
+
     def run_partition(cnt):
         nonlocal arena
         arena, counts = pp.partition_segment(
             arena, pred, jnp.int32(0), jnp.int32(cnt), jnp.int32(0),
             jnp.int32(dstB),
-            decision=(jnp.int32(0), jnp.int32(B // 2), jnp.int32(1),
-                      jnp.int32(0), jnp.int32(0), jnp.int32(B - 1),
-                      jnp.int32(0)),
+            decision=(jnp.int32(0), goleft, jnp.int32(0)),
             interpret=interp)
         return counts
 

@@ -1,14 +1,22 @@
-"""Headline benchmark: Higgs-shaped binary training throughput.
+"""Headline benchmarks: Higgs-shaped binary training + MSLR-shaped
+lambdarank, with quality floors.
 
-Reproduces the reference's Experiments.rst workload shape (HIGGS: 10.5M
+Workload 1 reproduces the reference's Experiments.rst HIGGS shape (10.5M
 rows x 28 dense numeric features, 500 iterations, num_leaves=255,
-learning_rate=0.1, max_bin=255 — docs/Experiments.rst:41-99) on synthetic
-data sized to the device, and reports end-to-end training throughput in
-rows*iterations/second against the reference's published 2x E5-2670v3
-wall-clock (238.505 s -> 22.01M rows*iter/s, docs/Experiments.rst:103-115).
+max_bin=255 — docs/Experiments.rst:41-99) on synthetic data sized to the
+device, and reports end-to-end training throughput in rows*iterations/s
+against the published 2x E5-2670v3 wall-clock (238.505 s -> 22.01M
+rows*iter/s, docs/Experiments.rst:103-115).  Workload 2 reproduces the
+MS LTR shape (ranked queries, lambdarank + ndcg@10,
+docs/Experiments.rst:137-144).
+
+Quality floors make a wrong-trees regression fail the bench instead of
+posting a good-looking throughput: held-out AUC for workload 1, NDCG@10
+for workload 2 (floors set ~5 rel-% under measured healthy values).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
+Exit code 1 when a quality floor is violated.
 """
 import json
 import sys
@@ -17,6 +25,156 @@ import time
 import numpy as np
 
 BASELINE_ROWS_ITER_PER_S = 10_500_000 * 500 / 238.505  # reference CPU Higgs
+AUC_FLOOR = 0.88          # measured ~0.945 on the synthetic task after 42 it
+NDCG10_FLOOR = 0.85       # measured ~0.92 on the synthetic ranking task
+
+
+def _auc(y, p):
+    order = np.argsort(p)
+    ranks = np.empty(len(p))
+    ranks[order] = np.arange(1, len(p) + 1)
+    pos = y > 0.5
+    np_, nn = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - np_ * (np_ + 1) / 2) / (np_ * nn)
+
+
+def _ndcg_at_k(labels, scores, qid, k=10):
+    out, cnt = 0.0, 0
+    start = 0
+    n = len(labels)
+    order_q = np.argsort(qid, kind="stable")
+    labels, scores, qid = labels[order_q], scores[order_q], qid[order_q]
+    while start < n:
+        end = start
+        while end < n and qid[end] == qid[start]:
+            end += 1
+        lab, sc = labels[start:end], scores[start:end]
+        if lab.max() > 0:
+            top = np.argsort(-sc, kind="stable")[:k]
+            gains = (2.0 ** lab[top] - 1) / np.log2(np.arange(2, len(top) + 2))
+            ideal = np.sort(lab)[::-1][:k]
+            idcg = ((2.0 ** ideal - 1)
+                    / np.log2(np.arange(2, len(ideal) + 2))).sum()
+            out += gains.sum() / idcg
+            cnt += 1
+        start = end
+    return out / max(cnt, 1)
+
+
+def _make_sync(jax, jnp):
+    # dispatch is async (and block_until_ready is unreliable through
+    # remote device attachments): force a device-side reduction to a
+    # scalar and fetch it
+    scalar = jax.jit(jnp.sum)
+
+    def sync(booster):
+        return float(scalar(booster._gbdt.train_state.score))
+
+    return sync
+
+
+def bench_higgs(lgb, sync, on_tpu):
+    n = 4_000_000 if on_tpu else 100_000
+    F = 28
+    timed_iters = 40 if on_tpu else 5
+    rng = np.random.RandomState(7)
+    n_hold = min(100_000, n // 4)
+
+    def gen(m, seed_rng):
+        Xg = seed_rng.randn(m, F).astype(np.float32)
+        return Xg
+
+    X = gen(n, rng)
+    w = rng.randn(F)
+
+    def label_of(Xg, seed_rng):
+        logits = Xg @ w * 0.5 + 0.8 * np.sin(Xg[:, 0] * 2) * Xg[:, 1]
+        return (logits + seed_rng.randn(len(Xg)) > 0).astype(np.float32)
+
+    y = label_of(X, rng)
+    # genuinely held out: drawn from the same distribution, never trained
+    Xh = gen(n_hold, rng)
+    yh = label_of(Xh, rng)
+
+    params = {
+        "objective": "binary", "num_leaves": 255, "learning_rate": 0.1,
+        "max_bin": 255, "min_data_in_leaf": 20, "verbose": -1,
+    }
+    ds = lgb.Dataset(X, y)
+    booster = lgb.train(params, ds, num_boost_round=2)   # warmup/compile
+    sync(booster)
+
+    t0 = time.perf_counter()
+    for _ in range(timed_iters):
+        booster.update()
+    sync(booster)
+    elapsed = time.perf_counter() - t0
+
+    auc = _auc(yh, booster.predict(Xh))
+    rows_iter_per_s = n * timed_iters / elapsed
+    return {
+        "throughput_mrows_iter_s": round(rows_iter_per_s / 1e6, 3),
+        "vs_baseline": round(rows_iter_per_s / BASELINE_ROWS_ITER_PER_S, 4),
+        "elapsed_s": round(elapsed, 3), "rows": n, "timed_iters": timed_iters,
+        "extrapolated_higgs_500iter_s": round(
+            10_500_000 * 500 / rows_iter_per_s, 1),
+        "holdout_auc": round(float(auc), 4),
+        "auc_floor": AUC_FLOOR,
+        "quality_ok": bool(auc >= AUC_FLOOR),
+    }
+
+
+def bench_lambdarank(lgb, sync, on_tpu):
+    """MSLR-WEB30K shape: ~120 docs/query, 137 features, graded 0-4
+    relevance (docs/Experiments.rst:34,137-144)."""
+    n_query = 8000 if on_tpu else 300
+    docs_per_q = 120
+    F = 137
+    n = n_query * docs_per_q
+    iters = 20 if on_tpu else 3
+    rng = np.random.RandomState(11)
+    X = rng.randn(n, F).astype(np.float32)
+    # sparse signal: learnable within the timed budget, so the NDCG floor
+    # actually separates healthy training from a wrong-trees regression
+    w = np.zeros(F)
+    w[:10] = rng.randn(10)
+    util = X @ w + 0.3 * rng.randn(n)
+    # graded relevance via per-query ranking of utility
+    qid = np.repeat(np.arange(n_query), docs_per_q)
+    labels = np.zeros(n, np.float32)
+    u2 = util.reshape(n_query, docs_per_q)
+    order = np.argsort(-u2, axis=1)
+    grades = [(2, 4), (6, 3), (15, 2), (40, 1)]   # top-k cutoffs -> grade
+    for qi in range(n_query):
+        prev = 0
+        lab_row = labels[qi * docs_per_q:(qi + 1) * docs_per_q]
+        for cut, g in grades:
+            lab_row[order[qi, prev:cut]] = g
+            prev = cut
+    group = np.full(n_query, docs_per_q)
+
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "num_leaves": 63, "learning_rate": 0.1, "verbose": -1,
+              "min_data_in_leaf": 20}
+    ds = lgb.Dataset(X, labels, group=group)
+    booster = lgb.train(params, ds, num_boost_round=2)   # warmup/compile
+    sync(booster)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        booster.update()
+    sync(booster)
+    elapsed = time.perf_counter() - t0
+    pred = booster.predict(X)
+    ndcg = _ndcg_at_k(labels, pred, qid, 10)
+    return {
+        "rows": n, "queries": n_query, "features": F, "iters": iters,
+        "train_s": round(elapsed, 3),
+        "throughput_mrows_iter_s": round(n * iters / elapsed / 1e6, 3),
+        "ndcg_at_10": round(float(ndcg), 4),
+        "ndcg_floor": NDCG10_FLOOR,
+        "quality_ok": bool(ndcg >= NDCG10_FLOOR),
+        "reference_mslr_ndcg10": 0.527371,   # docs/Experiments.rst:143
+    }
 
 
 def main():
@@ -27,65 +185,29 @@ def main():
     from lightgbm_tpu.utils import log as lgb_log
 
     lgb_log.set_level(-1)  # keep stdout to the single JSON line
-
-    @jax.jit
-    def _scalar(x):
-        return jnp.sum(x)
-
-    def sync(booster):
-        # dispatch is async (and block_until_ready is unreliable through
-        # remote device attachments): force a device-side reduction to a
-        # scalar and fetch it
-        return float(_scalar(booster._gbdt.train_state.score))
-
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
-    n = 4_000_000 if on_tpu else 100_000
-    F = 28
-    num_leaves = 255
-    warmup_iters = 2
-    timed_iters = 40 if on_tpu else 5
+    sync = _make_sync(jax, jnp)
 
-    rng = np.random.RandomState(7)
-    X = rng.randn(n, F).astype(np.float32)
-    # separable-ish synthetic target so trees have real structure to find
-    w = rng.randn(F)
-    logits = X @ w * 0.5 + 0.8 * np.sin(X[:, 0] * 2) * X[:, 1]
-    y = (logits + rng.randn(n) > 0).astype(np.float32)
+    higgs = bench_higgs(lgb, sync, on_tpu)
+    rank = bench_lambdarank(lgb, sync, on_tpu)
 
-    params = {
-        "objective": "binary", "metric": "binary_logloss",
-        "num_leaves": num_leaves, "learning_rate": 0.1, "max_bin": 255,
-        "min_data_in_leaf": 20, "verbose": -1,
-    }
-
-    ds = lgb.Dataset(X, y)
-    # warmup: dataset construction + first compiles
-    booster = lgb.train(params, ds, num_boost_round=warmup_iters)
-    sync(booster)
-
-    t0 = time.perf_counter()
-    for _ in range(timed_iters):
-        booster.update()
-    sync(booster)
-    elapsed = time.perf_counter() - t0
-
-    rows_iter_per_s = n * timed_iters / elapsed
+    ok = higgs["quality_ok"] and rank["quality_ok"]
     result = {
         "metric": "higgs_shape_binary_train_throughput",
-        "value": round(rows_iter_per_s / 1e6, 3),
+        "value": higgs["throughput_mrows_iter_s"],
         "unit": "Mrows*iter/s",
-        "vs_baseline": round(rows_iter_per_s / BASELINE_ROWS_ITER_PER_S, 4),
+        "vs_baseline": higgs["vs_baseline"],
         "detail": {
-            "backend": backend, "rows": n, "features": F,
-            "num_leaves": num_leaves, "timed_iters": timed_iters,
-            "elapsed_s": round(elapsed, 3),
-            "extrapolated_higgs_500iter_s": round(
-                10_500_000 * 500 / rows_iter_per_s, 1),
+            "backend": backend,
             "baseline_higgs_500iter_s": 238.505,
+            "higgs": higgs,
+            "lambdarank": rank,
+            "quality_ok": ok,
         },
     }
     print(json.dumps(result))
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -693,8 +693,7 @@ class GBDT:
                     if self.train_set.num_features else 1)
         C, cap = pp.arena_geometry(self.num_data, n_groups,
                                    cfg.tpu_arena_factor)
-        hist_cache_bytes = (self.config.num_leaves
-                            * max(self.train_set.num_features, 1)
+        hist_cache_bytes = (self.config.num_leaves * n_groups
                             * max(self.max_bin, 2) * 3 * 4)
         arena_bytes = (C * cap * 2 + self.num_data * C * 2
                        + hist_cache_bytes)      # bf16 arena + bins_t + hists

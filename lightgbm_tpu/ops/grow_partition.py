@@ -17,9 +17,12 @@ growing (a debug print fires; raise tpu_arena_factor) — the default
 arena budget covers a balanced 255-leaf tree, and the GBDT driver falls
 back to the label engine for configs that need full generality.
 
-Restrictions vs the label engine (the GBDT driver auto-selects): serial
-learner only (no collectives), f32 only, max_bin <= 256, no categorical
-splits yet, n < 2^24 (rowids ride three byte planes exactly).
+Supports categorical bitset splits, EFB-bundled datasets (both via the
+go-left mask decision) and data-parallel sharding (axis_name: psum'd
+histograms, local arenas).  Remaining restrictions vs the label engine
+(the GBDT driver auto-selects): f32 only, max_bin <= 256, no forced
+splits, n < 2^24 (rowids ride three byte planes exactly), serial or
+data-parallel only (feature-/voting-parallel use the label engine).
 """
 from __future__ import annotations
 

@@ -142,14 +142,17 @@ def LGBM_DatasetCreateFromFile(filename, parameters, reference, out):
     from .io.dataset import BinnedDataset
     path = _to_str(filename)
     params = _parse_params(parameters)
-    try:     # binary cache fast path (dataset_loader.cpp:267)
+    # binary cache fast path (dataset_loader.cpp:267): detect the npz
+    # container magic first so a corrupt/truncated binary file fails
+    # loudly HERE instead of surfacing as a confusing text-parse error
+    with open(path, "rb") as fh:
+        is_binary = fh.read(2) == b"PK"
+    if is_binary:
         binned = BinnedDataset.load_binary(path)
         ds = Dataset(None, params=params)
         ds._binned = binned
         _out(out).value = _new_handle(ds)
         return
-    except Exception:
-        pass
     from .config import Config
     from .io import loader as loader_mod
     cfg = Config(params)
@@ -360,11 +363,8 @@ def LGBM_BoosterGetEvalCounts(handle, out):
 @_wrap
 def LGBM_BoosterGetEvalNames(handle, out_len, out_strs):
     bst = _resolve(handle)
-    names = [m.name for m in bst._gbdt.train_metrics]
-    _out(out_len).value = len(names)
-    for i, name in enumerate(names):
-        ctypes.memmove(out_strs[i], name.encode("utf-8") + b"\0",
-                       len(name) + 1)
+    _write_strings([m.name for m in bst._gbdt.train_metrics],
+                   out_len, out_strs)
 
 
 def _eval_values(gbdt, data_idx: int):
@@ -384,10 +384,7 @@ def _aslist(v):
 def LGBM_BoosterGetEval(handle, data_idx, out_len, out_results):
     bst = _resolve(handle)
     vals = _eval_values(bst._gbdt, int(getattr(data_idx, "value", data_idx)))
-    _out(out_len).value = len(vals)
-    ptr = ctypes.cast(out_results, ctypes.POINTER(ctypes.c_double))
-    for i, v in enumerate(vals):
-        ptr[i] = float(v)
+    _write_doubles(vals, out_len, out_results)
 
 
 @_wrap
@@ -441,11 +438,7 @@ def LGBM_BoosterPredictForMat(handle, data, data_type, nrow, ncol,
     pred = np.asarray(_predict(
         bst, X, getattr(predict_type, "value", predict_type),
         getattr(num_iteration, "value", num_iteration)), np.float64)
-    flatp = pred.reshape(-1)
-    _out(out_len).value = len(flatp)
-    ptr = ctypes.cast(out_result, ctypes.POINTER(ctypes.c_double))
-    for i, v in enumerate(flatp):
-        ptr[i] = float(v)
+    _write_doubles(pred, out_len, out_result)
 
 
 @_wrap
@@ -466,11 +459,7 @@ def LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices, data,
     pred = np.asarray(_predict(
         bst, X, getattr(predict_type, "value", predict_type),
         getattr(num_iteration, "value", num_iteration)), np.float64)
-    flatp = pred.reshape(-1)
-    _out(out_len).value = len(flatp)
-    ptr = ctypes.cast(out_result, ctypes.POINTER(ctypes.c_double))
-    for i, v in enumerate(flatp):
-        ptr[i] = float(v)
+    _write_doubles(pred, out_len, out_result)
 
 
 @_wrap
@@ -518,3 +507,428 @@ def LGBM_NetworkInit(machines, local_listen_port, listen_time_out,
 @_wrap
 def LGBM_NetworkFree():
     pass
+
+
+def LGBM_SetLastError(msg):
+    """c_api.h LGBM_SetLastError."""
+    v = msg.value if hasattr(msg, "value") else msg
+    _last_error[0] = v if isinstance(v, bytes) else str(v).encode("utf-8")
+    return 0
+
+
+def _ival(v, default=0):
+    return int(getattr(v, "value", v) if v is not None else default)
+
+
+def _write_strings(names, out_len, out_strs):
+    _out(out_len).value = len(names)
+    # NB: indexing a (c_char_p * n) array yields a bytes COPY — cast to
+    # void-pointers so memmove hits the caller's buffers
+    ptrs = ctypes.cast(out_strs, ctypes.POINTER(ctypes.c_void_p))
+    for i, name in enumerate(names):
+        raw = name.encode("utf-8") + b"\0"
+        ctypes.memmove(ptrs[i], raw, len(raw))
+
+
+def _write_doubles(vals, out_len, out_result):
+    flat = np.ascontiguousarray(np.asarray(vals, np.float64).reshape(-1))
+    if out_len is not None:
+        _out(out_len).value = len(flat)
+    ctypes.memmove(ctypes.cast(out_result, ctypes.c_void_p),
+                   flat.ctypes.data, flat.nbytes)
+
+
+# --------------------------------------------------------------------- #
+# Dataset breadth (c_api.cpp:382-868)
+# --------------------------------------------------------------------- #
+@_wrap
+def LGBM_DatasetCreateFromMats(nmat, data_ptrs, data_type, nrows, ncol,
+                               is_row_major, parameters, reference, out):
+    nmat = _ival(nmat)
+    ncol = _ival(ncol)
+    code = _ival(data_type)
+    rm = _ival(is_row_major, 1)
+    mats = []
+    for i in range(nmat):
+        nr = int(nrows[i]) if hasattr(nrows, "__getitem__") else _ival(nrows)
+        flat = _as_np(data_ptrs[i], code, nr * ncol)
+        mats.append(flat.reshape(nr, ncol) if rm
+                    else flat.reshape(ncol, nr).T)
+    X = np.concatenate(mats, axis=0).astype(np.float64)
+    ds = Dataset(X, params=_parse_params(parameters))
+    _finish_dataset(ds, reference, out)
+
+
+@_wrap
+def LGBM_DatasetCreateFromSampledColumn(sample_data, sample_indices, ncol,
+                                        num_per_col, sample_cnt,
+                                        num_total_row, parameters, out):
+    """Streaming ingest entry (c_api.cpp:382-421): creates an empty
+    dataset expecting LGBM_DatasetPushRows.  Bin mappers are found at
+    construction from the pushed rows (the sample is only used for the
+    row-count contract here — with full data at hand the mappers are at
+    least as good as sample-derived ones)."""
+    ncol = _ival(ncol)
+    total = _ival(num_total_row)
+    ds = Dataset(np.zeros((total, ncol), np.float64),
+                 params=_parse_params(parameters))
+    ds._pushed_rows = 0
+    _out(out).value = _new_handle(ds)
+
+
+@_wrap
+def LGBM_DatasetCreateByReference(reference, num_total_row, out):
+    ref = _resolve(reference)
+    total = _ival(num_total_row)
+    ncol = (ref._binned.num_total_features if ref._binned is not None
+            else np.asarray(ref.data).shape[1])
+    ds = Dataset(np.zeros((total, ncol), np.float64), reference=ref)
+    ds._pushed_rows = 0
+    _out(out).value = _new_handle(ds)
+
+
+def _push_block(ds, X_block, start_row):
+    if ds._binned is not None:
+        raise _CApiError("cannot push rows into a constructed Dataset")
+    ds.data[start_row:start_row + len(X_block)] = X_block
+    ds._pushed_rows = max(getattr(ds, "_pushed_rows", 0),
+                          start_row + len(X_block))
+
+
+@_wrap
+def LGBM_DatasetPushRows(handle, data, data_type, nrow, ncol, start_row):
+    ds = _resolve(handle)
+    nrow, ncol = _ival(nrow), _ival(ncol)
+    flat = _as_np(data, _ival(data_type), nrow * ncol)
+    _push_block(ds, flat.reshape(nrow, ncol).astype(np.float64),
+                _ival(start_row))
+
+
+@_wrap
+def LGBM_DatasetPushRowsByCSR(handle, indptr, indptr_type, indices, data,
+                              data_type, nindptr, nelem, num_col, start_row):
+    ds = _resolve(handle)
+    nindptr, nelem = _ival(nindptr), _ival(nelem)
+    num_col = _ival(num_col)
+    ip = _as_np(indptr, _ival(indptr_type), nindptr)
+    idx = _as_np(indices, C_API_DTYPE_INT32, nelem)
+    vals = _as_np(data, _ival(data_type), nelem)
+    block = np.zeros((nindptr - 1, num_col), np.float64)
+    for r in range(nindptr - 1):
+        j0, j1 = int(ip[r]), int(ip[r + 1])
+        block[r, idx[j0:j1]] = vals[j0:j1]
+    _push_block(ds, block, _ival(start_row))
+
+
+@_wrap
+def LGBM_DatasetGetSubset(handle, used_row_indices, num_used_row_indices,
+                          parameters, out):
+    ds = _resolve(handle)
+    num = _ival(num_used_row_indices)
+    idx = _as_np(used_row_indices, C_API_DTYPE_INT32, num)
+    sub = ds.subset(np.asarray(idx, np.int64),
+                    params=_parse_params(parameters))
+    sub.construct()
+    _out(out).value = _new_handle(sub)
+
+
+@_wrap
+def LGBM_DatasetSetFeatureNames(handle, feature_names, num_feature_names):
+    ds = _resolve(handle)
+    num = _ival(num_feature_names)
+    names = []
+    for i in range(num):
+        v = feature_names[i]
+        names.append(v.decode("utf-8") if isinstance(v, bytes) else str(v))
+    ds.feature_name = names
+    if ds._binned is not None:
+        ds._binned.feature_names = list(names)
+
+
+@_wrap
+def LGBM_DatasetGetFeatureNames(handle, out_strs, out_len):
+    ds = _resolve(handle)
+    ds.construct()
+    _write_strings(list(ds.get_feature_name()), out_len, out_strs)
+
+
+@_wrap
+def LGBM_DatasetAddFeaturesFrom(target, source):
+    """Column-concatenate two unconstructed datasets (c_api.cpp
+    AddFeaturesFrom; Dataset::addFeaturesFrom)."""
+    t, s = _resolve(target), _resolve(source)
+    if t._binned is not None or s._binned is not None:
+        raise _CApiError("add_features_from requires unconstructed Datasets")
+    t.data = np.column_stack([np.asarray(t.data), np.asarray(s.data)])
+
+
+@_wrap
+def LGBM_DatasetAddDataFrom(target, source):
+    """Row-concatenate (Dataset::addDataFrom analogue)."""
+    t, s = _resolve(target), _resolve(source)
+    if t._binned is not None or s._binned is not None:
+        raise _CApiError("add_data_from requires unconstructed Datasets")
+    t.data = np.vstack([np.asarray(t.data), np.asarray(s.data)])
+    if t.label is not None and s.label is not None:
+        t.label = np.concatenate([np.asarray(t.label), np.asarray(s.label)])
+
+
+@_wrap
+def LGBM_DatasetConcatenate(handle1, handle2, parameters, out):
+    a, b = _resolve(handle1), _resolve(handle2)
+    X = np.vstack([np.asarray(a.data), np.asarray(b.data)])
+    lab = None
+    if a.label is not None and b.label is not None:
+        lab = np.concatenate([np.asarray(a.label), np.asarray(b.label)])
+    ds = Dataset(X, label=lab, params=_parse_params(parameters))
+    _out(out).value = _new_handle(ds)
+
+
+@_wrap
+def LGBM_DatasetUpdateParam(handle, parameters):
+    ds = _resolve(handle)
+    if ds._binned is not None:
+        log.warning("Dataset already constructed; new dataset parameters "
+                    "are ignored")
+        return
+    ds.params.update(_parse_params(parameters))
+
+
+@_wrap
+def LGBM_DatasetDumpText(handle, filename):
+    """Text dump of the BINNED matrix + labels (Dataset::DumpTextFile,
+    dataset.cpp): one row per line, tab-separated bin values."""
+    ds = _resolve(handle)
+    ds.construct()
+    b = ds._binned
+    with open(_to_str(filename), "w") as f:
+        f.write("num_data: %d\n" % b.num_data)
+        f.write("num_features: %d\n" % b.num_total_features)
+        if b.metadata.label is not None:
+            f.write("labels: %s\n" % " ".join(
+                "%g" % v for v in np.asarray(b.metadata.label)[:100]))
+        for r in range(min(b.num_data, 1000)):
+            f.write("\t".join(str(int(v)) for v in b.bins[r]) + "\n")
+
+
+# --------------------------------------------------------------------- #
+# Booster breadth (c_api.cpp:924-1380)
+# --------------------------------------------------------------------- #
+@_wrap
+def LGBM_BoosterMerge(handle, other_handle):
+    bst, other = _resolve(handle), _resolve(other_handle)
+    g = bst._gbdt
+    g._sync_model()
+    other._gbdt._sync_model()
+    g.models.extend(other._gbdt.models)
+    g.iter = len(g.models) // max(g.num_tree_per_iteration, 1)
+    g._model_gen = getattr(g, "_model_gen", 0) + 1
+    # keep the score<->models invariant: further boosting / eval / rollback
+    # must see the merged ensemble's contributions
+    g._rebuild_train_score()
+
+
+@_wrap
+def LGBM_BoosterResetTrainingData(handle, train_data):
+    bst = _resolve(handle)
+    ds = _resolve(train_data)
+    ds.construct()
+    g = bst._gbdt
+    g._sync_model()
+    models = g.models
+    g._setup_train(ds._binned)
+    g.models = models
+    g._rebuild_train_score()
+
+
+@_wrap
+def LGBM_BoosterResetParameter(handle, parameters):
+    from .config import Config
+    bst = _resolve(handle)
+    params = _parse_params(parameters)
+    g = bst._gbdt
+    g._sync_model()
+    merged = dict(bst.params or {})
+    merged.update(params)
+    bst.params = merged
+    g.config = Config(merged)
+    g.shrinkage_rate = g.config.learning_rate
+    g._refresh_split_params()   # growth reads split_params, not config
+    g._fused_fn = None     # statics may have changed; retrace lazily
+
+
+@_wrap
+def LGBM_BoosterNumberOfTotalModel(handle, out):
+    _out(out).value = _resolve(handle)._gbdt.num_trees()
+
+
+@_wrap
+def LGBM_BoosterNumModelPerIteration(handle, out):
+    _out(out).value = _resolve(handle)._gbdt.num_model_per_iteration()
+
+
+@_wrap
+def LGBM_BoosterGetNumFeature(handle, out):
+    _out(out).value = _resolve(handle)._gbdt.max_feature_idx + 1
+
+
+@_wrap
+def LGBM_BoosterGetFeatureNames(handle, out_len, out_strs):
+    _write_strings(list(_resolve(handle).feature_name()), out_len, out_strs)
+
+
+@_wrap
+def LGBM_BoosterFeatureImportance(handle, num_iteration, importance_type,
+                                  out_results):
+    bst = _resolve(handle)
+    itype = "split" if _ival(importance_type) == 0 else "gain"
+    imp = bst._gbdt.feature_importance(itype, _ival(num_iteration, -1))
+    _write_doubles(imp, None, out_results)
+
+
+@_wrap
+def LGBM_BoosterGetLeafValue(handle, tree_idx, leaf_idx, out):
+    g = _resolve(handle)._gbdt
+    g._sync_model()
+    tree = g.models[_ival(tree_idx)]
+    _out(out).value = float(tree.leaf_value[_ival(leaf_idx)])
+
+
+@_wrap
+def LGBM_BoosterSetLeafValue(handle, tree_idx, leaf_idx, val):
+    g = _resolve(handle)._gbdt
+    g._sync_model()
+    tree = g.models[_ival(tree_idx)]
+    tree.leaf_value[_ival(leaf_idx)] = float(getattr(val, "value", val))
+    g._model_gen = getattr(g, "_model_gen", 0) + 1
+
+
+@_wrap
+def LGBM_BoosterShuffleModels(handle, start_iter, end_iter):
+    g = _resolve(handle)._gbdt
+    g._sync_model()
+    k = max(g.num_tree_per_iteration, 1)
+    s = _ival(start_iter) * k
+    e = _ival(end_iter, 0) * k
+    if e <= 0 or e > len(g.models):
+        e = len(g.models)
+    seg = g.models[s:e]
+    np.random.RandomState(g.config.seed).shuffle(seg)
+    g.models[s:e] = seg
+    g._model_gen = getattr(g, "_model_gen", 0) + 1
+
+
+@_wrap
+def LGBM_BoosterUpdateOneIterCustom(handle, grad, hess, is_finished):
+    bst = _resolve(handle)
+    g = bst._gbdt
+    n = g.num_data * max(g.num_tree_per_iteration, 1)
+    gr = _as_np(grad, C_API_DTYPE_FLOAT32, n)
+    he = _as_np(hess, C_API_DTYPE_FLOAT32, n)
+    _out(is_finished).value = int(bool(
+        g.train_one_iter(np.asarray(gr, np.float64),
+                         np.asarray(he, np.float64))))
+
+
+@_wrap
+def LGBM_BoosterRefit(handle, leaf_preds, nrow, ncol):
+    g = _resolve(handle)._gbdt
+    nrow, ncol = _ival(nrow), _ival(ncol)
+    lp = _as_np(leaf_preds, C_API_DTYPE_INT32, nrow * ncol)
+    g.refit_with_leaf_preds(np.asarray(lp).reshape(nrow, ncol), nrow)
+
+
+@_wrap
+def LGBM_BoosterCalcNumPredict(handle, num_row, predict_type, num_iteration,
+                               out_len):
+    g = _resolve(handle)._gbdt
+    g._sync_model()
+    nrow = _ival(num_row)
+    pt = _ival(predict_type)
+    k = max(g.num_tree_per_iteration, 1)
+    total_iters = len(g.models) // k
+    ni = _ival(num_iteration, -1)
+    iters = total_iters if ni <= 0 else min(ni, total_iters)
+    if pt == C_API_PREDICT_LEAF_INDEX:
+        per_row = iters * k
+    elif pt == C_API_PREDICT_CONTRIB:
+        per_row = (g.max_feature_idx + 2) * k
+    else:
+        per_row = k
+    _out(out_len).value = nrow * per_row
+
+
+@_wrap
+def LGBM_BoosterGetPredict(handle, data_idx, out_len, out_result):
+    """Raw-ish predictions for the train (0) or a validation dataset —
+    the reference returns converted scores (GetPredictAt, gbdt.cpp:
+    585-620)."""
+    g = _resolve(handle)._gbdt
+    idx = _ival(data_idx)
+    state = g.train_state if idx == 0 else g.valid_states[idx - 1][1]
+    score = np.asarray(state.score, np.float64)     # [k, n] class-major
+    if score.shape[0] > 1:
+        raw = score.T                                # convert expects [n, k]
+        if g.objective is not None:
+            raw = np.asarray(g.objective.convert_output_multi(raw))
+        flat = raw.reshape(-1)                       # out[i*k + j] row-major
+    else:
+        flat = score[0]
+        if g.objective is not None:
+            import jax.numpy as jnp
+            flat = np.asarray(g.objective.convert_output(jnp.asarray(flat)))
+    _write_doubles(flat, out_len, out_result)
+
+
+@_wrap
+def LGBM_BoosterDumpModel(handle, start_iteration, num_iteration,
+                          buffer_len, out_len, out_str):
+    import json
+    bst = _resolve(handle)
+    d = bst.dump_model(num_iteration=_ival(num_iteration, -1))
+    raw = json.dumps(d, default=float).encode("utf-8") + b"\0"
+    _out(out_len).value = len(raw)
+    blen = _ival(buffer_len)
+    if out_str and blen >= len(raw):
+        ctypes.memmove(out_str, raw, len(raw))
+
+
+@_wrap
+def LGBM_BoosterPredictForCSC(handle, col_ptr, col_ptr_type, indices, data,
+                              data_type, ncol_ptr, nelem, num_row,
+                              predict_type, num_iteration, parameter,
+                              out_len, out_result):
+    import scipy.sparse as sp
+    bst = _resolve(handle)
+    ncol_ptr, nelem = _ival(ncol_ptr), _ival(nelem)
+    num_row = _ival(num_row)
+    cp = _as_np(col_ptr, _ival(col_ptr_type), ncol_ptr)
+    idx = _as_np(indices, C_API_DTYPE_INT32, nelem)
+    vals = _as_np(data, _ival(data_type), nelem)
+    X = sp.csc_matrix((vals, idx, cp), shape=(num_row, ncol_ptr - 1)).tocsr()
+    pred = np.asarray(_predict(bst, X, _ival(predict_type),
+                               _ival(num_iteration, -1)), np.float64)
+    _write_doubles(pred, out_len, out_result)
+
+
+@_wrap
+def LGBM_BoosterPredictForMats(handle, data_ptrs, data_type, nrow, ncol,
+                               predict_type, num_iteration, parameter,
+                               out_len, out_result):
+    bst = _resolve(handle)
+    nrow, ncol = _ival(nrow), _ival(ncol)
+    code = _ival(data_type)
+    rows = [np.asarray(_as_np(data_ptrs[i], code, ncol), np.float64)
+            for i in range(nrow)]
+    X = np.stack(rows, axis=0)
+    pred = np.asarray(_predict(bst, X, _ival(predict_type),
+                               _ival(num_iteration, -1)), np.float64)
+    _write_doubles(pred, out_len, out_result)
+
+
+@_wrap
+def LGBM_NetworkInitWithFunctions(num_machines, rank, reduce_scatter_ext_fun,
+                                  allgather_ext_fun):
+    log.warning("LGBM_NetworkInitWithFunctions is a no-op: distributed "
+                "training uses the JAX device mesh (parallel/learners.py); "
+                "external collective injection is not required")

@@ -173,18 +173,7 @@ class GBDT:
         self.max_bin = self.train_state.hist_max_bin
         F = max(train_set.num_features, 1)
         self._feature_mask_all = jnp.ones(F, bool)
-        self.split_params = SplitParams(
-            lambda_l1=self.config.lambda_l1, lambda_l2=self.config.lambda_l2,
-            max_delta_step=self.config.max_delta_step,
-            min_data_in_leaf=self.config.min_data_in_leaf,
-            min_sum_hessian_in_leaf=self.config.min_sum_hessian_in_leaf,
-            min_gain_to_split=self.config.min_gain_to_split,
-            max_cat_to_onehot=self.config.max_cat_to_onehot,
-            cat_smooth=self.config.cat_smooth,
-            cat_l2=self.config.cat_l2,
-            min_data_per_group=self.config.min_data_per_group,
-            cegb_split_penalty=(self.config.cegb_tradeoff
-                                * self.config.cegb_penalty_split))
+        self._refresh_split_params()
         # [F] bin-type vector; None when the dataset is purely numerical so
         # the grow loop skips the categorical scan entirely
         cat_flags = np.array([m.bin_type == 1 for m in train_set.bin_mappers],
@@ -229,6 +218,22 @@ class GBDT:
         # custom-fobj training also starts from them
         if train_set.metadata.init_score is not None:
             self._apply_init_scores()
+
+    def _refresh_split_params(self) -> None:
+        """(Re)build the growth-time parameter record from config — must
+        be called whenever config changes mid-training (reset_parameter)."""
+        self.split_params = SplitParams(
+            lambda_l1=self.config.lambda_l1, lambda_l2=self.config.lambda_l2,
+            max_delta_step=self.config.max_delta_step,
+            min_data_in_leaf=self.config.min_data_in_leaf,
+            min_sum_hessian_in_leaf=self.config.min_sum_hessian_in_leaf,
+            min_gain_to_split=self.config.min_gain_to_split,
+            max_cat_to_onehot=self.config.max_cat_to_onehot,
+            cat_smooth=self.config.cat_smooth,
+            cat_l2=self.config.cat_l2,
+            min_data_per_group=self.config.min_data_per_group,
+            cegb_split_penalty=(self.config.cegb_tradeoff
+                                * self.config.cegb_penalty_split))
 
     def add_valid(self, name: str, valid_set: BinnedDataset,
                   metrics: Sequence[Metric]) -> None:
@@ -1184,14 +1189,10 @@ class GBDT:
         SerialTreeLearner::FitByExistingTree, serial_tree_learner.cpp:235-265).
         """
         self._sync_model()
-        # leaf values mutate in place: invalidate the device ensemble
-        self._model_gen = getattr(self, "_model_gen", 0) + 1
         from ..io.metadata import Metadata
-        from ..ops.split import calculate_splitted_leaf_output
 
         X = _dense_matrix(X)
         n = len(X)
-        k = max(self.num_tree_per_iteration, 1)
         if self.objective is None:
             log.fatal("Cannot refit without an objective")
         meta = Metadata(n)
@@ -1205,6 +1206,16 @@ class GBDT:
         leaf_preds = np.column_stack([
             t.predict_leaf_index(X) if t.num_leaves > 1
             else np.zeros(n, np.int32) for t in self.models])
+        self.refit_with_leaf_preds(leaf_preds, n)
+
+    def refit_with_leaf_preds(self, leaf_preds: np.ndarray, n: int) -> None:
+        """Renew leaf values from a precomputed [n, num_models] row->leaf
+        map (the LGBM_BoosterRefit entry, c_api.cpp) against the
+        objective's current labels."""
+        from ..ops.split import calculate_splitted_leaf_output
+        self._sync_model()
+        self._model_gen = getattr(self, "_model_gen", 0) + 1
+        k = max(self.num_tree_per_iteration, 1)
         cfg = self.config
         decay = cfg.refit_decay_rate
         score = jnp.zeros((k, n), self.dtype)

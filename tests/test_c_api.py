@@ -170,3 +170,174 @@ def test_booster_train_eval_save_predict(tmp_path):
     from sklearn.metrics import roc_auc_score
     assert roc_auc_score(label, vals) > 0.78
     LIB.LGBM_BoosterFree(booster2)
+
+
+def _mat_dataset(rng, n=400, f=6, label=True, params="max_bin=31"):
+    X = rng.rand(n, f)
+    h = ctypes.c_void_p()
+    flat = np.ascontiguousarray(X.reshape(-1))
+    assert LIB.LGBM_DatasetCreateFromMat(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)), 1, n, f, 1,
+        c_str(params), None, ctypes.byref(h)) == 0
+    if label:
+        y = (X[:, 0] > 0.5).astype(np.float32)
+        assert LIB.LGBM_DatasetSetField(
+            h, c_str("label"), c_array(ctypes.c_float, y), n, 0) == 0
+    return h, X
+
+
+def test_streaming_push_rows(rng):
+    n, f = 300, 5
+    h = ctypes.c_void_p()
+    assert LIB.LGBM_DatasetCreateFromSampledColumn(
+        None, None, f, None, 50, n, c_str("max_bin=15"),
+        ctypes.byref(h)) == 0
+    X = rng.rand(n, f)
+    half = n // 2
+    for start, block in ((0, X[:half]), (half, X[half:])):
+        flat = np.ascontiguousarray(block.reshape(-1))
+        assert LIB.LGBM_DatasetPushRows(
+            h, flat.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)), 1,
+            len(block), f, start) == 0
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    assert LIB.LGBM_DatasetSetField(
+        h, c_str("label"), c_array(ctypes.c_float, y), n, 0) == 0
+    nd = ctypes.c_long()
+    assert LIB.LGBM_DatasetGetNumData(h, ctypes.byref(nd)) == 0
+    assert nd.value == n
+    # pushed rows must train
+    bst = ctypes.c_void_p()
+    assert LIB.LGBM_BoosterCreate(
+        h, c_str("objective=binary verbose=-1 min_data_in_leaf=5"),
+        ctypes.byref(bst)) == 0
+    fin = ctypes.c_int()
+    assert LIB.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+
+
+def test_push_rows_by_csr(rng):
+    from scipy import sparse
+    n, f = 200, 6
+    X = (rng.rand(n, f) * (rng.rand(n, f) > 0.5)).astype(np.float64)
+    csr = sparse.csr_matrix(X)
+    h = ctypes.c_void_p()
+    assert LIB.LGBM_DatasetCreateFromSampledColumn(
+        None, None, f, None, 50, n, c_str("max_bin=15"),
+        ctypes.byref(h)) == 0
+    assert LIB.LGBM_DatasetPushRowsByCSR(
+        h, c_array(ctypes.c_int, csr.indptr), 2,
+        c_array(ctypes.c_int, csr.indices),
+        csr.data.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)), 1,
+        len(csr.indptr), len(csr.data), f, 0) == 0
+    ds = LIB._resolve(h)
+    np.testing.assert_allclose(np.asarray(ds.data), X)
+
+
+def test_subset_and_feature_names(rng):
+    h, X = _mat_dataset(rng)
+    names = (ctypes.c_char_p * 6)(*[("f%d" % i).encode() for i in range(6)])
+    assert LIB.LGBM_DatasetSetFeatureNames(h, names, 6) == 0
+    idx = np.arange(0, 100, dtype=np.int32)
+    sub = ctypes.c_void_p()
+    assert LIB.LGBM_DatasetGetSubset(
+        h, c_array(ctypes.c_int32, idx), len(idx), c_str(""),
+        ctypes.byref(sub)) == 0
+    nd = ctypes.c_long()
+    assert LIB.LGBM_DatasetGetNumData(sub, ctypes.byref(nd)) == 0
+    assert nd.value == 100
+    bufs = [ctypes.create_string_buffer(64) for _ in range(6)]
+    arr = (ctypes.c_char_p * 6)(*[ctypes.cast(b, ctypes.c_char_p)
+                                  for b in bufs])
+    out_len = ctypes.c_int()
+    assert LIB.LGBM_DatasetGetFeatureNames(
+        h, arr, ctypes.byref(out_len)) == 0
+    assert out_len.value == 6 and bufs[0].value == b"f0"
+
+
+def test_booster_breadth(rng):
+    h, X = _mat_dataset(rng)
+    bst = ctypes.c_void_p()
+    assert LIB.LGBM_BoosterCreate(
+        h, c_str("objective=binary verbose=-1 min_data_in_leaf=5"),
+        ctypes.byref(bst)) == 0
+    fin = ctypes.c_int()
+    for _ in range(3):
+        assert LIB.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+    # custom-gradient update
+    n = len(X)
+    pred = np.zeros(n, np.float64)
+    grad = np.asarray(pred - (X[:, 0] > 0.5), np.float32)
+    hess = np.full(n, 0.25, np.float32)
+    assert LIB.LGBM_BoosterUpdateOneIterCustom(
+        bst, c_array(ctypes.c_float, grad), c_array(ctypes.c_float, hess),
+        ctypes.byref(fin)) == 0
+    # counters
+    out = ctypes.c_long()
+    assert LIB.LGBM_BoosterNumberOfTotalModel(bst, ctypes.byref(out)) == 0
+    assert out.value == 4
+    assert LIB.LGBM_BoosterNumModelPerIteration(bst, ctypes.byref(out)) == 0
+    assert out.value == 1
+    assert LIB.LGBM_BoosterGetNumFeature(bst, ctypes.byref(out)) == 0
+    assert out.value == 6
+    # leaf get/set round trip
+    lv = ctypes.c_double()
+    assert LIB.LGBM_BoosterGetLeafValue(bst, 0, 0, ctypes.byref(lv)) == 0
+    assert LIB.LGBM_BoosterSetLeafValue(bst, 0, 0, lv.value * 2.0) == 0
+    lv2 = ctypes.c_double()
+    assert LIB.LGBM_BoosterGetLeafValue(bst, 0, 0, ctypes.byref(lv2)) == 0
+    assert abs(lv2.value - lv.value * 2.0) < 1e-12
+    # importance
+    imp = np.zeros(6, np.float64)
+    assert LIB.LGBM_BoosterFeatureImportance(
+        bst, -1, 0, imp.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    assert imp.sum() > 0
+    # dump model JSON
+    out_len = ctypes.c_long()
+    buf = ctypes.create_string_buffer(1 << 20)
+    assert LIB.LGBM_BoosterDumpModel(
+        bst, 0, -1, len(buf.raw), ctypes.byref(out_len), buf) == 0
+    import json
+    d = json.loads(buf.value.decode())
+    assert d["tree_info"]
+    # calc num predict
+    assert LIB.LGBM_BoosterCalcNumPredict(
+        bst, 10, 0, -1, ctypes.byref(out_len)) == 0
+    assert out_len.value == 10
+    # predict for mats (array of row pointers)
+    rows = [np.ascontiguousarray(X[i]) for i in range(4)]
+    ptrs = (ctypes.POINTER(ctypes.c_double) * 4)(
+        *[r.ctypes.data_as(ctypes.POINTER(ctypes.c_double)) for r in rows])
+    res = np.zeros(4, np.float64)
+    assert LIB.LGBM_BoosterPredictForMats(
+        bst, ptrs, 1, 4, 6, 0, -1, c_str(""), ctypes.byref(out_len),
+        res.ctypes.data_as(ctypes.POINTER(ctypes.c_double))) == 0
+    assert out_len.value == 4
+    # reset parameter
+    assert LIB.LGBM_BoosterResetParameter(
+        bst, c_str("learning_rate=0.05")) == 0
+    assert abs(LIB._resolve(bst)._gbdt.shrinkage_rate - 0.05) < 1e-12
+    # refit with leaf preds
+    lp = np.zeros((n, 4), np.int32)
+    assert LIB.LGBM_BoosterRefit(
+        bst, lp.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), n, 4) == 0
+    # merge
+    bst2 = ctypes.c_void_p()
+    assert LIB.LGBM_BoosterCreate(
+        h, c_str("objective=binary verbose=-1 min_data_in_leaf=5"),
+        ctypes.byref(bst2)) == 0
+    assert LIB.LGBM_BoosterUpdateOneIter(bst2, ctypes.byref(fin)) == 0
+    assert LIB.LGBM_BoosterMerge(bst, bst2) == 0
+    assert LIB.LGBM_BoosterNumberOfTotalModel(bst, ctypes.byref(out)) == 0
+    assert out.value == 5
+
+
+def test_dataset_dump_text(rng, tmp_path):
+    h, _ = _mat_dataset(rng, n=50)
+    p = tmp_path / "dump.txt"
+    assert LIB.LGBM_DatasetDumpText(h, c_str(str(p))) == 0
+    text = p.read_text()
+    assert text.startswith("num_data: 50")
+
+
+def test_set_last_error():
+    assert LIB.LGBM_SetLastError(b"custom boom") == 0
+    assert LIB.LGBM_GetLastError() == b"custom boom"

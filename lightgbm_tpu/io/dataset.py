@@ -60,7 +60,8 @@ class BinnedDataset:
                   categorical_features: Sequence[int] = (),
                   feature_names: Optional[Sequence[str]] = None,
                   reference: Optional["BinnedDataset"] = None,
-                  sample_indices: Optional[np.ndarray] = None) -> "BinnedDataset":
+                  sample_indices: Optional[np.ndarray] = None,
+                  find_bin_comm=None) -> "BinnedDataset":
         """Build from a raw float matrix.
 
         With `reference` given, reuse its bin mappers (validation-set path,
@@ -118,8 +119,8 @@ class BinnedDataset:
         # trivial-feature filter count scales with the sampling fraction
         # (dataset_loader.cpp:849-850)
         filter_cnt = max(1, int(config.min_data_in_leaf * len(sample_indices) / n))
-        mappers: List[Optional[BinMapper]] = []
-        for f in range(num_raw):
+
+        def _find_one(f: int) -> BinMapper:
             if _issparse(Xs):
                 # stored entries only — implicit zeros are not "nonzero"
                 col = np.asarray(
@@ -133,7 +134,30 @@ class BinnedDataset:
                        filter_cnt,
                        CATEGORICAL if f in cat_set else NUMERICAL,
                        config.use_missing, config.zero_as_missing)
-            mappers.append(m)
+            return m
+
+        if find_bin_comm is not None:
+            # distributed find-bin (dataset_loader.cpp:873-955): each rank
+            # finds bins only for its contiguous feature shard, then the
+            # serialized mappers are allgathered and merged — compute
+            # sharding, identical mappers to a single-rank load
+            rank, world, allgather = find_bin_comm
+            per = -(-num_raw // world)
+            lo, hi = rank * per, min((rank + 1) * per, num_raw)
+            mine = {f: _find_one(f).to_state() for f in range(lo, hi)}
+            merged: dict = {}
+            for part in allgather(mine):
+                # normalize keys: a byte transport (e.g. JSON) may have
+                # stringified the int feature ids
+                merged.update({int(k): v for k, v in part.items()})
+            missing = [f for f in range(num_raw) if f not in merged]
+            if missing:
+                log.fatal("distributed find-bin allgather is missing "
+                          "mappers for features %s" % missing[:10])
+            mappers: List[BinMapper] = [BinMapper.from_state(merged[f])
+                                        for f in range(num_raw)]
+        else:
+            mappers = [_find_one(f) for f in range(num_raw)]
 
         # --- drop trivial features (dataset.cpp Construct) ----------------
         ds.used_feature_map = [-1] * num_raw

@@ -1,0 +1,150 @@
+"""Distributed data loading: find-bin sharding + query pre-partition.
+
+Oracle (SURVEY §2.1 DatasetLoader / dataset_loader.cpp:694-955): a
+rank-sharded load must produce bit-identical bin mappers on every rank
+(and identical to a single-rank load), query groups must never straddle
+ranks, and data-parallel training over the rank shards must reproduce
+the single-machine trees.
+"""
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.parallel.dist_data import (LocalComm, construct_rank_shard,
+                                             pre_partition_rows)
+
+WORLD = 4
+
+
+def _run_ranks(fn):
+    with ThreadPoolExecutor(max_workers=WORLD) as ex:
+        return list(ex.map(fn, range(WORLD)))
+
+
+def _mapper_states(ds: BinnedDataset):
+    return [m.to_state() for m in ds.bin_mappers]
+
+
+def test_distributed_find_bin_matches_serial(rng):
+    n, F = 3000, 11
+    X = rng.randn(n, F)
+    X[:, 3] = np.round(X[:, 3] * 2)          # repeated values
+    X[rng.rand(n) < 0.3, 5] = 0.0            # sparse-ish column
+    cfg = Config({"max_bin": 63, "verbose": -1})
+    serial = BinnedDataset.construct(X, cfg)
+
+    comm = LocalComm(WORLD)
+
+    def one_rank(rank):
+        return BinnedDataset.construct(
+            X, cfg, find_bin_comm=(rank, WORLD, comm.allgather_fn(rank)))
+
+    shards = _run_ranks(one_rank)
+    ser_states = _mapper_states(serial)
+    for ds in shards:
+        assert _mapper_states(ds) == ser_states
+        np.testing.assert_array_equal(ds.bins, serial.bins)
+
+
+def test_pre_partition_query_granular(rng):
+    group = rng.randint(5, 30, 40)
+    qb = np.concatenate([[0], np.cumsum(group)])
+    n = int(qb[-1])
+    parts = [pre_partition_rows(n, r, WORLD, qb, seed=3)
+             for r in range(WORLD)]
+    # exact disjoint cover
+    allrows = np.sort(np.concatenate(parts))
+    np.testing.assert_array_equal(allrows, np.arange(n))
+    # no query straddles ranks
+    q_of_row = np.repeat(np.arange(len(group)), group)
+    for rows in parts:
+        for q in np.unique(q_of_row[rows]):
+            members = np.flatnonzero(q_of_row == q)
+            assert np.isin(members, rows).all()
+
+
+def test_rank_sharded_training_matches_serial(rng):
+    """Full pipeline: rank shards (pre-partitioned rows + distributed
+    find-bin) trained data-parallel must grow the single-machine trees."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from lightgbm_tpu.ops import grow as grow_ops
+    from lightgbm_tpu.ops.split import SplitParams
+    from lightgbm_tpu.parallel.learners import AXIS
+
+    n, F = 2000, 8
+    X = rng.randn(n, F)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    cfg = Config({"max_bin": 31, "verbose": -1})
+    comm = LocalComm(WORLD)
+
+    shards = _run_ranks(lambda r: construct_rank_shard(
+        X, cfg, r, WORLD, comm, label=y))
+    serial = BinnedDataset.construct(X, cfg)
+
+    # identical mappers everywhere
+    for s in shards:
+        assert _mapper_states(s) == _mapper_states(serial)
+
+    # data-parallel training over the actual rank shards: rows land on
+    # devices in shard order; pad each shard to a common length
+    max_len = max(s.num_data for s in shards)
+    pad_len = max_len + (-max_len % 4)
+    bins_blocks, grad_blocks = [], []
+    params = SplitParams(min_data_in_leaf=5)
+
+    def grads(labels):
+        p = 0.5
+        return (p - labels).astype(np.float32)
+
+    hess_blocks, row_blocks = [], []
+    for s in shards:
+        pad = pad_len - s.num_data
+        bins_blocks.append(np.pad(np.asarray(s.bins, np.uint8),
+                                  ((0, pad), (0, 0))))
+        lab = np.asarray(s.metadata.label, np.float32)
+        grad_blocks.append(np.pad(grads(lab), (0, pad)))
+        hess_blocks.append(np.pad(np.full(s.num_data, 0.25, np.float32),
+                                  (0, pad)))
+        row_blocks.append(np.pad(np.zeros(s.num_data, np.int32), (0, pad),
+                                 constant_values=-1))
+    bins_dp = jnp.asarray(np.concatenate(bins_blocks))
+    grad_dp = jnp.asarray(np.concatenate(grad_blocks))
+    hess_dp = jnp.asarray(np.concatenate(hess_blocks))
+    row_dp = jnp.asarray(np.concatenate(row_blocks))
+
+    meta = serial
+    fm = jnp.ones(len(meta.bin_mappers), bool)
+    nb = jnp.asarray([m.num_bin for m in meta.bin_mappers], jnp.int32)
+    db = jnp.asarray([m.default_bin for m in meta.bin_mappers], jnp.int32)
+    mt = jnp.asarray([m.missing_type for m in meta.bin_mappers], jnp.int32)
+
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:WORLD]), (AXIS,))
+    inner = lambda b, g, h, r: grow_ops.grow_tree_impl(
+        b, g, h, r, fm, nb, db, mt, params, max_leaves=15, max_bin=31,
+        hist_impl="scatter", learner="data", axis_name=AXIS,
+        num_machines=WORLD)
+    fn = jax.jit(jax.shard_map(inner, mesh=mesh,
+                               in_specs=(P(AXIS, None), P(AXIS), P(AXIS),
+                                         P(AXIS)),
+                               out_specs=(P(), P(AXIS)), check_vma=False))
+    tree_dp, _ = fn(bins_dp, grad_dp, hess_dp, row_dp)
+
+    # serial oracle on the unsharded data
+    lab = np.asarray(y, np.float32)
+    tree_s, _ = grow_ops.grow_tree(
+        jnp.asarray(np.asarray(serial.bins, np.uint8)),
+        jnp.asarray(grads(lab)), jnp.asarray(np.full(n, 0.25, np.float32)),
+        jnp.zeros(n, jnp.int32), fm, nb, db, mt, params,
+        max_leaves=15, max_bin=31, hist_impl="scatter")
+
+    assert int(tree_dp.num_leaves) == int(tree_s.num_leaves)
+    np.testing.assert_array_equal(np.asarray(tree_dp.split_feature),
+                                  np.asarray(tree_s.split_feature))
+    np.testing.assert_array_equal(np.asarray(tree_dp.threshold_bin),
+                                  np.asarray(tree_s.threshold_bin))

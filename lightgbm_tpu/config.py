@@ -361,9 +361,6 @@ def alias_transform(params: Dict[str, Any]) -> Dict[str, Any]:
 _INERT_PARAMS: Dict[str, str] = {
     "two_round": "the whole text file is parsed in memory "
                  "(no two-round/streaming ingest yet)",
-    "histogram_pool_size": "the per-leaf histogram cache is a fixed "
-                           "[num_leaves, F, bins, 3] device tensor sized "
-                           "by num_leaves, not by a memory budget",
     "is_enable_sparse": "bin storage is always dense on TPU (EFB bundles "
                         "sparse features into dense groups instead)",
     "sparse_threshold": "bin storage is always dense on TPU",

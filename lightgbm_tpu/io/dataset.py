@@ -61,7 +61,8 @@ class BinnedDataset:
                   feature_names: Optional[Sequence[str]] = None,
                   reference: Optional["BinnedDataset"] = None,
                   sample_indices: Optional[np.ndarray] = None,
-                  find_bin_comm=None) -> "BinnedDataset":
+                  find_bin_comm=None,
+                  bin_rows: bool = True) -> "BinnedDataset":
         """Build from a raw float matrix.
 
         With `reference` given, reuse its bin mappers (validation-set path,
@@ -174,7 +175,10 @@ class BinnedDataset:
         ds._set_offsets()
         ds._resolve_constraints(config)
         ds._find_bundles(Xs, config)
-        ds._bin_all(X)
+        if bin_rows:
+            ds._bin_all(X)
+        # else: mapper-only construction (distributed ingest — the caller
+        # bins its row shard against these mappers via `reference`)
         return ds
 
     def _find_bundles(self, Xs: np.ndarray, config) -> None:

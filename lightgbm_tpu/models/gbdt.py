@@ -495,6 +495,7 @@ class GBDT:
                 max_depth=self.config.max_depth,
                 max_bin=self.max_bin, emit="score", full_bag=True,
                 max_cat_threshold=self.config.max_cat_threshold,
+                hist_slots=self._hist_slots,
                 interpret=interpret)
             new_score = score_row + shrink * delta.astype(score_row.dtype)
             ivec, fvec = grow_ops.pack_tree_arrays(arrays)
@@ -698,13 +699,27 @@ class GBDT:
                     if self.train_set.num_features else 1)
         C, cap = pp.arena_geometry(self.num_data, n_groups,
                                    cfg.tpu_arena_factor)
-        hist_cache_bytes = (self.config.num_leaves * n_groups
-                            * max(self.max_bin, 2) * 3 * 4)
+        # histogram pooling (HistogramPool, feature_histogram.hpp:646-818):
+        # bound the per-leaf histogram cache by histogram_pool_size MB (or
+        # auto-cap at a fraction of HBM for wide datasets) — spilled
+        # parents are recomputed from their arena segments
+        L = max(self.config.num_leaves, 2)
+        entry_bytes = n_groups * max(self.max_bin, 2) * 3 * 4
+        budget = _device_memory_budget()
+        pool_mb = cfg.histogram_pool_size
+        if pool_mb > 0:
+            slots = int(pool_mb * (1 << 20) / max(entry_bytes, 1))
+        elif L * entry_bytes > 0.25 * budget:
+            slots = int(0.25 * budget / max(entry_bytes, 1))
+        else:
+            slots = L
+        self._hist_slots = 0 if slots >= L else max(4, slots)
+        hist_cache_bytes = (self._hist_slots or L) * entry_bytes
         arena_bytes = (C * cap * 2 + self.num_data * C * 2
                        + hist_cache_bytes)      # bf16 arena + bins_t + hists
         if eng == "auto":
             # C also bounds the kernels' VMEM scratch (2 x C x TILE f32)
-            fits = arena_bytes < _device_memory_budget() and C <= 512
+            fits = arena_bytes < budget and C <= 512
             eng = ("partition" if eligible and fits
                    and jax.default_backend() == "tpu" else "label")
         self._use_partition_engine = eng == "partition"
@@ -745,6 +760,7 @@ class GBDT:
                     emit=self._last_emit,
                     full_bag=self._bag_mask is None,
                     max_cat_threshold=self.config.max_cat_threshold,
+                    hist_slots=self._hist_slots,
                     interpret=jax.default_backend() != "tpu")
                 if not getattr(self, "_partition_validated", False):
                     # force materialization once: async dispatch would

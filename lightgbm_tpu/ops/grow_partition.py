@@ -53,7 +53,12 @@ class PartState(NamedTuple):
     leaf_local: jnp.ndarray        # [L] int32 LOCAL segment lengths (==
     #   tree.leaf_count when serial; differs under data-parallel sharding)
     cursor: jnp.ndarray            # int32 bump cursor (256-aligned)
-    hist_cache: jnp.ndarray        # [L, F, B, 3]
+    hist_cache: jnp.ndarray        # [K, G, B, 3] slot cache (HistogramPool,
+    #   feature_histogram.hpp:646-818: K < L spills by LRU; a missed
+    #   parent is recomputed from its still-intact segment)
+    slot_leaf: jnp.ndarray         # [K] int32 leaf whose hist each slot holds
+    slot_tick: jnp.ndarray         # [K] int32 write-recency for eviction
+    tick: jnp.ndarray              # int32 monotone write counter
     split_cache: SplitResult
     done: jnp.ndarray
     cegb_used: jnp.ndarray         # [F] bool (CEGB coupled feature_used)
@@ -87,6 +92,7 @@ def grow_tree_partition_impl(
         full_bag: bool = False,
         max_cat_threshold: int = 32,
         axis_name: Optional[str] = None,
+        hist_slots: int = 0,
         interpret: bool = False):
     """Grow one leaf-wise tree.
 
@@ -221,7 +227,17 @@ def grow_tree_partition_impl(
                                  jnp.asarray(0, jnp.int32), used=cegb_used0,
                                  minc=ninf, maxc=pinf)
 
-    hist_cache = jnp.zeros((L,) + root_hist.shape, dtype).at[0].set(root_hist)
+    # histogram slot cache: K < L spills by LRU (hist_slots; 0 = one slot
+    # per leaf, never spills — leaf-indexed, no lookup machinery traced)
+    K = max(min(hist_slots, L), 4) if hist_slots and hist_slots > 0 else L
+    pooled = K < L
+    hist_cache = jnp.zeros((K,) + root_hist.shape, dtype).at[0].set(root_hist)
+    if pooled:
+        slot_leaf0 = jnp.full(K, -1, jnp.int32).at[0].set(0)
+        slot_tick0 = jnp.zeros(K, jnp.int32).at[0].set(1)
+    else:
+        slot_leaf0 = jnp.zeros(1, jnp.int32)    # placeholders (untraced)
+        slot_tick0 = jnp.zeros(1, jnp.int32)
     split_cache = SplitResult(*[
         None if v is None else
         jnp.zeros((L,) + jnp.shape(jnp.asarray(v)), jnp.asarray(v).dtype)
@@ -235,7 +251,9 @@ def grow_tree_partition_impl(
         leaf_start=jnp.zeros(L, jnp.int32),
         leaf_local=jnp.zeros(L, jnp.int32).at[0].set(root_c_local),
         cursor=cursor0,
-        hist_cache=hist_cache, split_cache=split_cache,
+        hist_cache=hist_cache, slot_leaf=slot_leaf0, slot_tick=slot_tick0,
+        tick=jnp.asarray(2, jnp.int32),
+        split_cache=split_cache,
         done=jnp.asarray(False), cegb_used=cegb_used0,
         truncated=jnp.asarray(False),
         leaf_min=jnp.full(L, ninf, dtype),
@@ -287,6 +305,26 @@ def grow_tree_partition_impl(
         cntP = jnp.where(no_split, 0, cntP_local)
         dstB = state.cursor
 
+        if pooled:
+            # parent histogram: slot-cache lookup (HistogramPool::Get),
+            # with a recompute from the parent's STILL-INTACT segment on
+            # miss — this must run before the partition overwrites the
+            # segment.  The recompute kernel degenerates to cnt=0 (free)
+            # on a hit.
+            in_slot = state.slot_leaf == best_leaf
+            found = jnp.any(in_slot)
+            pslot = jnp.argmax(in_slot).astype(jnp.int32)
+            recomputed = seg(state.arena, s0,
+                             jnp.where(found | no_split, 0, cntP_local))
+            if axis_name is not None:
+                recomputed = jax.lax.psum(recomputed, axis_name)
+            parent_hist = jnp.where(found, state.hist_cache[pslot],
+                                    recomputed.astype(dtype))
+        else:
+            # dense cache (one slot per leaf): direct index, no extra
+            # kernel or collective on the split critical path
+            parent_hist = state.hist_cache[best_leaf]
+
         # the go-left decision is evaluated INSIDE the kernel via a
         # [1, B] mask vector over arena bin values — built here to encode
         # numerical threshold + missing direction (NumericalDecision,
@@ -330,12 +368,28 @@ def grow_tree_partition_impl(
             # DP: ONE collective per split — the smaller child's histogram
             # allreduce; the sibling still comes from subtraction (§3.4.2)
             small_hist = jax.lax.psum(small_hist, axis_name)
-        parent_hist = state.hist_cache[best_leaf]
         large_hist = parent_hist - small_hist
         left_hist = jnp.where(left_smaller, small_hist, large_hist)
         right_hist = jnp.where(left_smaller, large_hist, small_hist)
-        hist_cache = state.hist_cache.at[best_leaf].set(left_hist)
-        hist_cache = hist_cache.at[new_leaf].set(right_hist)
+        if pooled:
+            # store both children: the parent's slot (if cached) is
+            # reused for the left child, the right child evicts the
+            # least-recently-written slot (HistogramPool::Move + LRU)
+            slotL = jnp.where(found, pslot,
+                              jnp.argmin(state.slot_tick).astype(jnp.int32))
+            tickL = state.slot_tick.at[slotL].set(state.tick)
+            slotR = jnp.argmin(tickL).astype(jnp.int32)
+            hist_cache = state.hist_cache.at[slotL].set(left_hist)
+            hist_cache = hist_cache.at[slotR].set(right_hist)
+            slot_leaf = state.slot_leaf.at[slotL].set(best_leaf)
+            slot_leaf = slot_leaf.at[slotR].set(new_leaf)
+            slot_tick = tickL.at[slotR].set(state.tick + 1)
+            tick = state.tick + 2
+        else:
+            hist_cache = state.hist_cache.at[best_leaf].set(left_hist)
+            hist_cache = hist_cache.at[new_leaf].set(right_hist)
+            slot_leaf, slot_tick, tick = (state.slot_leaf, state.slot_tick,
+                                          state.tick)
 
         leaf_start = state.leaf_start.at[best_leaf].set(
             jnp.where(left_smaller, dstB, s0))
@@ -445,6 +499,9 @@ def grow_tree_partition_impl(
             leaf_local=sel(state.leaf_local, leaf_local),
             cursor=sel(state.cursor, cursor),
             hist_cache=sel(state.hist_cache, hist_cache),
+            slot_leaf=sel(state.slot_leaf, slot_leaf),
+            slot_tick=sel(state.slot_tick, slot_tick),
+            tick=sel(state.tick, tick),
             split_cache=split_cache,
             done=keep, cegb_used=sel(state.cegb_used, used2),
             truncated=state.truncated | overflow,
@@ -483,5 +540,5 @@ def grow_tree_partition_impl(
 
 grow_tree_partition = partial(jax.jit, static_argnames=(
     "max_leaves", "max_depth", "max_bin", "emit", "full_bag",
-    "max_cat_threshold", "axis_name", "interpret"),
+    "max_cat_threshold", "axis_name", "hist_slots", "interpret"),
     donate_argnums=(0,))(grow_tree_partition_impl)

@@ -46,30 +46,38 @@ class LocalComm:
         def allgather(payload: dict) -> List[dict]:
             self._slots[rank] = payload
             self._barrier.wait(timeout=300)
-            return list(self._slots)
+            out = list(self._slots)
+            # second barrier: no rank may start the NEXT round (and
+            # overwrite its slot) until every rank has read this one
+            self._barrier.wait(timeout=300)
+            return out
         return allgather
 
 
 def pre_partition_rows(n: int, rank: int, num_machines: int,
                        query_boundaries: Optional[np.ndarray] = None,
-                       seed: int = 0) -> np.ndarray:
-    """Row indices assigned to `rank` (dataset_loader.cpp:694-740):
-    uniform random per row, or whole-query-at-a-time when query
-    boundaries are given so ranking groups never straddle ranks."""
+                       seed: int = 0):
+    """(row_indices, q_rank) assigned to `rank` (dataset_loader.cpp:
+    694-740): uniform random per row, or whole-query-at-a-time when
+    query boundaries are given so ranking groups never straddle ranks.
+    q_rank ([num_queries] or None) is returned so callers can derive the
+    per-rank group sizes from the SAME draw."""
     rng = np.random.RandomState(seed)
     if query_boundaries is None:
-        return np.flatnonzero(rng.randint(0, num_machines, n) == rank)
+        return np.flatnonzero(rng.randint(0, num_machines, n) == rank), None
     nq = len(query_boundaries) - 1
     q_rank = rng.randint(0, num_machines, nq)
     q_of_row = np.repeat(np.arange(nq),
                          np.diff(np.asarray(query_boundaries)))
-    return np.flatnonzero(q_rank[q_of_row] == rank)
+    return np.flatnonzero(q_rank[q_of_row] == rank), q_rank
 
 
 def construct_rank_shard(X: np.ndarray, config, rank: int, world: int,
                          comm: LocalComm,
                          label: Optional[np.ndarray] = None,
                          group: Optional[Sequence[int]] = None,
+                         weight: Optional[np.ndarray] = None,
+                         init_score: Optional[np.ndarray] = None,
                          categorical_features: Sequence[int] = (),
                          pre_partition: bool = True) -> BinnedDataset:
     """One rank's view of a distributed load: (optionally) keep only this
@@ -85,37 +93,45 @@ def construct_rank_shard(X: np.ndarray, config, rank: int, world: int,
     qb = None
     if group is not None:
         qb = np.concatenate([[0], np.cumsum(np.asarray(group))])
-    keep = (pre_partition_rows(n, rank, world, qb,
-                               seed=config.data_random_seed)
-            if pre_partition else np.arange(n))
+    if pre_partition:
+        keep, q_rank = pre_partition_rows(n, rank, world, qb,
+                                          seed=config.data_random_seed)
+    else:
+        keep, q_rank = np.arange(n), None
+
+    def fill_meta(meta, rows):
+        if label is not None:
+            meta.set_label(np.asarray(label)[rows])
+        if weight is not None:
+            meta.set_weights(np.asarray(weight)[rows])
+        if init_score is not None:
+            meta.set_init_score(np.asarray(init_score)[rows])
 
     # find-bin runs BEFORE the row partition, on the full data, so every
     # rank derives identical mappers (the reference's !pre_partition
     # find-bin semantics; with pre_partition the reference accepts
     # shard-local mappers — we keep the exact variant, which is stronger)
     allgather = comm.allgather_fn(rank)
-    meta = Metadata(len(keep))
-    if label is not None:
-        meta.set_label(np.asarray(label)[keep])
-    if group is not None and qb is not None:
-        rng = np.random.RandomState(config.data_random_seed)
-        q_rank = rng.randint(0, world, len(qb) - 1)
-        meta.set_query(np.asarray(group)[q_rank == rank])
-
-    full_sample_ds = BinnedDataset.construct(
+    mapper_ds = BinnedDataset.construct(
         X, config, metadata=Metadata(n),
         categorical_features=categorical_features,
-        find_bin_comm=(rank, world, allgather))
+        find_bin_comm=(rank, world, allgather),
+        bin_rows=not pre_partition)   # mapper-only when re-binning a shard
     if not pre_partition:
-        if label is not None:
-            full_sample_ds.metadata.set_label(np.asarray(label))
-        return full_sample_ds
+        fill_meta(mapper_ds.metadata, keep)
+        if group is not None:
+            mapper_ds.metadata.set_query(np.asarray(group))
+        return mapper_ds
 
-    # re-bin only this rank's rows against the agreed mappers
+    # bin ONLY this rank's rows against the agreed mappers
+    meta = Metadata(len(keep))
+    fill_meta(meta, keep)
+    if group is not None and q_rank is not None:
+        meta.set_query(np.asarray(group)[q_rank == rank])
     shard = BinnedDataset.construct(
         X[keep], config, metadata=meta,
         categorical_features=categorical_features,
-        reference=full_sample_ds)
+        reference=mapper_ds)
     return shard
 
 
@@ -129,4 +145,5 @@ def load_rank_shard_file(config, filename: str, rank: int, world: int,
               len(d.X))
     return construct_rank_shard(
         d.X, config, rank, world, comm, label=d.label, group=d.group,
+        weight=d.weight, init_score=d.init_score,
         categorical_features=d.categorical or ())

@@ -53,7 +53,7 @@ def test_pre_partition_query_granular(rng):
     group = rng.randint(5, 30, 40)
     qb = np.concatenate([[0], np.cumsum(group)])
     n = int(qb[-1])
-    parts = [pre_partition_rows(n, r, WORLD, qb, seed=3)
+    parts = [pre_partition_rows(n, r, WORLD, qb, seed=3)[0]
              for r in range(WORLD)]
     # exact disjoint cover
     allrows = np.sort(np.concatenate(parts))

@@ -269,3 +269,45 @@ def test_efb_bundle_parity():
     assert ds._binned.bundle is not None, "test setup: EFB did not bundle"
     outs = _train_both(X, y)
     assert outs["partition"] == outs["label"]
+
+
+def test_hist_pool_spill_matches_dense(rng):
+    """A tiny slot cache (spill + recompute on every other split) must
+    grow exactly the tree the unlimited cache grows."""
+    bins, grad, hess, nb, db, mt = _case(rng)
+    row0 = np.zeros(len(grad), np.int32)
+    params = SplitParams(min_data_in_leaf=10)
+    outs = []
+    for slots in (0, 4):
+        arena = jnp.zeros((pp.arena_channels(6), 8 * pp.TILE), pp.ARENA_DT)
+        t, l, _, _ = gp.grow_tree_partition(
+            arena, jnp.asarray(bins.T.astype(np.float32)),
+            jnp.asarray(grad), jnp.asarray(hess), jnp.asarray(row0),
+            jnp.ones(6, bool), jnp.asarray(nb), jnp.asarray(db),
+            jnp.asarray(mt), params, max_leaves=15, max_bin=48,
+            hist_slots=slots, interpret=True)
+        outs.append((t, l))
+    (t0, l0), (t1, l1) = outs
+    assert int(t0.num_leaves) == int(t1.num_leaves) == 15
+    _assert_trees_equal(t0, t1)
+    np.testing.assert_array_equal(np.asarray(l0), np.asarray(l1))
+
+
+def test_hist_pool_booster_wide(rng):
+    """histogram_pool_size engages the pooled cache at the Booster level
+    and training still works."""
+    import lightgbm_tpu as lgb
+    n, F = 1500, 40
+    X = rng.randn(n, F).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y)
+    p = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+         "tpu_tree_engine": "partition",
+         # tiny pool: forces slot spills every split
+         "histogram_pool_size": 40 * 255 * 3 * 4 * 6 / (1 << 20)}
+    bst = lgb.train(p, ds, num_boost_round=3)
+    g = bst._gbdt
+    assert g._use_partition_engine and 0 < g._hist_slots < 31
+    assert bst.num_trees() == 3
+    pred = bst.predict(X)
+    assert np.mean((pred > 0.5) == y) > 0.9

@@ -267,6 +267,31 @@ class Booster:
                 merged = dict(train_set.params)
                 merged.update(self.params)
                 train_set.params = merged
+            elif train_set._binned is not None and self.params:
+                # an already-constructed dataset keeps its binning — warn
+                # when a dataset-relevant train param would have changed it
+                # (the reference warns likewise, basic.py _update_params).
+                # Compare EFFECTIVE values (defaults applied) so passing
+                # the value the dataset already used stays silent.
+                relevant = ("max_bin", "bin_construct_sample_cnt",
+                            "min_data_in_bin", "use_missing",
+                            "zero_as_missing", "enable_bundle",
+                            "max_conflict_rate", "monotone_constraints",
+                            "feature_contri", "categorical_feature")
+                ds_cfg = Config(train_set.params)
+                tr_cfg = Config(self.params)
+                for key in relevant:
+                    if key not in self.params:
+                        continue
+                    eff_ds = getattr(ds_cfg, key,
+                                     train_set.params.get(key))
+                    eff_tr = getattr(tr_cfg, key, self.params[key])
+                    if eff_ds != eff_tr:
+                        log.warning(
+                            "Dataset is already constructed; parameter "
+                            "'%s=%s' is ignored for binning (reconstruct "
+                            "the Dataset to apply it)",
+                            key, self.params[key])
             train_set.construct()
             cfg = Config(self.params)
             objective = None

@@ -1,8 +1,15 @@
-"""Training callbacks (python-package/lightgbm/callback.py)."""
+"""Training callbacks.
+
+The public surface (CallbackEnv fields, factory signatures, `order` /
+`before_iteration` attributes, EarlyStopException) is shared API with the
+reference's python-package/lightgbm/callback.py — bindings and user code
+depend on it verbatim.  The implementations are this framework's own.
+"""
 from __future__ import annotations
 
 import collections
-from typing import Callable, List
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
 
 from .utils import log
 
@@ -14,6 +21,8 @@ class EarlyStopException(Exception):
         self.best_score = best_score
 
 
+# (dataset_name, metric_name, value, bigger_is_better[, stdv]) tuples ride
+# in evaluation_result_list; the namedtuple name and field order are ABI.
 CallbackEnv = collections.namedtuple(
     "LightGBMCallbackEnv",
     ["model", "params", "iteration", "begin_iteration", "end_iteration",
@@ -21,85 +30,116 @@ CallbackEnv = collections.namedtuple(
 
 
 def _format_eval_result(value, show_stdv: bool = True) -> str:
-    if len(value) == 4:
-        return "%s's %s: %g" % (value[0], value[1], value[2])
-    if len(value) == 5:
-        if show_stdv:
-            return "%s's %s: %g + %g" % (value[0], value[1], value[2], value[4])
-        return "%s's %s: %g" % (value[0], value[1], value[2])
+    name, metric, score = value[0], value[1], value[2]
+    if len(value) == 5 and show_stdv:
+        return "%s's %s: %g + %g" % (name, metric, score, value[4])
+    if len(value) in (4, 5):
+        return "%s's %s: %g" % (name, metric, score)
     raise ValueError("Wrong metric value")
 
 
 def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    """Log the evaluation results every `period` iterations."""
+
     def _callback(env: CallbackEnv) -> None:
-        if period > 0 and env.evaluation_result_list \
-           and (env.iteration + 1) % period == 0:
-            result = "\t".join(_format_eval_result(x, show_stdv)
-                               for x in env.evaluation_result_list)
-            log.info("[%d]\t%s", env.iteration + 1, result)
+        if period <= 0 or not env.evaluation_result_list:
+            return
+        if (env.iteration + 1) % period:
+            return
+        log.info("[%d]\t%s", env.iteration + 1,
+                 "\t".join(_format_eval_result(v, show_stdv)
+                           for v in env.evaluation_result_list))
+
     _callback.order = 10
     return _callback
 
 
 def record_evaluation(eval_result: dict) -> Callable:
+    """Append every metric value into eval_result[dataset][metric]."""
     if not isinstance(eval_result, dict):
         raise TypeError("eval_result should be a dict")
 
-    def _init(env: CallbackEnv) -> None:
-        eval_result.clear()
-        for item in env.evaluation_result_list:
-            eval_result.setdefault(item[0], collections.OrderedDict())
-            eval_result[item[0]].setdefault(item[1], [])
-
     def _callback(env: CallbackEnv) -> None:
-        if not eval_result:
-            _init(env)
-        for item in env.evaluation_result_list:
-            eval_result[item[0]][item[1]].append(item[2])
+        for v in env.evaluation_result_list:
+            series = eval_result.setdefault(
+                v[0], collections.OrderedDict())
+            series.setdefault(v[1], []).append(v[2])
+
     _callback.order = 20
     return _callback
 
 
+def _resolve_schedule(key: str, spec, round_idx: int, num_rounds: int):
+    """A per-round parameter value from a list (one entry per round) or a
+    callable round_idx -> value."""
+    if isinstance(spec, list):
+        if len(spec) != num_rounds:
+            raise ValueError("Length of list %s has to equal to "
+                             "'num_boost_round'." % key)
+        return spec[round_idx]
+    if callable(spec):
+        return spec(round_idx)
+    raise ValueError("Only list and callable values are supported as a "
+                     "mapping from boosting round index to new parameter "
+                     "value.")
+
+
 def reset_parameter(**kwargs) -> Callable:
+    """Schedule parameter changes per boosting round (lists or callables
+    keyed by parameter name)."""
+
     def _callback(env: CallbackEnv) -> None:
-        new_parameters = {}
-        for key, value in kwargs.items():
-            if isinstance(value, list):
-                if len(value) != env.end_iteration - env.begin_iteration:
-                    raise ValueError("Length of list %s has to equal to "
-                                     "'num_boost_round'." % key)
-                new_param = value[env.iteration - env.begin_iteration]
-            elif callable(value):
-                new_param = value(env.iteration - env.begin_iteration)
-            else:
-                raise ValueError("Only list and callable values are supported "
-                                 "as a mapping from boosting round index to new "
-                                 "parameter value.")
-            new_parameters[key] = new_param
-        if new_parameters:
-            if "learning_rate" in new_parameters:
-                boosters = (env.model.boosters
-                            if hasattr(env.model, "boosters") else [env.model])
-                for bst in boosters:
-                    bst._gbdt.shrinkage_rate = new_parameters["learning_rate"]
-            env.params.update(new_parameters)
+        round_idx = env.iteration - env.begin_iteration
+        num_rounds = env.end_iteration - env.begin_iteration
+        updates = {k: _resolve_schedule(k, v, round_idx, num_rounds)
+                   for k, v in kwargs.items()}
+        if not updates:
+            return
+        lr = updates.get("learning_rate")
+        if lr is not None:
+            targets = getattr(env.model, "boosters", None) or [env.model]
+            for bst in targets:
+                bst._gbdt.shrinkage_rate = lr
+        env.params.update(updates)
+
     _callback.before_iteration = True
     _callback.order = 10
     return _callback
 
 
+@dataclass
+class _MetricTracker:
+    """Best-so-far state of one (dataset, metric) series."""
+    bigger_is_better: bool
+    best_score: float = field(default=None)  # type: ignore[assignment]
+    best_iter: int = 0
+    best_results: Optional[list] = None
+
+    def improved(self, score: float) -> bool:
+        if self.best_results is None:
+            return True
+        if self.bigger_is_better:
+            return score > self.best_score
+        return score < self.best_score
+
+    def update(self, score: float, iteration: int, results) -> None:
+        self.best_score = score
+        self.best_iter = iteration
+        self.best_results = results
+
+
 def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                    verbose: bool = True) -> Callable:
-    best_score: List[float] = []
-    best_iter: List[int] = []
-    best_score_list: List = []
-    cmp_op: List[Callable] = []
-    enabled = [True]
+    """Stop when no tracked validation metric improved for
+    `stopping_rounds` iterations; raises EarlyStopException carrying the
+    best iteration (train() catches it, engine.py)."""
+    state: Dict[str, Any] = {"trackers": None, "enabled": True}
 
-    def _init(env: CallbackEnv) -> None:
-        enabled[0] = not any(env.params.get(alias, "") == "dart"
-                             for alias in ("boosting", "boosting_type", "boost"))
-        if not enabled[0]:
+    def _start(env: CallbackEnv) -> None:
+        dart = any(env.params.get(alias, "") == "dart"
+                   for alias in ("boosting", "boosting_type", "boost"))
+        state["enabled"] = not dart
+        if dart:
             log.warning("Early stopping is not available in dart mode")
             return
         if not env.evaluation_result_list:
@@ -108,46 +148,38 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
         if verbose:
             log.info("Training until validation scores don't improve for %d "
                      "rounds.", stopping_rounds)
-        for eval_ret in env.evaluation_result_list:
-            best_iter.append(0)
-            best_score_list.append(None)
-            if eval_ret[3]:  # bigger is better
-                best_score.append(float("-inf"))
-                cmp_op.append(lambda a, b: a > b)
-            else:
-                best_score.append(float("inf"))
-                cmp_op.append(lambda a, b: a < b)
+        state["trackers"] = [_MetricTracker(bigger_is_better=bool(v[3]))
+                            for v in env.evaluation_result_list]
+
+    def _finish(tracker: _MetricTracker, stopped_early: bool) -> None:
+        if verbose:
+            head = ("Early stopping, best iteration is:" if stopped_early
+                    else "Did not meet early stopping. Best iteration is:")
+            log.info("%s\n[%d]\t%s", head, tracker.best_iter + 1,
+                     "\t".join(_format_eval_result(v)
+                               for v in tracker.best_results))
+        raise EarlyStopException(tracker.best_iter, tracker.best_results)
 
     def _callback(env: CallbackEnv) -> None:
-        if not cmp_op:
-            _init(env)
-        if not enabled[0]:
+        if state["trackers"] is None and state["enabled"]:
+            _start(env)
+        if not state["enabled"]:
             return
-        for i in range(len(env.evaluation_result_list)):
-            score = env.evaluation_result_list[i][2]
-            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
-                best_score[i] = score
-                best_iter[i] = env.iteration
-                best_score_list[i] = env.evaluation_result_list
-            # training-data metrics don't trigger early stopping
-            train_name = getattr(env.model, "_train_data_name", "training")
-            if env.evaluation_result_list[i][0] == train_name:
+        train_name = getattr(env.model, "_train_data_name", "training")
+        for tracker, value in zip(state["trackers"],
+                                  env.evaluation_result_list):
+            if tracker.improved(value[2]):
+                tracker.update(value[2], env.iteration,
+                               env.evaluation_result_list)
+            if value[0] == train_name:
+                # training-set metrics never trigger the stop
                 continue
-            elif env.iteration - best_iter[i] >= stopping_rounds:
-                if verbose:
-                    log.info("Early stopping, best iteration is:\n[%d]\t%s",
-                             best_iter[i] + 1,
-                             "\t".join(_format_eval_result(x)
-                                       for x in best_score_list[i]))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
+            if env.iteration - tracker.best_iter >= stopping_rounds:
+                _finish(tracker, stopped_early=True)
             if env.iteration == env.end_iteration - 1:
-                if verbose:
-                    log.info("Did not meet early stopping. Best iteration is:"
-                             "\n[%d]\t%s", best_iter[i] + 1,
-                             "\t".join(_format_eval_result(x)
-                                       for x in best_score_list[i]))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
+                _finish(tracker, stopped_early=False)
             if first_metric_only:
                 break
+
     _callback.order = 30
     return _callback

@@ -632,6 +632,13 @@ class GBDT:
                 # like the eager path
                 del self.models[max(first_slot, k):]
                 self.iter = it
+                # under stochastic row sampling (GOSS/bagging) iterations
+                # AFTER a degenerate one can still have grown real trees
+                # whose device score updates were applied before this
+                # rollback deleted them — recompute the training scores
+                # from the surviving model so post-stop metrics and any
+                # further training see a consistent state
+                self._rebuild_train_score()
                 return True
         return False
 

@@ -316,10 +316,9 @@ def grow_tree_partition_impl(
             pslot = jnp.argmax(in_slot).astype(jnp.int32)
             recomputed = seg(state.arena, s0,
                              jnp.where(found | no_split, 0, cntP_local))
-            if axis_name is not None:
-                recomputed = jax.lax.psum(recomputed, axis_name)
-            parent_hist = jnp.where(found, state.hist_cache[pslot],
-                                    recomputed.astype(dtype))
+            # under DP the recompute's allreduce is BATCHED with the
+            # smaller-child histogram's below (one collective per split
+            # even in pooled mode); only the kernel must run pre-split
         else:
             # dense cache (one slot per leaf): direct index, no extra
             # kernel or collective on the split critical path
@@ -366,8 +365,17 @@ def grow_tree_partition_impl(
                          jnp.where(no_split, 0, counts[1]))
         if axis_name is not None:
             # DP: ONE collective per split — the smaller child's histogram
-            # allreduce; the sibling still comes from subtraction (§3.4.2)
-            small_hist = jax.lax.psum(small_hist, axis_name)
+            # allreduce (the sibling still comes from subtraction, §3.4.2);
+            # in pooled mode the parent recompute rides the same allreduce
+            if pooled:
+                both_h = jax.lax.psum(jnp.stack([small_hist, recomputed]),
+                                      axis_name)
+                small_hist, recomputed = both_h[0], both_h[1]
+            else:
+                small_hist = jax.lax.psum(small_hist, axis_name)
+        if pooled:
+            parent_hist = jnp.where(found, state.hist_cache[pslot],
+                                    recomputed.astype(dtype))
         large_hist = parent_hist - small_hist
         left_hist = jnp.where(left_smaller, small_hist, large_hist)
         right_hist = jnp.where(left_smaller, large_hist, small_hist)

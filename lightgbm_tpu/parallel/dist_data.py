@@ -105,7 +105,11 @@ def construct_rank_shard(X: np.ndarray, config, rank: int, world: int,
         if weight is not None:
             meta.set_weights(np.asarray(weight)[rows])
         if init_score is not None:
-            meta.set_init_score(np.asarray(init_score)[rows])
+            # init_score is class-major [n*k] for multiclass: slice each
+            # class block by the shard rows (Metadata.subset layout)
+            s = np.asarray(init_score, np.float64)
+            k = max(1, len(s) // n)
+            meta.set_init_score(s.reshape(k, n)[:, rows].reshape(-1))
 
     # find-bin runs BEFORE the row partition, on the full data, so every
     # rank derives identical mappers (the reference's !pre_partition

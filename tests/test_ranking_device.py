@@ -93,3 +93,44 @@ def test_ndcg_empty_query_counts_as_one(rng):
     m.init(meta, 4)
     score = rng.randn(4)
     np.testing.assert_allclose(m.eval(score), m.eval_host(score), rtol=1e-6)
+
+
+def test_lambdarank_f32_path_matches_f64_oracle(rng):
+    """The shipped production default runs the device kernels in f32
+    (jax_enable_x64 off); the harness forces x64, so this test disables
+    it to exercise the f32 score-sort tie-breaking and pair sums against
+    the f64 host oracle under a loosened tolerance."""
+    import jax
+    meta, n, _ = _rank_data(rng, num_queries=30)
+    obj = LambdarankNDCG(Config({"objective": "lambdarank"}))
+    obj.init(meta, n)
+    # distinct scores: f32 cannot re-order ties the f64 oracle resolves
+    score = np.linspace(-2, 2, n)
+    rng.shuffle(score)
+    prev = jax.config.jax_enable_x64
+    try:
+        jax.config.update("jax_enable_x64", False)
+        gd, hd = (np.asarray(a, np.float64)
+                  for a in obj.get_gradients(score))
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+    gh, hh = obj.get_gradients_host(score)
+    np.testing.assert_allclose(gd, gh, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(hd, hh, rtol=2e-3, atol=2e-4)
+
+
+def test_ndcg_f32_path_matches_f64_oracle(rng):
+    import jax
+    meta, n, _ = _rank_data(rng, num_queries=30)
+    m = NDCGMetric(Config({"metric": "ndcg", "eval_at": [5]}))
+    m.init(meta, n)
+    score = np.linspace(-1, 1, n)
+    rng.shuffle(score)
+    prev = jax.config.jax_enable_x64
+    try:
+        jax.config.update("jax_enable_x64", False)
+        dev = m.eval(score)
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+    np.testing.assert_allclose(dev, m.eval_host(score), rtol=2e-4,
+                               atol=2e-5)

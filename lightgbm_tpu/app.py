@@ -72,6 +72,19 @@ class Application:
         pre_partition = (not cfg.is_single_machine()
                          and cfg.tree_learner in ("data", "voting")
                          and cfg.pre_partition)
+        if cfg.two_round and pre_partition:
+            log.warning("two_round streaming does not implement the "
+                        "distributed row pre-partition yet; falling back "
+                        "to in-memory loading for this rank")
+        elif cfg.two_round:
+            # memory-bounded streaming ingest: the binned dataset comes
+            # back fully constructed (two passes over the file, no full
+            # float matrix — dataset_loader.cpp:161-219)
+            binned = loader_mod.load_two_round(
+                cfg, cfg.data, initscore_filename=cfg.initscore_filename)
+            ds = basic.Dataset(None, params=dict(self.raw_params))
+            ds._binned = binned
+            return ds
         d = loader_mod.load_data_file(cfg, cfg.data,
                                       rank=cfg.machine_rank,
                                       num_machines=cfg.num_machines,

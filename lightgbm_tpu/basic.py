@@ -107,6 +107,19 @@ class Dataset:
             self._set_fields(self._binned.metadata, subset=True)
             return self
 
+        if (isinstance(self.data, str) and self.reference is None
+                and Config(self.params).two_round):
+            # memory-bounded streaming ingest straight from the file
+            # (dataset_loader.cpp:161-219 two-round branch)
+            from .io.loader import load_two_round
+            self._binned = load_two_round(Config(self.params), self.data)
+            if self.label is not None:
+                self._binned.metadata.set_label(np.asarray(self.label))
+            self._set_fields(self._binned.metadata)
+            if self.free_raw_data:
+                self.data = None
+            return self
+
         mat, label, names = _to_matrix(self.data, self.label)
         cat_auto = _pandas_categorical_columns(self.data)
         if self.label is not None:
@@ -273,11 +286,14 @@ class Booster:
                 # (the reference warns likewise, basic.py _update_params).
                 # Compare EFFECTIVE values (defaults applied) so passing
                 # the value the dataset already used stays silent.
+                # categorical_feature is excluded: it normally arrives via
+                # the Dataset constructor attribute (not params), so a
+                # params-level comparison would warn spuriously
                 relevant = ("max_bin", "bin_construct_sample_cnt",
                             "min_data_in_bin", "use_missing",
                             "zero_as_missing", "enable_bundle",
                             "max_conflict_rate", "monotone_constraints",
-                            "feature_contri", "categorical_feature")
+                            "feature_contri")
                 ds_cfg = Config(train_set.params)
                 tr_cfg = Config(self.params)
                 for key in relevant:

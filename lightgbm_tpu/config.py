@@ -359,8 +359,6 @@ def alias_transform(params: Dict[str, Any]) -> Dict[str, Any]:
 # this in sync as features land: a key must leave this table the moment
 # it starts acting.
 _INERT_PARAMS: Dict[str, str] = {
-    "two_round": "the whole text file is parsed in memory "
-                 "(no two-round/streaming ingest yet)",
     "is_enable_sparse": "bin storage is always dense on TPU (EFB bundles "
                         "sparse features into dense groups instead)",
     "sparse_threshold": "bin storage is always dense on TPU",

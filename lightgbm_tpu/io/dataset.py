@@ -236,10 +236,11 @@ class BinnedDataset:
                 [config.feature_contri[raw] for raw in self.real_feature_index],
                 dtype=np.float64)
 
-    def _bin_all(self, X) -> None:
-        if _issparse(X):
-            self._bin_all_sparse(X)
-            return
+    def bin_block(self, X) -> np.ndarray:
+        """Bin a dense row block against the fitted mappers:
+        [k, num_raw] floats -> [k, num_groups_or_features] packed bins.
+        Used by _bin_all and by the two_round streaming loader (chunks
+        binned straight into a preallocated matrix)."""
         n = X.shape[0]
         F = self.num_features
         if self.bundle is not None:
@@ -266,16 +267,20 @@ class BinnedDataset:
                     nz = b != int(info.feature_default[inner])
                     col = np.where(nz, b + int(info.feature_shift[inner]), col)
                 bins[:, g] = col.astype(dtype)
-            self.bins = bins
-            self._device_cache.clear()
-            return
+            return bins
         max_nb = max((m.num_bin for m in self.bin_mappers), default=2)
         dtype = np.uint8 if max_nb <= 256 else np.uint16
         bins = np.empty((n, F), dtype=dtype)
         for inner, raw in enumerate(self.real_feature_index):
             bins[:, inner] = self.bin_mappers[inner].values_to_bins(
                 np.asarray(X[:, raw], dtype=np.float64)).astype(dtype)
-        self.bins = bins
+        return bins
+
+    def _bin_all(self, X) -> None:
+        if _issparse(X):
+            self._bin_all_sparse(X)
+            return
+        self.bins = self.bin_block(np.asarray(X))
         self._device_cache.clear()
 
     def _bin_all_sparse(self, X) -> None:

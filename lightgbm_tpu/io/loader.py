@@ -81,6 +81,80 @@ def load_init_score_file(data_filename: str,
     return scores.reshape(-1, order="F")  # [k * n] class-major
 
 
+class _Layout:
+    """Resolved column roles of a delimited training file."""
+
+    def __init__(self, label_idx, weight_idx, group_idx, keep, cat,
+                 feature_names):
+        self.label_idx = label_idx
+        self.weight_idx = weight_idx
+        self.group_idx = group_idx
+        self.keep = keep
+        self.cat = cat
+        self.feature_names = feature_names
+
+
+def _resolve_layout(config, names, ncol) -> _Layout:
+    """Column-role resolution shared by the one-round and two_round
+    loaders (DatasetLoader::SetHeader, dataset_loader.cpp:24-115)."""
+    label_idx = _resolve_column(config.label_column, names, "label")
+    if label_idx < 0:
+        label_idx = 0     # default: first column (dataset_loader.cpp:33)
+
+    def skip_label(i):
+        # integer specs do not count the label column (reference
+        # SetHeader: "index ... doesn't count the label column",
+        # dataset_loader.cpp:46-115); name: specs resolve directly
+        return i + 1 if 0 <= label_idx <= i else i
+
+    def adj(spec, what):
+        idx = _resolve_column(spec, names, what)
+        if idx >= 0 and not spec.startswith("name:"):
+            idx = skip_label(idx)
+        return idx
+
+    weight_idx = adj(config.weight_column, "weight")
+    group_idx = adj(config.group_column, "group")
+
+    def adj_list(spec, what):
+        idxs = _resolve_list(spec, names, what)
+        if not spec.startswith("name:"):
+            idxs = [skip_label(i) for i in idxs]
+        return idxs
+
+    ignore = set(adj_list(config.ignore_column, "ignore_column"))
+    cat_raw = adj_list(config.categorical_feature, "categorical_feature")
+
+    special = {label_idx} | {i for i in (weight_idx, group_idx) if i >= 0}
+    keep = [i for i in range(ncol) if i not in special and i not in ignore]
+    # feature indices in config refer to the ORIGINAL columns minus the
+    # specials removed before them (reference remaps the same way)
+    remap = {orig: new for new, orig in enumerate(keep)}
+    cat = [remap[c] for c in cat_raw if c in remap]
+    feature_names = [names[i] for i in keep] if names else None
+    return _Layout(label_idx, weight_idx, group_idx, keep, cat,
+                   feature_names)
+
+
+def _group_ids_to_counts(ids: np.ndarray) -> np.ndarray:
+    """Group column holds a query id per row -> per-query counts."""
+    change = np.flatnonzero(np.diff(ids)) + 1
+    bounds = np.concatenate([[0], change, [len(ids)]])
+    return np.diff(bounds).astype(np.int32)
+
+
+def _load_side_files(filename: str, group, weight):
+    """<data>.query / <data>.weight side channels (metadata.cpp
+    LoadQueryBoundaries/LoadWeights); column data wins over side files."""
+    import os
+    if group is None and os.path.exists(filename + ".query"):
+        counts = np.loadtxt(filename + ".query", dtype=np.int64, ndmin=1)
+        group = counts.astype(np.int32)
+    if weight is None and os.path.exists(filename + ".weight"):
+        weight = np.loadtxt(filename + ".weight", dtype=np.float64, ndmin=1)
+    return group, weight
+
+
 def load_data_file(config, filename: str,
                    rank: int = 0, num_machines: int = 1,
                    pre_partition: bool = False,
@@ -96,63 +170,17 @@ def load_data_file(config, filename: str,
         cat = _resolve_list(config.categorical_feature, None,
                             "categorical_feature")
     else:
-        ncol = mat.shape[1]
-        label_idx = _resolve_column(config.label_column, names, "label")
-        if label_idx < 0:
-            label_idx = 0     # default: first column (dataset_loader.cpp:33)
+        lay = _resolve_layout(config, names, mat.shape[1])
+        X = mat[:, lay.keep]
+        label = mat[:, lay.label_idx]
+        weight = mat[:, lay.weight_idx] if lay.weight_idx >= 0 else None
+        group_col = mat[:, lay.group_idx] if lay.group_idx >= 0 else None
+        cat = lay.cat
+        feature_names = lay.feature_names
+        group = (None if group_col is None
+                 else _group_ids_to_counts(group_col))
 
-        def skip_label(i):
-            # integer specs do not count the label column (reference
-            # SetHeader: "index ... doesn't count the label column",
-            # dataset_loader.cpp:46-115); name: specs resolve directly
-            return i + 1 if 0 <= label_idx <= i else i
-
-        def adj(spec, what):
-            idx = _resolve_column(spec, names, what)
-            if idx >= 0 and not spec.startswith("name:"):
-                idx = skip_label(idx)
-            return idx
-
-        weight_idx = adj(config.weight_column, "weight")
-        group_idx = adj(config.group_column, "group")
-
-        def adj_list(spec, what):
-            idxs = _resolve_list(spec, names, what)
-            if not spec.startswith("name:"):
-                idxs = [skip_label(i) for i in idxs]
-            return idxs
-
-        ignore = set(adj_list(config.ignore_column, "ignore_column"))
-        cat_raw = adj_list(config.categorical_feature, "categorical_feature")
-
-        special = {label_idx} | {i for i in (weight_idx, group_idx) if i >= 0}
-        keep = [i for i in range(ncol) if i not in special and i not in ignore]
-        X = mat[:, keep]
-        label = mat[:, label_idx]
-        weight = mat[:, weight_idx] if weight_idx >= 0 else None
-        group_col = mat[:, group_idx] if group_idx >= 0 else None
-        # feature indices in config refer to the ORIGINAL columns minus the
-        # specials removed before them (reference remaps the same way)
-        remap = {orig: new for new, orig in enumerate(keep)}
-        cat = [remap[c] for c in cat_raw if c in remap]
-        feature_names = [names[i] for i in keep] if names else None
-
-        group = None
-        if group_col is not None:
-            # group column holds a query id per row -> boundaries
-            ids = group_col
-            change = np.flatnonzero(np.diff(ids)) + 1
-            bounds = np.concatenate([[0], change, [len(ids)]])
-            group = np.diff(bounds).astype(np.int32)
-
-    # query-file / weight-file side channels (<data>.query / <data>.weight,
-    # metadata.cpp LoadQueryBoundaries/LoadWeights)
-    import os
-    if group is None and os.path.exists(filename + ".query"):
-        counts = np.loadtxt(filename + ".query", dtype=np.int64, ndmin=1)
-        group = counts.astype(np.int32)
-    if weight is None and os.path.exists(filename + ".weight"):
-        weight = np.loadtxt(filename + ".weight", dtype=np.float64, ndmin=1)
+    group, weight = _load_side_files(filename, group, weight)
     init_score = load_init_score_file(filename, initscore_filename)
 
     if pre_partition and num_machines > 1:
@@ -176,3 +204,137 @@ def load_data_file(config, filename: str,
                  for c in range(k)])
 
     return LoadedData(X, label, weight, group, feature_names, cat, init_score)
+
+
+def _iter_delimited_chunks(filename: str, sep: str, header: bool,
+                           chunk_rows: int):
+    """Yield [k, ncol] float chunks of a CSV/TSV file (pandas streaming)."""
+    import pandas as pd
+    reader = pd.read_csv(filename, sep=sep, header=0 if header else None,
+                         comment="#", skip_blank_lines=True,
+                         chunksize=chunk_rows)
+    names = None
+    for i, df in enumerate(reader):
+        if i == 0 and header:
+            names = [str(c) for c in df.columns]
+        yield df.to_numpy(dtype=np.float64), names
+
+
+def load_two_round(config, filename: str,
+                   initscore_filename: str = "",
+                   chunk_rows: int = 1 << 16):
+    """Memory-bounded two-pass ingest (`two_round`,
+    dataset_loader.cpp:161-219 LoadFromFile two-round branch).
+
+    Pass 1 streams the file chunk-by-chunk collecting row count, the
+    label/weight/group columns and a reservoir sample of
+    bin_construct_sample_cnt rows; bin mappers (and EFB bundles) are
+    found from the sample only.  Pass 2 streams again, binning each
+    chunk straight into the preallocated packed bins matrix — the full
+    [n, F] float matrix never materializes, so >RAM text files load in
+    O(sample + chunk + bins) memory.
+
+    Returns a fully constructed BinnedDataset (metadata filled).
+    CSV/TSV only; LibSVM falls back to the one-round loader.
+    """
+    from .dataset import BinnedDataset
+    from .metadata import Metadata
+    from .parser import _read_head, detect_format
+
+    head = _read_head(filename, 33, skip_comments=True)
+    fmt = detect_format(head[1:] if config.header else head)
+    if fmt == "libsvm":
+        log.warning("two_round streaming supports CSV/TSV only; LibSVM "
+                    "file falls back to in-memory loading")
+        d = load_data_file(config, filename,
+                           initscore_filename=initscore_filename)
+        meta = Metadata(len(d.X))
+        meta.set_label(d.label)
+        if d.weight is not None:
+            meta.set_weights(d.weight)
+        if d.group is not None:
+            meta.set_query(d.group)
+        if d.init_score is not None:
+            meta.set_init_score(d.init_score)
+        return BinnedDataset.construct(
+            d.X, config, metadata=meta,
+            categorical_features=d.categorical or (),
+            feature_names=d.feature_names)
+    sep = "\t" if fmt == "tsv" else ","
+
+    # ---- pass 1: count, collect side columns, reservoir-sample rows ----
+    rng = np.random.RandomState(config.data_random_seed)
+    S = max(2, config.bin_construct_sample_cnt)
+    sample_rows = None
+    labels, weights, group_ids = [], [], []
+    lay = None
+    n = 0
+    for chunk, names in _iter_delimited_chunks(filename, sep, config.header,
+                                               chunk_rows):
+        if lay is None:
+            lay = _resolve_layout(config, names, chunk.shape[1])
+            sample_rows = np.empty((0, len(lay.keep)), np.float64)
+        feats = chunk[:, lay.keep]
+        labels.append(chunk[:, lay.label_idx])
+        if lay.weight_idx >= 0:
+            weights.append(chunk[:, lay.weight_idx])
+        if lay.group_idx >= 0:
+            group_ids.append(chunk[:, lay.group_idx])
+        k = len(feats)
+        if len(sample_rows) < S:
+            take = min(S - len(sample_rows), k)
+            sample_rows = np.vstack([sample_rows, feats[:take]])
+            feats, base = feats[take:], n + take
+        else:
+            base = n
+        if len(feats):
+            # vectorized reservoir (algorithm R): row at global index t
+            # replaces a random slot with probability S/(t+1)
+            t = base + np.arange(len(feats))
+            slot = (rng.rand(len(feats)) * (t + 1)).astype(np.int64)
+            hit = slot < S
+            sample_rows[slot[hit]] = feats[hit]
+        n += k
+
+    if n == 0 or lay is None:
+        log.fatal("two_round loader: %s is empty" % filename)
+
+    # ---- find bins + bundles from the sample only (mapper-only build) --
+    mapper_ds = BinnedDataset.construct(
+        sample_rows, config, categorical_features=lay.cat,
+        feature_names=lay.feature_names, bin_rows=False)
+
+    # ---- pass 2: bin chunks straight into the packed matrix ------------
+    probe = mapper_ds.bin_block(sample_rows[:1])
+    bins = np.empty((n, probe.shape[1]), probe.dtype)
+    row = 0
+    for chunk, _names in _iter_delimited_chunks(filename, sep, config.header,
+                                                chunk_rows):
+        blk = mapper_ds.bin_block(chunk[:, lay.keep])
+        bins[row:row + len(blk)] = blk
+        row += len(blk)
+
+    if row != n:
+        log.fatal("two_round loader: pass 2 read %d rows but pass 1 "
+                  "counted %d (file changed between passes?)" % (row, n))
+
+    ds = mapper_ds
+    ds.bins = bins
+    ds.num_data = n
+    ds._device_cache.clear()
+    meta = Metadata(n)
+    meta.set_label(np.concatenate(labels))
+    group = (_group_ids_to_counts(np.concatenate(group_ids))
+             if group_ids else None)
+    weight = np.concatenate(weights) if weights else None
+    group, weight = _load_side_files(filename, group, weight)
+    if group is not None:
+        meta.set_query(group)
+    if weight is not None:
+        meta.set_weights(weight)
+    init_score = load_init_score_file(filename, initscore_filename)
+    if init_score is not None:
+        meta.set_init_score(init_score)
+    meta.init(n)
+    ds.metadata = meta
+    return ds

@@ -61,18 +61,18 @@ def test_inert_params_warn_once(capsys):
     """Accepted-but-inert knobs must warn, not silently no-op."""
     import lightgbm_tpu.config as config_mod
     config_mod._INERT_WARNED.clear()
-    Config({"two_round": True, "histogram_pool_size": 512.0,
-            "sparse_threshold": 0.5})
+    # two_round and histogram_pool_size act now; only the storage
+    # knobs remain inert
+    Config({"sparse_threshold": 0.5, "is_enable_sparse": False})
     out = capsys.readouterr().out
-    assert "two_round" in out and "histogram_pool_size" in out \
-        and "sparse_threshold" in out
+    assert "sparse_threshold" in out and "is_enable_sparse" in out
     # once per process only
-    Config({"two_round": True})
-    assert "two_round" not in capsys.readouterr().out
+    Config({"sparse_threshold": 0.5})
+    assert "sparse_threshold" not in capsys.readouterr().out
     # default values stay silent
     config_mod._INERT_WARNED.clear()
-    Config({"two_round": False})
-    assert "two_round" not in capsys.readouterr().out
+    Config({"sparse_threshold": 0.8})
+    assert "sparse_threshold" not in capsys.readouterr().out
 
 
 def test_initscore_file_loading(tmp_path):

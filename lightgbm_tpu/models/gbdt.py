@@ -496,6 +496,7 @@ class GBDT:
                 max_bin=self.max_bin, emit="score", full_bag=True,
                 max_cat_threshold=self.config.max_cat_threshold,
                 hist_slots=self._hist_slots,
+                forced_splits=self._forced_splits,
                 interpret=interpret)
             new_score = score_row + shrink * delta.astype(score_row.dtype)
             ivec, fvec = grow_ops.pack_tree_arrays(arrays)
@@ -692,13 +693,12 @@ class GBDT:
         eligible = (self._grower is None
                     and self.dtype == jnp.float32
                     and self.max_bin <= 256
-                    and not self._forced_splits
                     and self.train_set.num_features > 0
                     and self.num_data < (1 << 24))
         if eng == "partition" and not eligible:
             log.warning("tpu_tree_engine=partition not applicable here "
-                        "(needs serial learner, f32, max_bin<=256, no "
-                        "forced splits); using label engine")
+                        "(needs serial learner, f32, max_bin<=256); "
+                        "using label engine")
             eng = "label"
         from ..ops import partition_pallas as pp
         # the arena stores the (possibly EFB-bundled) GROUP columns
@@ -721,6 +721,12 @@ class GBDT:
         else:
             slots = L
         self._hist_slots = 0 if slots >= L else max(4, slots)
+        pooling_blocked = False
+        if self._forced_splits and self._hist_slots:
+            # the forced-split injection indexes the histogram cache by
+            # leaf id, which requires the dense (one slot per leaf) cache
+            self._hist_slots = 0
+            pooling_blocked = True
         hist_cache_bytes = (self._hist_slots or L) * entry_bytes
         arena_bytes = (C * cap * 2 + self.num_data * C * 2
                        + hist_cache_bytes)      # bf16 arena + bins_t + hists
@@ -730,6 +736,9 @@ class GBDT:
             eng = ("partition" if eligible and fits
                    and jax.default_backend() == "tpu" else "label")
         self._use_partition_engine = eng == "partition"
+        if pooling_blocked and self._use_partition_engine:
+            log.warning("forced splits disable histogram pooling (dense "
+                        "per-leaf cache required)")
         self._bins_t = None
         self._last_truncated = None     # device bool from the last grown tree
         self._truncation_warned = False
@@ -768,6 +777,7 @@ class GBDT:
                     full_bag=self._bag_mask is None,
                     max_cat_threshold=self.config.max_cat_threshold,
                     hist_slots=self._hist_slots,
+                    forced_splits=self._forced_splits,
                     interpret=jax.default_backend() != "tpu")
                 if not getattr(self, "_partition_validated", False):
                     # force materialization once: async dispatch would

@@ -18,11 +18,12 @@ arena budget covers a balanced 255-leaf tree, and the GBDT driver falls
 back to the label engine for configs that need full generality.
 
 Supports categorical bitset splits, EFB-bundled datasets (both via the
-go-left mask decision) and data-parallel sharding (axis_name: psum'd
+go-left mask decision), forced splits (the same cache-injection scheme
+as the label engine) and data-parallel sharding (axis_name: psum'd
 histograms, local arenas).  Remaining restrictions vs the label engine
-(the GBDT driver auto-selects): f32 only, max_bin <= 256, no forced
-splits, n < 2^24 (rowids ride three byte planes exactly), serial or
-data-parallel only (feature-/voting-parallel use the label engine).
+(the GBDT driver auto-selects): f32 only, max_bin <= 256, n < 2^24
+(rowids ride three byte planes exactly), serial or data-parallel only
+(feature-/voting-parallel use the label engine).
 """
 from __future__ import annotations
 
@@ -93,6 +94,7 @@ def grow_tree_partition_impl(
         max_cat_threshold: int = 32,
         axis_name: Optional[str] = None,
         hist_slots: int = 0,
+        forced_splits: tuple = (),
         interpret: bool = False):
     """Grow one leaf-wise tree.
 
@@ -231,6 +233,9 @@ def grow_tree_partition_impl(
     # per leaf, never spills — leaf-indexed, no lookup machinery traced)
     K = max(min(hist_slots, L), 4) if hist_slots and hist_slots > 0 else L
     pooled = K < L
+    if forced_splits and pooled:
+        raise ValueError("forced_splits require the dense histogram cache "
+                         "(hist_slots=0): the injection indexes it by leaf")
     hist_cache = jnp.zeros((K,) + root_hist.shape, dtype).at[0].set(root_hist)
     if pooled:
         slot_leaf0 = jnp.full(K, -1, jnp.int32).at[0].set(0)
@@ -516,6 +521,73 @@ def grow_tree_partition_impl(
             leaf_min=sel(state.leaf_min, leaf_min),
             leaf_max=sel(state.leaf_max, leaf_max))
 
+    # Forced splits first (trace-time unrolled, same scheme as the label
+    # engine: inject a +inf-gain forced result into the split cache and
+    # run one standard body step; a static->dynamic leaf map abandons
+    # invalid subtrees — ForceSplits, serial_tree_learner.cpp:593-751).
+    # NOTE: the dense-cache path indexes hist_cache by leaf id; forced
+    # splits require hist_slots == 0 (the driver only offers them there).
+    if forced_splits:
+        from .split import forced_split_result
+        leafmap = jnp.full((len(forced_splits) + 1,), -1,
+                           jnp.int32).at[0].set(0)
+        for i, (f_leaf, f_feat, f_thr, f_dl) in enumerate(forced_splits):
+            if i >= L - 1:
+                break
+            dyn_leaf = leafmap[f_leaf]
+            safe_leaf = jnp.maximum(dyn_leaf, 0)
+            f_hist = state.hist_cache[safe_leaf]
+            f_g = jnp.sum(f_hist[0, :, 0])
+            f_h = jnp.sum(f_hist[0, :, 1])
+            f_cnt = state.tree.leaf_count[safe_leaf]
+            fsp = forced_split_result(
+                unbundle(f_hist, f_g, f_h, f_cnt),
+                jnp.int32(f_feat), jnp.int32(f_thr), f_g, f_h, f_cnt,
+                num_bins, default_bins, missing_types, params,
+                jnp.asarray(bool(f_dl)))
+            if state.split_cache.cat_mask is not None:
+                fsp = fsp._replace(cat_mask=jnp.zeros(
+                    state.split_cache.cat_mask.shape[1], bool))
+            pre_valid = (dyn_leaf >= 0) & (fsp.gain > K_MIN_SCORE) & \
+                        (state.tree.num_leaves < L)
+            # Unlike the label engine, the merge must NOT select over the
+            # arena (a [C, cap] where would force a copy alongside the
+            # aliased kernel).  Instead an INVALID entry masks every gain
+            # in the injected cache to K_MIN so body() itself no-ops
+            # (cnt=0 kernel pass, arena genuinely untouched, small state
+            # kept) and stepped flows through unconditionally; only the
+            # split cache must be restored afterwards (the no-op path
+            # would otherwise keep the masked gains and end growth).
+            inj = _stack_split(fsp, state.split_cache, safe_leaf)
+            inj = inj._replace(gain=jnp.where(
+                pre_valid, inj.gain,
+                jnp.full_like(inj.gain, K_MIN_SCORE)))
+            saved_cache = state.split_cache
+            prev_leaves = state.tree.num_leaves
+            dyn_new = prev_leaves
+            stepped = body(state._replace(split_cache=inj))
+            # the split may ALSO no-op on arena overflow inside body —
+            # gate the leaf map on whether it actually applied, so an
+            # abandoned entry's forced subtree is dropped
+            applied = stepped.tree.num_leaves == prev_leaves + 1
+
+            def _selc(new_v, old_v):
+                if new_v is None:
+                    return None
+                return jnp.where(applied, new_v, old_v)
+
+            state = stepped._replace(
+                done=jnp.asarray(False),
+                split_cache=SplitResult(*[
+                    _selc(nn, oo) for nn, oo in
+                    zip(stepped.split_cache, saved_cache)]))
+            leafmap = leafmap.at[i + 1].set(jnp.where(applied, dyn_new, -1))
+            # on failure also unmap the target: the only later entry that
+            # references static id f_leaf is this entry's LEFT-child
+            # entry, which must be abandoned with the right subtree
+            leafmap = leafmap.at[f_leaf].set(
+                jnp.where(applied, dyn_leaf, -1))
+
     state = jax.lax.while_loop(cond, body, state)
 
     # ---- recover per-row outputs from the final segments -----------------
@@ -548,5 +620,6 @@ def grow_tree_partition_impl(
 
 grow_tree_partition = partial(jax.jit, static_argnames=(
     "max_leaves", "max_depth", "max_bin", "emit", "full_bag",
-    "max_cat_threshold", "axis_name", "hist_slots", "interpret"),
+    "max_cat_threshold", "axis_name", "hist_slots", "forced_splits",
+    "interpret"),
     donate_argnums=(0,))(grow_tree_partition_impl)

@@ -232,31 +232,33 @@ def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
     carryB[:] = jnp.zeros((C, CARRY_W), ARENA_DT)
 
     def append_and_flush(carry, comp, ck, fill, written, dst, stream, fslot):
-        """Add comp (already positioned at `fill`) into the carry; flush one
-        FLUSH_W chunk if filled.  Returns (fill', written', fslot')."""
+        """Add comp (already positioned at `fill`) into the carry; flush
+        filled FLUSH_W chunks (up to ceil(SUB/FLUSH_W) per append when
+        FLUSH_W < SUB).  Returns (fill', written', fslot')."""
         carry[:] = carry[:] + comp
         fill = fill + ck
 
-        @pl.when(fill >= FLUSH_W)
-        def _():
-            # previous flush of this slot (two flushes ago) must have landed
-            @pl.when(written >= 2 * FLUSH_W)
-            def _():
-                flush_dma(stream, fslot, 0).wait()
-            flush_buf[stream, fslot] = carry[:, 0:FLUSH_W]
-            flush_dma(stream, fslot, dst + written).start()
-            # static left-shift by FLUSH_W via slice+pad (pltpu.roll only
-            # rotates 32-bit data; the carry is bf16)
-            shifted = jnp.concatenate(
-                [carry[:, FLUSH_W:CARRY_W],
-                 jnp.zeros((C, FLUSH_W), ARENA_DT)], axis=1)
-            carry[:] = jnp.where(lane_w < fill - FLUSH_W, shifted,
-                                 jnp.bfloat16(0.0))
+        for _ in range(-(-SUB // FLUSH_W)):
+            @pl.when(fill >= FLUSH_W)
+            def _(fill=fill, written=written, fslot=fslot):
+                # previous flush of this slot (2 flushes ago) must have landed
+                @pl.when(written >= 2 * FLUSH_W)
+                def _():
+                    flush_dma(stream, fslot, 0).wait()
+                flush_buf[stream, fslot] = carry[:, 0:FLUSH_W]
+                flush_dma(stream, fslot, dst + written).start()
+                # static left-shift by FLUSH_W via slice+pad (pltpu.roll
+                # only rotates 32-bit data; the carry is bf16)
+                shifted = jnp.concatenate(
+                    [carry[:, FLUSH_W:CARRY_W],
+                     jnp.zeros((C, FLUSH_W), ARENA_DT)], axis=1)
+                carry[:] = jnp.where(lane_w < fill - FLUSH_W, shifted,
+                                     jnp.bfloat16(0.0))
 
-        flushed = fill >= FLUSH_W
-        fill = jnp.where(flushed, fill - FLUSH_W, fill)
-        written = jnp.where(flushed, written + FLUSH_W, written)
-        fslot = jnp.where(flushed, 1 - fslot, fslot)
+            flushed = fill >= FLUSH_W
+            fill = jnp.where(flushed, fill - FLUSH_W, fill)
+            written = jnp.where(flushed, written + FLUSH_W, written)
+            fslot = jnp.where(flushed, 1 - fslot, fslot)
         return fill, written, fslot
 
     def loop(j, carry_state):

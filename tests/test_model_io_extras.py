@@ -210,3 +210,48 @@ def test_forced_splits(tmp_path, rng):
     # quality sanity: remaining best-first splits still learn feature 0
     from sklearn.metrics import roc_auc_score
     assert roc_auc_score(y, bst.predict(X)) > 0.9
+
+
+def test_forced_splits_partition_engine(tmp_path, rng):
+    """Forced splits run on the partition engine too (same injection
+    scheme as the label engine) and both grow the same structure."""
+    import json as _json
+
+    n, F = 900, 4
+    X = rng.randn(n, F).astype(np.float32)
+    flip = rng.rand(n) < 0.15
+    y = (((X[:, 0] > 0) ^ flip)).astype(np.float32)
+    fs = tmp_path / "forced.json"
+    fs.write_text(_json.dumps({
+        "feature": 3, "threshold": 0.0,
+        "left": {"feature": 2, "threshold": 0.0}}))
+    outs = {}
+    for eng in ("partition", "label"):
+        params = {"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 5, "verbose": -1,
+                  "forcedsplits_filename": str(fs),
+                  "tpu_tree_engine": eng}
+        bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=2)
+        assert bst._gbdt._use_partition_engine == (eng == "partition")
+        outs[eng] = bst.dump_model()
+    for eng, d in outs.items():
+        for t in d["tree_info"]:
+            root = t["tree_structure"]
+            assert root["split_feature"] == 3, eng
+            assert root["left_child"]["split_feature"] == 2, eng
+
+    def skel(d):
+        out = []
+
+        def walk(nd):
+            if "leaf_value" in nd:
+                out.append(("leaf", nd["leaf_count"]))
+            else:
+                out.append((nd["split_feature"], nd["internal_count"]))
+                walk(nd["left_child"])
+                walk(nd["right_child"])
+        for t in d["tree_info"]:
+            walk(t["tree_structure"])
+        return out
+
+    assert skel(outs["partition"]) == skel(outs["label"])

@@ -48,6 +48,26 @@ class BundleMaps(NamedTuple):
     needs_fix: jnp.ndarray      # [F] bool default bin reconstructed at scan
 
 
+def build_forced_candidate(hist, cnt, f_feat, f_thr, f_dl, unbundle,
+                           num_bins, default_bins, missing_types, params,
+                           cat_width: int = 0):
+    """One forced-split plan entry -> the SplitResult to inject into the
+    split cache (shared by the label and partition engines so the
+    candidate semantics cannot drift; ForceSplits,
+    serial_tree_learner.cpp:593-751)."""
+    from .split import forced_split_result
+    f_g = jnp.sum(hist[0, :, 0])
+    f_h = jnp.sum(hist[0, :, 1])
+    fsp = forced_split_result(
+        unbundle(hist, f_g, f_h, cnt),
+        jnp.int32(f_feat), jnp.int32(f_thr), f_g, f_h, cnt,
+        num_bins, default_bins, missing_types, params,
+        jnp.asarray(bool(f_dl)))
+    if cat_width:
+        fsp = fsp._replace(cat_mask=jnp.zeros(cat_width, bool))
+    return fsp
+
+
 def unbundle_hist(hist, sum_g, sum_h, cnt, bundle: Optional[BundleMaps],
                   default_bins):
     """[G, B, 3] group histogram -> [F, B, 3] per-feature view.
@@ -538,25 +558,18 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
     # so its whole forced subtree is abandoned (ForceSplits,
     # serial_tree_learner.cpp:593-751) while siblings from other branches
     # still resolve to the right dynamic leaf ids.
-    from .split import forced_split_result
     leafmap = jnp.full((len(forced_splits) + 1,), -1, jnp.int32).at[0].set(0)
     for i, (f_leaf, f_feat, f_thr, f_dl) in enumerate(forced_splits):
         if i >= max_leaves - 1:
             break      # each applied split adds one leaf; bound the count
         dyn_leaf = leafmap[f_leaf]
         safe_leaf = jnp.maximum(dyn_leaf, 0)
-        f_hist = state.hist_cache[safe_leaf]
-        f_g = jnp.sum(f_hist[0, :, 0])
-        f_h = jnp.sum(f_hist[0, :, 1])
-        f_cnt = state.tree.leaf_count[safe_leaf]
-        fsp = forced_split_result(
-            unbundle(f_hist, f_g, f_h, f_cnt),
-            jnp.int32(f_feat), jnp.int32(f_thr), f_g, f_h, f_cnt,
+        fsp = build_forced_candidate(
+            state.hist_cache[safe_leaf], state.tree.leaf_count[safe_leaf],
+            f_feat, f_thr, f_dl, unbundle,
             num_bins, default_bins, missing_types, params,
-            jnp.asarray(bool(f_dl)))
-        if state.split_cache.cat_mask is not None:
-            fsp = fsp._replace(
-                cat_mask=jnp.zeros(state.split_cache.cat_mask.shape[1], bool))
+            cat_width=(state.split_cache.cat_mask.shape[1]
+                       if state.split_cache.cat_mask is not None else 0))
         valid = (dyn_leaf >= 0) & (fsp.gain > K_MIN_SCORE) & \
                 (state.tree.num_leaves < max_leaves)
         injected = state._replace(

@@ -528,7 +528,7 @@ def grow_tree_partition_impl(
     # NOTE: the dense-cache path indexes hist_cache by leaf id; forced
     # splits require hist_slots == 0 (the driver only offers them there).
     if forced_splits:
-        from .split import forced_split_result
+        from .grow import build_forced_candidate
         leafmap = jnp.full((len(forced_splits) + 1,), -1,
                            jnp.int32).at[0].set(0)
         for i, (f_leaf, f_feat, f_thr, f_dl) in enumerate(forced_splits):
@@ -536,18 +536,13 @@ def grow_tree_partition_impl(
                 break
             dyn_leaf = leafmap[f_leaf]
             safe_leaf = jnp.maximum(dyn_leaf, 0)
-            f_hist = state.hist_cache[safe_leaf]
-            f_g = jnp.sum(f_hist[0, :, 0])
-            f_h = jnp.sum(f_hist[0, :, 1])
-            f_cnt = state.tree.leaf_count[safe_leaf]
-            fsp = forced_split_result(
-                unbundle(f_hist, f_g, f_h, f_cnt),
-                jnp.int32(f_feat), jnp.int32(f_thr), f_g, f_h, f_cnt,
+            fsp = build_forced_candidate(
+                state.hist_cache[safe_leaf],
+                state.tree.leaf_count[safe_leaf],
+                f_feat, f_thr, f_dl, unbundle,
                 num_bins, default_bins, missing_types, params,
-                jnp.asarray(bool(f_dl)))
-            if state.split_cache.cat_mask is not None:
-                fsp = fsp._replace(cat_mask=jnp.zeros(
-                    state.split_cache.cat_mask.shape[1], bool))
+                cat_width=(state.split_cache.cat_mask.shape[1]
+                           if state.split_cache.cat_mask is not None else 0))
             pre_valid = (dyn_leaf >= 0) & (fsp.gain > K_MIN_SCORE) & \
                         (state.tree.num_leaves < L)
             # Unlike the label engine, the merge must NOT select over the

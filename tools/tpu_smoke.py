@@ -48,6 +48,11 @@ def main() -> int:
         ("goss", {"boosting": "goss"}),
         ("dart", {"boosting": "dart"}),
         ("multiclass", {"objective": "multiclass", "num_class": 3}),
+        ("bagging", {"bagging_fraction": 0.7, "bagging_freq": 1}),
+        ("categorical", {"categorical": True}),
+        ("hist-pool", {"tpu_tree_engine": "partition",
+                       "histogram_pool_size": 0.5}),
+        ("forced", {"forced": True}),
     ]
     for name, extra in configs:
         p = {"objective": "binary", "num_leaves": 31, "verbose": -1}
@@ -55,8 +60,23 @@ def main() -> int:
         yy = (np.digitize(y + X[:, 3], [0.5, 1.2]).astype(np.float32)
               if p.get("objective") == "multiclass" else y)
         t0 = time.time()
+        forced_file = None
         try:
-            ds = lgb.Dataset(X, label=yy)
+            if p.pop("categorical", False):
+                Xc = X.copy()
+                Xc[:, 5] = np.floor(np.abs(Xc[:, 5]) * 3) % 8
+                ds = lgb.Dataset(Xc, label=yy, categorical_feature=[5])
+            else:
+                ds = lgb.Dataset(X, label=yy)
+            if p.pop("forced", False):
+                import json
+                import tempfile
+                fs = tempfile.NamedTemporaryFile(
+                    "w", suffix=".json", delete=False)
+                json.dump({"feature": 2, "threshold": 0.0}, fs)
+                fs.close()
+                forced_file = fs.name
+                p["forcedsplits_filename"] = forced_file
             bst = lgb.train(p, ds, num_boost_round=2)
             nt = bst.num_trees()
             assert nt >= 1, "no trees grew"
@@ -70,6 +90,10 @@ def main() -> int:
             print("  %-16s FAIL: %s: %s" % (name, type(exc).__name__,
                                             str(exc).split("\n")[0][:160]))
             failures.append(name)
+        finally:
+            if forced_file:
+                import os
+                os.unlink(forced_file)
     if failures:
         print("SMOKE FAILED:", ", ".join(failures))
         return 1

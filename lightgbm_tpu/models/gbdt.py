@@ -324,17 +324,22 @@ class GBDT:
         if no_bagging and self._fused_eligible(deferred_ok, k, custom):
             try:
                 with self.profiler.phase("fused_iter"):
-                    packed = self._run_fused_iter()
-                for p in packed:
-                    p.copy_to_host_async()
-                self.models.append(None)
-                self._inflight.append(dict(
-                    packed=packed, max_leaves=self.config.num_leaves,
-                    cat_bins=(self.max_bin if self.is_categorical is not None
-                              else 0),
-                    init_score=init_scores[0],
-                    has_trunc_flag=True, it=self.iter,
-                    slot=len(self.models) - 1))
+                    packed_per_class = self._run_fused_iter()
+                # start every host copy BEFORE the first bookkeeping
+                # append: a fault surfacing mid-loop must not leave
+                # orphaned model slots behind for the fallback path
+                for packed in packed_per_class:
+                    for p in packed:
+                        p.copy_to_host_async()
+                for kk, packed in enumerate(packed_per_class):
+                    self.models.append(None)
+                    self._inflight.append(dict(
+                        packed=packed, max_leaves=self.config.num_leaves,
+                        cat_bins=(self.max_bin
+                                  if self.is_categorical is not None else 0),
+                        init_score=init_scores[kk],
+                        has_trunc_flag=True, it=self.iter,
+                        slot=len(self.models) - 1))
                 self.iter += 1
                 return False
             except Exception as exc:
@@ -458,56 +463,85 @@ class GBDT:
     # dominate on remote-attached TPUs.
     # ------------------------------------------------------------------ #
     def _fused_eligible(self, deferred_ok: bool, k: int, custom: bool) -> bool:
-        return (deferred_ok and k == 1 and not custom
+        return (deferred_ok and not custom
                 and getattr(self, "_use_partition_engine", False)
                 and self.objective is not None
-                and self.objective.class_need_train(0)
+                and all(self.objective.class_need_train(kk)
+                        for kk in range(k))
                 and type(self)._sample_gradients is GBDT._sample_gradients
                 and self.train_set.num_features > 0)
 
-    def _build_fused_iter(self):
-        from functools import partial as _partial
+    def _objective_device_fields(self):
+        """[(holder, attr)] of every array the objective's gradient math
+        closes over — including multiclass internals (_label_int, OVA
+        per-class sub-objectives).  Swapped for traced ARGUMENTS inside
+        the fused program so they don't ship as compile-request constants
+        through the device tunnel."""
+        holders = [self.objective] + list(
+            getattr(self.objective, "binary_loss", []) or [])
+        fields = []
+        for h in holders:
+            for name, v in vars(h).items():
+                if isinstance(v, (jnp.ndarray, np.ndarray)) and v.ndim > 0:
+                    fields.append((h, name))
+        return fields
 
+    def _build_fused_iter(self):
         from ..ops import grow_partition as gp
         objective = self.objective
         interpret = jax.default_backend() != "tpu"
+        k = max(self.num_tree_per_iteration, 1)
+        self._fused_fields = self._objective_device_fields()
+        fields = self._fused_fields
 
-        def fused(arena, bins_t, score_row, label, weights, row0, fmask,
+        def fused(arena, bins_t, score, field_vals, row0, fmasks,
                   num_bins, default_bins, missing_types, sparams, monotone,
                   penalty, shrink):
-            # gradients: trace the objective's device math against the
-            # ARGUMENT label/weights (a closure over the attribute arrays
-            # would ship them as compile-request constants through the
-            # device tunnel)
-            old_l, old_w = objective.label, objective.weights
-            objective.label, objective.weights = label, weights
+            # score is [k, n]; gradients come back class-major and every
+            # class's tree grows in the SAME program, reusing the one
+            # donated arena; each class gets its own feature mask (the
+            # eager path samples per tree)
+            olds = [getattr(h, a) for h, a in fields]
+            for (h, a), v in zip(fields, field_vals):
+                setattr(h, a, v)
             try:
-                grad, hess = objective.get_gradients(score_row)
+                grad, hess = objective.get_gradients(
+                    score if k > 1 else score[0])
             finally:
-                objective.label, objective.weights = old_l, old_w
-            grad = jnp.asarray(grad, jnp.float32).reshape(-1)
-            hess = jnp.asarray(hess, jnp.float32).reshape(-1)
-            arrays, delta, arena, trunc = gp.grow_tree_partition_impl(
-                arena, bins_t, grad, hess, row0, fmask, num_bins,
-                default_bins, missing_types, sparams, monotone, penalty,
-                None, None, self.is_categorical, self.train_state.bundle,
-                max_leaves=self.config.num_leaves,
-                max_depth=self.config.max_depth,
-                max_bin=self.max_bin, emit="score", full_bag=True,
-                max_cat_threshold=self.config.max_cat_threshold,
-                hist_slots=self._hist_slots,
-                forced_splits=self._forced_splits,
-                interpret=interpret)
-            new_score = score_row + shrink * delta.astype(score_row.dtype)
-            ivec, fvec = grow_ops.pack_tree_arrays(arrays)
-            ivec = jnp.concatenate([ivec, trunc.astype(jnp.int32)[None]])
-            return ivec, fvec, new_score, arena
+                for (h, a), v in zip(fields, olds):
+                    setattr(h, a, v)
+            n = score.shape[1]
+            grad = jnp.asarray(grad, jnp.float32).reshape(k, n)
+            hess = jnp.asarray(hess, jnp.float32).reshape(k, n)
+            ivecs, fvecs, deltas = [], [], []
+            for kk in range(k):
+                arrays, delta, arena, trunc = gp.grow_tree_partition_impl(
+                    arena, bins_t, grad[kk], hess[kk], row0, fmasks[kk],
+                    num_bins, default_bins, missing_types, sparams,
+                    monotone, penalty,
+                    None, None, self.is_categorical,
+                    self.train_state.bundle,
+                    max_leaves=self.config.num_leaves,
+                    max_depth=self.config.max_depth,
+                    max_bin=self.max_bin, emit="score", full_bag=True,
+                    max_cat_threshold=self.config.max_cat_threshold,
+                    hist_slots=self._hist_slots,
+                    forced_splits=self._forced_splits,
+                    interpret=interpret)
+                ivec, fvec = grow_ops.pack_tree_arrays(arrays)
+                ivecs.append(jnp.concatenate(
+                    [ivec, trunc.astype(jnp.int32)[None]]))
+                fvecs.append(fvec)
+                deltas.append(delta.astype(score.dtype))
+            new_score = score + shrink * jnp.stack(deltas)
+            return ivecs, fvecs, new_score, arena
 
         return jax.jit(fused, donate_argnums=(0, 2))
 
     def _run_fused_iter(self):
-        """One fused iteration; returns the packed (ivec, fvec) device
-        arrays with the truncation flag appended (the _inflight payload)."""
+        """One fused iteration; returns per-class packed (ivec, fvec)
+        device arrays with the truncation flag appended (the _inflight
+        payloads)."""
         # the jitted fn bakes these in at trace time; rebuild if a
         # reset_parameter callback changed them mid-training
         key = (self.config.num_leaves, self.config.max_depth, self.max_bin,
@@ -517,10 +551,12 @@ class GBDT:
             self._fused_fn = self._build_fused_iter()
             self._fused_key = key
         sh = jnp.asarray(self.shrinkage_rate, self.dtype)
-        ivec, fvec, new_score, arena = self._fused_fn(
-            self._arena, self._bins_t, self.train_state.score[0],
-            self.objective.label, self.objective.weights,
-            self._row_all_in, self._feature_sample(),
+        k = max(self.num_tree_per_iteration, 1)
+        fmasks = jnp.stack([self._feature_sample() for _ in range(k)])
+        field_vals = [getattr(h, a) for h, a in self._fused_fields]
+        ivecs, fvecs, new_score, arena = self._fused_fn(
+            self._arena, self._bins_t, self.train_state.score,
+            field_vals, self._row_all_in, fmasks,
             self.train_state.num_bins, self.train_state.default_bins,
             self.train_state.missing_types, self.split_params,
             self.monotone, self.penalty, sh)
@@ -528,12 +564,12 @@ class GBDT:
             # force materialization once so a device runtime fault raises
             # HERE (inside the fallback guard) instead of at a later
             # async fetch
-            int(ivec[-1])
+            int(ivecs[0][-1])
             self._fused_validated = True
         self._arena = arena
-        self.train_state.score = new_score[None]
+        self.train_state.score = new_score
         self._last_truncated = jnp.asarray(False)   # flag rides ivec[-1]
-        return ivec, fvec
+        return list(zip(ivecs, fvecs))
 
     def _rebuild_train_score(self):
         """Recompute training scores from the materialized model — used

@@ -159,6 +159,19 @@ class GBDT:
 
     # ------------------------------------------------------------------ #
     def _setup_train(self, train_set: BinnedDataset) -> None:
+        # the fused-iteration jit closes over THIS train set's bundle maps,
+        # categorical flags, hist slots and forced splits as trace-time
+        # constants; a ResetTrainingData with a same-shaped dataset would
+        # otherwise reuse the stale trace and silently train on the old
+        # dataset's structure (c_api.cpp ResetTrainingData contract)
+        self._fused_fn = None
+        self._fused_key = None
+        self._fused_fields = None
+        self._fused_validated = False
+        self._partition_validated = False
+        # a booster that stopped on the OLD data (no splittable leaves)
+        # must be trainable again on the new data
+        self._deferred_stopped = False
         self.train_set = train_set
         self.num_data = train_set.num_data
         self.max_feature_idx = train_set.num_total_features - 1

@@ -197,3 +197,52 @@ class TestEngineFallback:
             bst.update()
         assert bst.num_trees() == 2
         assert not g._use_partition_engine
+
+
+class TestResetTrainingDataInvalidatesFusedTrace:
+    def test_reset_clears_fused_caches(self, rng):
+        """ResetTrainingData swaps the dataset under the booster; the
+        fused-iteration jit baked the OLD dataset's bundle maps /
+        categorical flags in as trace constants, so _setup_train must
+        drop the caches or a same-shaped replacement silently trains on
+        the old structure (round-3 advisor medium)."""
+        X = rng.randn(400, 5).astype(np.float64)
+        y = (X[:, 0] > 0).astype(np.float64)
+        ds_a = lgb.Dataset(X, label=y, params={"verbose": -1})
+        bst = lgb.Booster(params={"objective": "binary", "verbose": -1},
+                          train_set=ds_a)
+        bst.update()
+        g = bst._gbdt
+        # simulate a cached fused trace regardless of which engine the
+        # CPU test environment selected
+        g._fused_fn = object()
+        g._fused_key = ("stale",)
+        g._fused_fields = [("stale", "stale")]
+        g._fused_validated = True
+        g._partition_validated = True
+
+        X2 = rng.randn(400, 5).astype(np.float64)
+        y2 = (X2[:, 1] > 0).astype(np.float64)
+        ds_b = lgb.Dataset(X2, label=y2, params={"verbose": -1})
+        ds_b.construct()
+        # a booster stopped on the old data must train again on the new
+        g._deferred_stopped = True
+        # drive the REAL c_api entry point (python-level objects satisfy
+        # its duck-typed contract: bst._gbdt, ds.construct()/_binned)
+        from lightgbm_tpu import c_api
+        bh, dh = c_api._new_handle(bst), c_api._new_handle(ds_b)
+        try:
+            ret = c_api.LGBM_BoosterResetTrainingData(bh, dh)
+        finally:
+            c_api._handles.pop(bh, None)
+            c_api._handles.pop(dh, None)
+        assert ret == 0, c_api.LGBM_GetLastError()
+        assert not g._deferred_stopped
+        assert g._fused_fn is None
+        assert g._fused_fields is None
+        assert g._fused_key is None
+        assert not g._fused_validated
+        assert not g._partition_validated
+        # training must continue cleanly on the new dataset
+        bst.update()
+        assert bst.num_trees() == 2

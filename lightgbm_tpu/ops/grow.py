@@ -282,14 +282,53 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
         return (jnp.broadcast_to(jnp.asarray(minc, dtype), (nf,)),
                 jnp.broadcast_to(jnp.asarray(maxc, dtype), (nf,)))
 
+    # feature statics for the Pallas scan, hoisted out of the while loop
+    # (only the CEGB column is leaf-dependent and is patched per call)
+    from . import split_pallas as sp_pl
+    use_scan_kernel = is_categorical is None and dtype == jnp.float32
+    if use_scan_kernel:
+        _fvec_full = sp_pl.build_feature_statics(
+            num_bins, default_bins, missing_types, monotone=monotone,
+            penalty=penalty, feature_mask=feature_mask, children=1)
+        _fvec_local = (_fvec_full if not (distributed and learner == "feature")
+                       else sp_pl.build_feature_statics(
+                           l_num_bins, l_default_bins, l_missing,
+                           monotone=l_monotone, penalty=l_penalty,
+                           feature_mask=l_feature_mask, children=1))
+    else:
+        _fvec_full = _fvec_local = None
+
     def local_scan(hist, sum_g, sum_h, cnt, nb, db, mt, mono, pen, fmask,
-                   icat, findex=None, used=None, minc=None, maxc=None):
+                   icat, findex=None, used=None, minc=None, maxc=None,
+                   fvec_pre=None):
         """Per-feature scan (numerical or bin-type-dispatched) + argmax."""
         cegb_pen = None
         if cegb_coupled is not None and used is not None:
             cegb_pen = jnp.where(used, 0.0, cegb_coupled)
         mn, mx = _bounds(minc, maxc, hist.shape[0])
-        if icat is None:
+        if icat is None and hist.dtype == jnp.float32:
+            # single-launch Pallas scan (ops/split_pallas.py) — the XLA
+            # op chain is ~0.45 ms of dispatch latency per call; the
+            # kernel matches it up to f32 prefix-sum association, and
+            # BOTH engines route here so their trees stay identical
+            if fvec_pre is not None:
+                fvec = fvec_pre
+            else:
+                fvec = sp_pl.build_feature_statics(
+                    nb, db, mt, monotone=mono, penalty=pen,
+                    feature_mask=fmask, children=1)
+            if cegb_pen is not None:
+                fvec = fvec.at[:, sp_pl._CEGBF].set(
+                    cegb_pen.astype(jnp.float32))
+            pf = sp_pl.best_splits_pallas(
+                hist[None], jnp.reshape(sum_g, (1,)),
+                jnp.reshape(sum_h, (1,)), jnp.reshape(cnt, (1,)), fvec,
+                params,
+                min_constraints=None if mn is None else mn[:1],
+                max_constraints=None if mx is None else mx[:1],
+                interpret=jax.default_backend() != "tpu")
+            pf = sp_pl.index_per_feature(pf, 0)
+        elif icat is None:
             pf = best_split_per_feature(hist, sum_g, sum_h, cnt, nb, db, mt,
                                         params, monotone=mono, penalty=pen,
                                         min_constraints=mn, max_constraints=mx,
@@ -311,7 +350,7 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
                 hist, sum_g, sum_h, cnt,
                 l_num_bins, l_default_bins, l_missing,
                 l_monotone, l_penalty, l_feature_mask, l_is_categorical,
-                used=None, minc=minc, maxc=maxc)
+                used=None, minc=minc, maxc=maxc, fvec_pre=_fvec_local)
             # map the local winner to its global feature id
             local = local._replace(feature=jnp.where(
                 local.feature >= 0, l_feature_index[local.feature],
@@ -359,13 +398,15 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
                 monotone, penalty, feature_mask, is_categorical,
                 axis_name=axis_name, num_machines=num_machines,
                 top_k=top_k, max_cat_threshold=max_cat_threshold,
-                min_constraints=mn, max_constraints=mx)
+                min_constraints=mn, max_constraints=mx,
+                fvec_local=_fvec_full)
         else:
             res = local_scan(unbundle(hist, sum_g, sum_h, cnt),
                              sum_g, sum_h, cnt,
                              num_bins, default_bins, missing_types,
                              monotone, penalty, feature_mask, is_categorical,
-                             used=used, minc=minc, maxc=maxc)
+                             used=used, minc=minc, maxc=maxc,
+                             fvec_pre=_fvec_full)
         depth_ok = (max_depth <= 0) | (depth < max_depth)
         blocked = (res.feature < 0) | ~depth_ok
         return res._replace(gain=jnp.where(blocked, K_MIN_SCORE, res.gain),
@@ -674,7 +715,8 @@ def _voting_best_split(local_hist, sum_g, sum_h, cnt,
                        *, axis_name: str, num_machines: int, top_k: int,
                        max_cat_threshold: int = 32,
                        min_constraints=None,
-                       max_constraints=None) -> SplitResult:
+                       max_constraints=None,
+                       fvec_local=None) -> SplitResult:
     """PV-tree best split (voting_parallel_tree_learner.cpp:257-460).
 
     local_hist [F, B, 3] holds *local-shard* rows only.  Protocol:
@@ -699,7 +741,23 @@ def _voting_best_split(local_hist, sum_g, sum_h, cnt,
     loc_c = jnp.round(jnp.sum(local_hist[0, :, 2])).astype(jnp.int32)
 
     def scan(hist, sg, sh, sc, nb, db, mt, mono, pen, fmask, icat, p,
-             mn=None, mx=None):
+             mn=None, mx=None, fvec_pre=None):
+        if icat is None and hist.dtype == jnp.float32:
+            # same Pallas kernel as the serial scan — voting must elect
+            # and score with bit-identical gains or its trees drift from
+            # the serial learner on prefix-sum association ties
+            from . import split_pallas as sp_pl
+            fvec = fvec_pre if fvec_pre is not None else \
+                sp_pl.build_feature_statics(
+                    nb, db, mt, monotone=mono, penalty=pen,
+                    feature_mask=fmask, children=1)
+            pf = sp_pl.best_splits_pallas(
+                hist[None], jnp.reshape(sg, (1,)), jnp.reshape(sh, (1,)),
+                jnp.reshape(sc, (1,)), fvec, p,
+                min_constraints=None if mn is None else mn[:1],
+                max_constraints=None if mx is None else mx[:1],
+                interpret=jax.default_backend() != "tpu")
+            return sp_pl.index_per_feature(pf, 0)
         if icat is None:
             return best_split_per_feature(hist, sg, sh, sc, nb, db, mt, p,
                                           monotone=mono, penalty=pen,
@@ -719,7 +777,8 @@ def _voting_best_split(local_hist, sum_g, sum_h, cnt,
     pf_local = scan(local_hist, loc_g, loc_h, loc_c,
                     num_bins, default_bins, missing_types,
                     monotone, penalty, feature_mask, is_categorical,
-                    local_params, min_constraints, max_constraints)
+                    local_params, min_constraints, max_constraints,
+                    fvec_pre=fvec_local)
 
     _, top_idx = jax.lax.top_k(pf_local.gain, k)                # [k]
     top_valid = jnp.take(pf_local.gain, top_idx) > K_MIN_SCORE

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from . import partition_pallas as pp
+from . import split_pallas as sp_pl
 from .grow import (MISSING_NAN, MISSING_ZERO, BundleMaps, TreeArrays,
                    _index_split, _stack_split, empty_tree)
 from .split import (K_MIN_SCORE, SplitParams, SplitResult,
@@ -185,6 +186,49 @@ def grow_tree_partition_impl(
         from .grow import unbundle_hist
         return unbundle_hist(hist, sum_g, sum_h, cnt, bundle, default_bins)
 
+    # The numerical best-split scan runs as ONE Pallas launch for both
+    # children (ops/split_pallas.py) — the XLA op chain was ~0.45 ms of
+    # pure dispatch latency per split, the largest single line item in
+    # the round-4 profile.  Categorical datasets keep the XLA path.
+    use_scan_kernel = is_categorical is None
+    fvec_base = sp_pl.build_feature_statics(
+        num_bins, default_bins, missing_types,
+        monotone=monotone, penalty=penalty, feature_mask=feature_mask,
+        children=2) if use_scan_kernel else None
+
+    def pair_best_split(hist2, sg2, sh2, cnt2_, depth, used, mn2, mx2):
+        """Best split of BOTH children: [2, ...] stacked inputs ->
+        (left SplitResult, right SplitResult)."""
+        cegb_pen = None
+        if cegb_coupled is not None and used is not None:
+            cegb_pen = jnp.where(used, 0.0, cegb_coupled)
+        if use_scan_kernel:
+            h2 = jax.vmap(lambda hh, gg, hs, cc: unbundle(hh, gg, hs, cc))(
+                hist2, sg2, sh2, cnt2_)
+            fvec = fvec_base
+            if cegb_pen is not None:
+                fvec = fvec.at[:, sp_pl._CEGBF].set(
+                    jnp.concatenate([cegb_pen, cegb_pen]).astype(jnp.float32))
+            pf2 = sp_pl.best_splits_pallas(
+                h2, sg2, sh2, cnt2_, fvec, params,
+                min_constraints=(mn2 if monotone is not None else None),
+                max_constraints=(mx2 if monotone is not None else None),
+                interpret=interpret)
+            depth_ok = (max_depth <= 0) | (depth < max_depth)
+
+            def finish(i):
+                pf = sp_pl.index_per_feature(pf2, i)
+                res = select_best_feature(pf)
+                blocked = (res.feature < 0) | ~depth_ok
+                return res._replace(
+                    gain=jnp.where(blocked, K_MIN_SCORE, res.gain),
+                    feature=jnp.where(depth_ok, res.feature, -1))
+            return finish(0), finish(1)
+        both = jax.vmap(lambda hh, gg, hs2, cc, mn, mx: leaf_best_split(
+            hh, gg, hs2, cc, depth, used=used, minc=mn, maxc=mx))(
+            hist2, sg2, sh2, cnt2_, mn2, mx2)
+        return _index_split(both, 0), _index_split(both, 1)
+
     def leaf_best_split(hist, sum_g, sum_h, cnt, depth, used=None,
                         minc=None, maxc=None):
         cegb_pen = None
@@ -195,7 +239,24 @@ def grow_tree_partition_impl(
             mn = jnp.broadcast_to(jnp.asarray(minc, dtype), (F,))
             mx = jnp.broadcast_to(jnp.asarray(maxc, dtype), (F,))
         hist = unbundle(hist, sum_g, sum_h, cnt)
-        if is_categorical is None:
+        if use_scan_kernel:
+            # same single-launch scan as the body splits: the ROOT split
+            # must come from the identical kernel or last-ulp prefix-sum
+            # association diffs could pick a different first split than
+            # the label engine
+            fvec = sp_pl.build_feature_statics(
+                num_bins, default_bins, missing_types, monotone=monotone,
+                penalty=penalty, feature_mask=feature_mask,
+                cegb_feature_penalty=cegb_pen, children=1)
+            pf1 = sp_pl.best_splits_pallas(
+                hist[None], jnp.reshape(sum_g, (1,)),
+                jnp.reshape(sum_h, (1,)), jnp.reshape(cnt, (1,)), fvec,
+                params,
+                min_constraints=None if mn is None else mn[:1],
+                max_constraints=None if mx is None else mx[:1],
+                interpret=interpret)
+            pf = sp_pl.index_per_feature(pf1, 0)
+        elif is_categorical is None:
             pf = best_split_per_feature(hist, sum_g, sum_h, cnt, num_bins,
                                         default_bins, missing_types, params,
                                         monotone=monotone, penalty=penalty,
@@ -360,14 +421,21 @@ def grow_tree_partition_impl(
                                 cm[jnp.clip(fbin, 0, 255)], go_left)
         decision = (chan, go_left.astype(jnp.float32),
                     left_smaller.astype(jnp.int32))
-        # NOT fused with the histogram: a fused pass would accumulate the
-        # masked histogram over the WHOLE parent stream (O(parent) radix
-        # FLOPs); the separate kernel touches only the compacted smaller
-        # child (O(small)) — measured faster despite the extra launch
-        arena, counts = part(state.arena, pred_dummy, s0, cntP, s0, dstB,
-                             decision=decision)
-        small_hist = seg(arena, dstB,
-                         jnp.where(no_split, 0, counts[1]))
+        # FUSED with the smaller-child histogram: the round-4 bandwidth
+        # profile (tools/kernel_ablate.py) showed both kernels are
+        # HBM-bound on this chip (~40 GB/s practical ceiling, far below
+        # the MXU's appetite), so the fused pass's extra radix FLOPs
+        # over the whole parent stream are hidden under the DMA time
+        # while the separate kernel's re-read of the compacted child
+        # (O(small) bytes) is pure added traffic.  Stream B is always
+        # the smaller child (the xr choreography routes the larger side
+        # in place), so hist_stream=1.
+        arena, counts, small_hist = part(
+            state.arena, pred_dummy, s0, cntP, s0, dstB,
+            decision=decision, hist_stream=1,
+            num_features=G, max_bin=max_bin)
+        small_hist = jnp.where(no_split, jnp.zeros_like(small_hist),
+                               small_hist).astype(dtype)
         if axis_name is not None:
             # DP: ONE collective per split — the smaller child's histogram
             # allreduce (the sibling still comes from subtraction, §3.4.2);
@@ -476,20 +544,16 @@ def grow_tree_partition_impl(
             leaf_max = leaf_max.at[best_leaf].set(maxL).at[new_leaf].set(maxR)
 
         used2 = state.cegb_used.at[feat].set(True)
-        # ONE vmapped scan over both children: the best-split scan is a
-        # long chain of tiny [F, B] ops whose per-op launch latency (not
-        # bandwidth) dominates inside the while loop — batching the pair
-        # halves the op count on the critical path
-        both = jax.vmap(lambda hh, gg, hs2, cc, mn, mx: leaf_best_split(
-            hh, gg, hs2, cc, depth + 1, used=used2, minc=mn, maxc=mx))(
+        # ONE scan over both children (single Pallas launch on the
+        # numerical path, vmapped XLA chain otherwise)
+        lsp, rsp = pair_best_split(
             jnp.stack([left_hist, right_hist]),
             jnp.stack([sp.left_sum_gradient, sp.right_sum_gradient]),
             jnp.stack([sp.left_sum_hessian, sp.right_sum_hessian]),
             jnp.stack([sp.left_count, sp.right_count]),
+            depth + 1, used2,
             jnp.stack([jnp.asarray(minL, dtype), jnp.asarray(minR, dtype)]),
             jnp.stack([jnp.asarray(maxL, dtype), jnp.asarray(maxR, dtype)]))
-        lsp = _index_split(both, 0)
-        rsp = _index_split(both, 1)
         split_cache = _stack_split(lsp, state.split_cache, best_leaf)
         split_cache = _stack_split(rsp, split_cache, new_leaf)
 

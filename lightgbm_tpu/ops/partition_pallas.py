@@ -132,25 +132,29 @@ FLUSH_W = SUB          # flush chunk width; all HBM write offsets are
 CARRY_W = FLUSH_W + SUB    # per-stream carry width (append window)
 
 
-def _compact_subblock(block_k, prefix_k, pred_k, fill):
-    """Place the columns of `block_k` [C, S] (bf16) selected by `pred_k`
-    [1, S] (0/1 f32, inclusive prefix sum `prefix_k` precomputed)
-    contiguously starting at carry position `fill` (< FLUSH_W):
-    destination one-hot P[u, fill + pos_u] [S, CARRY_W] -> one
-    [C, S] @ [S, CARRY_W] bf16 MXU matmul (each output column copies
-    exactly one input column, so bf16 is exact).  Positioning is baked
-    into P so no dynamic roll/shift of the carry is ever needed.
-    Returns comp [C, CARRY_W] bf16; columns outside [fill, fill+count)
-    are 0."""
-    pos_col = (prefix_k - 1.0).astype(jnp.int32).reshape(SUB, 1) + fill
-    sel_col = pred_k.reshape(SUB, 1) > 0.5
-    t_iota = jax.lax.broadcasted_iota(jnp.int32, (SUB, CARRY_W), 1)
+def _dual_stream_P(pref2, pred2, K: int):
+    """Destination one-hots for ALL subblocks of a tile in one build:
+    P_all [K, S, 2*SUB] bf16 — subblock k's valid rows map to column
+    posA (stream A, left half) or SUB + posB (stream B, right half),
+    both compacted to offset 0.  The carry-fill offset is NOT baked in
+    (it is applied later as a cheap VPU dynamic roll), so one
+    [C, S] @ [S, 2*SUB] MXU matmul moves BOTH streams — half the MACs
+    of two fill-positioned [S, CARRY_W] products — and the P builds
+    carry no dependency on the serial append state.
+
+    pref2/pred2: [2K, SUB] f32 — A-rows then B-rows (inclusive prefix
+    sums and 0/1 predicates)."""
+    pA = pred2[:K]                                     # [K, S] f32 0/1
+    vAB = pred2[:K] + pred2[K:]                        # valid (0/1)
+    pos = (pA * (pref2[:K] - 1.0)
+           + (1.0 - pA) * (pref2[K:] - 1.0 + SUB))     # [K, S] f32
+    t3 = jax.lax.broadcasted_iota(jnp.int32, (K, SUB, 2 * SUB), 2)
     # build the one-hot in f32 then cast: an i1 mask from 32-bit compares
     # can't relayout onto 16-bit vector selects in Mosaic
-    P = jnp.where((pos_col == t_iota) & sel_col,
-                  jnp.float32(1.0), jnp.float32(0.0)).astype(jnp.bfloat16)
-    comp = jax.lax.dot(block_k, P, preferred_element_type=jnp.float32)
-    return comp.astype(ARENA_DT)
+    return jnp.where(
+        (pos.astype(jnp.int32)[:, :, None] == t3)
+        & (vAB[:, :, None] > 0.5),
+        jnp.float32(1.0), jnp.float32(0.0)).astype(jnp.bfloat16)
 
 
 def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
@@ -228,14 +232,20 @@ def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
             d.start()
         for d in read_dmas(0, 0):
             d.wait()
-    carryA[:] = jnp.zeros((C, CARRY_W), ARENA_DT)
-    carryB[:] = jnp.zeros((C, CARRY_W), ARENA_DT)
+    carryA[:] = jnp.zeros((C, CARRY_W), jnp.float32)
+    carryB[:] = jnp.zeros((C, CARRY_W), jnp.float32)
 
     def append_and_flush(carry, comp, ck, fill, written, dst, stream, fslot):
-        """Add comp (already positioned at `fill`) into the carry; flush
-        filled FLUSH_W chunks (up to ceil(SUB/FLUSH_W) per append when
-        FLUSH_W < SUB).  Returns (fill', written', fslot')."""
-        carry[:] = carry[:] + comp
+        """Roll comp ([C, SUB] f32, compacted at offset 0) up to the
+        carry fill point, add it in, and flush filled FLUSH_W chunks
+        (up to ceil(SUB/FLUSH_W) per append when FLUSH_W < SUB).  The
+        carry is f32 precisely so the positioning can be a dynamic
+        pltpu.roll (32-bit-only op) instead of MXU MACs; values are
+        exact bf16 payloads so the f32->bf16 cast at flush is lossless.
+        Returns (fill', written', fslot')."""
+        padded = jnp.concatenate(
+            [comp, jnp.zeros((C, CARRY_W - SUB), jnp.float32)], axis=1)
+        carry[:] = carry[:] + pltpu.roll(padded, fill, axis=1)
         fill = fill + ck
 
         for _ in range(-(-SUB // FLUSH_W)):
@@ -245,15 +255,13 @@ def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
                 @pl.when(written >= 2 * FLUSH_W)
                 def _():
                     flush_dma(stream, fslot, 0).wait()
-                flush_buf[stream, fslot] = carry[:, 0:FLUSH_W]
+                flush_buf[stream, fslot] = carry[:, 0:FLUSH_W].astype(ARENA_DT)
                 flush_dma(stream, fslot, dst + written).start()
-                # static left-shift by FLUSH_W via slice+pad (pltpu.roll
-                # only rotates 32-bit data; the carry is bf16)
                 shifted = jnp.concatenate(
                     [carry[:, FLUSH_W:CARRY_W],
-                     jnp.zeros((C, FLUSH_W), ARENA_DT)], axis=1)
+                     jnp.zeros((C, FLUSH_W), jnp.float32)], axis=1)
                 carry[:] = jnp.where(lane_w < fill - FLUSH_W, shifted,
-                                     jnp.bfloat16(0.0))
+                                     jnp.float32(0.0))
 
             flushed = fill >= FLUSH_W
             fill = jnp.where(flushed, fill - FLUSH_W, fill)
@@ -309,27 +317,24 @@ def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
 
         # ONE batched prefix scan for all subblocks of both streams — the
         # per-subblock scans were 2*K*log2(SUB) serial roll steps, the
-        # kernel's dominant latency.  The carry fills still thread
-        # serially through append_and_flush, but that chain is
-        # scalar-only (counts come from the batched scan), so the P
-        # builds and compaction matmuls no longer wait on each other's
-        # vector work.
+        # kernel's dominant latency.  Then ONE batched P build and K
+        # dependency-free dual-stream matmuls: nothing on the MXU path
+        # waits on the serial carry/fill chain (that chain is cheap VPU
+        # roll+add work), so the systolic array stays fed.
         pred2 = jnp.concatenate(
             [predA.reshape(K, SUB), predB.reshape(K, SUB)], axis=0)
         pref2 = _prefix_scan_lanes(pred2)                  # [2K, SUB]
         cnt2 = pref2[:, SUB - 1].astype(jnp.int32)         # [2K]
+        P_all = _dual_stream_P(pref2, pred2, K)            # [K, S, 2S]
+        comps = [jax.lax.dot(block[:, k * SUB:(k + 1) * SUB], P_all[k],
+                             preferred_element_type=jnp.float32)
+                 for k in range(K)]                        # [C, 2S] f32
         for k in range(K):
-            blk = block[:, k * SUB:(k + 1) * SUB]
             ca, cb = cnt2[k], cnt2[K + k]
-            compA = _compact_subblock(
-                blk, pref2[k:k + 1], predA[:, k * SUB:(k + 1) * SUB], fillA)
-            compB = _compact_subblock(
-                blk, pref2[K + k:K + k + 1],
-                predB[:, k * SUB:(k + 1) * SUB], fillB)
             fillA, wA, fsA = append_and_flush(
-                carryA, compA, ca, fillA, wA, dstA, 0, fsA)
+                carryA, comps[k][:, :SUB], ca, fillA, wA, dstA, 0, fsA)
             fillB, wB, fsB = append_and_flush(
-                carryB, compB, cb, fillB, wB, dstB, 1, fsB)
+                carryB, comps[k][:, SUB:], cb, fillB, wB, dstB, 1, fsB)
 
         @pl.when(j + 1 < n_tiles)
         def _():
@@ -354,7 +359,7 @@ def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
             @pl.when(w >= 2 * FLUSH_W)
             def _():
                 flush_dma(stream, fslot, 0).wait()     # flush c-2
-            flush_buf[stream, fslot] = carry[:, 0:FLUSH_W]
+            flush_buf[stream, fslot] = carry[:, 0:FLUSH_W].astype(ARENA_DT)
             flush_dma(stream, fslot, dst + w).start()
             flush_dma(stream, fslot, 0).wait()         # the final flush
 
@@ -448,8 +453,8 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
         scratch_shapes=[
             pltpu.VMEM((2, C, tile), ARENA_DT),
             pltpu.VMEM((2, 1, tile), jnp.float32),
-            pltpu.VMEM((C, CARRY_W), ARENA_DT),
-            pltpu.VMEM((C, CARRY_W), ARENA_DT),
+            pltpu.VMEM((C, CARRY_W), jnp.float32),
+            pltpu.VMEM((C, CARRY_W), jnp.float32),
             pltpu.VMEM((2, 2, C, FLUSH_W), ARENA_DT),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),

@@ -75,6 +75,32 @@ def _eval_from_callable(func: Callable):
     return wrapped
 
 
+def _apply_class_weight(class_weight, y, sample_weight):
+    """dict / 'balanced' class_weight -> per-sample weights folded into
+    sample_weight (reference _LGBMComputeSampleWeight usage,
+    python-package/lightgbm/sklearn.py:488-493).  Returns sample_weight
+    unchanged when class_weight is None."""
+    if class_weight is None:
+        return sample_weight
+    if _SKLEARN:
+        from sklearn.utils.class_weight import compute_sample_weight
+        cw = compute_sample_weight(class_weight, y)
+    else:
+        y = np.asarray(y)
+        classes, counts = np.unique(y, return_counts=True)
+        if class_weight == "balanced":
+            wmap = {c: len(y) / (len(classes) * cnt)
+                    for c, cnt in zip(classes, counts)}
+        elif isinstance(class_weight, dict):
+            wmap = {c: class_weight.get(c, 1.0) for c in classes}
+        else:
+            raise ValueError("class_weight must be 'balanced' or a dict")
+        cw = np.array([wmap[v] for v in y], np.float64)
+    if sample_weight is None or len(sample_weight) == 0:
+        return cw
+    return np.multiply(np.asarray(sample_weight, np.float64), cw)
+
+
 class LGBMModel(BaseEstimator):
     """Base sklearn estimator (sklearn.py:216-617)."""
 
@@ -170,13 +196,22 @@ class LGBMModel(BaseEstimator):
     # -- fit ---------------------------------------------------------------
     def fit(self, X, y, sample_weight=None, init_score=None, group=None,
             eval_set=None, eval_names=None, eval_sample_weight=None,
-            eval_init_score=None, eval_group=None, eval_metric=None,
-            early_stopping_rounds=None, verbose=True, feature_name="auto",
-            categorical_feature="auto", callbacks=None):
+            eval_class_weight=None, eval_init_score=None, eval_group=None,
+            eval_metric=None, early_stopping_rounds=None, verbose=True,
+            feature_name="auto", categorical_feature="auto", callbacks=None):
         params = self._process_params()
         if eval_metric is not None and not callable(eval_metric):
             params["metric"] = eval_metric
         feval = _eval_from_callable(eval_metric) if callable(eval_metric) else None
+
+        # class_weight -> per-sample weights multiplied into sample_weight
+        # (reference fit path, python-package/lightgbm/sklearn.py:488-493).
+        # LGBMClassifier folds it in on the ORIGINAL labels before
+        # encoding (_cw_folded); this base path covers direct LGBMModel
+        # users
+        if not getattr(self, "_cw_folded", False):
+            sample_weight = _apply_class_weight(self.class_weight, y,
+                                                sample_weight)
 
         X = np.asarray(X, np.float64)
         self._n_features = X.shape[1]
@@ -191,6 +226,8 @@ class LGBMModel(BaseEstimator):
                 eval_set = [eval_set]
             for i, (vx, vy) in enumerate(eval_set):
                 vw = eval_sample_weight[i] if eval_sample_weight else None
+                if eval_class_weight is not None and i < len(eval_class_weight):
+                    vw = _apply_class_weight(eval_class_weight[i], vy, vw)
                 vg = eval_group[i] if eval_group else None
                 vi = eval_init_score[i] if eval_init_score else None
                 valid_sets.append(basic.Dataset(
@@ -282,7 +319,19 @@ class LGBMClassifier(LGBMModel, ClassifierMixin):
                                     else "multiclass")
         self._fit_param_overrides = (
             {"num_class": self._n_classes} if self._n_classes > 2 else {})
-        return super().fit(X, y_enc, **kwargs)
+        # dict class_weight keys refer to ORIGINAL labels: fold the
+        # weights in here, before label encoding, so {label: w} works for
+        # any label set (the v2.2.4 reference applies it to the encoded
+        # labels — a landmine later LightGBM fixed; 'balanced' and
+        # 0..k-1 integer dicts are unaffected either way)
+        if self.class_weight is not None:
+            kwargs["sample_weight"] = _apply_class_weight(
+                self.class_weight, y, kwargs.get("sample_weight"))
+        self._cw_folded = True
+        try:
+            return super().fit(X, y_enc, **kwargs)
+        finally:
+            self._cw_folded = False
 
     def predict(self, X, raw_score=False, num_iteration=-1,
                 pred_leaf=False, pred_contrib=False, **kwargs):

@@ -246,3 +246,37 @@ class TestResetTrainingDataInvalidatesFusedTrace:
         # training must continue cleanly on the new dataset
         bst.update()
         assert bst.num_trees() == 2
+
+
+class TestClassWeight:
+    def test_balanced_shifts_minority_probability(self, rng):
+        """class_weight='balanced' must upweight the minority class: on
+        a 9:1 imbalanced task the weighted model's mean predicted
+        probability for the minority class must exceed the unweighted
+        model's (reference fit path sklearn.py:488-493)."""
+        from lightgbm_tpu.sklearn import LGBMClassifier
+        n = 1200
+        X = rng.randn(n, 4)
+        # minority class needs some signal so probabilities move
+        y = ((X[:, 0] + 0.5 * rng.randn(n)) > 1.28).astype(int)
+        assert 0.03 < y.mean() < 0.25
+        common = dict(n_estimators=30, num_leaves=15, verbose=-1)
+        plain = LGBMClassifier(**common).fit(X, y)
+        bal = LGBMClassifier(class_weight="balanced", **common).fit(X, y)
+        p_plain = plain.predict_proba(X)[:, 1].mean()
+        p_bal = bal.predict_proba(X)[:, 1].mean()
+        assert p_bal > p_plain + 0.05
+
+    def test_dict_weight_equals_sample_weight(self, rng):
+        """A {class: w} dict must train identically to passing the same
+        per-sample weights explicitly."""
+        from lightgbm_tpu.sklearn import LGBMClassifier
+        n = 800
+        X = rng.randn(n, 3)
+        y = (X[:, 0] > 0.8).astype(int)
+        common = dict(n_estimators=15, num_leaves=7, verbose=-1)
+        cw = LGBMClassifier(class_weight={0: 1.0, 1: 3.0}, **common).fit(X, y)
+        sw = np.where(y == 1, 3.0, 1.0)
+        ref = LGBMClassifier(**common).fit(X, y, sample_weight=sw)
+        np.testing.assert_allclose(cw.predict_proba(X), ref.predict_proba(X),
+                                   rtol=1e-6, atol=1e-7)

@@ -1,0 +1,132 @@
+"""Parity: the single-launch Pallas split scan must reproduce the XLA
+scan (ops/split.py) across missing types, regularization, monotone
+constraints, penalties and degenerate cases."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu.ops import split_pallas as sp_pl
+from lightgbm_tpu.ops.split import (K_MIN_SCORE, SplitParams,
+                                    best_split_per_feature)
+
+
+def _rand_hist(rng, F, B, n_rows=5000):
+    # counts integral, hessians positive — as real histograms are
+    cnt = rng.multinomial(n_rows, np.ones(F * B) / (F * B)).reshape(F, B)
+    g = rng.standard_normal((F, B)) * np.sqrt(cnt + 1e-3)
+    h = rng.random((F, B)) * cnt * 0.25 + cnt * 1e-3
+    return np.stack([g, h, cnt.astype(np.float64)], axis=-1).astype(np.float32)
+
+
+def _compare(hist2, sg, sh, nd, num_bins, default_bins, missing_types,
+             params, monotone=None, penalty=None, fmask=None,
+             minc=None, maxc=None, cegb_f=None):
+    CH = hist2.shape[0]
+    fvec = sp_pl.build_feature_statics(
+        num_bins, default_bins, missing_types, monotone=monotone,
+        penalty=penalty, feature_mask=fmask,
+        cegb_feature_penalty=cegb_f, children=CH)
+    got = sp_pl.best_splits_pallas(
+        jnp.asarray(hist2), jnp.asarray(sg), jnp.asarray(sh),
+        jnp.asarray(nd), fvec, params,
+        min_constraints=None if minc is None else jnp.asarray(minc),
+        max_constraints=None if maxc is None else jnp.asarray(maxc),
+        interpret=True)
+    for i in range(CH):
+        want = best_split_per_feature(
+            jnp.asarray(hist2[i]), jnp.asarray(sg[i]), jnp.asarray(sh[i]),
+            jnp.asarray(nd[i]), num_bins, default_bins, missing_types,
+            params,
+            monotone=monotone, penalty=penalty,
+            min_constraints=(None if minc is None
+                             else jnp.full(num_bins.shape[0], minc[i])),
+            max_constraints=(None if maxc is None
+                             else jnp.full(num_bins.shape[0], maxc[i])),
+            feature_mask=fmask, cegb_feature_penalty=cegb_f)
+        g_got = np.asarray(got.gain[i])
+        g_want = np.asarray(want.gain)
+        valid_got = g_got > K_MIN_SCORE
+        valid_want = g_want > K_MIN_SCORE
+        np.testing.assert_array_equal(valid_got, valid_want)
+        v = valid_got
+        np.testing.assert_allclose(g_got[v], g_want[v], rtol=2e-4, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(got.threshold[i])[v],
+                                      np.asarray(want.threshold)[v])
+        np.testing.assert_array_equal(np.asarray(got.default_left[i])[v],
+                                      np.asarray(want.default_left)[v])
+        for fld in ("left_sum_gradient", "left_sum_hessian", "left_count",
+                    "left_output", "right_sum_gradient", "right_sum_hessian",
+                    "right_count", "right_output"):
+            a = np.asarray(getattr(got, fld)[i])[v]
+            b = np.asarray(getattr(want, fld))[v]
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5,
+                                       err_msg=fld)
+
+
+class TestSplitScanParity:
+    @pytest.mark.parametrize("missing", [0, 1, 2, "mixed"])
+    def test_missing_types(self, missing):
+        rng = np.random.default_rng(hash(str(missing)) % 2**31)
+        F, B = 9, 64
+        hist2 = np.stack([_rand_hist(rng, F, B), _rand_hist(rng, F, B)])
+        sg = hist2[..., 0].sum((1, 2))
+        sh = hist2[..., 1].sum((1, 2))
+        nd = hist2[..., 2].sum((1, 2)).astype(np.int32)
+        if missing == "mixed":
+            mt = jnp.asarray(rng.integers(0, 3, F), jnp.int32)
+        else:
+            mt = jnp.full(F, missing, jnp.int32)
+        num_bins = jnp.asarray(rng.integers(3, B + 1, F), jnp.int32)
+        default_bins = jnp.asarray(rng.integers(0, 3, F), jnp.int32)
+        params = SplitParams(min_data_in_leaf=20)
+        _compare(hist2, sg, sh, nd, num_bins, default_bins, mt, params)
+
+    def test_regularization_and_monotone(self):
+        rng = np.random.default_rng(5)
+        F, B = 7, 32
+        hist2 = np.stack([_rand_hist(rng, F, B), _rand_hist(rng, F, B)])
+        sg = hist2[..., 0].sum((1, 2))
+        sh = hist2[..., 1].sum((1, 2))
+        nd = hist2[..., 2].sum((1, 2)).astype(np.int32)
+        num_bins = jnp.full(F, B, jnp.int32)
+        default_bins = jnp.zeros(F, jnp.int32)
+        mt = jnp.full(F, 1, jnp.int32)
+        params = SplitParams(lambda_l1=0.5, lambda_l2=2.0,
+                             max_delta_step=0.4, min_data_in_leaf=50,
+                             min_sum_hessian_in_leaf=1.0,
+                             min_gain_to_split=0.1)
+        mono = jnp.asarray(rng.integers(-1, 2, F), jnp.int32)
+        _compare(hist2, sg, sh, nd, num_bins, default_bins, mt, params,
+                 monotone=mono, minc=np.array([-0.2, -np.inf]),
+                 maxc=np.array([0.2, np.inf]))
+
+    def test_penalties_and_mask(self):
+        rng = np.random.default_rng(9)
+        F, B = 6, 16
+        hist2 = np.stack([_rand_hist(rng, F, B)])
+        sg = hist2[..., 0].sum((1, 2))
+        sh = hist2[..., 1].sum((1, 2))
+        nd = hist2[..., 2].sum((1, 2)).astype(np.int32)
+        num_bins = jnp.full(F, B, jnp.int32)
+        default_bins = jnp.zeros(F, jnp.int32)
+        mt = jnp.zeros(F, jnp.int32)
+        params = SplitParams(min_data_in_leaf=5,
+                             cegb_split_penalty=1e-6)
+        pen = jnp.asarray(rng.random(F).astype(np.float32) + 0.5)
+        fmask = jnp.asarray(rng.random(F) > 0.3)
+        cegb_f = jnp.asarray(rng.random(F).astype(np.float32) * 0.1)
+        _compare(hist2, sg, sh, nd, num_bins, default_bins, mt, params,
+                 penalty=pen, fmask=fmask, cegb_f=cegb_f)
+
+    def test_degenerate_no_split(self):
+        # constant labels: no positive gain anywhere
+        F, B = 4, 8
+        hist = np.zeros((1, F, B, 3), np.float32)
+        hist[..., 2] = 10.0
+        hist[..., 1] = 2.5
+        num_bins = jnp.full(F, B, jnp.int32)
+        params = SplitParams(min_data_in_leaf=1)
+        _compare(hist, np.zeros(1), hist[..., 1].sum((1, 2)),
+                 np.full(1, F * B * 10, np.int32), num_bins,
+                 jnp.zeros(F, jnp.int32), jnp.zeros(F, jnp.int32), params)

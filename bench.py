@@ -1,12 +1,12 @@
 """Headline benchmarks: Higgs-shaped binary training + MSLR-shaped
 lambdarank, with quality floors.
 
-Workload 1 reproduces the reference's Experiments.rst HIGGS shape (10.5M
+Workload 1 reproduces the reference's Experiments.rst HIGGS scale (10.5M
 rows x 28 dense numeric features, 500 iterations, num_leaves=255,
-max_bin=255 — docs/Experiments.rst:41-99) on synthetic data sized to the
-device, and reports end-to-end training throughput in rows*iterations/s
-against the published 2x E5-2670v3 wall-clock (238.505 s -> 22.01M
-rows*iter/s, docs/Experiments.rst:103-115).  Workload 2 reproduces the
+max_bin=255 — docs/Experiments.rst:41-99) on synthetic data at FULL
+reference size with the FULL iteration count measured end to end, and
+reports wall-clock + throughput against the published 2x E5-2670v3
+wall-clock (238.505 s -> 22.01M rows*iter/s, docs/Experiments.rst:103-115).  Workload 2 reproduces the
 MS LTR shape (ranked queries, lambdarank + ndcg@10,
 docs/Experiments.rst:137-144).
 
@@ -74,9 +74,11 @@ def _make_sync(jax, jnp):
 
 
 def bench_higgs(lgb, sync, on_tpu):
-    n = 4_000_000 if on_tpu else 100_000
+    # the REFERENCE scale: 10.5M x 28, 500 iterations MEASURED end to end
+    # (docs/Experiments.rst:103-115) — no extrapolation in the headline
+    n = 10_500_000 if on_tpu else 100_000
     F = 28
-    timed_iters = 40 if on_tpu else 5
+    timed_iters = 500 if on_tpu else 5
     rng = np.random.RandomState(7)
     n_hold = min(100_000, n // 4)
 
@@ -112,26 +114,37 @@ def bench_higgs(lgb, sync, on_tpu):
 
     auc = _auc(yh, booster.predict(Xh))
     rows_iter_per_s = n * timed_iters / elapsed
-    return {
+    out = {
         "throughput_mrows_iter_s": round(rows_iter_per_s / 1e6, 3),
         "vs_baseline": round(rows_iter_per_s / BASELINE_ROWS_ITER_PER_S, 4),
         "elapsed_s": round(elapsed, 3), "rows": n, "timed_iters": timed_iters,
-        "extrapolated_higgs_500iter_s": round(
-            10_500_000 * 500 / rows_iter_per_s, 1),
         "holdout_auc": round(float(auc), 4),
         "auc_floor": AUC_FLOOR,
         "quality_ok": bool(auc >= AUC_FLOOR),
+        "engine": ("partition" if booster._gbdt._use_partition_engine
+                   else "label"),
     }
+    if n == 10_500_000 and timed_iters == 500:
+        # the honest reference-comparable number: measured, same scale,
+        # same iteration count as docs/Experiments.rst:103-115
+        out["measured_500iter_s"] = round(elapsed, 1)
+    else:
+        out["extrapolated_higgs_500iter_s"] = round(
+            10_500_000 * 500 / rows_iter_per_s, 1)
+    return out
 
 
 def bench_lambdarank(lgb, sync, on_tpu):
     """MSLR-WEB30K shape: ~120 docs/query, 137 features, graded 0-4
     relevance (docs/Experiments.rst:34,137-144)."""
-    n_query = 8000 if on_tpu else 300
+    # MSLR-WEB30K scale: 2.27M docs, 137 features
+    # (docs/Experiments.rst:110,137-144; reference wall-clock 215.32 s
+    # for 500 iterations)
+    n_query = 18_900 if on_tpu else 300
     docs_per_q = 120
     F = 137
     n = n_query * docs_per_q
-    iters = 20 if on_tpu else 3
+    iters = 60 if on_tpu else 3
     rng = np.random.RandomState(11)
     X = rng.randn(n, F).astype(np.float32)
     # sparse signal: learnable within the timed budget, so the NDCG floor
@@ -166,10 +179,13 @@ def bench_lambdarank(lgb, sync, on_tpu):
     elapsed = time.perf_counter() - t0
     pred = booster.predict(X)
     ndcg = _ndcg_at_k(labels, pred, qid, 10)
+    rps = n * iters / elapsed
     return {
         "rows": n, "queries": n_query, "features": F, "iters": iters,
         "train_s": round(elapsed, 3),
-        "throughput_mrows_iter_s": round(n * iters / elapsed / 1e6, 3),
+        "throughput_mrows_iter_s": round(rps / 1e6, 3),
+        "extrapolated_mslr_500iter_s": round(n * 500 / rps, 1),
+        "reference_mslr_500iter_s": 215.32,  # docs/Experiments.rst:110
         "ndcg_at_10": round(float(ndcg), 4),
         "ndcg_floor": NDCG10_FLOOR,
         "quality_ok": bool(ndcg >= NDCG10_FLOOR),

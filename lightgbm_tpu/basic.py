@@ -96,10 +96,56 @@ class Dataset:
         self.used_indices: Optional[np.ndarray] = None
         self._binned: Optional[BinnedDataset] = None
         self._predictor = None  # set when continuing training (init_model)
+        self._stream_mapper: Optional[BinnedDataset] = None
+        self._stream_bins: Optional[np.ndarray] = None
+
+    @classmethod
+    def for_streaming(cls, sample: np.ndarray, num_total_row: int,
+                      params: Optional[Dict[str, Any]] = None,
+                      mapper: Optional[BinnedDataset] = None) -> "Dataset":
+        """Row-push ingest shell (LGBM_DatasetCreateFromSampledColumn /
+        CreateByReference + PushRows, c_api.cpp:382-480): bin mappers are
+        fitted from `sample` now (or shared from `mapper`), and pushed
+        row blocks are binned INCREMENTALLY into a uint8 matrix — the
+        full float row matrix never materializes, the point of the
+        reference's push protocol (same scheme as the two_round loader,
+        io/loader.py load_two_round)."""
+        self = cls(None, params=params)
+        sample = np.asarray(sample, np.float64)
+        if mapper is None:
+            mapper = BinnedDataset.construct(sample, Config(self.params),
+                                             bin_rows=False)
+        probe = mapper.bin_block(sample[:1])
+        self._stream_mapper = mapper
+        self._stream_bins = np.zeros((num_total_row, probe.shape[1]),
+                                     probe.dtype)
+        return self
+
+    def _push_binned(self, block: np.ndarray, start_row: int) -> None:
+        self._stream_bins[start_row:start_row + len(block)] = \
+            self._stream_mapper.bin_block(np.asarray(block, np.float64))
 
     # -- construction ------------------------------------------------------
     def construct(self) -> "Dataset":
         if self._binned is not None:
+            return self
+        if self._stream_mapper is not None:
+            # finalize the pushed stream: attach the prebinned matrix to
+            # a (copy of the) mapper dataset — the two_round pattern
+            import copy
+            m = copy.copy(self._stream_mapper)
+            m.bins = self._stream_bins
+            m.num_data = len(self._stream_bins)
+            m._device_cache = {}
+            meta = Metadata(m.num_data)
+            if self.label is not None:
+                meta.set_label(np.asarray(self.label))
+            self._set_fields(meta)
+            meta.init(m.num_data)
+            m.metadata = meta
+            self._binned = m
+            self._stream_mapper = None
+            self._stream_bins = None
             return self
         if self.used_indices is not None and self.reference is not None:
             ref = self.reference.construct()

@@ -563,15 +563,38 @@ def LGBM_DatasetCreateFromMats(nmat, data_ptrs, data_type, nrows, ncol,
 def LGBM_DatasetCreateFromSampledColumn(sample_data, sample_indices, ncol,
                                         num_per_col, sample_cnt,
                                         num_total_row, parameters, out):
-    """Streaming ingest entry (c_api.cpp:382-421): creates an empty
-    dataset expecting LGBM_DatasetPushRows.  Bin mappers are found at
-    construction from the pushed rows (the sample is only used for the
-    row-count contract here — with full data at hand the mappers are at
-    least as good as sample-derived ones)."""
+    """Streaming ingest entry (c_api.cpp:382-421): bin mappers are
+    fitted from the PROVIDED sampled columns right here and pushed row
+    blocks are binned incrementally (uint8), so host memory stays
+    O(sample + bins) — the point of the reference's push protocol; the
+    old implementation staged the full float64 row matrix."""
     ncol = _ival(ncol)
     total = _ival(num_total_row)
-    ds = Dataset(np.zeros((total, ncol), np.float64),
-                 params=_parse_params(parameters))
+    cnt = _ival(sample_cnt)
+    if sample_data is None or num_per_col is None:
+        # NULL sample: no mappers can be fitted up front — keep the
+        # legacy staging path (raw rows buffered, binned at construct)
+        ds = Dataset(np.zeros((total, ncol), np.float64),
+                     params=_parse_params(parameters))
+        ds._pushed_rows = 0
+        _out(out).value = _new_handle(ds)
+        return
+    sample = np.zeros((cnt, ncol), np.float64)
+    for j in range(ncol):
+        m = int(num_per_col[j]) if hasattr(num_per_col, "__getitem__") \
+            else _ival(num_per_col)
+        if m <= 0:
+            continue
+        vp, ip = sample_data[j], sample_indices[j]
+        if isinstance(vp, int):
+            vp = ctypes.c_void_p(vp)
+        if isinstance(ip, int):
+            ip = ctypes.c_void_p(ip)
+        vals = _as_np(vp, C_API_DTYPE_FLOAT64, m)
+        idx = _as_np(ip, C_API_DTYPE_INT32, m)
+        sample[idx[:m], j] = vals[:m]
+    ds = Dataset.for_streaming(sample, total,
+                               params=_parse_params(parameters))
     ds._pushed_rows = 0
     _out(out).value = _new_handle(ds)
 
@@ -580,9 +603,16 @@ def LGBM_DatasetCreateFromSampledColumn(sample_data, sample_indices, ncol,
 def LGBM_DatasetCreateByReference(reference, num_total_row, out):
     ref = _resolve(reference)
     total = _ival(num_total_row)
-    ncol = (ref._binned.num_total_features if ref._binned is not None
-            else np.asarray(ref.data).shape[1])
-    ds = Dataset(np.zeros((total, ncol), np.float64), reference=ref)
+    if ref._binned is not None:
+        # share the reference's fitted mappers; pushed rows are binned
+        # incrementally against them (create_valid contract)
+        ds = Dataset.for_streaming(
+            np.zeros((1, ref._binned.num_total_features)), total,
+            mapper=ref._binned)
+        ds.reference = ref
+    else:
+        ncol = np.asarray(ref.data).shape[1]
+        ds = Dataset(np.zeros((total, ncol), np.float64), reference=ref)
     ds._pushed_rows = 0
     _out(out).value = _new_handle(ds)
 
@@ -590,7 +620,10 @@ def LGBM_DatasetCreateByReference(reference, num_total_row, out):
 def _push_block(ds, X_block, start_row):
     if ds._binned is not None:
         raise _CApiError("cannot push rows into a constructed Dataset")
-    ds.data[start_row:start_row + len(X_block)] = X_block
+    if getattr(ds, "_stream_mapper", None) is not None:
+        ds._push_binned(X_block, start_row)
+    else:
+        ds.data[start_row:start_row + len(X_block)] = X_block
     ds._pushed_rows = max(getattr(ds, "_pushed_rows", 0),
                           start_row + len(X_block))
 
@@ -724,8 +757,11 @@ def LGBM_BoosterMerge(handle, other_handle):
     g.iter = len(g.models) // max(g.num_tree_per_iteration, 1)
     g._model_gen = getattr(g, "_model_gen", 0) + 1
     # keep the score<->models invariant: further boosting / eval / rollback
-    # must see the merged ensemble's contributions
+    # must see the merged ensemble's contributions — on the TRAINING
+    # scores and on every attached validation set's scores (eval after a
+    # merge must report post-merge metrics)
     g._rebuild_train_score()
+    g._rebuild_valid_scores()
 
 
 @_wrap

@@ -28,8 +28,12 @@ from ..utils import log
 from .tree import Tree
 
 K_EPSILON = 1e-15
-# deferred-pipeline drain cadence (iterations between bulk tree fetches)
-_DRAIN_EVERY = 16
+# deferred-pipeline drain cadence (iterations between bulk tree fetches).
+# Each drain is a blocking fetch (~85-100 ms through the remote-device
+# tunnel), so the cadence is a direct per-iteration tax: 48 costs
+# ~2 ms/iter vs 16's ~6.  Degenerate-stop detection is still exact on
+# drain (unchanged scores make every pending iteration degenerate too).
+_DRAIN_EVERY = 48
 
 
 def _dense_matrix(X) -> np.ndarray:
@@ -540,7 +544,7 @@ class GBDT:
                     max_cat_threshold=self.config.max_cat_threshold,
                     hist_slots=self._hist_slots,
                     forced_splits=self._forced_splits,
-                    interpret=interpret)
+                    pristine=True, interpret=interpret)
                 ivec, fvec = grow_ops.pack_tree_arrays(arrays)
                 ivecs.append(jnp.concatenate(
                     [ivec, trunc.astype(jnp.int32)[None]]))
@@ -597,6 +601,21 @@ class GBDT:
         for i, tree in enumerate(self.models):
             if tree is not None:
                 self._update_train_score_full(tree, i % k)
+
+    def _rebuild_valid_scores(self):
+        """Replay the full model onto every attached validation set's
+        scores — needed when the ensemble changes other than by boosting
+        (e.g. LGBM_BoosterMerge), or eval reports pre-change metrics."""
+        k = max(self.num_tree_per_iteration, 1)
+        for _name, state, _metrics in self.valid_states:
+            state.score = jnp.zeros((k, state.ds.num_data), self.dtype)
+            if state.ds.metadata.init_score is not None:
+                init = _expand_init_score(state.ds.metadata.init_score,
+                                          k, state.ds.num_data)
+                state.score = state.score + jnp.asarray(init, self.dtype)
+            for i, tree in enumerate(self.models):
+                if tree is not None:
+                    _add_tree_score(state, tree, i % k, self)
 
     def _pack_tree_with_flag(self, arrays):
         """Pack TreeArrays into (ivec, fvec) for one bulk host fetch; the
@@ -753,8 +772,12 @@ class GBDT:
         # the arena stores the (possibly EFB-bundled) GROUP columns
         n_groups = (self.train_state.bins.shape[1]
                     if self.train_set.num_features else 1)
+        # pristine layout reserves the read-only pristine block + the
+        # redirected root copy before the bump region — needs factor >= 4
+        # (a user-set tpu_arena_factor=3, the legacy minimum, would
+        # silently halve the child-segment budget and truncate trees)
         C, cap = pp.arena_geometry(self.num_data, n_groups,
-                                   cfg.tpu_arena_factor)
+                                   max(cfg.tpu_arena_factor, 4))
         # histogram pooling (HistogramPool, feature_histogram.hpp:646-818):
         # bound the per-leaf histogram cache by histogram_pool_size MB (or
         # auto-cap at a fraction of HBM for wide datasets) — spilled
@@ -780,8 +803,26 @@ class GBDT:
         arena_bytes = (C * cap * 2 + self.num_data * C * 2
                        + hist_cache_bytes)      # bf16 arena + bins_t + hists
         if eng == "auto":
-            # C also bounds the kernels' VMEM scratch (2 x C x TILE f32)
-            fits = arena_bytes < budget and C <= 512
+            # C also bounds the kernels' VMEM scratch (2 x C x TILE f32);
+            # the bagging root pass FUSES partition + histogram, so its
+            # combined VMEM footprint (partition scratch + radix
+            # accumulator) must fit too — a config whose kernels fit
+            # individually can still blow VMEM fused, which would demote
+            # the whole booster to the label engine at runtime (silent
+            # perf cliff flagged by the round-3 advisor)
+            from ..ops.histogram_pallas import _radix_plan
+            lo_n, hi_n, m_r = _radix_plan(max(self.max_bin, 2))
+            f_blk = max(m_r, 8)
+            nb_r = pp.feature_channels(n_groups) // f_blk
+            fused_vmem = (
+                2 * C * pp.TILE * 2                       # in_buf bf16
+                + (pp.TILE // pp.SUB) * pp.SUB * 2 * pp.SUB * 2   # P_all
+                + 2 * C * pp.CARRY_W * 4                  # carries f32
+                + 4 * C * pp.FLUSH_W * 2                  # flush bufs
+                + 2 * pp.TILE * 4                         # pred bufs
+                + nb_r * (f_blk // m_r) * 7 * hi_n * m_r * 128 * 4)
+            fits = (arena_bytes < budget and C <= 512
+                    and fused_vmem < 13 * (1 << 20))
             eng = ("partition" if eligible and fits
                    and jax.default_backend() == "tpu" else "label")
         self._use_partition_engine = eng == "partition"
@@ -796,8 +837,14 @@ class GBDT:
             from ..ops import partition_pallas as _pp
             self._bins_t = jnp.asarray(
                 self.train_state.bins, _pp.ARENA_DT).T
-            self._arena = jnp.zeros((C, cap), _pp.ARENA_DT)
-            self._grow_partition = gp.grow_tree_partition
+            # pristine layout: bins + rowid planes written ONCE here;
+            # per-tree assembly refreshes only the g/h payload planes and
+            # the first split is redirected off the pristine block
+            self._arena = _pp.init_pristine(
+                jnp.zeros((C, cap), _pp.ARENA_DT), self._bins_t)
+            from functools import partial as _ppart
+            self._grow_partition = _ppart(gp.grow_tree_partition,
+                                          pristine=True)
 
     def _grow_one_tree(self, grad, hess, row_init):
         """Grow one tree via the selected learner (serial or distributed) —
@@ -1361,10 +1408,13 @@ class GBDT:
 
     @property
     def current_iteration(self) -> int:
-        # drain first: deferred placeholders / rolled-back trees must not
-        # be counted (every public accessor derived from self.models
-        # syncs — the drain-consistency invariant)
-        self._sync_model()
+        # count WITHOUT draining: deferred placeholders already occupy
+        # their slots in self.models, so the count is exact while the
+        # pipeline stays unflushed — a per-iteration caller (user
+        # callbacks) must not serialize training with a host round-trip.
+        # (Rolled-back/degenerate trees are trimmed on drain, but a drain
+        # only ever REMOVES whole trailing iterations that subsequent
+        # boosting re-runs; accessors returning tree CONTENTS still sync.)
         return len(self.models) // max(self.num_tree_per_iteration, 1)
 
     def num_trees(self) -> int:

@@ -285,7 +285,11 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
     # feature statics for the Pallas scan, hoisted out of the while loop
     # (only the CEGB column is leaf-dependent and is patched per call)
     from . import split_pallas as sp_pl
-    use_scan_kernel = is_categorical is None and dtype == jnp.float32
+    # n < 2^24 bound: the kernel's counts ride f32 prefix sums, which
+    # are integer-exact only below 2^24 rows per leaf — the XLA path
+    # keeps integer cumsums precisely for the billion-row regime
+    use_scan_kernel = (is_categorical is None and dtype == jnp.float32
+                       and n < (1 << 24))
     if use_scan_kernel:
         _fvec_full = sp_pl.build_feature_statics(
             num_bins, default_bins, missing_types, monotone=monotone,
@@ -306,28 +310,16 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
         if cegb_coupled is not None and used is not None:
             cegb_pen = jnp.where(used, 0.0, cegb_coupled)
         mn, mx = _bounds(minc, maxc, hist.shape[0])
-        if icat is None and hist.dtype == jnp.float32:
+        if use_scan_kernel and icat is None and hist.dtype == jnp.float32:
             # single-launch Pallas scan (ops/split_pallas.py) — the XLA
             # op chain is ~0.45 ms of dispatch latency per call; the
             # kernel matches it up to f32 prefix-sum association, and
             # BOTH engines route here so their trees stay identical
-            if fvec_pre is not None:
-                fvec = fvec_pre
-            else:
-                fvec = sp_pl.build_feature_statics(
-                    nb, db, mt, monotone=mono, penalty=pen,
-                    feature_mask=fmask, children=1)
-            if cegb_pen is not None:
-                fvec = fvec.at[:, sp_pl._CEGBF].set(
-                    cegb_pen.astype(jnp.float32))
-            pf = sp_pl.best_splits_pallas(
-                hist[None], jnp.reshape(sum_g, (1,)),
-                jnp.reshape(sum_h, (1,)), jnp.reshape(cnt, (1,)), fvec,
-                params,
-                min_constraints=None if mn is None else mn[:1],
-                max_constraints=None if mx is None else mx[:1],
-                interpret=jax.default_backend() != "tpu")
-            pf = sp_pl.index_per_feature(pf, 0)
+            pf = sp_pl.scan_single(
+                hist, sum_g, sum_h, cnt, params, fvec_pre=fvec_pre,
+                num_bins=nb, default_bins=db, missing_types=mt,
+                monotone=mono, penalty=pen, feature_mask=fmask,
+                cegb_pen=cegb_pen, mn=mn, mx=mx)
         elif icat is None:
             pf = best_split_per_feature(hist, sum_g, sum_h, cnt, nb, db, mt,
                                         params, monotone=mono, penalty=pen,
@@ -399,7 +391,7 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
                 axis_name=axis_name, num_machines=num_machines,
                 top_k=top_k, max_cat_threshold=max_cat_threshold,
                 min_constraints=mn, max_constraints=mx,
-                fvec_local=_fvec_full)
+                fvec_local=_fvec_full, use_kernel=use_scan_kernel)
         else:
             res = local_scan(unbundle(hist, sum_g, sum_h, cnt),
                              sum_g, sum_h, cnt,
@@ -716,7 +708,8 @@ def _voting_best_split(local_hist, sum_g, sum_h, cnt,
                        max_cat_threshold: int = 32,
                        min_constraints=None,
                        max_constraints=None,
-                       fvec_local=None) -> SplitResult:
+                       fvec_local=None,
+                       use_kernel: bool = True) -> SplitResult:
     """PV-tree best split (voting_parallel_tree_learner.cpp:257-460).
 
     local_hist [F, B, 3] holds *local-shard* rows only.  Protocol:
@@ -742,22 +735,17 @@ def _voting_best_split(local_hist, sum_g, sum_h, cnt,
 
     def scan(hist, sg, sh, sc, nb, db, mt, mono, pen, fmask, icat, p,
              mn=None, mx=None, fvec_pre=None):
-        if icat is None and hist.dtype == jnp.float32:
+        if (icat is None and hist.dtype == jnp.float32
+                and use_kernel):
             # same Pallas kernel as the serial scan — voting must elect
             # and score with bit-identical gains or its trees drift from
             # the serial learner on prefix-sum association ties
             from . import split_pallas as sp_pl
-            fvec = fvec_pre if fvec_pre is not None else \
-                sp_pl.build_feature_statics(
-                    nb, db, mt, monotone=mono, penalty=pen,
-                    feature_mask=fmask, children=1)
-            pf = sp_pl.best_splits_pallas(
-                hist[None], jnp.reshape(sg, (1,)), jnp.reshape(sh, (1,)),
-                jnp.reshape(sc, (1,)), fvec, p,
-                min_constraints=None if mn is None else mn[:1],
-                max_constraints=None if mx is None else mx[:1],
-                interpret=jax.default_backend() != "tpu")
-            return sp_pl.index_per_feature(pf, 0)
+            return sp_pl.scan_single(
+                hist, sg, sh, sc, p, fvec_pre=fvec_pre,
+                num_bins=nb, default_bins=db, missing_types=mt,
+                monotone=mono, penalty=pen, feature_mask=fmask,
+                mn=mn, mx=mx)
         if icat is None:
             return best_split_per_feature(hist, sg, sh, sc, nb, db, mt, p,
                                           monotone=mono, penalty=pen,

@@ -77,11 +77,44 @@ def arena_geometry(num_data: int, num_features: int,
     shared by GBDT._setup_tree_engine and the driver compile check
     (__graft_entry__.entry), so the compile check always exercises the
     same shapes real training uses.  `factor` multiples of the row
-    footprint cover root + OOB dump + bump-allocated child segments;
-    the 16-tile tail is kernel read-overrun headroom."""
+    footprint cover root + OOB dump + bump-allocated child segments
+    (pristine layout: pristine bins + root copy + dump + bump -> pass
+    factor >= 4); the 16-tile tail is kernel read-overrun headroom."""
     base = -(-max(num_data, 1) // TILE) * TILE
     cap = max(factor, 3) * base + 16 * TILE
     return arena_channels(max(num_features, 1)), cap
+
+
+def pristine_work0(num_data: int) -> int:
+    """First work-region column in the pristine arena layout: the
+    pristine row block [0, align(n)) plus one guard tile (kernel reads
+    overrun segments by < TILE)."""
+    return -(-max(num_data, 1) // TILE) * TILE + TILE
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def init_pristine(arena, bins_t):
+    """Write the PER-DATASET arena channels (feature bins + rowid byte
+    planes + padding) into the pristine region [0, n) once.  Per-tree
+    assembly then touches only the six g/h payload planes — the other
+    42-of-48 channels of the old full re-assembly were identical every
+    tree (the bins never change and pristine rows stay in row order).
+    g/h plane rows are left untouched (overwritten per tree)."""
+    C, cap = arena.shape
+    G, n = bins_t.shape
+    Fp = feature_channels(G)
+    adt = ARENA_DT
+    chans = [bins_t.astype(adt)]
+    if Fp > G:
+        chans.append(jnp.zeros((Fp - G, n), adt))
+    arena = jax.lax.dynamic_update_slice(
+        arena, jnp.concatenate(chans, axis=0), (0, 0))
+    rid = jnp.stack(split_rowid(jnp.arange(n, dtype=jnp.int32)))
+    arena = jax.lax.dynamic_update_slice(arena, rid, (Fp + 6, 0))
+    if C > Fp + N_AUX:
+        arena = jax.lax.dynamic_update_slice(
+            arena, jnp.zeros((C - Fp - N_AUX, n), adt), (Fp + N_AUX, 0))
+    return arena
 
 
 def split_f32(x):

@@ -47,8 +47,12 @@ _NB, _DB, _MT, _MONO, _PEN, _FMASK, _CEGBF = range(7)
 _SG, _SH, _ND, _MINC, _MAXC = range(5)
 # pvec layout (params, [8] f32 SMEM)
 _L1, _L2, _MDS, _MINCNT, _MINH, _MINGAIN, _CEGBS = range(7)
-# output lane layout
-_OG, _OT, _ODL, _OLG, _OLH, _OLC, _OLO, _ORG, _ORH, _ORC, _ORO = range(11)
+# output lane layout (shared by the per-feature block and the selected
+# best-rows: lane 1 holds the feature id so a best-row is a complete,
+# directly-scatterable SplitResult record)
+(_OG, _OF, _OT, _ODL, _OLG, _OLH, _OLC, _OLO,
+ _ORG, _ORH, _ORC, _ORO) = range(12)
+ROW_W = 128        # lane width of one packed split row
 
 
 def _prefix_lanes(x):
@@ -63,7 +67,7 @@ def _prefix_lanes(x):
 
 
 def _split_scan_kernel(pvec_ref, svec_ref, fvec_ref, hist_ref, out_ref,
-                       *, CH: int, F: int, B: int):
+                       best_ref, *, CH: int, F: int, B: int):
     R = CH * F
     l1 = pvec_ref[_L1]
     l2 = pvec_ref[_L2]
@@ -189,10 +193,36 @@ def _split_scan_kernel(pvec_ref, svec_ref, fvec_ref, hist_ref, out_ref,
     two_bin_nan = (mt == 2.0) & (nb <= 2.0)
     dl = jnp.where(use_desc & ~two_bin_nan, 1.0, 0.0)
 
-    cols = [feat_gain, best_thr, dl, stats[0], stats[1], stats[2], lo_p,
-            stats[3], stats[4], stats[5], ro_p]
-    out_ref[:] = jnp.concatenate(
-        cols + [jnp.zeros((R, 128 - len(cols)), jnp.float32)], axis=1)
+    feat_id = (row - (row // F) * F).astype(jnp.float32)
+    cols = [feat_gain, feat_id, best_thr, dl, stats[0], stats[1], stats[2],
+            lo_p, stats[3], stats[4], stats[5], ro_p]
+    block = jnp.concatenate(
+        cols + [jnp.zeros((R, ROW_W - len(cols)), jnp.float32)], axis=1)
+    out_ref[:] = block
+
+    # in-kernel cross-feature selection (select_best_feature): per child,
+    # max gain over its F rows, lowest feature id on ties — emitted as a
+    # ready-to-scatter [CH, ROW_W] result row for the packed grow state.
+    # The gain lane keeps the NEG sentinel when no feature has a valid
+    # split (feature lane -1), and the +eps directional hessian bias is
+    # removed exactly like select_best_feature.
+    best_rows = []
+    row_f = row.astype(jnp.float32)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, ROW_W), 1)
+    for ch in range(CH):
+        in_ch = (row >= ch * F) & (row < (ch + 1) * F)
+        mgain = jnp.where(in_ch, feat_gain, jnp.float32(NEG))
+        bg = jnp.max(mgain)
+        brow = jnp.min(jnp.where(mgain == bg, row_f, jnp.float32(BIG)))
+        sel = row_f == brow
+        picked = jnp.sum(jnp.where(sel, block, 0.0), axis=0, keepdims=True)
+        has = bg > jnp.float32(NEG_GATE)
+        feat_lane = jnp.where(has, picked[:, _OF:_OF + 1], -1.0)
+        picked = jnp.where(lane == _OF, feat_lane, picked)
+        picked = jnp.where((lane == _OLH) | (lane == _ORH),
+                           picked - jnp.float32(K_EPSILON), picked)
+        best_rows.append(picked)
+    best_ref[:] = jnp.concatenate(best_rows, axis=0)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -208,8 +238,10 @@ def _run_scan(pvec, svec, fvec, hist3, *, interpret: bool):
                   pl.BlockSpec(memory_space=pltpu.SMEM),
                   pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((R, 128), jnp.float32),
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        out_shape=(jax.ShapeDtypeStruct((R, ROW_W), jnp.float32),
+                   jax.ShapeDtypeStruct((CH, ROW_W), jnp.float32)),
         interpret=interpret,
     )(pvec, svec, fvec, hist3)
 
@@ -242,16 +274,10 @@ def build_feature_statics(num_bins, default_bins, missing_types,
     return jnp.concatenate([one] * children, axis=0)
 
 
-def best_splits_pallas(hist,            # [CH, F, B, 3]
-                       sum_g, sum_h, num_data,          # [CH] each
-                       fvec,            # [CH*F, 8] from build_feature_statics
-                       params: SplitParams,
-                       min_constraints=None, max_constraints=None,  # [CH]
-                       interpret: bool = False) -> PerFeatureSplit:
-    """Numerical best split per feature for CH children in one kernel
-    launch.  Returns a PerFeatureSplit with [CH, F] fields (cat_mask
-    None) matching ops/split.py best_split_per_feature vmapped over
-    children, up to f32 prefix-sum association order."""
+def _pack_inputs(hist, sum_g, sum_h, num_data, min_constraints,
+                 max_constraints, params: SplitParams):
+    """(pvec, svec, hist3) shared by both kernel entry points — ONE place
+    owns the lane layouts (_SG.._MAXC / _L1.._CEGBS)."""
     CH, F, B, _ = hist.shape
     f32 = jnp.float32
     hist3 = jnp.moveaxis(hist.astype(f32), 3, 0).reshape(3, CH * F, B)
@@ -275,8 +301,29 @@ def best_splits_pallas(hist,            # [CH, F, B, 3]
         jnp.asarray(params.min_sum_hessian_in_leaf, f32),
         jnp.asarray(params.min_gain_to_split, f32),
         jnp.asarray(params.cegb_split_penalty, f32)] + [jnp.float32(0.0)])
-    out = _run_scan(pvec, svec, fvec, hist3, interpret=interpret)
-    out = out.reshape(CH, F, 128)
+    return pvec, svec, hist3
+
+
+def best_splits_pallas(hist,            # [CH, F, B, 3]
+                       sum_g, sum_h, num_data,          # [CH] each
+                       fvec,            # [CH*F, 8] from build_feature_statics
+                       params: SplitParams,
+                       min_constraints=None, max_constraints=None,  # [CH]
+                       interpret: bool = False) -> PerFeatureSplit:
+    """Numerical best split per feature for CH children in one kernel
+    launch.  Returns a PerFeatureSplit with [CH, F] fields (cat_mask
+    None) matching ops/split.py best_split_per_feature vmapped over
+    children, up to f32 prefix-sum association order.
+
+    NOTE: counts ride f32 prefix sums in-kernel — exact only for
+    num_data < 2^24; callers gate on that (the same bound as the
+    partition engine's rowid planes)."""
+    CH, F, B, _ = hist.shape
+    pvec, svec, hist3 = _pack_inputs(hist, sum_g, sum_h, num_data,
+                                     min_constraints, max_constraints,
+                                     params)
+    out, _ = _run_scan(pvec, svec, fvec, hist3, interpret=interpret)
+    out = out.reshape(CH, F, ROW_W)
     gain = out[..., _OG]
     gain = jnp.where(gain <= NEG_GATE, K_MIN_SCORE, gain)
     return PerFeatureSplit(
@@ -292,3 +339,70 @@ def best_splits_pallas(hist,            # [CH, F, B, 3]
         right_count=jnp.round(out[..., _ORC]).astype(jnp.int32),
         right_output=out[..., _ORO],
     )
+
+
+def best_split_rows_pallas(hist, sum_g, sum_h, num_data, fvec,
+                           params: SplitParams,
+                           min_constraints=None, max_constraints=None,
+                           interpret: bool = False):
+    """[CH, ROW_W] packed best-split rows (lane layout _O*): the kernel's
+    in-kernel select_best_feature output, ready to scatter into the
+    packed split cache of the grow loop.  gain lane uses the NEG
+    sentinel (compare against NEG_GATE), feature lane is -1 when no
+    valid split."""
+    pvec, svec, hist3 = _pack_inputs(hist, sum_g, sum_h, num_data,
+                                     min_constraints, max_constraints,
+                                     params)
+    _, best = _run_scan(pvec, svec, fvec, hist3, interpret=interpret)
+    return best
+
+
+def pack_split_row(res, cat_width: int = 0):
+    """SplitResult -> [ROW_W (+cat_width)] packed row (XLA fallback used
+    by the categorical/mixed path and forced splits; keeps K_MIN_SCORE
+    gains as-is — any gain <= NEG_GATE means no split)."""
+    f32 = jnp.float32
+    vals = [jnp.asarray(res.gain, f32), jnp.asarray(res.feature, f32),
+            jnp.asarray(res.threshold, f32),
+            jnp.asarray(res.default_left, f32),
+            jnp.asarray(res.left_sum_gradient, f32),
+            jnp.asarray(res.left_sum_hessian, f32),
+            jnp.asarray(res.left_count, f32),
+            jnp.asarray(res.left_output, f32),
+            jnp.asarray(res.right_sum_gradient, f32),
+            jnp.asarray(res.right_sum_hessian, f32),
+            jnp.asarray(res.right_count, f32),
+            jnp.asarray(res.right_output, f32)]
+    row = jnp.zeros(ROW_W + cat_width, f32)
+    row = row.at[:12].set(jnp.stack(vals))
+    if cat_width:
+        row = row.at[ROW_W:].set(jnp.asarray(res.cat_mask, f32))
+    return row
+
+def scan_single(hist, sum_g, sum_h, cnt, params: SplitParams,
+                fvec_pre=None, num_bins=None, default_bins=None,
+                missing_types=None, monotone=None, penalty=None,
+                feature_mask=None, cegb_pen=None, mn=None, mx=None,
+                interpret=None) -> PerFeatureSplit:
+    """One-child kernel dispatch shared by the serial/feature-parallel
+    and voting scans in ops/grow.py — the two call sites must stay
+    bit-identical (voting elects against serial gains) so the argument
+    massaging lives HERE once."""
+    import jax as _jax
+    if interpret is None:
+        interpret = _jax.default_backend() != "tpu"
+    if fvec_pre is not None:
+        fvec = fvec_pre
+    else:
+        fvec = build_feature_statics(
+            num_bins, default_bins, missing_types, monotone=monotone,
+            penalty=penalty, feature_mask=feature_mask, children=1)
+    if cegb_pen is not None:
+        fvec = fvec.at[:, _CEGBF].set(cegb_pen.astype(jnp.float32))
+    pf = best_splits_pallas(
+        hist[None], jnp.reshape(sum_g, (1,)), jnp.reshape(sum_h, (1,)),
+        jnp.reshape(cnt, (1,)), fvec, params,
+        min_constraints=None if mn is None else mn[:1],
+        max_constraints=None if mx is None else mx[:1],
+        interpret=interpret)
+    return index_per_feature(pf, 0)

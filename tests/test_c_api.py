@@ -215,13 +215,25 @@ def test_streaming_push_rows(rng):
 
 
 def test_push_rows_by_csr(rng):
+    """Streaming ingest: mappers fitted from the sampled columns, pushed
+    rows binned incrementally — the binned result must match a dataset
+    constructed from the same rows with mappers from the same sample."""
     from scipy import sparse
-    n, f = 200, 6
+    n, f, s = 200, 6, 50
     X = (rng.rand(n, f) * (rng.rand(n, f) > 0.5)).astype(np.float64)
     csr = sparse.csr_matrix(X)
     h = ctypes.c_void_p()
+    # dense per-column sample of the first s rows (the reference's
+    # sampled-column format: values + row indices per column)
+    col_vals = [np.ascontiguousarray(X[:s, j]) for j in range(f)]
+    col_idx = [np.arange(s, dtype=np.int32) for _ in range(f)]
+    vp = (ctypes.c_void_p * f)(*[v.ctypes.data_as(ctypes.c_void_p).value
+                                 for v in col_vals])
+    ip = (ctypes.c_void_p * f)(*[v.ctypes.data_as(ctypes.c_void_p).value
+                                 for v in col_idx])
+    npc = (ctypes.c_int32 * f)(*([s] * f))
     assert LIB.LGBM_DatasetCreateFromSampledColumn(
-        None, None, f, None, 50, n, c_str("max_bin=15"),
+        vp, ip, f, npc, s, n, c_str("max_bin=15"),
         ctypes.byref(h)) == 0
     assert LIB.LGBM_DatasetPushRowsByCSR(
         h, c_array(ctypes.c_int, csr.indptr), 2,
@@ -229,7 +241,15 @@ def test_push_rows_by_csr(rng):
         csr.data.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)), 1,
         len(csr.indptr), len(csr.data), f, 0) == 0
     ds = LIB._resolve(h)
-    np.testing.assert_allclose(np.asarray(ds.data), X)
+    # no O(n*f) float staging: the raw matrix must NOT exist
+    assert ds.data is None
+    ds.construct()
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    oracle_m = BinnedDataset.construct(X[:s], Config({"max_bin": 15}),
+                                       bin_rows=False)
+    np.testing.assert_array_equal(np.asarray(ds._binned.bins),
+                                  oracle_m.bin_block(X))
 
 
 def test_subset_and_feature_names(rng):

@@ -12,6 +12,11 @@ import os
 
 import pytest
 
+# interpret-mode Pallas dominates these — excluded from the
+# fast tier (pytest -m 'not slow'); run the full suite before
+# committing engine changes
+pytestmark = pytest.mark.slow
+
 REF_TEST = "/root/reference/tests/c_api_test/test_.py"
 
 

@@ -8,6 +8,11 @@ import os
 import numpy as np
 import pytest
 
+# interpret-mode Pallas dominates these — excluded from the
+# fast tier (pytest -m 'not slow'); run the full suite before
+# committing engine changes
+pytestmark = pytest.mark.slow
+
 import lightgbm_tpu as lgb
 from lightgbm_tpu.app import Application
 from lightgbm_tpu.io.parser import load_text_file

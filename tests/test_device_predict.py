@@ -2,6 +2,11 @@
 import numpy as np
 import pytest
 
+# interpret-mode Pallas dominates these — excluded from the
+# fast tier (pytest -m 'not slow'); run the full suite before
+# committing engine changes
+pytestmark = pytest.mark.slow
+
 import lightgbm_tpu as lgb
 from lightgbm_tpu.ops import predict as predict_ops
 

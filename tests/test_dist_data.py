@@ -11,6 +11,11 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 import pytest
 
+# interpret-mode Pallas dominates these — excluded from the
+# fast tier (pytest -m 'not slow'); run the full suite before
+# committing engine changes
+pytestmark = pytest.mark.slow
+
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.io.dataset import BinnedDataset
 from lightgbm_tpu.parallel.dist_data import (LocalComm, construct_rank_shard,

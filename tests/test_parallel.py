@@ -7,6 +7,11 @@ the collectives change where stats are computed, not their values.
 import numpy as np
 import pytest
 
+# interpret-mode Pallas dominates these — excluded from the
+# fast tier (pytest -m 'not slow'); run the full suite before
+# committing engine changes
+pytestmark = pytest.mark.slow
+
 import lightgbm_tpu as lgb
 from lightgbm_tpu.ops import grow as grow_ops
 from lightgbm_tpu.ops.split import SplitParams

@@ -6,6 +6,12 @@ from lightgbm_tpu.io.metadata import Metadata
 from lightgbm_tpu.metric_rank import NDCGMetric
 from lightgbm_tpu.objective_rank import LambdarankNDCG
 
+# interpret-mode Pallas dominates these — excluded from the
+# fast tier (pytest -m 'not slow'); run the full suite before
+# committing engine changes
+import pytest  # noqa: E402
+pytestmark = pytest.mark.slow
+
 
 def _rank_data(rng, num_queries=60, max_docs=40):
     sizes = rng.randint(1, max_docs, num_queries)

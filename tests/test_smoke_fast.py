@@ -1,0 +1,24 @@
+"""Fast-tier smoke of the partition engine and the full training flow —
+the minimal counterpart of the `slow`-marked interpret-mode suites so
+`pytest -m "not slow"` still exercises the flagship path end to end."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def test_partition_engine_smoke(rng):
+    n, F = 400, 4
+    X = rng.randn(n, F).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    preds = {}
+    for eng in ("partition", "label"):
+        params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+                  "min_data_in_leaf": 10, "tpu_tree_engine": eng}
+        bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=2)
+        assert bst._gbdt._use_partition_engine == (eng == "partition")
+        preds[eng] = bst.predict(X)
+    # tiny model, single near-tie-free task: engines agree tightly here
+    np.testing.assert_allclose(preds["partition"], preds["label"],
+                               rtol=1e-3, atol=1e-3)
+    acc = ((preds["partition"] > 0.5) == y).mean()
+    assert acc > 0.8

@@ -130,3 +130,60 @@ class TestSplitScanParity:
         _compare(hist, np.zeros(1), hist[..., 1].sum((1, 2)),
                  np.full(1, F * B * 10, np.int32), num_bins,
                  jnp.zeros(F, jnp.int32), jnp.zeros(F, jnp.int32), params)
+
+
+class TestBestRowsParity:
+    def test_rows_match_select_best_feature(self):
+        rng = np.random.default_rng(11)
+        F, B = 9, 64
+        hist2 = np.stack([_rand_hist(rng, F, B), _rand_hist(rng, F, B)])
+        sg = hist2[..., 0].sum((1, 2))
+        sh = hist2[..., 1].sum((1, 2))
+        nd = hist2[..., 2].sum((1, 2)).astype(np.int32)
+        num_bins = jnp.asarray(rng.integers(3, B + 1, F), jnp.int32)
+        default_bins = jnp.zeros(F, jnp.int32)
+        mt = jnp.asarray(rng.integers(0, 3, F), jnp.int32)
+        params = SplitParams(min_data_in_leaf=20)
+        fvec = sp_pl.build_feature_statics(num_bins, default_bins, mt,
+                                           children=2)
+        rows = sp_pl.best_split_rows_pallas(
+            jnp.asarray(hist2), jnp.asarray(sg), jnp.asarray(sh),
+            jnp.asarray(nd), fvec, params, interpret=True)
+        from lightgbm_tpu.ops.split import select_best_feature
+        for i in range(2):
+            want = select_best_feature(best_split_per_feature(
+                jnp.asarray(hist2[i]), jnp.asarray(sg[i]), jnp.asarray(sh[i]),
+                jnp.asarray(nd[i]), num_bins, default_bins, mt, params))
+            row = np.asarray(rows[i])
+            assert int(row[sp_pl._OF]) == int(want.feature)
+            if int(want.feature) >= 0:
+                np.testing.assert_allclose(row[sp_pl._OG], float(want.gain),
+                                           rtol=2e-4)
+                assert int(row[sp_pl._OT]) == int(want.threshold)
+                assert (row[sp_pl._ODL] > 0.5) == bool(want.default_left)
+                for ln, fld in ((sp_pl._OLG, "left_sum_gradient"),
+                                (sp_pl._OLH, "left_sum_hessian"),
+                                (sp_pl._OLC, "left_count"),
+                                (sp_pl._OLO, "left_output"),
+                                (sp_pl._ORG, "right_sum_gradient"),
+                                (sp_pl._ORH, "right_sum_hessian"),
+                                (sp_pl._ORC, "right_count"),
+                                (sp_pl._ORO, "right_output")):
+                    np.testing.assert_allclose(
+                        row[ln], float(getattr(want, fld)), rtol=2e-4,
+                        atol=1e-5, err_msg=fld)
+
+    def test_rows_no_valid_split(self):
+        F, B = 4, 8
+        hist = np.zeros((1, F, B, 3), np.float32)
+        hist[..., 2] = 10.0
+        hist[..., 1] = 2.5
+        params = SplitParams(min_data_in_leaf=1)
+        fvec = sp_pl.build_feature_statics(
+            jnp.full(F, B, jnp.int32), jnp.zeros(F, jnp.int32),
+            jnp.zeros(F, jnp.int32), children=1)
+        rows = sp_pl.best_split_rows_pallas(
+            jnp.asarray(hist), jnp.zeros(1), jnp.asarray([100.0]),
+            jnp.asarray([320], jnp.int32), fvec, params, interpret=True)
+        assert int(rows[0, sp_pl._OF]) == -1
+        assert float(rows[0, sp_pl._OG]) <= sp_pl.NEG_GATE

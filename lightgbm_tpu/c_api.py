@@ -603,12 +603,14 @@ def LGBM_DatasetCreateFromSampledColumn(sample_data, sample_indices, ncol,
 def LGBM_DatasetCreateByReference(reference, num_total_row, out):
     ref = _resolve(reference)
     total = _ival(num_total_row)
-    if ref._binned is not None:
-        # share the reference's fitted mappers; pushed rows are binned
-        # incrementally against them (create_valid contract)
+    if ref._binned is not None or ref._stream_mapper is not None:
+        # share the reference's fitted mappers (already available even
+        # before a streaming reference is constructed); pushed rows are
+        # binned incrementally against them (create_valid contract)
+        mapper = (ref._binned if ref._binned is not None
+                  else ref._stream_mapper)
         ds = Dataset.for_streaming(
-            np.zeros((1, ref._binned.num_total_features)), total,
-            mapper=ref._binned)
+            np.zeros((1, mapper.num_total_features)), total, mapper=mapper)
         ds.reference = ref
     else:
         ncol = np.asarray(ref.data).shape[1]

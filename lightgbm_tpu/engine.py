@@ -156,7 +156,13 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
             break
 
     if booster.best_iteration <= 0:
-        booster.best_iteration = booster.current_iteration
+        # end-of-training count must be the SYNCED one: current_iteration
+        # reports undrained pipeline slots for cheap in-loop callbacks,
+        # but a drain can still trim trailing degenerate iterations and
+        # best_iteration must match the materialized model
+        booster.best_iteration = (booster.num_trees()
+                                  // max(booster._gbdt.num_tree_per_iteration,
+                                         1))
     if not keep_training_booster:
         booster._train_set = None
     return booster

@@ -217,6 +217,13 @@ def _split_scan_kernel(pvec_ref, svec_ref, fvec_ref, hist_ref, out_ref,
         sel = row_f == brow
         picked = jnp.sum(jnp.where(sel, block, 0.0), axis=0, keepdims=True)
         has = bg > jnp.float32(NEG_GATE)
+        # no-valid-split guard: with bg == NEG the tie-break row may be
+        # ANOTHER child's (out-of-child rows are also NEG), leaking the
+        # sibling's gain/stats into this child's row — mask the whole
+        # row back to the no-split sentinel (gain NEG, feature -1)
+        picked = jnp.where(has, picked, 0.0)
+        picked = jnp.where(lane == _OG,
+                           jnp.where(has, picked, jnp.float32(NEG)), picked)
         feat_lane = jnp.where(has, picked[:, _OF:_OF + 1], -1.0)
         picked = jnp.where(lane == _OF, feat_lane, picked)
         picked = jnp.where((lane == _OLH) | (lane == _ORH),

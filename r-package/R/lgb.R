@@ -1,0 +1,43 @@
+# Minimal R API over the lightgbm_tpu C ABI (.Call glue in src/) —
+# the lgb.Dataset / lgb.train / predict idiom of the reference
+# R-package, reduced to the training/predict core.
+
+.lgb_loaded <- FALSE
+
+lgb.load_lib <- function(so_path = NULL) {
+  if (.lgb_loaded) return(invisible(TRUE))
+  if (is.null(so_path)) {
+    so_path <- file.path(dirname(dirname(getwd())), "native",
+                         "liblightgbm_tpu.so")
+  }
+  dyn.load(so_path, local = FALSE)   # LGBM_* must be global for the glue
+  dyn.load(file.path("src", "lightgbm_tpu_R.so"))
+  .lgb_loaded <<- TRUE
+  invisible(TRUE)
+}
+
+lgb.Dataset <- function(data, label = NULL, params = "") {
+  stopifnot(is.matrix(data))
+  .Call("LGBMR_DatasetCreateFromMat", data, nrow(data), ncol(data),
+        params, if (is.null(label)) NULL else as.double(label))
+}
+
+lgb.train <- function(params, data, nrounds = 10) {
+  bst <- .Call("LGBMR_BoosterCreate", data, params)
+  for (i in seq_len(nrounds)) {
+    .Call("LGBMR_BoosterUpdateOneIter", bst)
+  }
+  bst
+}
+
+predict.lgb <- function(bst, data) {
+  .Call("LGBMR_BoosterPredictForMat", bst, data, nrow(data), ncol(data))
+}
+
+lgb.save <- function(bst, filename) {
+  invisible(.Call("LGBMR_BoosterSaveModel", bst, filename))
+}
+
+lgb.load <- function(filename) {
+  .Call("LGBMR_BoosterCreateFromModelfile", filename)
+}

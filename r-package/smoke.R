@@ -1,0 +1,26 @@
+# End-to-end R smoke over the real C ABI (.so): train, predict, save,
+# reload, compare.  Run from r-package/ after building the glue:
+#   R CMD SHLIB src/lightgbm_tpu_R.c -L../native -llightgbm_tpu \
+#       -Wl,-rpath,$(realpath ../native)
+#   PYTHONPATH=.. Rscript smoke.R
+source("R/lgb.R")
+lgb.load_lib()
+
+set.seed(7)
+n <- 2000; f <- 5
+X <- matrix(rnorm(n * f), n, f)
+y <- as.double(X[, 1] > 0)
+
+ds <- lgb.Dataset(X, label = y, params = "max_bin=63")
+bst <- lgb.train("objective=binary verbose=-1 num_leaves=15", ds,
+                 nrounds = 6)
+p <- predict.lgb(bst, X)
+sep <- mean(p[y > 0.5]) - mean(p[y < 0.5])
+cat(sprintf("separation: %.3f\n", sep))
+stopifnot(sep > 0.2)
+
+lgb.save(bst, "model_r.txt")
+bst2 <- lgb.load("model_r.txt")
+p2 <- predict.lgb(bst2, X)
+stopifnot(max(abs(p - p2)) < 1e-6)
+cat("R ABI SMOKE OK\n")

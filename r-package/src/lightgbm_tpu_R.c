@@ -1,0 +1,110 @@
+/* .Call glue over the LGBM_* C ABI exported by
+ * native/liblightgbm_tpu.so — the same thin argument-shuffle role as
+ * the reference's R-package/src/lightgbm_R.cpp (1-625), written
+ * against this framework's trampoline.  Build with:
+ *   R CMD SHLIB lightgbm_tpu_R.c -L../../native -llightgbm_tpu
+ * (needs an R toolchain; see ../README.md for the validation story).
+ */
+#include <R.h>
+#include <Rinternals.h>
+#include <stdint.h>
+#include <string.h>
+
+typedef void *DatasetHandle;
+typedef void *BoosterHandle;
+
+extern const char *LGBM_GetLastError(void);
+extern int LGBM_DatasetCreateFromMat(const void *, int, int32_t, int32_t,
+                                     int, const char *, const DatasetHandle,
+                                     DatasetHandle *);
+extern int LGBM_DatasetSetField(DatasetHandle, const char *, const void *,
+                                int32_t, int);
+extern int LGBM_DatasetFree(DatasetHandle);
+extern int LGBM_BoosterCreate(const DatasetHandle, const char *,
+                              BoosterHandle *);
+extern int LGBM_BoosterUpdateOneIter(BoosterHandle, int *);
+extern int LGBM_BoosterPredictForMat(BoosterHandle, const void *, int,
+                                     int32_t, int32_t, int, int, int,
+                                     const char *, int64_t *, double *);
+extern int LGBM_BoosterSaveModel(BoosterHandle, int, int, const char *);
+extern int LGBM_BoosterCreateFromModelfile(const char *, int *,
+                                           BoosterHandle *);
+extern int LGBM_BoosterFree(BoosterHandle);
+
+#define CHECK_CALL(x) \
+  if ((x) != 0) Rf_error("lightgbm_tpu: %s", LGBM_GetLastError())
+
+static void dataset_finalizer(SEXP ext) {
+  DatasetHandle h = R_ExternalPtrAddr(ext);
+  if (h != NULL) { LGBM_DatasetFree(h); R_ClearExternalPtr(ext); }
+}
+
+static void booster_finalizer(SEXP ext) {
+  BoosterHandle h = R_ExternalPtrAddr(ext);
+  if (h != NULL) { LGBM_BoosterFree(h); R_ClearExternalPtr(ext); }
+}
+
+SEXP LGBMR_DatasetCreateFromMat(SEXP mat, SEXP nrow, SEXP ncol,
+                                SEXP params, SEXP label) {
+  DatasetHandle h = NULL;
+  int nr = Rf_asInteger(nrow), nc = Rf_asInteger(ncol);
+  /* R matrices are column-major: is_row_major = 0 */
+  CHECK_CALL(LGBM_DatasetCreateFromMat(REAL(mat), /*float64*/ 1, nr, nc, 0,
+                                       CHAR(Rf_asChar(params)), NULL, &h));
+  if (!Rf_isNull(label)) {
+    int n = Rf_length(label);
+    float *buf = (float *)R_alloc(n, sizeof(float));
+    double *src = REAL(label);
+    for (int i = 0; i < n; i++) buf[i] = (float)src[i];
+    CHECK_CALL(LGBM_DatasetSetField(h, "label", buf, n, /*float32*/ 0));
+  }
+  SEXP ext = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ext, dataset_finalizer, TRUE);
+  UNPROTECT(1);
+  return ext;
+}
+
+SEXP LGBMR_BoosterCreate(SEXP ds, SEXP params) {
+  BoosterHandle h = NULL;
+  CHECK_CALL(LGBM_BoosterCreate(R_ExternalPtrAddr(ds),
+                                CHAR(Rf_asChar(params)), &h));
+  SEXP ext = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ext, booster_finalizer, TRUE);
+  UNPROTECT(1);
+  return ext;
+}
+
+SEXP LGBMR_BoosterUpdateOneIter(SEXP bst) {
+  int finished = 0;
+  CHECK_CALL(LGBM_BoosterUpdateOneIter(R_ExternalPtrAddr(bst), &finished));
+  return Rf_ScalarLogical(finished);
+}
+
+SEXP LGBMR_BoosterPredictForMat(SEXP bst, SEXP mat, SEXP nrow, SEXP ncol) {
+  int nr = Rf_asInteger(nrow), nc = Rf_asInteger(ncol);
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, nr));
+  int64_t out_len = 0;
+  CHECK_CALL(LGBM_BoosterPredictForMat(
+      R_ExternalPtrAddr(bst), REAL(mat), 1, nr, nc, 0,
+      /*normal*/ 0, /*all iters*/ -1, "", &out_len, REAL(out)));
+  if (out_len != nr) Rf_error("prediction length mismatch");
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMR_BoosterSaveModel(SEXP bst, SEXP filename) {
+  CHECK_CALL(LGBM_BoosterSaveModel(R_ExternalPtrAddr(bst), 0, -1,
+                                   CHAR(Rf_asChar(filename))));
+  return R_NilValue;
+}
+
+SEXP LGBMR_BoosterCreateFromModelfile(SEXP filename) {
+  BoosterHandle h = NULL;
+  int iters = 0;
+  CHECK_CALL(LGBM_BoosterCreateFromModelfile(CHAR(Rf_asChar(filename)),
+                                             &iters, &h));
+  SEXP ext = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
+  R_RegisterCFinalizerEx(ext, booster_finalizer, TRUE);
+  UNPROTECT(1);
+  return ext;
+}

@@ -187,3 +187,32 @@ class TestBestRowsParity:
             jnp.asarray([320], jnp.int32), fvec, params, interpret=True)
         assert int(rows[0, sp_pl._OF]) == -1
         assert float(rows[0, sp_pl._OG]) <= sp_pl.NEG_GATE
+
+    def test_rows_asymmetric_no_valid_split(self):
+        """One child valid, the other not (the routine late-tree state):
+        the invalid child's row must carry the no-split sentinel, NOT a
+        leak of the sibling's gain/threshold/stats (round-4 regression
+        caught by review)."""
+        rng = np.random.default_rng(3)
+        F, B = 5, 16
+        good = _rand_hist(rng, F, B)
+        # all mass in one bin: no threshold can satisfy min_data_in_leaf
+        bad = np.zeros((F, B, 3), np.float32)
+        bad[:, 0, 0] = 3.0
+        bad[:, 0, 1] = 5.0
+        bad[:, 0, 2] = 100.0
+        hist2 = np.stack([good, bad])
+        sg = hist2[..., 0].sum((1, 2))
+        sh = hist2[..., 1].sum((1, 2))
+        nd = hist2[..., 2].sum((1, 2)).astype(np.int32)
+        params = SplitParams(min_data_in_leaf=5)
+        fvec = sp_pl.build_feature_statics(
+            jnp.full(F, B, jnp.int32), jnp.zeros(F, jnp.int32),
+            jnp.zeros(F, jnp.int32), children=2)
+        rows = sp_pl.best_split_rows_pallas(
+            jnp.asarray(hist2), jnp.asarray(sg), jnp.asarray(sh),
+            jnp.asarray(nd), fvec, params, interpret=True)
+        assert float(rows[0, sp_pl._OG]) > 0          # good child splits
+        assert int(rows[1, sp_pl._OF]) == -1
+        assert float(rows[1, sp_pl._OG]) <= sp_pl.NEG_GATE, \
+            "sibling gain leaked into the no-split child"

@@ -758,11 +758,35 @@ class GBDT:
         full generality (CPU/f64/categorical/distributed learners)."""
         cfg = self.config
         eng = cfg.tpu_tree_engine
-        eligible = (self._grower is None
-                    and self.dtype == jnp.float32
-                    and self.max_bin <= 256
-                    and self.train_set.num_features > 0
-                    and self.num_data < (1 << 24))
+        base_ok = (self.dtype == jnp.float32
+                   and self.max_bin <= 256
+                   and self.train_set.num_features > 0
+                   and self.num_data < (1 << 24))
+        if self._grower is not None:
+            # distributed learners: the partition engine runs under
+            # shard_map inside ParallelGrower (local arenas per device,
+            # all three modes); forced splits / CEGB stay on the label
+            # engine (leaf-indexed cache injection + coupled penalties
+            # are serial-path features, matching the reference where
+            # they live in SerialTreeLearner)
+            self._use_partition_engine = False
+            self._bins_t = None
+            self._last_truncated = None
+            self._truncation_warned = False
+            self._hist_slots = 0
+            grower_ok = (base_ok and not self._forced_splits
+                         and self._cegb_coupled is None)
+            if eng == "partition" and not grower_ok:
+                log.warning("tpu_tree_engine=partition not applicable to "
+                            "this distributed config; using label engine")
+            want = (eng == "partition"
+                    or (eng == "auto" and jax.default_backend() == "tpu"))
+            if grower_ok and want:
+                self._grower.enable_partition()
+            else:
+                self._grower.disable_partition()
+            return
+        eligible = base_ok
         if eng == "partition" and not eligible:
             log.warning("tpu_tree_engine=partition not applicable here "
                         "(needs serial learner, f32, max_bin<=256); "
@@ -904,7 +928,7 @@ class GBDT:
                                cegb_used_init=cegb_used)
         if self._grower is None and self._forced_splits:
             grow_fn = _partial(grow_fn, forced_splits=self._forced_splits)
-        return grow_fn(
+        result = grow_fn(
             self.train_state.bins, grad, hess, row_init,
             self._feature_sample(),
             self.train_state.num_bins, self.train_state.default_bins,
@@ -918,6 +942,12 @@ class GBDT:
             hist_impl=self.config.tpu_histogram_impl,
             rows_per_chunk=self.config.tpu_rows_per_tile,
             max_cat_threshold=self.config.max_cat_threshold)
+        if self._grower is not None:
+            # the grower's shard_map'd partition path reports arena
+            # truncation the same way the serial path does — surface it
+            # so the "raise tpu_arena_factor" warning fires here too
+            self._last_truncated = self._grower.last_truncated
+        return result
 
     def _sample_gradients(self, grad: jnp.ndarray, hess: jnp.ndarray):
         """Per-iteration gradient/row sampling hook (overridden by GOSS)."""

@@ -19,11 +19,25 @@ back to the label engine for configs that need full generality.
 
 Supports categorical bitset splits, EFB-bundled datasets (both via the
 go-left mask decision), forced splits (the same cache-injection scheme
-as the label engine) and data-parallel sharding (axis_name: psum'd
-histograms, local arenas).  Remaining restrictions vs the label engine
-(the GBDT driver auto-selects): f32 only, max_bin <= 256, n < 2^24
-(rowids ride three byte planes exactly), serial or data-parallel only
-(feature-/voting-parallel use the label engine).
+as the label engine) and all three distributed learners (axis_name +
+learner):
+
+- "data":    rows sharded, local arenas, psum'd histograms — the
+  DataParallelTreeLearner schedule (data_parallel_tree_learner.cpp:
+  116-245) with ReduceScatter/Allreduce collapsed into psum;
+- "feature": data replicated (every device has the full arena — the
+  reference's FP learner replicates data too, feature_parallel_tree_
+  learner.cpp:30-74), the best-split SEARCH sharded by features, winner
+  synced with an all_gather of packed split rows (SyncUpGlobalBestSplit,
+  parallel_tree_learner.h:186-209); the partition itself is local
+  because every device holds all feature channels;
+- "voting":  rows sharded + per-leaf top-k election so only the ~2k
+  elected features' histograms ride the psum (PV-tree,
+  voting_parallel_tree_learner.cpp:166-460).
+
+Remaining restrictions vs the label engine (the GBDT driver
+auto-selects): f32 only, max_bin <= 256, n < 2^24 (rowids ride three
+byte planes exactly).
 """
 from __future__ import annotations
 
@@ -97,6 +111,9 @@ def grow_tree_partition_impl(
         full_bag: bool = False,
         max_cat_threshold: int = 32,
         axis_name: Optional[str] = None,
+        learner: str = "data",
+        num_machines: int = 1,
+        top_k: int = 20,
         hist_slots: int = 0,
         forced_splits: tuple = (),
         pristine: bool = False,
@@ -127,6 +144,19 @@ def grow_tree_partition_impl(
         raise ValueError("partition engine supports n < 2^24 rows")
     if C != pp.arena_channels(G):
         raise ValueError("arena_buf channel dim mismatch")
+    dist = axis_name is not None
+    dp = dist and learner == "data"
+    fp = dist and learner == "feature"
+    vp = dist and learner == "voting"
+    if fp and bundle is not None:
+        raise ValueError("EFB-bundled datasets do not support the "
+                         "feature-parallel learner (bundling is disabled "
+                         "at dataset construction for it)")
+    if fp and F % num_machines:
+        raise ValueError(
+            "feature-parallel requires num_features (%d) divisible by "
+            "num_machines (%d); pad features first (ParallelGrower does)"
+            % (F, num_machines))
     dtype = jnp.float32
     Fp = pp.feature_channels(G)
     L = max_leaves
@@ -197,12 +227,18 @@ def grow_tree_partition_impl(
     else:
         root_hist = root_hist_b.astype(dtype)
     root_c_local = root_c
-    if axis_name is not None:
+    if dp:
         # DP: one histogram allreduce; global sums/counts fall out of it
         root_hist = jax.lax.psum(root_hist, axis_name)
         root_c = jax.lax.psum(root_c, axis_name)
     root_g = jnp.sum(root_hist[0, :, 0])
     root_h = jnp.sum(root_hist[0, :, 1])
+    if vp:
+        # voting keeps histograms LOCAL; only the scalar root stats ride
+        # an allreduce (data_parallel_tree_learner.cpp:116-142)
+        root_g = jax.lax.psum(root_g, axis_name)
+        root_h = jax.lax.psum(root_h, axis_name)
+        root_c = jax.lax.psum(root_c, axis_name)
 
     def unbundle(hist, sum_g, sum_h, cnt):
         from .grow import unbundle_hist
@@ -225,11 +261,22 @@ def grow_tree_partition_impl(
     NEG_GATE = jnp.float32(sp_pl.NEG_GATE)
     N = max(L - 1, 1)
     use_scan_kernel = is_categorical is None
+    if fp:
+        # contiguous per-shard feature slice (the analogue of the
+        # bin-count-balanced shuffle, feature_parallel_tree_learner.cpp:
+        # 30-49): each device SCANS only its own features; data (and so
+        # histograms and partitions) are replicated
+        f_local = F // num_machines
+        _dev = jax.lax.axis_index(axis_name).astype(jnp.int32)
+        scan_feature_mask = feature_mask & (
+            (jnp.arange(F, dtype=jnp.int32) // f_local) == _dev)
+    else:
+        scan_feature_mask = feature_mask
     fvec1 = fvec2 = None
     if use_scan_kernel:
         fvec1 = sp_pl.build_feature_statics(
             num_bins, default_bins, missing_types, monotone=monotone,
-            penalty=penalty, feature_mask=feature_mask, children=1)
+            penalty=penalty, feature_mask=scan_feature_mask, children=1)
         fvec2 = jnp.concatenate([fvec1, fvec1], axis=0)
 
     def _patch_cegb(fvec, used, children):
@@ -246,6 +293,120 @@ def grow_tree_partition_impl(
         rows = jnp.where((lane == sp_pl._OG) & ~depth_ok, NEGF, rows)
         return jnp.where((lane == sp_pl._OF) & ~depth_ok, -1.0, rows)
 
+    def _fp_sync(rows):
+        """SyncUpGlobalBestSplit (parallel_tree_learner.h:186-209): each
+        device scanned only its feature shard; all_gather the packed
+        rows and keep the max-gain winner per child.  argmax first-hit =
+        lowest shard = lowest feature id, the reference's tie-break."""
+        allr = jax.lax.all_gather(rows, axis_name)       # [d, CH, RWC]
+        win = jnp.argmax(allr[:, :, sp_pl._OG], axis=0)  # [CH]
+        return jnp.take_along_axis(allr, win[None, :, None], axis=0)[0]
+
+    k_top = min(top_k, F)
+    n_elect = min(2 * k_top, F)
+
+    def _vote_rows(hist_l, sg, sh, cn, mn, mx):
+        """PV-tree election (voting_parallel_tree_learner.cpp:166-460)
+        over CH children in ONE all_gather + ONE psum: local scans with
+        1/num_machines-rescaled min-data thresholds -> local top-k ->
+        all_gather -> vote -> psum of the <=2k elected features'
+        histograms -> global scan -> packed [CH, RWC] winner rows.
+
+        hist_l [CH, G, B, 3] holds LOCAL-shard rows; sg/sh/cn [CH] are
+        the GLOBAL child stats (they ride the packed split rows)."""
+        CH = hist_l.shape[0]
+
+        def _unb1(h):
+            lg = jnp.sum(h[0, :, 0])
+            lh = jnp.sum(h[0, :, 1])
+            lc = jnp.sum(h[0, :, 2])
+            return unbundle(h, lg, lh, lc), jnp.stack([lg, lh, lc])
+
+        hu, locs = jax.vmap(_unb1)(hist_l)     # [CH, F, B, 3], [CH, 3]
+        loc_cnt = jnp.round(locs[:, 2]).astype(jnp.int32)
+        # locally-rescaled config (voting...cpp:50-57)
+        lparams = params._replace(
+            min_data_in_leaf=jnp.maximum(
+                params.min_data_in_leaf // num_machines, 1),
+            min_sum_hessian_in_leaf=(params.min_sum_hessian_in_leaf
+                                     / num_machines))
+        mn_a = None if monotone is None else mn
+        mx_a = None if monotone is None else mx
+        if use_scan_kernel:
+            fvecCH = fvec1 if CH == 1 else fvec2
+            pf_loc = sp_pl.best_splits_pallas(
+                hu, locs[:, 0], locs[:, 1], loc_cnt, fvecCH, lparams,
+                min_constraints=mn_a, max_constraints=mx_a,
+                interpret=interpret)
+            gains = pf_loc.gain                            # [CH, F]
+        else:
+            gains = jnp.stack([
+                best_split_per_feature_mixed(
+                    hu[i], locs[i, 0], locs[i, 1], loc_cnt[i],
+                    num_bins, default_bins, missing_types,
+                    is_categorical, lparams,
+                    monotone=monotone, penalty=penalty,
+                    feature_mask=scan_feature_mask,
+                    min_constraints=(None if mn_a is None else
+                                     jnp.broadcast_to(mn_a[i], (F,))),
+                    max_constraints=(None if mx_a is None else
+                                     jnp.broadcast_to(mx_a[i], (F,))),
+                    max_cat_threshold=max_cat_threshold).gain
+                for i in range(CH)])
+
+        # local top-k -> Allgather (the LightSplitInfo allgather) ->
+        # GlobalVoting; lax.top_k is stable so equal-vote ties break
+        # toward the smaller feature id (voting...cpp:166-195)
+        _, top_idx = jax.lax.top_k(gains, k_top)           # [CH, k]
+        top_ok = jnp.take_along_axis(gains, top_idx, axis=1) > K_MIN_SCORE
+        allt = jax.lax.all_gather(top_idx, axis_name)      # [d, CH, k]
+        allv = jax.lax.all_gather(top_ok, axis_name)
+
+        def _tally(t, v):
+            return jnp.zeros(F, jnp.int32).at[t.reshape(-1)].add(
+                v.reshape(-1).astype(jnp.int32))
+
+        votes = jax.vmap(_tally, in_axes=(1, 1))(allt, allv)   # [CH, F]
+        _, elected = jax.lax.top_k(votes, n_elect)
+        elected = elected.astype(jnp.int32)                # [CH, n_elect]
+        # psum of the elected features' histograms only — O(2k*B) bytes
+        # instead of O(F*B) (CopyLocalHistogram + ReduceScatter)
+        sel = jax.vmap(lambda h, e: jnp.take(h, e, axis=0))(hu, elected)
+        glob = jax.lax.psum(sel, axis_name)        # [CH, n_elect, B, 3]
+
+        rows = []
+        if use_scan_kernel:
+            fv = jax.vmap(lambda e: fvec1[e])(elected).reshape(
+                CH * n_elect, fvec1.shape[1])
+            pf_g = sp_pl.best_splits_pallas(
+                glob, sg, sh, cn, fv, params,
+                min_constraints=mn_a, max_constraints=mx_a,
+                interpret=interpret)
+            for i in range(CH):
+                res = select_best_feature(
+                    sp_pl.index_per_feature(pf_g, i),
+                    feature_index=elected[i])
+                rows.append(sp_pl.pack_split_row(res, cat_width=cat_w))
+        else:
+            for i in range(CH):
+                def _tk(a):
+                    return None if a is None else jnp.take(a, elected[i],
+                                                           axis=0)
+                pf = best_split_per_feature_mixed(
+                    glob[i], sg[i], sh[i], cn[i], _tk(num_bins),
+                    _tk(default_bins), _tk(missing_types),
+                    _tk(is_categorical), params,
+                    monotone=_tk(monotone), penalty=_tk(penalty),
+                    feature_mask=_tk(scan_feature_mask),
+                    min_constraints=(None if mn_a is None else
+                                     jnp.broadcast_to(mn_a[i], (n_elect,))),
+                    max_constraints=(None if mx_a is None else
+                                     jnp.broadcast_to(mx_a[i], (n_elect,))),
+                    max_cat_threshold=max_cat_threshold)
+                res = select_best_feature(pf, feature_index=elected[i])
+                rows.append(sp_pl.pack_split_row(res, cat_width=cat_w))
+        return jnp.stack(rows)
+
     def leaf_best_result(hist, sum_g, sum_h, cnt, used=None,
                          minc=None, maxc=None):
         """XLA SplitResult scan — categorical/mixed datasets only."""
@@ -261,7 +422,7 @@ def grow_tree_partition_impl(
             hist, sum_g, sum_h, cnt, num_bins, default_bins,
             missing_types, is_categorical, params,
             monotone=monotone, penalty=penalty,
-            feature_mask=feature_mask,
+            feature_mask=scan_feature_mask,
             min_constraints=mn, max_constraints=mx,
             cegb_feature_penalty=cegb_pen,
             max_cat_threshold=max_cat_threshold)
@@ -270,7 +431,16 @@ def grow_tree_partition_impl(
     def single_best_row(hist, sum_g, sum_h, cnt, depth, used=None,
                         minc=None, maxc=None):
         depth_ok = (max_depth <= 0) | (depth < max_depth)
-        if use_scan_kernel:
+        if vp:
+            rows = _vote_rows(
+                hist[None], jnp.reshape(sum_g, (1,)),
+                jnp.reshape(sum_h, (1,)),
+                jnp.reshape(jnp.asarray(cnt, dtype), (1,)),
+                None if minc is None else jnp.reshape(
+                    jnp.asarray(minc, dtype), (1,)),
+                None if maxc is None else jnp.reshape(
+                    jnp.asarray(maxc, dtype), (1,)))
+        elif use_scan_kernel:
             h1 = unbundle(hist, sum_g, sum_h, cnt)[None]
             mn1 = mx1 = None
             if monotone is not None and minc is not None:
@@ -285,13 +455,19 @@ def grow_tree_partition_impl(
             res = leaf_best_result(hist, sum_g, sum_h, cnt, used=used,
                                    minc=minc, maxc=maxc)
             rows = sp_pl.pack_split_row(res, cat_width=cat_w)[None]
+        if fp:
+            rows = _fp_sync(rows)
         return _gate(rows, depth_ok)[0]
 
     def pair_best_rows(hist2, sg2, sh2, cnt2_, depth, used, mn2, mx2):
         """[2, RWC] packed best rows of both children — one kernel
         launch on the numerical path."""
         depth_ok = (max_depth <= 0) | (depth < max_depth)
-        if use_scan_kernel:
+        if vp:
+            rows = _vote_rows(hist2, sg2, sh2, cnt2_,
+                              mn2 if monotone is not None else None,
+                              mx2 if monotone is not None else None)
+        elif use_scan_kernel:
             h2 = jax.vmap(lambda hh, gg, hs, cc: unbundle(hh, gg, hs, cc))(
                 hist2, sg2, sh2, cnt2_)
             rows = sp_pl.best_split_rows_pallas(
@@ -306,6 +482,8 @@ def grow_tree_partition_impl(
                                      used=used, minc=mn2[i], maxc=mx2[i]),
                     cat_width=cat_w)
                 for i in range(2)])
+        if fp:
+            rows = _fp_sync(rows)
         return _gate(rows, depth_ok)
 
     cegb_used0 = (cegb_used_init if cegb_used_init is not None
@@ -400,15 +578,17 @@ def grow_tree_partition_impl(
         # budget covers balanced trees; pathological shapes truncate —
         # the flag is surfaced so the driver can warn the user to raise
         # tpu_arena_factor).  Serial: the smaller-child count is exact.
-        # Data-parallel: the LOCAL smaller-child size is only known after
-        # the kernel runs, so the bound is the local parent size; the
-        # flag is all-reduced so every shard truncates together.
-        if axis_name is None:
+        # Data-parallel/voting: the LOCAL smaller-child size is only
+        # known after the kernel runs, so the bound is the local parent
+        # size; the flag is all-reduced so every shard truncates
+        # together.  Feature-parallel replicates data, so counts (and
+        # the overflow decision) are identical on every device.
+        if axis_name is None or fp:
             need_bound = _align(small_cnt, ALLOC)
         else:
             need_bound = _align(cntP_local, ALLOC)
         overflow = (~no_split) & (state.cursor + need_bound + pp.TILE > cap)
-        if axis_name is not None:
+        if dp or vp:
             overflow = jax.lax.psum(overflow.astype(jnp.int32),
                                     axis_name) > 0
         no_split = no_split | overflow
@@ -481,10 +661,13 @@ def grow_tree_partition_impl(
                              decision=decision)
         small_hist = seg(arena, dstB,
                          jnp.where(no_split, 0, counts[1]))
-        if axis_name is not None:
+        if dp:
             # DP: ONE collective per split — the smaller child's histogram
             # allreduce (the sibling still comes from subtraction, §3.4.2);
-            # in pooled mode the parent recompute rides the same allreduce
+            # in pooled mode the parent recompute rides the same allreduce.
+            # Voting and feature-parallel skip this: voting keeps local
+            # histograms (the election psums only elected features),
+            # feature-parallel's histograms are replicated already.
             if pooled:
                 both_h = jax.lax.psum(jnp.stack([small_hist, recomputed]),
                                       axis_name)
@@ -713,6 +896,6 @@ def grow_tree_partition_impl(
 
 grow_tree_partition = partial(jax.jit, static_argnames=(
     "max_leaves", "max_depth", "max_bin", "emit", "full_bag",
-    "max_cat_threshold", "axis_name", "hist_slots", "forced_splits",
-    "pristine", "interpret"),
+    "max_cat_threshold", "axis_name", "learner", "num_machines", "top_k",
+    "hist_slots", "forced_splits", "pristine", "interpret"),
     donate_argnums=(0,))(grow_tree_partition_impl)

@@ -7,7 +7,9 @@
 lgb.load_lib <- function(so_path = NULL) {
   if (.lgb_loaded) return(invisible(TRUE))
   if (is.null(so_path)) {
-    so_path <- file.path(dirname(dirname(getwd())), "native",
+    # documented flow runs from <repo>/r-package (cd r-package &&
+    # Rscript smoke.R), so the repo root is one dirname up
+    so_path <- file.path(dirname(getwd()), "native",
                          "liblightgbm_tpu.so")
   }
   dyn.load(so_path, local = FALSE)   # LGBM_* must be global for the glue

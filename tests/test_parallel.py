@@ -103,6 +103,155 @@ def test_voting_differs_only_in_election(rng):
                                   np.asarray(tp.split_feature))
 
 
+def _partition_serial_tree(rng, n=1024, F=8, B=24):
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops import grow_partition as gp
+    from lightgbm_tpu.ops import partition_pallas as pp_mod
+
+    bins = rng.randint(0, B, (n, F)).astype(np.float32)
+    # dyadic-rational grad/hess: every partial sum is EXACT in f32
+    # under any association, so serial / sharded / psum'd histograms are
+    # bit-identical and exact tree equality is a valid oracle (real
+    # workloads only get the GPU-parity band, docs/GPU-Performance.rst)
+    grad = (rng.randint(-64, 65, n) / 64.0).astype(np.float32)
+    hess = (rng.randint(1, 9, n) / 8.0).astype(np.float32)
+    meta = dict(row0=jnp.zeros(n, jnp.int32), fm=jnp.ones(F, bool),
+                nb=jnp.full(F, B, jnp.int32), db=jnp.zeros(F, jnp.int32),
+                mt=jnp.zeros(F, jnp.int32))
+    params = SplitParams(min_data_in_leaf=5)
+    statics = dict(max_leaves=15, max_bin=B, emit="leaf_ids",
+                   full_bag=True, interpret=True)
+    C, cap = pp_mod.arena_geometry(n, F)
+    arena = jnp.zeros((C, cap), pp_mod.ARENA_DT)
+    ts, ls, _, _ = gp.grow_tree_partition(
+        arena, jnp.asarray(bins.T, pp_mod.ARENA_DT), jnp.asarray(grad),
+        jnp.asarray(hess), meta["row0"], meta["fm"], meta["nb"],
+        meta["db"], meta["mt"], params, **statics)
+    return bins, grad, hess, meta, params, statics, ts, ls
+
+
+def _assert_trees_equal(ts, ls, tp, lp):
+    assert int(ts.num_leaves) == int(tp.num_leaves)
+    np.testing.assert_array_equal(np.asarray(ts.split_feature),
+                                  np.asarray(tp.split_feature))
+    np.testing.assert_array_equal(np.asarray(ts.threshold_bin),
+                                  np.asarray(tp.threshold_bin))
+    np.testing.assert_array_equal(np.asarray(ls), np.asarray(lp))
+    np.testing.assert_allclose(np.asarray(ts.leaf_value),
+                               np.asarray(tp.leaf_value),
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_partition_engine_feature_parallel(rng):
+    """Feature-parallel on the partition engine: data replicated, the
+    best-split search sharded by features, winner all_gathered — must
+    reproduce the serial partition trees exactly."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from lightgbm_tpu.ops import grow_partition as gp
+    from lightgbm_tpu.ops import partition_pallas as pp_mod
+    from lightgbm_tpu.parallel.learners import AXIS
+
+    (bins, grad, hess, m, params, statics,
+     ts, ls) = _partition_serial_tree(rng)
+    n, F = bins.shape
+    d = 8
+    C, cap = pp_mod.arena_geometry(n, F)
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:d]), (AXIS,))
+
+    def shard_fn(bins_t, g, h, r0):
+        arena_l = jnp.zeros((C, cap), pp_mod.ARENA_DT)
+        t, l, _, _ = gp.grow_tree_partition_impl(
+            arena_l, bins_t, g, h, r0, m["fm"], m["nb"], m["db"], m["mt"],
+            params, axis_name=AXIS, learner="feature", num_machines=d,
+            **statics)
+        return t, l
+
+    fn = jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh, in_specs=(P(), P(), P(), P()),
+        out_specs=(P(), P()), check_vma=False))
+    tp, lp = fn(jnp.asarray(bins.T, pp_mod.ARENA_DT), jnp.asarray(grad),
+                jnp.asarray(hess), m["row0"])
+    _assert_trees_equal(ts, ls, tp, lp)
+
+
+@pytest.mark.parametrize("top_k", [8, 3])
+def test_partition_engine_voting_parallel(rng, top_k):
+    """Voting-parallel on the partition engine: rows sharded, local
+    histograms, per-leaf top-k election, psum of elected features only.
+    With top_k >= F every feature is elected -> exact serial equality;
+    with a small top_k the election is still a valid PV-tree (structure
+    may legitimately differ near vote boundaries) — assert validity."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from lightgbm_tpu.ops import grow_partition as gp
+    from lightgbm_tpu.ops import partition_pallas as pp_mod
+    from lightgbm_tpu.parallel.learners import AXIS
+
+    (bins, grad, hess, m, params, statics,
+     ts, ls) = _partition_serial_tree(rng)
+    n, F = bins.shape
+    d = 8
+    n_loc = n // d
+    C2, cap_loc = pp_mod.arena_geometry(n_loc, F)
+    mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:d]), (AXIS,))
+
+    def shard_fn(bins_t, g, h, r0):
+        arena_l = jnp.zeros((C2, cap_loc), pp_mod.ARENA_DT)
+        t, l, _, _ = gp.grow_tree_partition_impl(
+            arena_l, bins_t, g, h, r0, m["fm"], m["nb"], m["db"], m["mt"],
+            params, axis_name=AXIS, learner="voting", num_machines=d,
+            top_k=top_k, **statics)
+        return t, l
+
+    fn = jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        out_specs=(P(), P(AXIS)), check_vma=False))
+    tp, lp = fn(jnp.asarray(bins.T, pp_mod.ARENA_DT), jnp.asarray(grad),
+                jnp.asarray(hess), m["row0"])
+    if top_k >= F:
+        _assert_trees_equal(ts, ls, tp, lp)
+    else:
+        # elected-subset growth: a full tree over valid leaf ids whose
+        # per-leaf counts match the partition
+        assert int(tp.num_leaves) == int(ts.num_leaves)
+        lp_np = np.asarray(lp)
+        counts = np.bincount(lp_np, minlength=int(tp.num_leaves))
+        np.testing.assert_array_equal(
+            counts[:int(tp.num_leaves)],
+            np.asarray(tp.leaf_count)[:int(tp.num_leaves)])
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_end_to_end_partition_parallel(rng, mode):
+    """lgb.train with tpu_tree_engine=partition routes the distributed
+    growers through ParallelGrower's shard_map'd partition path (no
+    silent label fallback) and matches serial predictions."""
+    n = 500
+    X = rng.randn(n, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.1 * rng.randn(n) > 0.3).astype(float)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 15, "learning_rate": 0.1, "verbose": -1,
+              "min_data_in_leaf": 5, "num_machines": 8,
+              "tpu_tree_engine": "partition"}
+    serial = lgb.train(dict(params, tree_learner="serial"),
+                       lgb.Dataset(X, y), num_boost_round=10)
+    par = lgb.train(dict(params, tree_learner=mode),
+                    lgb.Dataset(X, y), num_boost_round=10)
+    g = par._gbdt._grower
+    assert g is not None and g._partition is not None, \
+        "partition engine silently fell back under %s" % mode
+    ps, pp = serial.predict(X), par.predict(X)
+    assert np.mean((pp > 0.5) == y) > 0.85
+    assert np.mean(np.abs(ps - pp)) < 0.02
+
+
 def test_partition_engine_data_parallel(rng):
     """The partition (arena) engine under shard_map with rows sharded:
     psum'd histograms must reproduce the serial partition trees."""

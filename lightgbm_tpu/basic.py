@@ -226,6 +226,43 @@ class Dataset:
         ds.label = None
         return ds
 
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Column-merge `other` into this Dataset (reference
+        basic.py Dataset.add_features_from -> Dataset::addFeaturesFrom,
+        src/io/dataset.cpp:983).  Works on constructed datasets by
+        merging the BINNED feature groups (no re-binning); two raw,
+        unconstructed datasets are concatenated lazily."""
+        if self._binned is not None or other._binned is not None:
+            self.construct()
+            other.construct()
+            self._binned.add_features_from(other._binned)
+        else:
+            self.data = np.column_stack([np.asarray(self.data),
+                                         np.asarray(other.data)])
+        return self
+
+    def add_data_from(self, other: "Dataset") -> "Dataset":
+        """Row-append `other` (same bin mappers required once
+        constructed — Dataset::addDataFrom)."""
+        if self._binned is not None or other._binned is not None:
+            self.construct()
+            other.construct()
+            self._binned.add_data_from(other._binned)
+        else:
+            n0 = np.asarray(self.data).shape[0]
+            n1 = np.asarray(other.data).shape[0]
+            self.data = np.vstack([np.asarray(self.data),
+                                   np.asarray(other.data)])
+            if self.label is not None or other.label is not None:
+                # zero-fill the unlabeled side (same as the binned path)
+                # rather than silently dropping or truncating labels
+                a = (np.zeros(n0) if self.label is None
+                     else np.asarray(self.label, np.float64))
+                b = (np.zeros(n1) if other.label is None
+                     else np.asarray(other.label, np.float64))
+                self.label = np.concatenate([a, b])
+        return self
+
     def set_label(self, label) -> "Dataset":
         self.label = label
         if self._binned is not None and label is not None:

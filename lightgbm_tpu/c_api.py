@@ -689,23 +689,20 @@ def LGBM_DatasetGetFeatureNames(handle, out_strs, out_len):
 
 @_wrap
 def LGBM_DatasetAddFeaturesFrom(target, source):
-    """Column-concatenate two unconstructed datasets (c_api.cpp
-    AddFeaturesFrom; Dataset::addFeaturesFrom)."""
+    """Column-merge `source` into `target` (c_api.cpp AddFeaturesFrom;
+    Dataset::addFeaturesFrom, src/io/dataset.cpp:983).  Constructed
+    datasets merge their BINNED feature groups in place — no raw-matrix
+    staging or re-binning."""
     t, s = _resolve(target), _resolve(source)
-    if t._binned is not None or s._binned is not None:
-        raise _CApiError("add_features_from requires unconstructed Datasets")
-    t.data = np.column_stack([np.asarray(t.data), np.asarray(s.data)])
+    t.add_features_from(s)
 
 
 @_wrap
 def LGBM_DatasetAddDataFrom(target, source):
-    """Row-concatenate (Dataset::addDataFrom analogue)."""
+    """Row-append `source` (Dataset::addDataFrom): constructed datasets
+    must share bin mappers (CheckAlign)."""
     t, s = _resolve(target), _resolve(source)
-    if t._binned is not None or s._binned is not None:
-        raise _CApiError("add_data_from requires unconstructed Datasets")
-    t.data = np.vstack([np.asarray(t.data), np.asarray(s.data)])
-    if t.label is not None and s.label is not None:
-        t.label = np.concatenate([np.asarray(t.label), np.asarray(s.label)])
+    t.add_data_from(s)
 
 
 @_wrap

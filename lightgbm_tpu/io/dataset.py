@@ -339,6 +339,130 @@ class BinnedDataset:
                                        metadata=metadata, reference=self)
 
     # ------------------------------------------------------------------ #
+    # Constructed-dataset merges (Dataset::addFeaturesFrom,
+    # src/io/dataset.cpp:983; Dataset::addDataFrom used by the
+    # distributed append path)
+    # ------------------------------------------------------------------ #
+    def add_features_from(self, other: "BinnedDataset") -> None:
+        """Append `other`'s BINNED feature columns to this dataset.
+
+        Both datasets stay constructed: mappers, bins, names, bundle
+        layout and per-feature vectors are merged in place — the binned
+        equivalent of column-stacking the raw matrices, without ever
+        re-binning."""
+        if self.bins is None or other.bins is None:
+            log.fatal("add_features_from requires constructed datasets")
+        if self.num_data != other.num_data:
+            log.fatal("Cannot add features from other Dataset with "
+                      "a different number of rows")
+        F0 = len(self.bin_mappers)
+        raw0 = self.num_total_features
+        self.used_feature_map += [(-1 if v < 0 else v + F0)
+                                  for v in other.used_feature_map]
+        self.real_feature_index += [r + raw0
+                                    for r in other.real_feature_index]
+        self.bin_mappers = list(self.bin_mappers) + list(other.bin_mappers)
+        self.num_total_features = raw0 + other.num_total_features
+        self._set_offsets()
+        names_o = (list(other.feature_names) if other.feature_names
+                   else ["Column_%d" % (raw0 + i)
+                         for i in range(other.num_total_features)])
+        self.feature_names = list(self.feature_names) + names_o
+
+        def _cat(a, b, F_a, F_b, neutral, dtype):
+            if a is None and b is None:
+                return None
+            a = np.full(F_a, neutral, dtype) if a is None else np.asarray(a)
+            b = np.full(F_b, neutral, dtype) if b is None else np.asarray(b)
+            return np.concatenate([a, b])
+
+        Fo = len(other.bin_mappers)
+        self.monotone_constraints = _cat(
+            self.monotone_constraints, other.monotone_constraints,
+            F0, Fo, 0, np.int8)
+        self.feature_penalty = _cat(
+            self.feature_penalty, other.feature_penalty, F0, Fo, 1.0,
+            np.float64)
+        # merged bundle layout: either side without EFB contributes
+        # singleton groups; merged feature ids are shifted by F0
+        if self.bundle is not None or other.bundle is not None:
+            from . import efb
+
+            def _groups(ds, shift, count):
+                # NB: self.bin_mappers is already merged here — group
+                # counts must come from the PRE-merge feature counts
+                if ds.bundle is not None:
+                    return [[f + shift for f in grp]
+                            for grp in ds.bundle.groups]
+                return [[f + shift] for f in range(count)]
+
+            nb = [m.num_bin for m in self.bin_mappers]
+            db = [m.default_bin for m in self.bin_mappers]
+            self.bundle = efb.BundleInfo(
+                _groups(self, 0, F0) + _groups(other, F0, Fo), nb, db)
+        dt = (np.uint16 if (self.bins.dtype == np.uint16
+                            or other.bins.dtype == np.uint16) else np.uint8)
+        self.bins = np.column_stack([self.bins.astype(dt, copy=False),
+                                     other.bins.astype(dt, copy=False)])
+        self._device_cache.clear()
+
+    def add_data_from(self, other: "BinnedDataset") -> None:
+        """Append `other`'s ROWS; both must share the same bin mappers
+        (the reference checks alignment via Dataset::CheckAlign)."""
+        if self.bins is None or other.bins is None:
+            log.fatal("add_data_from requires constructed datasets")
+        if len(self.bin_mappers) != len(other.bin_mappers) or any(
+                a.num_bin != b.num_bin
+                for a, b in zip(self.bin_mappers, other.bin_mappers)):
+            log.fatal("Cannot add data from misaligned Dataset "
+                      "(bin mappers differ)")
+        if self.bins.shape[1] != other.bins.shape[1]:
+            log.fatal("Cannot add data from Dataset with a different "
+                      "bundled layout")
+        self.bins = np.vstack([self.bins, other.bins])
+        n0, n1 = self.num_data, other.num_data
+        self.num_data = n0 + n1
+        md, mo = self.metadata, other.metadata
+
+        def _rows(a, b):
+            if a is None and b is None:
+                return None
+            a = np.zeros(n0, np.float64) if a is None else np.asarray(a)
+            b = np.zeros(n1, np.float64) if b is None else np.asarray(b)
+            return np.concatenate([a, b])
+
+        # query metadata must stay consistent (query_boundaries[-1] ==
+        # num_data is a fatal Metadata invariant): appending unranked
+        # rows to a ranking dataset has no defensible semantics
+        if (md.query_boundaries is None) != (mo.query_boundaries is None):
+            log.fatal("Cannot add data from Dataset: only one side has "
+                      "query (group) information")
+        md.num_data = self.num_data
+        md.label = _rows(md.label, mo.label)
+        if md.weights is not None or mo.weights is not None:
+            md.weights = _rows(md.weights, mo.weights)
+        if md.query_boundaries is not None and mo.query_boundaries is not None:
+            md.query_boundaries = np.concatenate(
+                [md.query_boundaries[:-1],
+                 mo.query_boundaries + int(md.query_boundaries[-1])])
+            # query_weights are derived from per-row weights — recompute
+            # over the merged boundaries
+            md._update_query_weights()
+        if md.init_score is not None or mo.init_score is not None:
+            k = 1
+            if md.init_score is not None and n0:
+                k = md.init_score.size // n0
+            elif mo.init_score is not None and n1:
+                k = mo.init_score.size // n1
+            a = (np.zeros(n0 * k) if md.init_score is None
+                 else np.asarray(md.init_score).reshape(k, n0))
+            b = (np.zeros(n1 * k) if mo.init_score is None
+                 else np.asarray(mo.init_score).reshape(k, n1))
+            md.init_score = np.concatenate(
+                [a.reshape(k, n0), b.reshape(k, n1)], axis=1).reshape(-1)
+        self._device_cache.clear()
+
+    # ------------------------------------------------------------------ #
     # Accessors
     # ------------------------------------------------------------------ #
     @property

@@ -17,7 +17,9 @@ from .utils import log
 
 try:
     from sklearn.base import BaseEstimator, ClassifierMixin, RegressorMixin
+    from sklearn.exceptions import NotFittedError
     from sklearn.preprocessing import LabelEncoder
+    from sklearn.utils.validation import check_array
     _SKLEARN = True
 except ImportError:  # pragma: no cover
     BaseEstimator = object
@@ -27,8 +29,30 @@ except ImportError:  # pragma: no cover
 
     class RegressorMixin:
         pass
+
+    class NotFittedError(ValueError):
+        pass
     LabelEncoder = None
+    check_array = None
     _SKLEARN = False
+
+
+class LGBMNotFittedError(NotFittedError):
+    """Raised on predict-before-fit: a NotFittedError subclass so
+    sklearn tooling (check_is_fitted, pipelines) recognizes it
+    (reference compat.py LGBMNotFittedError)."""
+
+
+def _check_X(X, estimator=None):
+    """Input validation shared by fit/predict: rejects complex and empty
+    inputs with sklearn's messages, accepts CSR/CSC sparse (the Dataset
+    layer bins sparse columns natively) and preserves NaN (missing
+    values are first-class in GBDTs)."""
+    if _SKLEARN:
+        return check_array(X, accept_sparse=["csr", "csc"],
+                           dtype=np.float64, ensure_all_finite=False,
+                           estimator=estimator)
+    return np.asarray(X, np.float64)
 
 
 def _call_with_dataset(func: Callable, preds, dataset, what: str):
@@ -213,8 +237,25 @@ class LGBMModel(BaseEstimator):
             sample_weight = _apply_class_weight(self.class_weight, y,
                                                 sample_weight)
 
-        X = np.asarray(X, np.float64)
+        if y is None:
+            raise ValueError(
+                "requires y to be passed, but the target y is None")
+        X = _check_X(X, estimator=self)
+        if _SKLEARN:
+            from sklearn.utils.validation import (check_consistent_length,
+                                                  column_or_1d)
+            if not callable(getattr(self, "objective", None)):
+                # finite-label validation + 2d-column ravel with the
+                # standard DataConversionWarning; custom objectives may
+                # use unconventional label encodings, leave those alone
+                y = column_or_1d(y, warn=True)
+                y = check_array(y, ensure_2d=False, dtype=np.float64,
+                                input_name="y")
+            check_consistent_length(X, y)
         self._n_features = X.shape[1]
+        # sklearn-protocol fitted marker (trailing underscore, set in
+        # fit): check_is_fitted / pipelines key off it
+        self.n_features_in_ = X.shape[1]
         train_set = basic.Dataset(X, label=y, weight=sample_weight,
                                   group=group, init_score=init_score,
                                   feature_name=feature_name,
@@ -252,13 +293,15 @@ class LGBMModel(BaseEstimator):
     def predict(self, X, raw_score=False, num_iteration=-1,
                 pred_leaf=False, pred_contrib=False, **kwargs):
         if self._Booster is None:
-            raise basic.LightGBMError(
+            raise LGBMNotFittedError(
                 "Estimator not fitted, call fit before exploiting the model.")
-        X = np.asarray(X, np.float64)
+        X = _check_X(X, estimator=self)
         if X.shape[1] != self._n_features:
-            raise ValueError("Number of features of the model must match the "
-                             "input. Model n_features_ is %d and input "
-                             "n_features is %d" % (self._n_features, X.shape[1]))
+            # sklearn's standard consistency error message
+            raise ValueError(
+                "X has %d features, but %s is expecting %d features "
+                "as input." % (X.shape[1], type(self).__name__,
+                               self._n_features))
         return self._Booster.predict(X, raw_score=raw_score,
                                      num_iteration=num_iteration,
                                      pred_leaf=pred_leaf,
@@ -272,8 +315,15 @@ class LGBMModel(BaseEstimator):
     @property
     def booster_(self) -> basic.Booster:
         if self._Booster is None:
-            raise basic.LightGBMError("No booster found. Need to call fit first.")
+            raise LGBMNotFittedError(
+                "No booster found. Need to call fit first.")
         return self._Booster
+
+    def __sklearn_tags__(self):
+        tags = super().__sklearn_tags__()
+        tags.input_tags.sparse = True      # Dataset bins CSR/CSC natively
+        tags.input_tags.allow_nan = True   # missing values are first-class
+        return tags
 
     @property
     def best_iteration_(self):
@@ -293,7 +343,7 @@ class LGBMModel(BaseEstimator):
             importance_type=self.importance_type)
 
 
-class LGBMRegressor(LGBMModel, RegressorMixin):
+class LGBMRegressor(RegressorMixin, LGBMModel):
     """sklearn.py:619-658."""
 
     def fit(self, X, y, **kwargs):
@@ -302,11 +352,25 @@ class LGBMRegressor(LGBMModel, RegressorMixin):
         return super().fit(X, y, **kwargs)
 
 
-class LGBMClassifier(LGBMModel, ClassifierMixin):
+class LGBMClassifier(ClassifierMixin, LGBMModel):
     """sklearn.py:660-789."""
 
     def fit(self, X, y, **kwargs):
+        if y is None:
+            raise ValueError(
+                "requires y to be passed, but the target y is None")
         y = np.asarray(y)
+        if _SKLEARN:
+            from sklearn.utils.multiclass import check_classification_targets
+            from sklearn.utils.validation import column_or_1d
+            if y.ndim > 1:
+                y = column_or_1d(y, warn=True)
+            if y.dtype.kind == "f" and not np.isfinite(y).all():
+                raise ValueError(
+                    "Input y contains NaN or infinity")
+            # rejects continuous targets with the standard
+            # "Unknown label type: continuous" error
+            check_classification_targets(y)
         if LabelEncoder is not None:
             self._le = LabelEncoder().fit(y)
             y_enc = self._le.transform(y)

@@ -253,3 +253,99 @@ class TestTwoRound:
             % (data, model))
         Application(["config=%s" % conf]).run()
         assert model.exists() and "tree" in model.read_text()
+
+
+class TestConstructedMerge:
+    """Dataset::addFeaturesFrom / addDataFrom on CONSTRUCTED datasets
+    (src/io/dataset.cpp:983): binned feature groups merge in place and
+    training on the merged dataset equals training on the jointly-
+    constructed one."""
+
+    def test_add_features_from_trains_identically(self, rng):
+        import lightgbm_tpu as lgb
+
+        n = 400
+        Xa = rng.randn(n, 4)
+        Xb = rng.randn(n, 3)
+        y = (Xa[:, 0] + Xb[:, 1] > 0).astype(float)
+        params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+                  "min_data_in_leaf": 5}
+
+        joint = lgb.train(params, lgb.Dataset(np.column_stack([Xa, Xb]), y),
+                          num_boost_round=8)
+
+        da = lgb.Dataset(Xa, y)
+        db = lgb.Dataset(Xb)
+        da.construct()
+        db.construct()
+        da.add_features_from(db)
+        merged = lgb.train(params, da, num_boost_round=8)
+
+        X = np.column_stack([Xa, Xb])
+        np.testing.assert_allclose(joint.predict(X), merged.predict(X),
+                                   rtol=1e-6)
+
+    def test_add_features_from_merges_layout(self, rng):
+        n = 100
+        Xa, Xb = rng.randn(n, 3), rng.randn(n, 2)
+        a = BinnedDataset.construct(Xa, Config(max_bin=31))
+        b = BinnedDataset.construct(Xb, Config(max_bin=15))
+        a.add_features_from(b)
+        assert a.num_features == 5
+        assert a.num_total_features == 5
+        assert a.bins.shape == (n, 5)
+        assert len(a.feature_names) == 5
+        assert a.real_feature_index == [0, 1, 2, 3, 4]
+        # offsets rebuilt over the merged mappers
+        assert a.feature_offsets[-1] == sum(
+            m.num_bin for m in a.bin_mappers)
+
+    def test_add_data_from_appends_rows(self, rng):
+        n = 120
+        X = rng.randn(2 * n, 4)
+        y = (X[:, 0] > 0).astype(np.float64)
+        cfg = Config(max_bin=31)
+        half1 = BinnedDataset.construct(X[:n], cfg)
+        # second half binned against the SAME mappers (CheckAlign) —
+        # the oracle is the full matrix binned with those same mappers
+        # (mappers found from different samples legitimately differ)
+        half2 = BinnedDataset.construct(X[n:], cfg, reference=half1)
+        full = BinnedDataset.construct(X, cfg, reference=half1)
+        half1.metadata.set_label(y[:n])
+        half2.metadata.set_label(y[n:])
+        half1.add_data_from(half2)
+        assert half1.num_data == 2 * n
+        np.testing.assert_array_equal(half1.bins, full.bins)
+        np.testing.assert_allclose(half1.metadata.label, y)
+
+    def test_add_data_from_misaligned_raises(self, rng):
+        n = 80
+        a = BinnedDataset.construct(rng.randn(n, 3), Config(max_bin=31))
+        b = BinnedDataset.construct(rng.randn(n, 4), Config(max_bin=31))
+        with pytest.raises(Exception):
+            a.add_data_from(b)
+
+    def test_c_api_add_features_from_constructed(self, rng):
+        import ctypes
+
+        from lightgbm_tpu import c_api as C
+
+        n = 100
+        Xa = rng.randn(n, 3)
+        Xb = rng.randn(n, 2)
+        ha, hb = ctypes.c_void_p(), ctypes.c_void_p()
+        for X, h in ((Xa, ha), (Xb, hb)):
+            arr = np.ascontiguousarray(X, np.float64)
+            C.LGBM_DatasetCreateFromMat(
+                arr.ctypes.data_as(ctypes.c_void_p), C.C_API_DTYPE_FLOAT64,
+                np.int32(n), np.int32(X.shape[1]), 1, b"", None,
+                ctypes.byref(h))
+        out = ctypes.c_int()
+        C.LGBM_DatasetGetNumFeature(ha, ctypes.byref(out))
+        assert out.value == 3
+        # both handles are CONSTRUCTED datasets now
+        assert C.LGBM_DatasetAddFeaturesFrom(ha, hb) == 0
+        C.LGBM_DatasetGetNumFeature(ha, ctypes.byref(out))
+        assert out.value == 5
+        C.LGBM_DatasetFree(ha)
+        C.LGBM_DatasetFree(hb)

@@ -86,7 +86,7 @@ class Application:
             ds._binned = binned
             return ds
         d = loader_mod.load_data_file(cfg, cfg.data,
-                                      rank=cfg.machine_rank,
+                                      rank=max(cfg.machine_rank, 0),
                                       num_machines=cfg.num_machines,
                                       pre_partition=pre_partition,
                                       initscore_filename=cfg.initscore_filename)
@@ -99,6 +99,15 @@ class Application:
 
     def train(self) -> None:
         cfg = self.config
+        if not cfg.is_single_machine() and (cfg.machines
+                                            or cfg.machine_list_filename):
+            # multi-host: attach to the JAX coordination service so
+            # jax.devices() spans every machine and the shard_map'd
+            # learners' collectives ride DCN (Network::Init analogue,
+            # application.cpp:96-98)
+            from .parallel.distributed import initialize_from_config
+            rank, _world = initialize_from_config(cfg)
+            cfg.machine_rank = rank
         train_set = self._load_train_data()
         valid_sets, valid_names = [], []
         for i, vf in enumerate(cfg.valid):

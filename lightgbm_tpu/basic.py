@@ -253,14 +253,37 @@ class Dataset:
             n1 = np.asarray(other.data).shape[0]
             self.data = np.vstack([np.asarray(self.data),
                                    np.asarray(other.data)])
-            if self.label is not None or other.label is not None:
-                # zero-fill the unlabeled side (same as the binned path)
-                # rather than silently dropping or truncating labels
-                a = (np.zeros(n0) if self.label is None
-                     else np.asarray(self.label, np.float64))
-                b = (np.zeros(n1) if other.label is None
-                     else np.asarray(other.label, np.float64))
-                self.label = np.concatenate([a, b])
+
+            def _rows(a, b, fill):
+                # fill the absent side (labels 0, weights the NEUTRAL
+                # 1.0) rather than silently dropping or truncating —
+                # the binned-path semantics (BinnedDataset.add_data_from)
+                if a is None and b is None:
+                    return None
+                a = (np.full(n0, fill) if a is None
+                     else np.asarray(a, np.float64))
+                b = (np.full(n1, fill) if b is None
+                     else np.asarray(b, np.float64))
+                return np.concatenate([a, b])
+
+            self.label = _rows(self.label, other.label, 0.0)
+            self.weight = _rows(self.weight, other.weight, 1.0)
+            if (self.group is None) != (other.group is None):
+                raise ValueError("Cannot add data: only one side has "
+                                 "query (group) information")
+            if self.group is not None:
+                self.group = np.concatenate([np.asarray(self.group),
+                                             np.asarray(other.group)])
+            if self.init_score is not None or other.init_score is not None:
+                a = (np.zeros(n0) if self.init_score is None
+                     else np.asarray(self.init_score, np.float64))
+                b = (np.zeros(n1) if other.init_score is None
+                     else np.asarray(other.init_score, np.float64))
+                if len(a) != n0 or len(b) != n1:
+                    raise ValueError("add_data_from does not support "
+                                     "multiclass init_score on raw "
+                                     "datasets; construct first")
+                self.init_score = np.concatenate([a, b])
         return self
 
     def set_label(self, label) -> "Dataset":

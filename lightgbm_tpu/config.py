@@ -127,7 +127,8 @@ _SCHEMA = [
     ("eval_at", "vec_int", [1, 2, 3, 4, 5]),
     # --- network parameters (config.h:757-777)
     ("num_machines", int, 1),
-    ("machine_rank", int, 0),   # this process's rank for pre-partition loading
+    ("machine_rank", int, -1),  # this process's rank; -1 = resolve from
+    #   machine-list address match (parallel/distributed.resolve_rank)
     ("local_listen_port", int, 12400),
     ("time_out", int, 120),
     ("machine_list_filename", str, ""),

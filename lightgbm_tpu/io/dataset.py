@@ -424,11 +424,13 @@ class BinnedDataset:
         self.num_data = n0 + n1
         md, mo = self.metadata, other.metadata
 
-        def _rows(a, b):
+        def _rows(a, b, fill=0.0):
             if a is None and b is None:
                 return None
-            a = np.zeros(n0, np.float64) if a is None else np.asarray(a)
-            b = np.zeros(n1, np.float64) if b is None else np.asarray(b)
+            a = (np.full(n0, fill, np.float64) if a is None
+                 else np.asarray(a))
+            b = (np.full(n1, fill, np.float64) if b is None
+                 else np.asarray(b))
             return np.concatenate([a, b])
 
         # query metadata must stay consistent (query_boundaries[-1] ==
@@ -440,7 +442,9 @@ class BinnedDataset:
         md.num_data = self.num_data
         md.label = _rows(md.label, mo.label)
         if md.weights is not None or mo.weights is not None:
-            md.weights = _rows(md.weights, mo.weights)
+            # the unweighted side's rows carry the NEUTRAL weight 1.0 —
+            # zero would silently erase them from training
+            md.weights = _rows(md.weights, mo.weights, fill=1.0)
         if md.query_boundaries is not None and mo.query_boundaries is not None:
             md.query_boundaries = np.concatenate(
                 [md.query_boundaries[:-1],

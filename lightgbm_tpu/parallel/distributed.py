@@ -1,0 +1,253 @@
+"""Multi-host wiring: the machine-list entry point + cross-host comm.
+
+The reference trains across machines out of the box: Application reads
+`machines` / `machine_list_filename`, Network::Init builds a TCP
+connect mesh and rank is found by matching local interface addresses
+(src/network/linkers_socket.cpp:77-162, application.cpp:96-98).  The
+TPU-native equivalent has two halves:
+
+1. **Device-side collectives** — `jax.distributed.initialize` attaches
+   this process to the JAX coordination service; afterwards
+   `jax.devices()` spans every host and the SAME shard_map'd learners
+   (parallel/learners.py) emit ICI/DCN collectives with no code change.
+   `initialize_from_config` maps the reference's machine-list config
+   onto (coordinator_address, num_processes, process_id).
+
+2. **Host-side setup exchange** — distributed find-bin allgathers small
+   serialized bin mappers BEFORE any device array exists
+   (dist_data.construct_rank_shard).  `SocketComm` is the cross-host
+   transport for that seam (LocalComm covers single-process testing):
+   a hub-and-spoke TCP allgather on `local_listen_port`, the moral
+   equivalent of the reference's one-shot mapper Allgather
+   (dataset_loader.cpp:873-955) without the O(n^2) pairwise mesh the
+   reference builds for its hot-path collectives (ours ride XLA).
+
+Launch recipe (every host runs the same command):
+
+    # host0 is the coordinator; rank resolved from local addresses
+    python -m lightgbm_tpu config=train.conf \
+        machines=host0:12400,host1:12400 num_machines=2
+
+or from Python:
+
+    cfg = Config(machines="host0:12400,host1:12400", num_machines=2)
+    rank, world = initialize_from_config(cfg)     # jax.distributed up
+    comm = SocketComm(rank, world, parse_machines(cfg))
+    shard = dist_data.construct_rank_shard(X, cfg, rank, world, comm)
+    ... ParallelGrower("data", jax.device_count()) ...
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+from typing import List, Optional, Tuple
+
+from ..utils import log
+
+RANK_ENV = "LIGHTGBM_TPU_RANK"   # explicit override, highest priority
+
+
+def parse_machines(config) -> List[str]:
+    """machine list as ["host:port", ...] from `machines` or
+    `machine_list_filename` (config.h:748-755); ports default to
+    local_listen_port + rank-position like the reference's
+    machine-file parser (linkers_socket.cpp:77-121)."""
+    entries: List[str] = []
+    if getattr(config, "machines", ""):
+        entries = [m.strip() for m in config.machines.split(",") if m.strip()]
+    elif getattr(config, "machine_list_filename", ""):
+        with open(config.machine_list_filename) as f:
+            entries = [ln.strip() for ln in f
+                       if ln.strip() and not ln.startswith("#")]
+    out = []
+    for e in entries:
+        # the reference's machine files separate host and port with
+        # spaces or tabs (linkers_socket.cpp:77-121); normalize first
+        e = e.replace("\t", " ").strip()
+        if " " in e:
+            host, port = e.split()[:2]
+            e = "%s:%s" % (host, port)
+        if ":" not in e:
+            e = "%s:%d" % (e, config.local_listen_port)
+        out.append(e)
+    return out
+
+
+def _local_addresses() -> set:
+    """Hostnames/IPs that mean 'this machine' (the address-matching rank
+    discovery of linkers_socket.cpp:123-160)."""
+    names = {"localhost", "127.0.0.1", "::1"}
+    try:
+        host = socket.gethostname()
+        names.add(host)
+        names.add(socket.getfqdn())
+        for info in socket.getaddrinfo(host, None):
+            names.add(info[4][0])
+    except OSError:
+        pass
+    return names
+
+
+def resolve_rank(machines: List[str],
+                 explicit: Optional[int] = None) -> int:
+    """This process's rank: explicit argument > LIGHTGBM_TPU_RANK env >
+    local-address match against the machine list."""
+    if explicit is not None:
+        return int(explicit)
+    env = os.environ.get(RANK_ENV)
+    if env is not None:
+        return int(env)
+    local = _local_addresses()
+    matches = [i for i, m in enumerate(machines)
+               if m.rsplit(":", 1)[0] in local]
+    if len(matches) == 1:
+        return matches[0]
+    if len(matches) > 1:
+        # several list entries name this machine (multi-process per
+        # host): address matching cannot disambiguate — silently taking
+        # the first would give every local process the same rank
+        log.fatal("Machine list has %d entries matching this host "
+                  "(%s); set %s or machine_rank per process"
+                  % (len(matches), machines, RANK_ENV))
+    log.fatal("Could not find local machine in the machine list %s; "
+              "set %s or machine_rank explicitly" % (machines, RANK_ENV))
+    return -1
+
+
+def initialize_from_config(config, rank: Optional[int] = None
+                           ) -> Tuple[int, int]:
+    """Attach this process to the multi-host JAX runtime from the
+    reference's machine-list config (the Network::Init analogue,
+    application.cpp:96-98).  Returns (rank, num_machines); a no-op
+    (0, 1) for single-machine configs.
+
+    After this call jax.devices() spans all hosts, so
+    ParallelGrower/resolve_num_machines build a GLOBAL mesh and the
+    shard_map'd learners' psum/all_gather ride ICI/DCN across hosts.
+    """
+    if getattr(config, "num_machines", 1) <= 1:
+        return 0, 1
+    machines = parse_machines(config)
+    if len(machines) < 2:
+        log.warning("num_machines=%d but machine list has %d entries; "
+                    "staying single-machine",
+                    config.num_machines, len(machines))
+        return 0, 1
+    world = min(len(machines), config.num_machines)
+    cfg_rank = getattr(config, "machine_rank", -1)
+    r = resolve_rank(machines[:world],
+                     rank if rank is not None
+                     else (cfg_rank if cfg_rank >= 0 else None))
+    import jax
+    jax.distributed.initialize(coordinator_address=machines[0],
+                               num_processes=world, process_id=r)
+    log.info("Connected to %d-machine cluster as rank %d (%d devices "
+             "visible)", world, r, jax.device_count())
+    return r, world
+
+
+class SocketComm:
+    """Cross-host allgather for the find-bin seam: hub-and-spoke TCP
+    with length-prefixed pickled payloads.
+
+    Rank 0 binds its machine-list port and accepts world-1 spokes; each
+    allgather round every spoke sends its payload, the hub replies with
+    the full rank-ordered list.  Setup-phase traffic only (a few KB of
+    serialized BinMapper state) — hot-path collectives are XLA's job.
+    """
+
+    def __init__(self, rank: int, world: int, machines: List[str],
+                 timeout_s: float = 120.0):
+        self.rank, self.world = rank, world
+        self.timeout = timeout_s
+        host, port = machines[0].rsplit(":", 1)
+        self._peers: List[socket.socket] = []
+        if world == 1:
+            return
+        if rank == 0:
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            srv.bind((host if host in _local_addresses() else "", int(port)))
+            srv.listen(world - 1)
+            srv.settimeout(timeout_s)
+            by_rank = {}
+            for _ in range(world - 1):
+                conn, _addr = srv.accept()
+                conn.settimeout(timeout_s)
+                r = struct.unpack("!i", _recv_exact(conn, 4))[0]
+                by_rank[r] = conn
+            srv.close()
+            self._peers = [by_rank[r] for r in range(1, world)]
+        else:
+            # retry-connect until the hub binds (every host launches the
+            # same command, so spokes may start before rank 0 listens —
+            # the reference's linkers retry the same way)
+            import time
+            deadline = time.monotonic() + timeout_s
+            while True:
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s.settimeout(min(5.0, timeout_s))
+                try:
+                    s.connect((host, int(port)))
+                    break
+                except OSError:
+                    s.close()
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(0.25)
+            s.settimeout(timeout_s)
+            s.sendall(struct.pack("!i", rank))
+            self._peers = [s]
+
+    # LocalComm-compatible surface -------------------------------------
+    def allgather_fn(self, rank: int):
+        assert rank == self.rank
+        return self.allgather
+
+    def allgather(self, payload: dict) -> List[dict]:
+        if self.world == 1:
+            return [payload]
+        if self.rank == 0:
+            out: List[Optional[dict]] = [None] * self.world
+            out[0] = payload
+            for i, conn in enumerate(self._peers, start=1):
+                out[i] = _recv_msg(conn)
+            blob = pickle.dumps(out, protocol=pickle.HIGHEST_PROTOCOL)
+            for conn in self._peers:
+                _send_blob(conn, blob)
+            return out  # type: ignore[return-value]
+        _send_msg(self._peers[0], payload)
+        return _recv_msg(self._peers[0])
+
+    def close(self) -> None:
+        for s in self._peers:
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._peers = []
+
+
+def _send_blob(sock: socket.socket, blob: bytes) -> None:
+    sock.sendall(struct.pack("!q", len(blob)) + blob)
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    _send_blob(sock, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed during receive")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket):
+    (n,) = struct.unpack("!q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))

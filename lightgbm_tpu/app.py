@@ -85,8 +85,24 @@ class Application:
             ds = basic.Dataset(None, params=dict(self.raw_params))
             ds._binned = binned
             return ds
+        rank = cfg.machine_rank
+        if pre_partition and rank < 0:
+            # -1 means "unresolved": initialize_from_config resolves it
+            # when a machine list is given; without one, only an explicit
+            # rank prevents every host from silently loading shard 0
+            import os
+
+            from .parallel.distributed import RANK_ENV
+            env = os.environ.get(RANK_ENV)
+            if env is not None:
+                rank = int(env)
+            else:
+                log.fatal(
+                    "pre-partition loading needs this process's rank: "
+                    "set machines/machine_list_filename, machine_rank, "
+                    "or %s" % RANK_ENV)
         d = loader_mod.load_data_file(cfg, cfg.data,
-                                      rank=max(cfg.machine_rank, 0),
+                                      rank=max(rank, 0),
                                       num_machines=cfg.num_machines,
                                       pre_partition=pre_partition,
                                       initscore_filename=cfg.initscore_filename)

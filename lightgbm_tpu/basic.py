@@ -17,6 +17,7 @@ import numpy as np
 from .config import Config, param_dict_to_str
 from .io.dataset import BinnedDataset
 from .io.metadata import Metadata
+from .io.file_io import v_open
 from .io.parser import load_text_file
 from .metric import create_metric, default_metric_for_objective
 from .objective import create_objective
@@ -249,25 +250,13 @@ class Dataset:
             other.construct()
             self._binned.add_data_from(other._binned)
         else:
+            from .io.dataset import concat_fill
             n0 = np.asarray(self.data).shape[0]
             n1 = np.asarray(other.data).shape[0]
             self.data = np.vstack([np.asarray(self.data),
                                    np.asarray(other.data)])
-
-            def _rows(a, b, fill):
-                # fill the absent side (labels 0, weights the NEUTRAL
-                # 1.0) rather than silently dropping or truncating —
-                # the binned-path semantics (BinnedDataset.add_data_from)
-                if a is None and b is None:
-                    return None
-                a = (np.full(n0, fill) if a is None
-                     else np.asarray(a, np.float64))
-                b = (np.full(n1, fill) if b is None
-                     else np.asarray(b, np.float64))
-                return np.concatenate([a, b])
-
-            self.label = _rows(self.label, other.label, 0.0)
-            self.weight = _rows(self.weight, other.weight, 1.0)
+            self.label = concat_fill(self.label, other.label, n0, n1, 0.0)
+            self.weight = concat_fill(self.weight, other.weight, n0, n1, 1.0)
             if (self.group is None) != (other.group is None):
                 raise ValueError("Cannot add data: only one side has "
                                  "query (group) information")
@@ -275,15 +264,15 @@ class Dataset:
                 self.group = np.concatenate([np.asarray(self.group),
                                              np.asarray(other.group)])
             if self.init_score is not None or other.init_score is not None:
-                a = (np.zeros(n0) if self.init_score is None
-                     else np.asarray(self.init_score, np.float64))
-                b = (np.zeros(n1) if other.init_score is None
-                     else np.asarray(other.init_score, np.float64))
-                if len(a) != n0 or len(b) != n1:
+                if ((self.init_score is not None
+                     and len(np.asarray(self.init_score)) != n0)
+                        or (other.init_score is not None
+                            and len(np.asarray(other.init_score)) != n1)):
                     raise ValueError("add_data_from does not support "
                                      "multiclass init_score on raw "
                                      "datasets; construct first")
-                self.init_score = np.concatenate([a, b])
+                self.init_score = concat_fill(self.init_score,
+                                              other.init_score, n0, n1, 0.0)
         return self
 
     def set_label(self, label) -> "Dataset":
@@ -422,7 +411,7 @@ class Booster:
             self._gbdt = create_boosting(cfg, train_set._binned, objective)
             self.config = cfg
         elif model_file is not None:
-            with open(model_file) as f:
+            with v_open(model_file) as f:
                 text = f.read()
             self._init_from_string(text)
         elif model_str is not None:

@@ -31,6 +31,17 @@ def _issparse(X) -> bool:
         return False
 
 
+def concat_fill(a, b, n0: int, n1: int, fill: float):
+    """Concatenate two optional per-row vectors, filling the absent side
+    with `fill` (labels 0.0, weights the NEUTRAL 1.0) — the single home
+    of the add_data_from fill semantics (shared with basic.Dataset)."""
+    if a is None and b is None:
+        return None
+    a = np.full(n0, fill, np.float64) if a is None else np.asarray(a)
+    b = np.full(n1, fill, np.float64) if b is None else np.asarray(b)
+    return np.concatenate([a, b])
+
+
 class BinnedDataset:
     """Binned feature matrix + per-feature mappers + metadata."""
 
@@ -425,13 +436,7 @@ class BinnedDataset:
         md, mo = self.metadata, other.metadata
 
         def _rows(a, b, fill=0.0):
-            if a is None and b is None:
-                return None
-            a = (np.full(n0, fill, np.float64) if a is None
-                 else np.asarray(a))
-            b = (np.full(n1, fill, np.float64) if b is None
-                 else np.asarray(b))
-            return np.concatenate([a, b])
+            return concat_fill(a, b, n0, n1, fill)
 
         # query metadata must stay consistent (query_boundaries[-1] ==
         # num_data is a fatal Metadata invariant): appending unranked

@@ -14,6 +14,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from ..utils import log
+from .file_io import v_open
 
 CSV, TSV, LIBSVM = "csv", "tsv", "libsvm"
 
@@ -52,7 +53,7 @@ def _read_head(filename: str, n: int = 32,
     and '#' lines dropped), so a long comment preamble cannot exhaust the
     sniffing budget the way it cannot on the native path."""
     lines = []
-    with open(filename, "r") as f:
+    with v_open(filename, "r") as f:
         for line in f:
             if skip_comments:
                 s = line.strip()
@@ -92,7 +93,7 @@ def parse_libsvm(filename: str, num_features_hint: int = 0
     labels: List[float] = []
     rows: List[List[Tuple[int, float]]] = []
     max_idx = num_features_hint - 1
-    with open(filename, "r") as f:
+    with v_open(filename, "r") as f:
         for line in f:
             line = line.split("#", 1)[0].strip()
             if not line:
@@ -130,8 +131,12 @@ def parse_delimited(filename: str, sep: str, header: bool
                     ) -> Tuple[np.ndarray, Optional[List[str]]]:
     """CSV/TSV -> full float matrix (no label split yet) + column names."""
     import pandas as pd
-    df = pd.read_csv(filename, sep=sep, header=0 if header else None,
-                     comment="#", skip_blank_lines=True)
+
+    # open through the virtual-file seam (registered backends handle
+    # remote prefixes) instead of letting pandas route URLs to fsspec
+    with v_open(filename, "r") as fh:
+        df = pd.read_csv(fh, sep=sep, header=0 if header else None,
+                         comment="#", skip_blank_lines=True)
     names = [str(c) for c in df.columns] if header else None
     return df.to_numpy(dtype=np.float64), names
 
@@ -151,8 +156,10 @@ def load_text_file(filename: str, header: bool = False,
     """
     # native C++ parser fast path (native/fast_parser.cpp; the reference's
     # parser is native too, src/io/parser.cpp) — it sniffs the format
-    # itself, so the python-side sniff only runs on the fallback path
-    if file_format is None:
+    # itself, so the python-side sniff only runs on the fallback path.
+    # Virtual-file paths (registered backend / URL scheme) cannot go
+    # through the native fopen; they take the Python v_open readers.
+    if file_format is None and "://" not in str(filename):
         from . import native
         res = native.parse_file(filename, header=header,
                                 num_features_hint=num_features_hint)

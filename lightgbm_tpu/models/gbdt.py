@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ..config import Config
 from ..io.dataset import BinnedDataset
+from ..io.file_io import v_open
 from ..metric import Metric
 from ..objective import ObjectiveFunction
 from ..ops import grow as grow_ops
@@ -722,7 +723,7 @@ class GBDT:
         import json
         from collections import deque
 
-        with open(fname) as f:
+        with v_open(fname) as f:
             root = json.load(f)
         if not root:
             return ()
@@ -1283,7 +1284,7 @@ class GBDT:
 
     def save_model_to_file(self, filename: str, start_iteration: int = 0,
                            num_iteration: int = -1) -> None:
-        with open(filename, "w") as f:
+        with v_open(filename, "w") as f:
             f.write(self.save_model_to_string(start_iteration, num_iteration))
         log.info("Saved model to %s", filename)
 

@@ -159,10 +159,16 @@ class SocketComm:
     """
 
     def __init__(self, rank: int, world: int, machines: List[str],
-                 timeout_s: float = 120.0):
+                 timeout_s: float = 120.0, port_offset: int = 1):
+        """port_offset: the machine-list port belongs to the JAX
+        coordination service (initialize_from_config) — binding the hub
+        there would EADDRINUSE against it, so the find-bin comm uses
+        port + 1 by default (pass 0 when jax.distributed is not in
+        play)."""
         self.rank, self.world = rank, world
         self.timeout = timeout_s
         host, port = machines[0].rsplit(":", 1)
+        port = int(port) + port_offset
         self._peers: List[socket.socket] = []
         if world == 1:
             return

@@ -79,7 +79,7 @@ class TestMachineList:
 
 
 def _spoke_main(rank, world, machines, q):
-    comm = dist.SocketComm(rank, world, machines, timeout_s=60)
+    comm = dist.SocketComm(rank, world, machines, timeout_s=60, port_offset=0)
     try:
         for rnd in range(3):
             got = comm.allgather({"rank": rank, "round": rnd})
@@ -144,7 +144,7 @@ class TestSocketComm:
 def _run_shard(machines, X, y, rank, q):
     from lightgbm_tpu.parallel.dist_data import construct_rank_shard
     cfg = Config(max_bin=31, min_data_in_leaf=3)
-    comm = dist.SocketComm(rank, 2, machines, timeout_s=60)
+    comm = dist.SocketComm(rank, 2, machines, timeout_s=60, port_offset=0)
     try:
         ds = construct_rank_shard(X, cfg, rank, 2, comm,
                                   label=y, pre_partition=False)

@@ -90,12 +90,10 @@ class Application:
             # -1 means "unresolved": initialize_from_config resolves it
             # when a machine list is given; without one, only an explicit
             # rank prevents every host from silently loading shard 0
-            import os
-
-            from .parallel.distributed import RANK_ENV
-            env = os.environ.get(RANK_ENV)
+            from .parallel.distributed import RANK_ENV, rank_from_env
+            env = rank_from_env()
             if env is not None:
-                rank = int(env)
+                rank = env
             else:
                 log.fatal(
                     "pre-partition loading needs this process's rank: "

@@ -16,6 +16,7 @@ import numpy as np
 
 from ..utils import log
 from . import parser as parser_mod
+from .file_io import v_open
 
 
 def _resolve_column(spec: str, names: Optional[List[str]], what: str) -> int:
@@ -68,13 +69,15 @@ def load_init_score_file(data_filename: str,
     src/io/metadata.cpp:391-436): the explicit initscore file, else the
     `<data>.init` side file; tab-separated columns = classes, returned
     class-major flattened [k * n] like the reference stores them."""
-    import os
     path = initscore_filename or (data_filename + ".init")
-    if not os.path.exists(path):
+    try:
+        with v_open(path, "r") as fh:
+            scores = np.loadtxt(fh, dtype=np.float64, delimiter="\t",
+                                ndmin=2)
+    except (OSError, FileNotFoundError):
         if initscore_filename:
             log.fatal("Could not open initscore file %s" % path)
         return None
-    scores = np.loadtxt(path, dtype=np.float64, delimiter="\t", ndmin=2)
     if scores.size == 0:
         return None
     log.info("Loading initial scores...")
@@ -146,12 +149,19 @@ def _group_ids_to_counts(ids: np.ndarray) -> np.ndarray:
 def _load_side_files(filename: str, group, weight):
     """<data>.query / <data>.weight side channels (metadata.cpp
     LoadQueryBoundaries/LoadWeights); column data wins over side files."""
-    import os
-    if group is None and os.path.exists(filename + ".query"):
-        counts = np.loadtxt(filename + ".query", dtype=np.int64, ndmin=1)
-        group = counts.astype(np.int32)
-    if weight is None and os.path.exists(filename + ".weight"):
-        weight = np.loadtxt(filename + ".weight", dtype=np.float64, ndmin=1)
+    if group is None:
+        try:
+            with v_open(filename + ".query", "r") as fh:
+                group = np.loadtxt(fh, dtype=np.int64,
+                                   ndmin=1).astype(np.int32)
+        except (OSError, FileNotFoundError):
+            pass
+    if weight is None:
+        try:
+            with v_open(filename + ".weight", "r") as fh:
+                weight = np.loadtxt(fh, dtype=np.float64, ndmin=1)
+        except (OSError, FileNotFoundError):
+            pass
     return group, weight
 
 
@@ -210,14 +220,15 @@ def _iter_delimited_chunks(filename: str, sep: str, header: bool,
                            chunk_rows: int):
     """Yield [k, ncol] float chunks of a CSV/TSV file (pandas streaming)."""
     import pandas as pd
-    reader = pd.read_csv(filename, sep=sep, header=0 if header else None,
-                         comment="#", skip_blank_lines=True,
-                         chunksize=chunk_rows)
-    names = None
-    for i, df in enumerate(reader):
-        if i == 0 and header:
-            names = [str(c) for c in df.columns]
-        yield df.to_numpy(dtype=np.float64), names
+    with v_open(filename, "r") as fh:
+        reader = pd.read_csv(fh, sep=sep, header=0 if header else None,
+                             comment="#", skip_blank_lines=True,
+                             chunksize=chunk_rows)
+        names = None
+        for i, df in enumerate(reader):
+            if i == 0 and header:
+                names = [str(c) for c in df.columns]
+            yield df.to_numpy(dtype=np.float64), names
 
 
 def load_two_round(config, filename: str,

@@ -17,8 +17,10 @@ TPU-native equivalent has two halves:
    serialized bin mappers BEFORE any device array exists
    (dist_data.construct_rank_shard).  `SocketComm` is the cross-host
    transport for that seam (LocalComm covers single-process testing):
-   a hub-and-spoke TCP allgather on `local_listen_port`, the moral
-   equivalent of the reference's one-shot mapper Allgather
+   a hub-and-spoke TCP allgather on `local_listen_port + 1` (the
+   machine-list port itself belongs to the JAX coordination service;
+   open BOTH in the firewall), the moral equivalent of the reference's
+   one-shot mapper Allgather
    (dataset_loader.cpp:873-955) without the O(n^2) pairwise mesh the
    reference builds for its hot-path collectives (ours ride XLA).
 
@@ -90,15 +92,29 @@ def _local_addresses() -> set:
     return names
 
 
+def rank_from_env() -> Optional[int]:
+    """LIGHTGBM_TPU_RANK as an int, None when unset — the single home
+    of the env-override parsing (resolve_rank and the CLI pre-partition
+    guard both consult it)."""
+    env = os.environ.get(RANK_ENV)
+    if env is None:
+        return None
+    try:
+        return int(env)
+    except ValueError:
+        log.fatal("%s must be an integer rank, got %r" % (RANK_ENV, env))
+        return None
+
+
 def resolve_rank(machines: List[str],
                  explicit: Optional[int] = None) -> int:
     """This process's rank: explicit argument > LIGHTGBM_TPU_RANK env >
     local-address match against the machine list."""
     if explicit is not None:
         return int(explicit)
-    env = os.environ.get(RANK_ENV)
+    env = rank_from_env()
     if env is not None:
-        return int(env)
+        return env
     local = _local_addresses()
     matches = [i for i, m in enumerate(machines)
                if m.rsplit(":", 1)[0] in local]
@@ -152,7 +168,8 @@ class SocketComm:
     """Cross-host allgather for the find-bin seam: hub-and-spoke TCP
     with length-prefixed pickled payloads.
 
-    Rank 0 binds its machine-list port and accepts world-1 spokes; each
+    Rank 0 binds machine-list port + 1 (port_offset; the list port is
+    the JAX coordinator's) and accepts world-1 spokes; each
     allgather round every spoke sends its payload, the hub replies with
     the full rank-ordered list.  Setup-phase traffic only (a few KB of
     serialized BinMapper state) — hot-path collectives are XLA's job.

@@ -1,0 +1,47 @@
+/* SWIG interface for the lightgbm_tpu C ABI (Java target).
+ *
+ * The counterpart of the reference's swig/lightgbmlib.i: wraps the
+ * LGBM_* export surface of liblightgbm_tpu.so so JVM consumers (e.g.
+ * Spark integrations) drive training/prediction through JNI.  The
+ * helper typemaps below give Java callers typed carriers for the
+ * out-parameters (handles, counts, score buffers) — the same pattern
+ * the reference provides via carrays/cpointer helpers.
+ *
+ * Generate + build (needs a JDK for jni.h):
+ *   swig -java -package com.lightgbm.tpu -outdir java/com/lightgbm/tpu \
+ *        -o lightgbm_tpu_wrap.c lightgbm_tpu.i
+ *   cc -shared -fPIC -I$JAVA_HOME/include -I$JAVA_HOME/include/linux \
+ *        lightgbm_tpu_wrap.c -L../native -llightgbm_tpu \
+ *        -o liblightgbm_tpu_swig.so
+ *
+ * The underlying ABI contract is validated without a JVM by
+ * tests/test_capi_so.py (ctypes against the same .so); a CI with a JDK
+ * runs tests/test_swig_java.py's generation step plus this compile.
+ */
+%module lightgbmtpulib
+
+%{
+#include "../native/lightgbm_tpu_c_api.h"
+%}
+
+%include "stdint.i"
+%include "carrays.i"
+%include "cpointer.i"
+
+/* typed out-parameter carriers (Java: new_voidpp() -> handle cell,
+ * voidpp_value() to read it back; arrays for score/data buffers) */
+%pointer_functions(void *, voidpp)
+%pointer_functions(int, intp)
+%pointer_functions(int64_t, int64p)
+%pointer_functions(double, doublep)
+%array_functions(double, doubleArray)
+%array_functions(float, floatArray)
+%array_functions(int, intArray)
+%array_functions(int64_t, int64Array)
+
+/* string-array out-params (eval/feature names): fixed-size char buffers
+ * the caller allocates; mirrors the reference's string_array helpers */
+%include "cmalloc.i"
+%allocators(void, voidmem)
+
+%include "../native/lightgbm_tpu_c_api.h"

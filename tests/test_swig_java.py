@@ -1,0 +1,54 @@
+"""SWIG/Java binding generation (swig/lightgbm_tpu.i).
+
+No JDK ships in this image, so the compile step is documented rather
+than run (swig/README.md); what IS validated here: the interface file
+generates cleanly, every LGBM_* export of the .so surface comes out as
+a wrapped native method, and the out-parameter helper carriers exist —
+the reference validates its swig/lightgbmlib.i the same way (generation
+in CI, JNI compile on consumer machines, swig/ + CMakeLists.txt:176-205).
+"""
+import shutil
+import subprocess
+
+import pytest
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+@pytest.fixture(scope="module")
+def generated(tmp_path_factory):
+    if shutil.which("swig") is None:
+        pytest.skip("swig not available")
+    out = tmp_path_factory.mktemp("swigjava")
+    jdir = out / "java"
+    jdir.mkdir()
+    res = subprocess.run(
+        ["swig", "-java", "-package", "com.lightgbm.tpu",
+         "-outdir", str(jdir), "-o", str(out / "lightgbm_tpu_wrap.c"),
+         "lightgbm_tpu.i"],
+        cwd=REPO + "/swig", capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stderr
+    return out
+
+
+def test_all_symbols_wrapped(generated):
+    from lightgbm_tpu.capi_abi import SIGS
+    java = (generated / "java" / "lightgbmtpulib.java").read_text()
+    missing = [name for name in SIGS if name not in java]
+    assert not missing, "unwrapped ABI symbols: %s" % missing
+    assert "LGBM_GetLastError" in java
+
+
+def test_out_param_carriers_exist(generated):
+    java = (generated / "java" / "lightgbmtpulib.java").read_text()
+    for helper in ("new_voidpp", "voidpp_value", "new_intp", "intp_value",
+                   "new_doubleArray", "new_int64p"):
+        assert helper in java, helper
+
+
+def test_wrapper_c_references_real_so_surface(generated):
+    wrap = (generated / "lightgbm_tpu_wrap.c").read_text()
+    # the JNI wrapper must call the ABI functions directly (the .so the
+    # ctypes tests already exercise), not re-declare stubs
+    assert "LGBM_BoosterUpdateOneIter(" in wrap
+    assert "LGBM_DatasetCreateFromMat(" in wrap

@@ -72,19 +72,6 @@ class Application:
         pre_partition = (not cfg.is_single_machine()
                          and cfg.tree_learner in ("data", "voting")
                          and cfg.pre_partition)
-        if cfg.two_round and pre_partition:
-            log.warning("two_round streaming does not implement the "
-                        "distributed row pre-partition yet; falling back "
-                        "to in-memory loading for this rank")
-        elif cfg.two_round:
-            # memory-bounded streaming ingest: the binned dataset comes
-            # back fully constructed (two passes over the file, no full
-            # float matrix — dataset_loader.cpp:161-219)
-            binned = loader_mod.load_two_round(
-                cfg, cfg.data, initscore_filename=cfg.initscore_filename)
-            ds = basic.Dataset(None, params=dict(self.raw_params))
-            ds._binned = binned
-            return ds
         rank = cfg.machine_rank
         if pre_partition and rank < 0:
             # -1 means "unresolved": initialize_from_config resolves it
@@ -99,6 +86,19 @@ class Application:
                     "pre-partition loading needs this process's rank: "
                     "set machines/machine_list_filename, machine_rank, "
                     "or %s" % RANK_ENV)
+        if cfg.two_round:
+            # memory-bounded streaming ingest: the binned dataset comes
+            # back fully constructed (two passes over the file, no full
+            # float matrix — dataset_loader.cpp:161-219); with
+            # pre_partition, pass 2 keeps only this rank's rows
+            binned = loader_mod.load_two_round(
+                cfg, cfg.data, initscore_filename=cfg.initscore_filename,
+                rank=max(rank, 0),
+                num_machines=cfg.num_machines,
+                pre_partition=pre_partition)
+            ds = basic.Dataset(None, params=dict(self.raw_params))
+            ds._binned = binned
+            return ds
         d = loader_mod.load_data_file(cfg, cfg.data,
                                       rank=max(rank, 0),
                                       num_machines=cfg.num_machines,

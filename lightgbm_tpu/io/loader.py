@@ -74,7 +74,7 @@ def load_init_score_file(data_filename: str,
         with v_open(path, "r") as fh:
             scores = np.loadtxt(fh, dtype=np.float64, delimiter="\t",
                                 ndmin=2)
-    except (OSError, FileNotFoundError):
+    except FileNotFoundError:
         if initscore_filename:
             log.fatal("Could not open initscore file %s" % path)
         return None
@@ -149,18 +149,20 @@ def _group_ids_to_counts(ids: np.ndarray) -> np.ndarray:
 def _load_side_files(filename: str, group, weight):
     """<data>.query / <data>.weight side channels (metadata.cpp
     LoadQueryBoundaries/LoadWeights); column data wins over side files."""
+    # a MISSING side file is the normal case (skip); an existing but
+    # unreadable one must fail loudly, not silently train unweighted
     if group is None:
         try:
             with v_open(filename + ".query", "r") as fh:
                 group = np.loadtxt(fh, dtype=np.int64,
                                    ndmin=1).astype(np.int32)
-        except (OSError, FileNotFoundError):
+        except FileNotFoundError:
             pass
     if weight is None:
         try:
             with v_open(filename + ".weight", "r") as fh:
                 weight = np.loadtxt(fh, dtype=np.float64, ndmin=1)
-        except (OSError, FileNotFoundError):
+        except FileNotFoundError:
             pass
     return group, weight
 
@@ -233,7 +235,9 @@ def _iter_delimited_chunks(filename: str, sep: str, header: bool,
 
 def load_two_round(config, filename: str,
                    initscore_filename: str = "",
-                   chunk_rows: int = 1 << 16):
+                   chunk_rows: int = 1 << 16,
+                   rank: int = 0, num_machines: int = 1,
+                   pre_partition: bool = False):
     """Memory-bounded two-pass ingest (`two_round`,
     dataset_loader.cpp:161-219 LoadFromFile two-round branch).
 
@@ -247,6 +251,12 @@ def load_two_round(config, filename: str,
 
     Returns a fully constructed BinnedDataset (metadata filled).
     CSV/TSV only; LibSVM falls back to the one-round loader.
+
+    With pre_partition, pass 2 keeps only this rank's row assignment
+    (query-granular when group information exists — the distributed
+    pre-partition of dataset_loader.cpp:694-740) while find-bin still
+    runs on the full-file sample, so every rank derives identical
+    mappers.
     """
     from .dataset import BinnedDataset
     from .metadata import Metadata
@@ -257,7 +267,9 @@ def load_two_round(config, filename: str,
     if fmt == "libsvm":
         log.warning("two_round streaming supports CSV/TSV only; LibSVM "
                     "file falls back to in-memory loading")
-        d = load_data_file(config, filename,
+        d = load_data_file(config, filename, rank=rank,
+                           num_machines=num_machines,
+                           pre_partition=pre_partition,
                            initscore_filename=initscore_filename)
         meta = Metadata(len(d.X))
         meta.set_label(d.label)
@@ -315,37 +327,62 @@ def load_two_round(config, filename: str,
         sample_rows, config, categorical_features=lay.cat,
         feature_names=lay.feature_names, bin_rows=False)
 
-    # ---- pass 2: bin chunks straight into the packed matrix ------------
-    probe = mapper_ds.bin_block(sample_rows[:1])
-    bins = np.empty((n, probe.shape[1]), probe.dtype)
-    row = 0
-    for chunk, _names in _iter_delimited_chunks(filename, sep, config.header,
-                                                chunk_rows):
-        blk = mapper_ds.bin_block(chunk[:, lay.keep])
-        bins[row:row + len(blk)] = blk
-        row += len(blk)
-
-    if row != n:
-        log.fatal("two_round loader: pass 2 read %d rows but pass 1 "
-                  "counted %d (file changed between passes?)" % (row, n))
-
-    ds = mapper_ds
-    ds.bins = bins
-    ds.num_data = n
-    ds._device_cache.clear()
-    meta = Metadata(n)
-    meta.set_label(np.concatenate(labels))
+    # ---- row assignment for distributed loading (before pass 2) --------
+    label_full = np.concatenate(labels)
     group = (_group_ids_to_counts(np.concatenate(group_ids))
              if group_ids else None)
     weight = np.concatenate(weights) if weights else None
     group, weight = _load_side_files(filename, group, weight)
+    init_score = load_init_score_file(filename, initscore_filename)
+    keep_mask = None
+    keep_idx = np.arange(n)
+    if pre_partition and num_machines > 1:
+        from ..parallel.dist_data import pre_partition_rows
+        qb = (None if group is None
+              else np.concatenate([[0], np.cumsum(group)]))
+        keep_idx, q_rank = pre_partition_rows(
+            n, rank, num_machines, qb, seed=config.data_random_seed)
+        keep_mask = np.zeros(n, bool)
+        keep_mask[keep_idx] = True
+        if group is not None:
+            group = np.asarray(group)[q_rank == rank]
+    n_keep = len(keep_idx)
+
+    # ---- pass 2: bin chunks straight into the packed matrix ------------
+    probe = mapper_ds.bin_block(sample_rows[:1])
+    bins = np.empty((n_keep, probe.shape[1]), probe.dtype)
+    row = 0
+    dst = 0
+    for chunk, _names in _iter_delimited_chunks(filename, sep, config.header,
+                                                chunk_rows):
+        feats = chunk[:, lay.keep]
+        if keep_mask is not None:
+            feats = feats[keep_mask[row:row + len(chunk)]]
+        if len(feats):
+            blk = mapper_ds.bin_block(feats)
+            bins[dst:dst + len(blk)] = blk
+            dst += len(blk)
+        row += len(chunk)
+
+    if row != n or dst != n_keep:
+        log.fatal("two_round loader: pass 2 read %d rows (pass 1 counted "
+                  "%d) and kept %d (assignment expected %d) — file "
+                  "changed between passes, or a partition accounting bug"
+                  % (row, n, dst, n_keep))
+
+    ds = mapper_ds
+    ds.bins = bins
+    ds.num_data = n_keep
+    ds._device_cache.clear()
+    meta = Metadata(n_keep)
+    meta.set_label(label_full[keep_idx])
     if group is not None:
         meta.set_query(group)
     if weight is not None:
-        meta.set_weights(weight)
-    init_score = load_init_score_file(filename, initscore_filename)
+        meta.set_weights(np.asarray(weight)[keep_idx])
     if init_score is not None:
-        meta.set_init_score(init_score)
-    meta.init(n)
+        from ..parallel.dist_data import slice_class_major
+        meta.set_init_score(slice_class_major(init_score, n, keep_idx))
+    meta.init(n_keep)
     ds.metadata = meta
     return ds

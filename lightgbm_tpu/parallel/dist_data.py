@@ -54,6 +54,20 @@ class LocalComm:
         return allgather
 
 
+def slice_class_major(init_score, n: int, rows: np.ndarray) -> np.ndarray:
+    """Slice a class-major [k*n] init-score vector by row indices —
+    the single home of the multiclass layout slice (shared by
+    construct_rank_shard and the two_round pre-partition loader).
+    Fails loudly on a length that is not a multiple of n (stale side
+    file)."""
+    s = np.asarray(init_score, np.float64).reshape(-1)
+    if n <= 0 or s.size % n != 0:
+        log.fatal("init_score length %d is not a multiple of num_data %d"
+                  % (s.size, n))
+    k = max(1, s.size // n)
+    return s.reshape(k, n)[:, rows].reshape(-1)
+
+
 def pre_partition_rows(n: int, rank: int, num_machines: int,
                        query_boundaries: Optional[np.ndarray] = None,
                        seed: int = 0):
@@ -105,11 +119,7 @@ def construct_rank_shard(X: np.ndarray, config, rank: int, world: int,
         if weight is not None:
             meta.set_weights(np.asarray(weight)[rows])
         if init_score is not None:
-            # init_score is class-major [n*k] for multiclass: slice each
-            # class block by the shard rows (Metadata.subset layout)
-            s = np.asarray(init_score, np.float64)
-            k = max(1, len(s) // n)
-            meta.set_init_score(s.reshape(k, n)[:, rows].reshape(-1))
+            meta.set_init_score(slice_class_major(init_score, n, rows))
 
     # find-bin runs BEFORE the row partition, on the full data, so every
     # rank derives identical mappers (the reference's !pre_partition

@@ -11,6 +11,22 @@ overriding here, before the first jax op, still works.
 """
 import os
 
+# The image caps the stack at 8 MB; a full-suite run accumulates enough
+# jit state that a late XLA-CPU compile recurses past it and SEGFAULTS
+# (observed twice at ~78%, inside an estimator-check fit).  The hard
+# limit is unlimited, so raise the soft limit for the test process and
+# every thread it spawns after this point.
+import resource
+
+_soft, _hard = resource.getrlimit(resource.RLIMIT_STACK)
+if _soft != resource.RLIM_INFINITY and (_soft < 512 << 20):
+    resource.setrlimit(resource.RLIMIT_STACK,
+                       (512 << 20 if _hard == resource.RLIM_INFINITY
+                        else min(512 << 20, _hard), _hard))
+import threading
+
+threading.stack_size(64 << 20)   # XLA worker threads get big stacks too
+
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

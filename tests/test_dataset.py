@@ -383,3 +383,56 @@ class TestVirtualFileIO:
         with v_open(p, "w") as f:
             f.write("ok")
         assert p.read_text() == "ok"
+
+
+class TestTwoRoundPrePartition:
+    """two_round streaming + distributed row pre-partition
+    (dataset_loader.cpp:694-740 on the streaming path): every rank bins
+    against identical mappers, shards are disjoint, and their union is
+    the full dataset."""
+
+    def test_shards_partition_the_file(self, rng, tmp_path):
+        from lightgbm_tpu.io.loader import load_two_round
+        from lightgbm_tpu.parallel.dist_data import pre_partition_rows
+
+        n = 700
+        X = rng.randn(n, 4)
+        y = (X[:, 0] > 0).astype(np.float64)
+        f = tmp_path / "d.csv"
+        np.savetxt(f, np.column_stack([y, X]), delimiter=",", fmt="%.7g")
+        cfg = Config(max_bin=31, two_round=True, num_machines=4,
+                     data_random_seed=5)
+        full = load_two_round(cfg, str(f))
+        shards = [load_two_round(cfg, str(f), rank=r, num_machines=4,
+                                 pre_partition=True) for r in range(4)]
+        assert sum(s.num_data for s in shards) == n
+        # shard rows equal the full load's rows at the assignment's
+        # indices (same seed -> same draw as the in-memory path)
+        for r, s in enumerate(shards):
+            keep, _ = pre_partition_rows(n, r, 4, None, seed=5)
+            np.testing.assert_array_equal(s.bins, full.bins[keep])
+            np.testing.assert_allclose(s.metadata.label,
+                                       np.asarray(full.metadata.label)[keep])
+            # identical mappers on every rank
+            assert ([m.to_state() for m in s.bin_mappers]
+                    == [m.to_state() for m in full.bin_mappers])
+
+    def test_query_granular_shards(self, rng, tmp_path):
+        from lightgbm_tpu.io.loader import load_two_round
+
+        n, q = 600, 60
+        X = rng.randn(n, 3)
+        y = rng.randint(0, 3, n).astype(np.float64)
+        f = tmp_path / "r.csv"
+        np.savetxt(f, np.column_stack([y, X]), delimiter=",", fmt="%.7g")
+        np.savetxt(str(f) + ".query", np.full(q, n // q), fmt="%d")
+        cfg = Config(max_bin=31, two_round=True, num_machines=3,
+                     data_random_seed=9)
+        shards = [load_two_round(cfg, str(f), rank=r, num_machines=3,
+                                 pre_partition=True) for r in range(3)]
+        assert sum(s.num_data for s in shards) == n
+        for s in shards:
+            qb = s.metadata.query_boundaries
+            assert qb is not None and qb[-1] == s.num_data
+            # whole queries: every group is the full n//q rows
+            np.testing.assert_array_equal(np.diff(qb), n // q)

@@ -13,6 +13,11 @@ deployment that needs HDFS/GCS/S3 registers one function:
 
     from lightgbm_tpu.io.file_io import register_backend
     register_backend("gs://", lambda path, mode: fsspec.open(path, mode).open())
+
+Backend contract: openers should raise FileNotFoundError (or an OSError
+with errno ENOENT) for missing paths — optional side-file probing
+(<data>.query / .weight / .init) treats exactly those as "absent" and
+anything else (permissions, network faults) as a loud failure.
 """
 from __future__ import annotations
 

@@ -74,7 +74,9 @@ def load_init_score_file(data_filename: str,
         with v_open(path, "r") as fh:
             scores = np.loadtxt(fh, dtype=np.float64, delimiter="\t",
                                 ndmin=2)
-    except FileNotFoundError:
+    except OSError as e:
+        if not _is_missing(e):
+            raise
         if initscore_filename:
             log.fatal("Could not open initscore file %s" % path)
         return None
@@ -146,6 +148,16 @@ def _group_ids_to_counts(ids: np.ndarray) -> np.ndarray:
     return np.diff(bounds).astype(np.int32)
 
 
+def _is_missing(exc: OSError) -> bool:
+    """Missing-file signal from builtins.open or a registered backend:
+    FileNotFoundError, or a bare OSError carrying ENOENT (the documented
+    backend contract, io/file_io.py) — anything else (EACCES, network
+    faults) must fail loudly, not silently skip a side file."""
+    import errno
+    return (isinstance(exc, FileNotFoundError)
+            or getattr(exc, "errno", None) == errno.ENOENT)
+
+
 def _load_side_files(filename: str, group, weight):
     """<data>.query / <data>.weight side channels (metadata.cpp
     LoadQueryBoundaries/LoadWeights); column data wins over side files."""
@@ -156,14 +168,16 @@ def _load_side_files(filename: str, group, weight):
             with v_open(filename + ".query", "r") as fh:
                 group = np.loadtxt(fh, dtype=np.int64,
                                    ndmin=1).astype(np.int32)
-        except FileNotFoundError:
-            pass
+        except OSError as e:
+            if not _is_missing(e):
+                raise
     if weight is None:
         try:
             with v_open(filename + ".weight", "r") as fh:
                 weight = np.loadtxt(fh, dtype=np.float64, ndmin=1)
-        except FileNotFoundError:
-            pass
+        except OSError as e:
+            if not _is_missing(e):
+                raise
     return group, weight
 
 
@@ -206,14 +220,14 @@ def load_data_file(config, filename: str,
             group = group[q_rank == rank]
         else:
             keep_rows = rng.randint(0, num_machines, len(label)) == rank
+        n_all = len(keep_rows)
         X, label = X[keep_rows], label[keep_rows]
         if weight is not None:
             weight = weight[keep_rows]
         if init_score is not None:
-            k = len(init_score) // max(1, len(keep_rows))
-            init_score = np.concatenate(
-                [init_score[c * len(keep_rows):][:len(keep_rows)][keep_rows]
-                 for c in range(k)])
+            from ..parallel.dist_data import slice_class_major
+            init_score = slice_class_major(init_score, n_all,
+                                           np.flatnonzero(keep_rows))
 
     return LoadedData(X, label, weight, group, feature_names, cat, init_score)
 
@@ -334,6 +348,16 @@ def load_two_round(config, filename: str,
     weight = np.concatenate(weights) if weights else None
     group, weight = _load_side_files(filename, group, weight)
     init_score = load_init_score_file(filename, initscore_filename)
+    # stale side files must fail as loudly here as on the non-partition
+    # path (Metadata's validators never see the pre-sliced vectors):
+    # short .query counts would silently drop the tail rows from EVERY
+    # rank, an oversized .weight would slice to a plausible length
+    if group is not None and int(np.sum(group)) != n:
+        log.fatal("Sum of query counts (%d) != num_data (%d)"
+                  % (int(np.sum(group)), n))
+    if weight is not None and len(weight) != n:
+        log.fatal("Length of weights (%d) != num_data (%d)"
+                  % (len(weight), n))
     keep_mask = None
     keep_idx = np.arange(n)
     if pre_partition and num_machines > 1:

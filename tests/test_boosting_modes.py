@@ -20,6 +20,7 @@ def data():
     return X, y, Xt, yt
 
 
+@pytest.mark.slow
 def test_dart(data):
     X, y, Xt, yt = data
     train = lgb.Dataset(X, y)

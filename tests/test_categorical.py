@@ -105,6 +105,7 @@ def oracle_categorical(hist, sum_g, sum_h, n_data, num_bin, missing_type,
     dict(max_cat_to_onehot=1, cat_smooth=10.0,
          min_data_per_group=50, cat_l2=3.0),         # sorted, heavier reg
 ])
+@pytest.mark.slow
 def test_cat_scan_vs_oracle(rng, mode_params):
     import jax.numpy as jnp
     F, B = 6, 16
@@ -191,6 +192,7 @@ def test_cat_unseen_category_goes_right(rng):
     assert np.isfinite(b.predict(Xq2)).all()
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mode", ["data", "feature", "voting"])
 def test_cat_parallel_matches_serial(rng, mode):
     X, y = _cat_data(rng)

@@ -436,3 +436,19 @@ class TestTwoRoundPrePartition:
             assert qb is not None and qb[-1] == s.num_data
             # whole queries: every group is the full n//q rows
             np.testing.assert_array_equal(np.diff(qb), n // q)
+
+    def test_stale_side_files_fail_loudly(self, rng, tmp_path):
+        # a .query summing short of n (or an oversized .weight) must
+        # fatal under pre_partition exactly like the serial path — the
+        # sliced vectors would otherwise pass Metadata's validators
+        from lightgbm_tpu.io.loader import load_two_round
+        n = 300
+        X = rng.randn(n, 3)
+        y = (X[:, 0] > 0).astype(np.float64)
+        f = tmp_path / "s.csv"
+        np.savetxt(f, np.column_stack([y, X]), delimiter=",", fmt="%.6g")
+        np.savetxt(str(f) + ".query", np.full(5, 10), fmt="%d")  # sums 50
+        cfg = Config(max_bin=31, two_round=True, num_machines=2)
+        with pytest.raises(Exception, match="query counts"):
+            load_two_round(cfg, str(f), rank=0, num_machines=2,
+                           pre_partition=True)

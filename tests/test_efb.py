@@ -218,6 +218,7 @@ class TestEndToEnd:
 
 
 class TestBundledParallel:
+    @pytest.mark.slow
     def test_data_parallel_matches_serial(self, rng):
         import jax
         if jax.device_count() < 2:

@@ -117,6 +117,7 @@ def test_custom_objective(binary_data):
     assert evals["valid_0"]["error"][-1] < 0.3
 
 
+@pytest.mark.slow
 def test_cv(regression_data):
     X, y, _, _ = regression_data
     train = lgb.Dataset(X, y)

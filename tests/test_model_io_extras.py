@@ -212,6 +212,7 @@ def test_forced_splits(tmp_path, rng):
     assert roc_auc_score(y, bst.predict(X)) > 0.9
 
 
+@pytest.mark.slow
 def test_forced_splits_partition_engine(tmp_path, rng):
     """Forced splits run on the partition engine too (same injection
     scheme as the label engine) and both grow the same structure."""

@@ -143,6 +143,7 @@ class TestForcedSplitAbandonment:
             forced_splits=plan, max_leaves=8, max_bin=B,
             hist_impl="scatter")
 
+    @pytest.mark.slow
     def test_invalid_root_abandons_descendants(self, rng):
         # root entry forces constant feature 1 (empty child -> invalid);
         # its child entries must NOT be applied to the unsplit root
@@ -155,6 +156,7 @@ class TestForcedSplitAbandonment:
         np.testing.assert_array_equal(np.asarray(t_forced.threshold_bin),
                                       np.asarray(t_plain.threshold_bin))
 
+    @pytest.mark.slow
     def test_invalid_left_child_keeps_right_sibling(self, rng):
         # valid root; invalid left-child entry; valid right-child entry:
         # the right sibling must still land on the root's right child

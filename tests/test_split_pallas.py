@@ -82,6 +82,7 @@ class TestSplitScanParity:
         params = SplitParams(min_data_in_leaf=20)
         _compare(hist2, sg, sh, nd, num_bins, default_bins, mt, params)
 
+    @pytest.mark.slow
     def test_regularization_and_monotone(self):
         rng = np.random.default_rng(5)
         F, B = 7, 32

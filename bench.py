@@ -26,6 +26,10 @@ import numpy as np
 
 BASELINE_ROWS_ITER_PER_S = 10_500_000 * 500 / 238.505  # reference CPU Higgs
 AUC_FLOOR = 0.88          # measured ~0.945 on the synthetic task after 42 it
+RETRY_BUDGET_S = 500      # retry window: covers the worst observed
+#                           degraded run (346-473 s) so variance-hit runs
+#                           DO get their retry, while bounding the bench's
+#                           total wall clock for the harness
 NDCG10_FLOOR = 0.85       # measured ~0.92 on the synthetic ranking task
 
 
@@ -129,10 +133,13 @@ def bench_higgs(lgb, sync, on_tpu):
     # variance at this memory footprint (observed 346-473 s for
     # identical runs); a degraded first run earns ONE retry and the
     # better FULLY-MEASURED run is reported (best-of-N wall clock,
-    # never extrapolation)
+    # never extrapolation).  The retry is time-budgeted: a second run
+    # costs roughly the first again, so it only fires while the total
+    # stays within a harness-friendly window.
     booster, elapsed, blocks = one_measured_run()
     runs_s = [round(elapsed, 1)]
-    if on_tpu and (n * timed_iters / elapsed) < BASELINE_ROWS_ITER_PER_S:
+    if (on_tpu and elapsed < RETRY_BUDGET_S
+            and (n * timed_iters / elapsed) < BASELINE_ROWS_ITER_PER_S):
         b2, e2, blk2 = one_measured_run()
         runs_s.append(round(e2, 1))
         if e2 < elapsed:

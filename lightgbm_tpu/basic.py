@@ -253,24 +253,29 @@ class Dataset:
             from .io.dataset import concat_fill
             n0 = np.asarray(self.data).shape[0]
             n1 = np.asarray(other.data).shape[0]
+            # validate EVERYTHING before the first mutation so a raised
+            # error cannot leave self half-merged
+            if (self.group is None) != (other.group is None):
+                raise ValueError("Cannot add data: only one side has "
+                                 "query (group) information")
+            if self.init_score is not None or other.init_score is not None:
+                if ((self.init_score is not None
+                     and (np.asarray(self.init_score).ndim > 1
+                          or len(np.asarray(self.init_score)) != n0))
+                        or (other.init_score is not None
+                            and (np.asarray(other.init_score).ndim > 1
+                                 or len(np.asarray(other.init_score)) != n1))):
+                    raise ValueError("add_data_from does not support "
+                                     "multiclass init_score on raw "
+                                     "datasets; construct first")
             self.data = np.vstack([np.asarray(self.data),
                                    np.asarray(other.data)])
             self.label = concat_fill(self.label, other.label, n0, n1, 0.0)
             self.weight = concat_fill(self.weight, other.weight, n0, n1, 1.0)
-            if (self.group is None) != (other.group is None):
-                raise ValueError("Cannot add data: only one side has "
-                                 "query (group) information")
             if self.group is not None:
                 self.group = np.concatenate([np.asarray(self.group),
                                              np.asarray(other.group)])
             if self.init_score is not None or other.init_score is not None:
-                if ((self.init_score is not None
-                     and len(np.asarray(self.init_score)) != n0)
-                        or (other.init_score is not None
-                            and len(np.asarray(other.init_score)) != n1)):
-                    raise ValueError("add_data_from does not support "
-                                     "multiclass init_score on raw "
-                                     "datasets; construct first")
                 self.init_score = concat_fill(self.init_score,
                                               other.init_score, n0, n1, 0.0)
         return self

@@ -40,8 +40,9 @@ or from Python:
 """
 from __future__ import annotations
 
+import errno
+import json
 import os
-import pickle
 import socket
 import struct
 from typing import List, Optional, Tuple
@@ -146,16 +147,29 @@ def initialize_from_config(config, rank: Optional[int] = None
     if getattr(config, "num_machines", 1) <= 1:
         return 0, 1
     machines = parse_machines(config)
-    if len(machines) < 2:
-        log.warning("num_machines=%d but machine list has %d entries; "
-                    "staying single-machine",
-                    config.num_machines, len(machines))
+    if not machines:
+        log.warning("num_machines=%d but no machine list configured; "
+                    "staying single-machine", config.num_machines)
         return 0, 1
-    world = min(len(machines), config.num_machines)
+    if len(machines) < config.num_machines:
+        # a silently clamped world means some expected machines can
+        # never join — fail loudly like the reference's Network::Init
+        # does on a short machine file; a LONGER shared list is fine
+        # (the reference uses the first num_machines entries)
+        log.fatal("machine list has %d entries but num_machines=%d; "
+                  "the list is short" % (len(machines), config.num_machines))
+    world = config.num_machines
+    machines = machines[:world]
     cfg_rank = getattr(config, "machine_rank", -1)
-    r = resolve_rank(machines[:world],
+    r = resolve_rank(machines,
                      rank if rank is not None
                      else (cfg_rank if cfg_rank >= 0 else None))
+    if not 0 <= r < world:
+        # catch it here with a named error rather than letting
+        # jax.distributed.initialize fail with an opaque
+        # coordination-service timeout
+        log.fatal("resolved rank %d is outside [0, %d); check %s / "
+                  "machine_rank against the machine list" % (r, world, RANK_ENV))
     import jax
     jax.distributed.initialize(coordinator_address=machines[0],
                                num_processes=world, process_id=r)
@@ -166,7 +180,14 @@ def initialize_from_config(config, rank: Optional[int] = None
 
 class SocketComm:
     """Cross-host allgather for the find-bin seam: hub-and-spoke TCP
-    with length-prefixed pickled payloads.
+    with length-prefixed JSON payloads.
+
+    JSON, deliberately: the payloads are plain bin-mapper state dicts
+    (numbers, strings, lists), and a non-executable wire format means a
+    hostile peer that reaches the port can at worst corrupt mapper
+    state — never run code, matching the reference's numeric-buffer-only
+    socket mesh (linkers_socket.cpp).  Dict keys round-trip as strings;
+    the find-bin merge re-ints them (io/dataset.py).
 
     Rank 0 binds machine-list port + 1 (port_offset; the list port is
     the JAX coordinator's) and accepts world-1 spokes; each
@@ -192,7 +213,25 @@ class SocketComm:
         if rank == 0:
             srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            srv.bind((host if host in _local_addresses() else "", int(port)))
+            # bind the interface the machine list names for rank 0.  If
+            # that address is not locally bindable (NAT / port-forward
+            # deployments list the externally-reachable name), fall back
+            # to all interfaces — but LOUDLY, since that widens exposure
+            try:
+                srv.bind((host, int(port)))
+            except OSError as e:
+                # only a genuinely non-local address falls back (NAT /
+                # port-forward lists the external name); EADDRINUSE etc.
+                # must surface as the port conflict it is
+                if e.errno != errno.EADDRNOTAVAIL:
+                    srv.close()
+                    raise
+                log.warning("SocketComm hub cannot bind %s:%d (%s) — "
+                            "assuming NAT/port-forwarding and binding "
+                            "all interfaces; firewall port %d to the "
+                            "training cluster", host, int(port), e,
+                            int(port))
+                srv.bind(("", int(port)))
             srv.listen(world - 1)
             srv.settimeout(timeout_s)
             by_rank = {}
@@ -237,7 +276,7 @@ class SocketComm:
             out[0] = payload
             for i, conn in enumerate(self._peers, start=1):
                 out[i] = _recv_msg(conn)
-            blob = pickle.dumps(out, protocol=pickle.HIGHEST_PROTOCOL)
+            blob = _encode(out)
             for conn in self._peers:
                 _send_blob(conn, blob)
             return out  # type: ignore[return-value]
@@ -253,12 +292,28 @@ class SocketComm:
         self._peers = []
 
 
+def _json_default(o):
+    # mapper state can carry numpy scalars/arrays (min/max, bounds)
+    if hasattr(o, "item") and not hasattr(o, "__len__"):
+        return o.item()
+    if hasattr(o, "tolist"):
+        return o.tolist()
+    raise TypeError("SocketComm payloads must be JSON-serializable, "
+                    "got %r" % type(o))
+
+
+def _encode(obj) -> bytes:
+    # allow_nan stays on: bin-mapper min/max can legitimately be +-inf,
+    # and Python's json round-trips Infinity/NaN literals
+    return json.dumps(obj, default=_json_default).encode("utf-8")
+
+
 def _send_blob(sock: socket.socket, blob: bytes) -> None:
     sock.sendall(struct.pack("!q", len(blob)) + blob)
 
 
 def _send_msg(sock: socket.socket, obj) -> None:
-    _send_blob(sock, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+    _send_blob(sock, _encode(obj))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -273,4 +328,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def _recv_msg(sock: socket.socket):
     (n,) = struct.unpack("!q", _recv_exact(sock, 8))
-    return pickle.loads(_recv_exact(sock, n))
+    if n < 0 or n > _MAX_MSG:
+        raise ConnectionError("refusing %d-byte frame (cap %d): "
+                              "corrupt or hostile peer" % (n, _MAX_MSG))
+    return json.loads(_recv_exact(sock, n).decode("utf-8"))
+
+
+# mapper payloads are a few KB/feature; 256 MB caps even absurd widths
+# while bounding what a garbage length prefix can make us allocate
+_MAX_MSG = 256 << 20

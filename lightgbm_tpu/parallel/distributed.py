@@ -220,10 +220,12 @@ class SocketComm:
             try:
                 srv.bind((host, int(port)))
             except OSError as e:
-                # only a genuinely non-local address falls back (NAT /
-                # port-forward lists the external name); EADDRINUSE etc.
-                # must surface as the port conflict it is
-                if e.errno != errno.EADDRNOTAVAIL:
+                # only a genuinely non-local / non-resolvable address
+                # falls back (NAT / port-forward lists the external
+                # name, which may not even resolve from inside);
+                # EADDRINUSE etc. must surface as the port conflict it is
+                if not (e.errno == errno.EADDRNOTAVAIL
+                        or isinstance(e, socket.gaierror)):
                     srv.close()
                     raise
                 log.warning("SocketComm hub cannot bind %s:%d (%s) — "
@@ -329,11 +331,16 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 def _recv_msg(sock: socket.socket):
     (n,) = struct.unpack("!q", _recv_exact(sock, 8))
     if n < 0 or n > _MAX_MSG:
-        raise ConnectionError("refusing %d-byte frame (cap %d): "
-                              "corrupt or hostile peer" % (n, _MAX_MSG))
+        raise ConnectionError(
+            "refusing %d-byte frame (cap %d): either a corrupt/hostile "
+            "length prefix, or a dataset so wide its mapper exchange "
+            "exceeds the cap — raise distributed._MAX_MSG if the latter"
+            % (n, _MAX_MSG))
     return json.loads(_recv_exact(sock, n).decode("utf-8"))
 
 
-# mapper payloads are a few KB/feature; 256 MB caps even absurd widths
-# while bounding what a garbage length prefix can make us allocate
-_MAX_MSG = 256 << 20
+# mapper payloads are a few KB/feature and the hub broadcast carries
+# every rank's shard, so size the cap for very wide datasets (~1M
+# features) while still bounding what a garbage length prefix can make
+# us allocate
+_MAX_MSG = 8 << 30

@@ -1,0 +1,169 @@
+"""Generate the reference-interop fixtures under tests/fixtures/interop/.
+
+Cross-implementation parity is the strongest correctness oracle available:
+a model trained by the reference C++ implementation must load here and
+predict identically, and a model trained here must load in the reference
+CLI and predict identically (gbdt_model_text.cpp:244,343 defines the
+format both sides speak).
+
+This script needs a built reference CLI (out-of-tree, CPU only):
+
+    mkdir -p /tmp/refbuild && cd /tmp/refbuild
+    cmake /root/reference -DCMAKE_BUILD_TYPE=Release && make lightgbm
+    mv /root/reference/lightgbm /tmp/refbuild/   # CMake drops it in-tree
+
+then:  python tools/gen_interop_fixtures.py [path/to/lightgbm-cli]
+
+It freezes four fixtures (committed to the repo so the parity tests run
+everywhere with zero skips, reference build or not):
+
+  ref50.txt           model trained by the reference CLI (50 iters)
+  ref50_pred.txt      the reference CLI's own predictions on binary.test
+  repo50.txt          model trained by lightgbm_tpu with the same config
+  repo50_ref_pred.txt the reference CLI's predictions using repo50.txt
+
+tests/test_engine.py asserts both directions against these.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = "/root/reference/examples/binary_classification"
+OUT = os.path.join(REPO, "tests", "fixtures", "interop")
+
+# deterministic, no sampling: bagging/feature_fraction RNG differs by
+# design between implementations, and the oracle is model-file interop,
+# not training-path equivalence
+PARAMS = dict(objective="binary", num_leaves=31, learning_rate=0.1,
+              max_bin=255, min_data_in_leaf=20, min_sum_hessian_in_leaf=5.0)
+NUM_ITERS = 50
+
+
+def run_cli(cli, workdir, lines):
+    conf = os.path.join(workdir, "run.conf")
+    with open(conf, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    subprocess.run([cli, "config=" + conf], cwd=workdir, check=True,
+                   stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def make_categorical_data(work):
+    """Synthetic train/test with real categorical columns — the bitset
+    split encoding (gbdt_model_text.cpp cat_threshold) has no reference
+    example, so freeze one here.  Label first column, TSV like the
+    reference examples."""
+    import numpy as np
+    rng = np.random.RandomState(7)
+    n = 3000
+    num = rng.randn(n, 3)
+    cat_a = rng.randint(0, 12, n)          # 12 categories
+    cat_b = rng.randint(0, 70, n)          # forces multi-word bitsets
+    logit = (num[:, 0] - 0.5 * num[:, 1]
+             + np.where(cat_a % 3 == 0, 1.2, -0.4)
+             + np.where((cat_b > 20) & (cat_b < 45), 0.9, 0.0))
+    y = (logit + 0.5 * rng.randn(n) > 0).astype(int)
+    M = np.column_stack([y, num, cat_a, cat_b])
+    fmt = ["%d"] + ["%.8f"] * 3 + ["%d", "%d"]
+    np.savetxt(os.path.join(work, "cat.train"), M[:2000], fmt=fmt, delimiter="\t")
+    np.savetxt(os.path.join(work, "cat.test"), M[2000:], fmt=fmt, delimiter="\t")
+
+
+# (name, train_file, test_file, extra params, num_class-aware predict)
+SUITES = [
+    ("ref50", "/root/reference/examples/binary_classification",
+     "binary.train", "binary.test", dict(objective="binary"), 1),
+    ("reg50", "/root/reference/examples/regression",
+     "regression.train", "regression.test", dict(objective="regression"), 1),
+    ("mc50", "/root/reference/examples/multiclass_classification",
+     "multiclass.train", "multiclass.test",
+     dict(objective="multiclass", num_class=5), 5),
+    ("cat50", None, "cat.train", "cat.test",
+     dict(objective="binary", categorical_feature="3,4"), 1),
+]
+
+
+def main():
+    cli = sys.argv[1] if len(sys.argv) > 1 else "/tmp/refbuild/lightgbm"
+    if not os.path.exists(cli):
+        sys.exit("reference CLI not found at %s — see module docstring" % cli)
+    os.makedirs(OUT, exist_ok=True)
+    work = os.path.join("/tmp", "interop_work")
+    shutil.rmtree(work, ignore_errors=True)
+    os.makedirs(work)
+
+    sys.path.insert(0, REPO)
+    import numpy as np
+    import lightgbm_tpu as lgb
+
+    make_categorical_data(work)
+    # the synthetic categorical set is itself a fixture (tests predict on it)
+    shutil.copy(os.path.join(work, "cat.train"), OUT)
+    shutil.copy(os.path.join(work, "cat.test"), OUT)
+    worst = 0.0
+    for name, src, train_f, test_f, extra, k in SUITES:
+        if src is not None:
+            # data WITHOUT the sibling .weight files (the CLI auto-loads them)
+            shutil.copy(os.path.join(src, train_f), work)
+            shutil.copy(os.path.join(src, test_f), work)
+        params = dict(PARAMS, **extra)
+        common = ["%s=%s" % (kk, vv) for kk, vv in params.items()]
+
+        # --- forward: reference trains, reference predicts -------------
+        run_cli(cli, work, ["task=train", "data=" + train_f,
+                            "num_trees=%d" % NUM_ITERS,
+                            "output_model=%s.txt" % name, "verbosity=0"]
+                + common)
+        run_cli(cli, work, ["task=predict", "data=" + test_f,
+                            "input_model=%s.txt" % name,
+                            "output_result=%s_pred.txt" % name, "verbosity=0"])
+        shutil.copy(os.path.join(work, "%s.txt" % name), OUT)
+        shutil.copy(os.path.join(work, "%s_pred.txt" % name), OUT)
+
+        # --- reverse: repo trains, reference predicts from our model ---
+        data = np.loadtxt(os.path.join(work, train_f))
+        py_params = {kk: vv for kk, vv in params.items()}
+        if "categorical_feature" in py_params:
+            py_params["categorical_feature"] = [
+                int(c) - 1 for c in py_params["categorical_feature"].split(",")]
+            # CLI column indices count the label column; Python API doesn't
+        ds = lgb.Dataset(data[:, 1:], data[:, 0],
+                         categorical_feature=py_params.pop(
+                             "categorical_feature", "auto"))
+        bst = lgb.train(dict(py_params, verbose=-1), ds,
+                        num_boost_round=NUM_ITERS)
+        repo_model = os.path.join(work, "repo_%s.txt" % name)
+        bst.save_model(repo_model)
+        run_cli(cli, work, ["task=predict", "data=" + test_f,
+                            "input_model=repo_%s.txt" % name,
+                            "output_result=repo_%s_ref_pred.txt" % name,
+                            "verbosity=0"])
+        shutil.copy(repo_model, OUT)
+        shutil.copy(os.path.join(work, "repo_%s_ref_pred.txt" % name), OUT)
+
+        # sanity: both directions agree before freezing anything
+        test = np.loadtxt(os.path.join(work, test_f))
+        Xt = test[:, 1:]
+        scale = max(1.0, float(np.max(np.abs(test[:, 0]))))  # rel for regression
+        ref_pred = np.loadtxt(os.path.join(work, "%s_pred.txt" % name))
+        ours_on_ref = lgb.Booster(
+            model_file=os.path.join(OUT, "%s.txt" % name)).predict(Xt)
+        fwd = np.max(np.abs(np.asarray(ours_on_ref).reshape(ref_pred.shape)
+                            - ref_pred)) / scale
+        ref_on_ours = np.loadtxt(
+            os.path.join(work, "repo_%s_ref_pred.txt" % name))
+        rev = np.max(np.abs(np.asarray(bst.predict(Xt)).reshape(
+            ref_on_ours.shape) - ref_on_ours)) / scale
+        print("%-6s forward max|diff| = %.3g   reverse max|diff| = %.3g"
+              % (name, fwd, rev))
+        worst = max(worst, fwd, rev)
+    if worst > 2e-6:
+        sys.exit("parity check FAILED (%.3g) — fixtures not trustworthy" % worst)
+    print("fixtures written to", OUT)
+
+
+if __name__ == "__main__":
+    main()

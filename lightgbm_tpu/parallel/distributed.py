@@ -319,13 +319,16 @@ def _send_msg(sock: socket.socket, obj) -> None:
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = b""
+    # chunked reads: allocation grows with data actually received, so a
+    # garbage/hostile length prefix cannot force an up-front multi-GB
+    # buffer; bytearray keeps the append O(n)
+    buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
         if not chunk:
             raise ConnectionError("peer closed during receive")
         buf += chunk
-    return buf
+    return bytes(buf)
 
 
 def _recv_msg(sock: socket.socket):

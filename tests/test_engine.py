@@ -157,12 +157,13 @@ INTEROP_ATOL = 5e-6
 
 # (suite, test data) — suites frozen by tools/gen_interop_fixtures.py:
 # binary example, regression example, 5-class multiclass example, and a
-# synthetic categorical set exercising multi-word bitset splits
+# synthetic categorical set exercising multi-word bitset splits.  The
+# test sets are committed copies so the parity oracle runs with zero
+# skips on machines without the reference checkout.
 _INTEROP_SUITES = [
-    ("ref50", "/root/reference/examples/binary_classification/binary.test"),
-    ("reg50", "/root/reference/examples/regression/regression.test"),
-    ("mc50",
-     "/root/reference/examples/multiclass_classification/multiclass.test"),
+    ("ref50", os.path.join(INTEROP, "binary.test")),
+    ("reg50", os.path.join(INTEROP, "regression.test")),
+    ("mc50", os.path.join(INTEROP, "multiclass.test")),
     ("cat50", os.path.join(INTEROP, "cat.test")),
 ]
 

@@ -312,3 +312,51 @@ def test_partition_engine_data_parallel(rng):
     np.testing.assert_allclose(np.asarray(ts.leaf_value),
                                np.asarray(tp.leaf_value),
                                rtol=1e-3, atol=1e-5)
+
+
+def test_data_parallel_medium_scale_equivalence(rng):
+    """DP == serial at real scale: ~120k rows, deep tree, and a
+    min_data_in_leaf floor tight enough that many winning leaves sit just
+    above it.  Each of the 8 shards holds only ~1/8 of any leaf's rows,
+    so the constraint can ONLY be evaluated correctly on global counts
+    (parallel_tree_learner.h:62-68); a shard-local count check, or any
+    psum_scatter shard-boundary slip, produces a different tree."""
+    import jax.numpy as jnp
+    n, F, B = 119_731, 12, 64           # n % 8 != 0: pad path exercised
+    bins = jnp.asarray(rng.randint(0, B, (n, F)), jnp.uint8)
+    # piecewise signal so the grown tree is deep and data-dependent,
+    # quantized to dyadic rationals (1/64 units): with |sum| < 2^24
+    # units every partial sum is EXACT in f32 under any association, so
+    # exact tree equality is a valid oracle even at this row count
+    x0 = np.asarray(bins[:, 0], np.float32)
+    x1 = np.asarray(bins[:, 1], np.float32)
+    raw = np.sin(x0 / 5.0) + 0.3 * (x1 > 40) + 0.05 * rng.randn(n)
+    grad = jnp.asarray(np.round((raw - raw.mean()) * 64) / 64, jnp.float32)
+    hess = jnp.ones(n, jnp.float32)
+    row0 = jnp.zeros(n, jnp.int32)
+    fm = jnp.ones(F, bool)
+    nb = jnp.full(F, B, jnp.int32)
+    db = jnp.zeros(F, jnp.int32)
+    mt = jnp.zeros(F, jnp.int32)
+    params = SplitParams(min_data_in_leaf=800, min_sum_hessian_in_leaf=1e-3)
+    kw = dict(max_leaves=127, max_depth=-1, max_bin=B, hist_impl="auto")
+    args = (bins, grad, hess, row0, fm, nb, db, mt, params, None, None)
+
+    ts, ls = grow_ops.grow_tree(*args, **kw)
+    tp, lp = ParallelGrower("data", 8)(*args, **kw)
+
+    nl = int(ts.num_leaves)
+    assert nl == int(tp.num_leaves)
+    assert nl > 60, "tree too shallow to stress the leaf floor (%d)" % nl
+    # the floor must actually bind for the test to mean anything
+    counts = np.asarray(ts.leaf_count)[:nl]
+    assert counts.min() >= 800
+    assert (counts < 1600).sum() > 10, counts.min()
+    np.testing.assert_array_equal(np.asarray(ts.split_feature),
+                                  np.asarray(tp.split_feature))
+    np.testing.assert_array_equal(np.asarray(ts.threshold_bin),
+                                  np.asarray(tp.threshold_bin))
+    np.testing.assert_array_equal(np.asarray(ls), np.asarray(lp))
+    np.testing.assert_allclose(np.asarray(ts.leaf_value),
+                               np.asarray(tp.leaf_value),
+                               rtol=1e-3, atol=1e-5)

@@ -109,6 +109,9 @@ def main():
             # data WITHOUT the sibling .weight files (the CLI auto-loads them)
             shutil.copy(os.path.join(src, train_f), work)
             shutil.copy(os.path.join(src, test_f), work)
+            # the test set is itself a fixture (parity tests predict on it
+            # without needing the reference checkout)
+            shutil.copy(os.path.join(src, test_f), OUT)
         params = dict(PARAMS, **extra)
         common = ["%s=%s" % (kk, vv) for kk, vv in params.items()]
 

@@ -1,0 +1,27 @@
+"""Generate a small learning-to-rank dataset in the reference's
+lambdarank example format: TSV with graded 0-4 relevance labels in the
+first column, plus a `<data>.query` side file of per-query document
+counts (metadata.cpp LoadQueryBoundaries)."""
+import numpy as np
+
+rng = np.random.RandomState(3)
+N_QUERY, DOCS_PER_Q, F = 120, 25, 15
+n = N_QUERY * DOCS_PER_Q
+X = rng.randn(n, F).astype(np.float32)
+w = np.zeros(F)
+w[:5] = rng.randn(5)
+util = (X @ w + 0.4 * rng.randn(n)).reshape(N_QUERY, DOCS_PER_Q)
+labels = np.zeros((N_QUERY, DOCS_PER_Q), np.int64)
+order = np.argsort(-util, axis=1)
+for qi in range(N_QUERY):
+    labels[qi, order[qi, :1]] = 4
+    labels[qi, order[qi, 1:3]] = 3
+    labels[qi, order[qi, 3:7]] = 2
+    labels[qi, order[qi, 7:13]] = 1
+
+M = np.column_stack([labels.reshape(-1), X])
+np.savetxt("rank.train", M, fmt=["%d"] + ["%.6f"] * F, delimiter="\t")
+np.savetxt("rank.train.query", np.full(N_QUERY, DOCS_PER_Q, np.int64),
+           fmt="%d")
+print("wrote rank.train (%d docs, %d queries) + rank.train.query"
+      % (n, N_QUERY))

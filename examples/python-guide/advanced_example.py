@@ -1,0 +1,50 @@
+"""Advanced API surface (reference python-guide/advanced_example.py
+flow): cross-validation, continued training, custom objective/metric."""
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DATA = os.path.join(HERE, "..", "..", "tests", "fixtures", "interop",
+                    "binary.test")
+
+raw = np.loadtxt(DATA)
+y, X = raw[:, 0], raw[:, 1:]
+train = lgb.Dataset(X, y)
+
+# ---- cross-validation --------------------------------------------------
+cv = lgb.cv({"objective": "binary", "metric": "auc", "verbose": -1},
+            train, num_boost_round=30, nfold=4, stratified=True, seed=5)
+key = [k for k in cv if k.endswith("auc-mean")][0]
+print("cv auc (last round): %.4f" % cv[key][-1])
+
+# ---- continued training (init_model) -----------------------------------
+b1 = lgb.train({"objective": "binary", "verbose": -1}, train,
+               num_boost_round=10)
+b1.save_model(os.path.join(HERE, "warm.txt"))
+b2 = lgb.train({"objective": "binary", "verbose": -1}, train,
+               num_boost_round=10,
+               init_model=os.path.join(HERE, "warm.txt"))
+print("continued training:", b2.num_trees(), "trees total")
+
+# ---- custom objective + metric (fobj/feval) ----------------------------
+
+
+def logistic_obj(preds, dataset):
+    labels = dataset.get_label()
+    p = 1.0 / (1.0 + np.exp(-preds))
+    return p - labels, p * (1.0 - p)
+
+
+def brier_metric(preds, dataset):
+    labels = dataset.get_label()
+    p = 1.0 / (1.0 + np.exp(-preds))
+    return "brier", float(np.mean((p - labels) ** 2)), False
+
+
+b3 = lgb.train({"verbose": -1, "objective": "none"}, train,
+               num_boost_round=20, fobj=logistic_obj, feval=brier_metric,
+               valid_sets=[train], valid_names=["train"])
+print("custom-objective booster:", b3.num_trees(), "trees")

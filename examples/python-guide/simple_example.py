@@ -1,0 +1,41 @@
+"""Train / validate / predict with the plain Python API (the
+reference python-guide/simple_example.py flow)."""
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DATA = os.path.join(HERE, "..", "..", "tests", "fixtures", "interop",
+                    "binary.test")
+
+raw = np.loadtxt(DATA)
+y, X = raw[:, 0], raw[:, 1:]
+n_train = int(0.8 * len(y))
+train = lgb.Dataset(X[:n_train], y[:n_train])
+valid = train.create_valid(X[n_train:], y[n_train:])
+
+params = {
+    "objective": "binary",
+    "metric": ["binary_logloss", "auc"],
+    "num_leaves": 31,
+    "learning_rate": 0.1,
+    "verbose": 0,
+}
+
+evals = {}
+booster = lgb.train(
+    params, train, num_boost_round=40,
+    valid_sets=[valid], valid_names=["valid"],
+    callbacks=[lgb.record_evaluation(evals),
+               lgb.early_stopping(stopping_rounds=10)],
+)
+
+pred = booster.predict(X[n_train:])
+print("valid AUC:", round(evals["valid"]["auc"][booster.best_iteration - 1], 4))
+
+booster.save_model(os.path.join(HERE, "model.txt"))
+reloaded = lgb.Booster(model_file=os.path.join(HERE, "model.txt"))
+assert np.allclose(reloaded.predict(X[n_train:]), pred)
+print("saved + reloaded OK")

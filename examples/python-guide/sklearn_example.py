@@ -1,0 +1,35 @@
+"""The scikit-learn estimator API (reference
+python-guide/sklearn_example.py flow): fit / predict / GridSearchCV."""
+import os
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DATA = os.path.join(HERE, "..", "..", "tests", "fixtures", "interop",
+                    "regression.test")
+
+raw = np.loadtxt(DATA)
+y, X = raw[:, 0], raw[:, 1:]
+n_train = int(0.8 * len(y))
+
+reg = lgb.LGBMRegressor(num_leaves=31, learning_rate=0.05,
+                        n_estimators=40)
+reg.fit(X[:n_train], y[:n_train],
+        eval_set=[(X[n_train:], y[n_train:])],
+        eval_metric="l2",
+        callbacks=[lgb.early_stopping(stopping_rounds=5, verbose=False)])
+mse = float(np.mean((reg.predict(X[n_train:]) - y[n_train:]) ** 2))
+print("holdout MSE:", round(mse, 5))
+
+print("feature importances (top 5):",
+      np.argsort(reg.feature_importances_)[::-1][:5].tolist())
+
+from sklearn.model_selection import GridSearchCV
+
+gs = GridSearchCV(lgb.LGBMRegressor(n_estimators=20),
+                  {"num_leaves": [15, 31], "learning_rate": [0.05, 0.1]},
+                  cv=3)
+gs.fit(X[:n_train], y[:n_train])
+print("best params:", gs.best_params_)

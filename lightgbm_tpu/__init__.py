@@ -15,12 +15,16 @@ __version__ = "2.2.4.tpu0"
 # layers land; see basic.py / engine.py / sklearn.py.
 try:  # pragma: no cover - import cycle guard during early construction
     from .basic import Booster, Dataset  # noqa: F401
+    from .callback import (early_stopping, print_evaluation,  # noqa: F401
+                           record_evaluation, reset_parameter)
     from .engine import cv, train  # noqa: F401
     from .plotting import (create_tree_digraph, plot_importance,  # noqa: F401
                            plot_metric, plot_tree)
     from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: F401
                           LGBMRanker, LGBMRegressor)
     __all__ = ["Config", "Dataset", "Booster", "train", "cv", "log",
+               "early_stopping", "print_evaluation", "record_evaluation",
+               "reset_parameter",
                "plot_importance", "plot_metric", "plot_tree",
                "create_tree_digraph", "LGBMModel", "LGBMClassifier",
                "LGBMRegressor", "LGBMRanker"]

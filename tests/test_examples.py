@@ -1,0 +1,68 @@
+"""The examples/ directory stays runnable: the binary CLI example and
+the python-guide scripts execute end to end (the reference keeps its
+examples green the same way, via tests/python_package_test +
+.ci runs over examples/)."""
+import os
+import runpy
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _cleanup(*paths):
+    for p in paths:
+        if os.path.exists(p):
+            os.unlink(p)
+
+
+def test_binary_classification_example(monkeypatch):
+    from lightgbm_tpu import app
+    d = os.path.join(EXAMPLES, "binary_classification")
+    monkeypatch.chdir(d)
+    try:
+        assert app.main(["config=train.conf"]) == 0
+        assert os.path.exists("binary_model.txt")
+        assert app.main(["config=predict.conf"]) == 0
+        preds = open("binary_prediction.txt").read().splitlines()
+        assert len(preds) == 500
+        assert all(0.0 <= float(p) <= 1.0 for p in preds)
+    finally:
+        _cleanup("binary_model.txt", "binary_prediction.txt")
+
+
+def test_lambdarank_example(monkeypatch, tmp_path):
+    from lightgbm_tpu import app
+    d = os.path.join(EXAMPLES, "lambdarank")
+    # generate the data into a scratch dir, then run the conf against it
+    monkeypatch.chdir(tmp_path)
+    subprocess.run([sys.executable, os.path.join(d, "make_data.py")],
+                   check=True, cwd=tmp_path)
+    assert os.path.exists(tmp_path / "rank.train.query")
+    assert app.main(["config=%s" % os.path.join(d, "train.conf"),
+                     "data=rank.train", "valid_data=rank.train"]) == 0
+    assert os.path.exists("rank_model.txt")
+
+
+def test_python_guide_simple_example():
+    d = os.path.join(EXAMPLES, "python-guide")
+    try:
+        runpy.run_path(os.path.join(d, "simple_example.py"),
+                       run_name="__main__")
+    finally:
+        _cleanup(os.path.join(d, "model.txt"))
+
+
+@pytest.mark.slow
+def test_python_guide_other_examples():
+    d = os.path.join(EXAMPLES, "python-guide")
+    try:
+        runpy.run_path(os.path.join(d, "advanced_example.py"),
+                       run_name="__main__")
+        runpy.run_path(os.path.join(d, "sklearn_example.py"),
+                       run_name="__main__")
+    finally:
+        _cleanup(os.path.join(d, "warm.txt"))

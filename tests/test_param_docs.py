@@ -1,0 +1,27 @@
+"""Schema-to-docs pipeline guard: docs/Parameters.md must be exactly
+what tools/gen_param_docs.py generates from the live config schema —
+the CI diff the reference runs on its parameter_generator.py output
+(.ci/test.sh:36-41)."""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_parameters_md_in_sync():
+    import gen_param_docs
+    generated = gen_param_docs.generate()
+    with open(os.path.join(REPO, "docs", "Parameters.md")) as f:
+        committed = f.read()
+    assert committed == generated, (
+        "docs/Parameters.md is stale — run `python tools/gen_param_docs.py"
+        " --write` after changing the config schema")
+
+
+def test_docs_cover_every_schema_field():
+    from lightgbm_tpu.config import _SCHEMA
+    with open(os.path.join(REPO, "docs", "Parameters.md")) as f:
+        committed = f.read()
+    for name, _, _ in _SCHEMA:
+        assert "| `%s` |" % name in committed, name

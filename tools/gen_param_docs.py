@@ -1,0 +1,121 @@
+"""Generate docs/Parameters.md from the config schema.
+
+The reference generates docs/Parameters.rst + config_auto.cpp from
+config.h doc-comments and CI-diffs the result so docs can never drift
+from the schema (helpers/parameter_generator.py, .ci/test.sh:36-41).
+This is the same pipeline for this package: the single source of truth
+is ``lightgbm_tpu/config.py`` (``_SCHEMA`` + ``ALIAS_TABLE`` + the
+section comments), and ``tests/test_param_docs.py`` diffs the committed
+``docs/Parameters.md`` against a fresh regeneration.
+
+Regenerate with:  python tools/gen_param_docs.py --write
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "Parameters.md")
+sys.path.insert(0, REPO)
+
+
+def parse_sections():
+    """(section title, [param names]) in schema order, recovered from the
+    `# --- section` comments inside the _SCHEMA literal — the analogue of
+    the reference parsing config.h's `#pragma region` / doc comments."""
+    src = open(os.path.join(REPO, "lightgbm_tpu", "config.py")).read()
+    body = src.split("_SCHEMA = [", 1)[1].split("\n]", 1)[0]
+    sections, current = [], ("Parameters", [])
+    for line in body.splitlines():
+        m = re.match(r"\s*# --- (.+?)(;.*)?$", line)
+        if m:
+            if current[1]:
+                sections.append(current)
+            current = (m.group(1).strip(), [])
+            continue
+        m = re.match(r"\s*\(\"(\w+)\",", line)
+        if m:
+            current[1].append(m.group(1))
+    if current[1]:
+        sections.append(current)
+    return sections
+
+
+def generate() -> str:
+    from lightgbm_tpu.config import _SCHEMA, ALIAS_TABLE
+
+    by_name = {name: (typ, default) for name, typ, default in _SCHEMA}
+    aliases: dict = {}
+    for alias, canon in ALIAS_TABLE.items():
+        aliases.setdefault(canon, []).append(alias)
+
+    sections = parse_sections()
+    covered = {p for _, ps in sections for p in ps}
+    missing = set(by_name) - covered
+    if missing:
+        raise AssertionError("schema fields missing from section parse: %s"
+                             % sorted(missing))
+
+    def fmt_type(t):
+        return t if isinstance(t, str) else t.__name__
+
+    def fmt_default(v):
+        if isinstance(v, str):
+            return '`""`' if v == "" else "`%s`" % v
+        if isinstance(v, list):
+            return "`[]`" if not v else "`%s`" % ",".join(map(str, v))
+        return "`%s`" % v
+
+    out = [
+        "# Parameters",
+        "",
+        "Generated from `lightgbm_tpu/config.py` (`_SCHEMA` + "
+        "`ALIAS_TABLE`) by `tools/gen_param_docs.py` — do not edit by "
+        "hand; `tests/test_param_docs.py` fails when this file drifts "
+        "from the schema.",
+        "",
+        "Parameter *semantics* match the reference implementation's "
+        "Parameters.rst for every shared name (the `config.h` line "
+        "ranges cited in each section header below); `tpu_*` knobs are "
+        "this framework's own and documented inline in `config.py`.",
+        "",
+        "Unknown parameters warn; known-but-inert parameters (accepted "
+        "for compatibility, no effect on TPU) warn once at construct.",
+        "",
+    ]
+    for title, params in sections:
+        out.append("## %s" % title[:1].upper() + title[1:])
+        out.append("")
+        out.append("| parameter | type | default | aliases |")
+        out.append("|---|---|---|---|")
+        for p in params:
+            typ, default = by_name[p]
+            als = ", ".join("`%s`" % a for a in aliases.get(p, [])) or "—"
+            out.append("| `%s` | %s | %s | %s |"
+                       % (p, fmt_type(typ), fmt_default(default), als))
+        out.append("")
+    # aliases that point at params outside the schema would be bugs
+    stray = [a for a, c in ALIAS_TABLE.items() if c not in by_name]
+    if stray:
+        raise AssertionError("aliases to unknown params: %s" % stray)
+    out.append("*%d parameters, %d aliases.*" % (len(by_name),
+                                                 len(ALIAS_TABLE)))
+    out.append("")
+    return "\n".join(out)
+
+
+def main():
+    text = generate()
+    if "--write" in sys.argv:
+        os.makedirs(os.path.dirname(DOC), exist_ok=True)
+        with open(DOC, "w") as f:
+            f.write(text)
+        print("wrote", DOC)
+    else:
+        sys.stdout.write(text)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,7 @@ import numpy as np
 
 from ..utils import log
 from .bin_mapper import CATEGORICAL, NUMERICAL, BinMapper
+from .file_io import v_open
 from .metadata import Metadata
 
 _BINARY_MAGIC = "lightgbm_tpu_dataset_v1"
@@ -517,13 +518,18 @@ class BinnedDataset:
         if self.feature_penalty is not None:
             d["feature_penalty"] = self.feature_penalty
         d.update(self.metadata.to_npz_dict())
-        with open(filename, "wb") as f:  # exact filename, no .npz appending
+        # v_open: binary datasets ride the same backend seam as text IO,
+        # so save/load works against registered remote filesystems too
+        with v_open(filename, "wb") as f:  # exact filename, no .npz append
             np.savez_compressed(f, **d)
         log.info("Saved binary dataset to %s", filename)
 
     @classmethod
     def load_binary(cls, filename: str) -> "BinnedDataset":
-        d = np.load(filename, allow_pickle=False)
+        with v_open(filename, "rb") as f:
+            # eager dict(): NpzFile reads lazily, but the backing file
+            # (possibly a remote backend handle) closes with the `with`
+            d = dict(np.load(f, allow_pickle=False))
         if str(d["magic"]) != _BINARY_MAGIC:
             log.fatal("%s is not a lightgbm_tpu binary dataset file" % filename)
         ds = cls()

@@ -47,6 +47,41 @@ def v_open(path, mode: str = "r"):
     if "://" in path:
         raise OSError(
             "no file backend registered for '%s'; register one with "
-            "lightgbm_tpu.io.file_io.register_backend('%s', opener)"
-            % (path, path.split("://", 1)[0] + "://"))
+            "lightgbm_tpu.io.file_io.register_backend('%s', opener), or "
+            "call lightgbm_tpu.io.file_io.enable_fsspec('%s') if fsspec "
+            "handles that protocol"
+            % (path, path.split("://", 1)[0] + "://",
+               path.split("://", 1)[0]))
     return builtins.open(path, mode)
+
+
+def enable_fsspec(*protocols: str) -> None:
+    """Route the given URL protocols (e.g. "gs", "s3", "hdfs", "memory")
+    through fsspec — the working remote backend the reference ships for
+    HDFS (src/io/file_io.cpp:54-135 HDFSFile), generalized to every
+    filesystem fsspec implements.  fsspec stays an optional dependency:
+    importing it here is the only place the package touches it.
+
+        from lightgbm_tpu.io.file_io import enable_fsspec
+        enable_fsspec("gs")            # gs:// paths now work everywhere
+        enable_fsspec()                # register every known protocol
+
+    fsspec raises FileNotFoundError for missing paths, which satisfies
+    the backend contract above (side-file probing keeps working).
+    """
+    import fsspec
+
+    if not protocols:
+        protocols = tuple(sorted(
+            {p for p in fsspec.available_protocols() if p != "file"}))
+
+    def _opener(path, mode):
+        # fsspec.open returns an OpenFile; .open() yields the file-like.
+        # Text mode gets utf-8 like builtins.open under this package's
+        # loaders; binary modes pass through untouched.
+        if "b" in mode:
+            return fsspec.open(path, mode).open()
+        return fsspec.open(path, mode, encoding="utf-8").open()
+
+    for proto in protocols:
+        register_backend("%s://" % proto, _opener)

@@ -452,3 +452,48 @@ class TestTwoRoundPrePartition:
         with pytest.raises(Exception, match="query counts"):
             load_two_round(cfg, str(f), rank=0, num_machines=2,
                            pre_partition=True)
+
+
+class TestFsspecBackend:
+    """The fsspec-backed remote backend proves the v_open seam with a
+    real (in-memory) filesystem — the working-remote-backend analogue of
+    the reference's HDFS client (src/io/file_io.cpp:54-135)."""
+
+    @pytest.fixture(autouse=True)
+    def _fsspec_memory(self):
+        fsspec = pytest.importorskip("fsspec")
+        from lightgbm_tpu.io import file_io
+        file_io.enable_fsspec("memory")
+        yield fsspec
+        file_io.unregister_backend("memory://")
+        # wipe the shared in-memory store between tests
+        fsspec.filesystem("memory").store.clear()
+
+    def test_text_round_trip(self):
+        from lightgbm_tpu.io.file_io import v_open
+        with v_open("memory://bucket/hello.txt", "w") as f:
+            f.write("42\n")
+        with v_open("memory://bucket/hello.txt") as f:
+            assert f.read() == "42\n"
+
+    def test_binary_dataset_round_trip(self, rng):
+        from lightgbm_tpu.io.dataset import BinnedDataset
+        X = rng.randn(200, 5)
+        ds = BinnedDataset.construct(X, Config(max_bin=31))
+        ds.save_binary("memory://bucket/train.bin")
+        back = BinnedDataset.load_binary("memory://bucket/train.bin")
+        np.testing.assert_array_equal(np.asarray(ds.bins),
+                                      np.asarray(back.bins))
+        assert [m.to_state() for m in ds.bin_mappers] == \
+               [m.to_state() for m in back.bin_mappers]
+
+    def test_model_save_load_remote(self, rng):
+        import lightgbm_tpu as lgb
+        X = rng.randn(300, 4)
+        y = (X[:, 0] > 0).astype(np.float64)
+        bst = lgb.train({"objective": "binary", "verbose": -1},
+                        lgb.Dataset(X, y), num_boost_round=5)
+        pred = bst.predict(X)
+        bst.save_model("memory://models/m.txt")
+        back = lgb.Booster(model_file="memory://models/m.txt")
+        np.testing.assert_allclose(back.predict(X), pred, rtol=1e-9)

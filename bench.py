@@ -12,7 +12,9 @@ docs/Experiments.rst:137-144).
 
 Quality floors make a wrong-trees regression fail the bench instead of
 posting a good-looking throughput: held-out AUC for workload 1, NDCG@10
-for workload 2 (floors set ~5 rel-% under measured healthy values).
+for workload 2 (floors pinned ~1 rel-% under measured healthy values at
+the full iteration count; the short CPU smoke path gets looser floors
+scaled to its few iterations).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "detail": {...}}
@@ -25,12 +27,20 @@ import time
 import numpy as np
 
 BASELINE_ROWS_ITER_PER_S = 10_500_000 * 500 / 238.505  # reference CPU Higgs
-AUC_FLOOR = 0.88          # measured ~0.945 on the synthetic task after 42 it
+# Quality floors are pinned ~1 rel-% under healthy measured values so a
+# gain-math regression fails the bench loudly instead of costing a few
+# quiet quality points (pinned r5: holdout AUC 0.9548 at 500 iters,
+# NDCG@10 0.984 at 500 iters; deterministic seeds make the margins safe)
+AUC_FLOOR = 0.945
+NDCG10_FLOOR = 0.97
+# the non-TPU smoke path runs 3-5 iterations on tiny shapes — same
+# code, nowhere near converged; its floors only catch total breakage
+SMOKE_AUC_FLOOR = 0.75
+SMOKE_NDCG10_FLOOR = 0.85
 RETRY_BUDGET_S = 500      # retry window: covers the worst observed
 #                           degraded run (346-473 s) so variance-hit runs
 #                           DO get their retry, while bounding the bench's
 #                           total wall clock for the harness
-NDCG10_FLOOR = 0.85       # measured ~0.92 on the synthetic ranking task
 MSLR_REFERENCE_S = 215.32  # reference 500-iter MSLR wall-clock
 #                            (docs/Experiments.rst:110)
 
@@ -148,6 +158,7 @@ def bench_higgs(lgb, sync, on_tpu):
             booster, elapsed, blocks = b2, e2, blk2
 
     auc = _auc(yh, booster.predict(Xh))
+    auc_floor = AUC_FLOOR if on_tpu else SMOKE_AUC_FLOOR
     rows_iter_per_s = n * timed_iters / elapsed
     out = {
         "throughput_mrows_iter_s": round(rows_iter_per_s / 1e6, 3),
@@ -155,8 +166,8 @@ def bench_higgs(lgb, sync, on_tpu):
         "elapsed_s": round(elapsed, 3), "rows": n, "timed_iters": timed_iters,
         "block_ms_iter": blocks, "all_runs_s": runs_s,
         "holdout_auc": round(float(auc), 4),
-        "auc_floor": AUC_FLOOR,
-        "quality_ok": bool(auc >= AUC_FLOOR),
+        "auc_floor": auc_floor,
+        "quality_ok": bool(auc >= auc_floor),
         "engine": ("partition" if booster._gbdt._use_partition_engine
                    else "label"),
     }
@@ -236,6 +247,7 @@ def bench_lambdarank(lgb, sync, on_tpu):
 
     pred = booster.predict(X)
     ndcg = _ndcg_at_k(labels, pred, qid, 10)
+    ndcg_floor = NDCG10_FLOOR if on_tpu else SMOKE_NDCG10_FLOOR
     rps = n * iters / elapsed
     out = {
         "rows": n, "queries": n_query, "features": F, "iters": iters,
@@ -244,8 +256,8 @@ def bench_lambdarank(lgb, sync, on_tpu):
         "block_ms_iter": blocks, "all_runs_s": runs_s,
         "reference_mslr_500iter_s": MSLR_REFERENCE_S,
         "ndcg_at_10": round(float(ndcg), 4),
-        "ndcg_floor": NDCG10_FLOOR,
-        "quality_ok": bool(ndcg >= NDCG10_FLOOR),
+        "ndcg_floor": ndcg_floor,
+        "quality_ok": bool(ndcg >= ndcg_floor),
         "reference_mslr_ndcg10": 0.527371,   # docs/Experiments.rst:143
     }
     if iters == 500:

@@ -1,0 +1,60 @@
+"""Allstate-shaped stress: thousands of one-hot features through the
+always-dense + EFB design.
+
+The reference handles its 4,228-feature Allstate benchmark
+(docs/Experiments.rst) with sparse bin storage (src/io/sparse_bin.hpp);
+this framework deliberately dropped sparse bins (SURVEY §7, the GPU
+learner's own densification precedent, gpu_tree_learner.cpp:233-251)
+and relies on EFB to fold mutually-exclusive one-hot blocks into dense
+bundles.  This test is the proof point at that feature count: the
+bundling must recover ~categorical-variable-many dense columns from
+~4k one-hot inputs, train, and separate held-out data.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+pytestmark = pytest.mark.slow
+
+sp = pytest.importorskip("scipy.sparse")
+
+
+def _one_hot_dataset(rng, n_rows, n_vars, cats_per_var):
+    """CSR one-hot of n_vars categoricals -> n_vars*cats_per_var cols."""
+    F = n_vars * cats_per_var
+    cats = rng.randint(0, cats_per_var, size=(n_rows, n_vars))
+    cols = (cats + np.arange(n_vars) * cats_per_var).ravel()
+    rows = np.repeat(np.arange(n_rows), n_vars)
+    X = sp.csr_matrix(
+        (np.ones(n_rows * n_vars, np.float32), (rows, cols)),
+        shape=(n_rows, F))
+    # signal: a handful of (var, category) indicator effects
+    w = np.zeros(F, np.float32)
+    sig = rng.choice(F, 25, replace=False)
+    w[sig] = rng.randn(25) * 2.0
+    logits = np.asarray(X @ w).ravel()
+    y = (logits + 0.5 * rng.randn(n_rows) > 0).astype(np.float32)
+    return X, y
+
+
+def test_allstate_shaped_wide_one_hot(rng):
+    n_vars, cats = 211, 20            # 4,220 one-hot columns
+    X, y = _one_hot_dataset(rng, 30_000, n_vars, cats)
+    assert X.shape[1] == 4_220
+
+    ds = lgb.Dataset(X[:25_000], y[:25_000])
+    ds.construct()
+    binned = ds._binned
+    G = binned.bundle.num_groups if binned.bundle is not None else X.shape[1]
+    # each categorical's one-hot block is perfectly exclusive, so EFB
+    # must fold ~20x: anything near the raw width means bundling failed
+    assert G <= 2 * n_vars, "EFB produced %d groups from %d columns" % (
+        G, X.shape[1])
+
+    bst = lgb.train({"objective": "binary", "num_leaves": 63,
+                     "learning_rate": 0.2, "verbose": -1}, ds,
+                    num_boost_round=15)
+    from sklearn.metrics import roc_auc_score
+    auc = roc_auc_score(y[25_000:], bst.predict(X[25_000:]))
+    assert auc > 0.75, auc

@@ -354,16 +354,46 @@ def LGBM_BoosterGetCurrentIteration(handle, out):
     _out(out).value = _resolve(handle)._gbdt.iter
 
 
+def _ensure_train_metrics(bst):
+    """The reference's C-API Booster always constructs its training
+    metrics (Booster ctor -> CreateObjectiveAndMetrics); the Python
+    engine here attaches them lazily instead, so C-ABI callers get them
+    materialized on first eval-surface touch."""
+    g = bst._gbdt
+    if g.train_metrics or g.train_state is None:
+        return g
+    from .basic import _metrics_from_config
+    for m in _metrics_from_config(bst.config):
+        m.init(g.train_set.metadata, g.train_set.num_data)
+        g.train_metrics.append(m)
+    return g
+
+
+def _expanded_eval_names(gbdt):
+    """One name per eval VALUE: multi-position metrics (ndcg/map) expand
+    to name@k per eval_at entry, exactly like the reference where
+    Metric::GetName() returns a vector (metric.hpp) and GetEvalCounts
+    sums its sizes — keeps GetEvalCounts == len(GetEval results)."""
+    names = []
+    for m in gbdt.train_metrics:
+        ks = getattr(m, "eval_at", None)
+        if ks:
+            names.extend("%s@%d" % (m.name, k) for k in ks)
+        else:
+            names.append(m.name)
+    return names
+
+
 @_wrap
 def LGBM_BoosterGetEvalCounts(handle, out):
     bst = _resolve(handle)
-    _out(out).value = len(bst._gbdt.train_metrics)
+    _out(out).value = len(_expanded_eval_names(_ensure_train_metrics(bst)))
 
 
 @_wrap
 def LGBM_BoosterGetEvalNames(handle, out_len, out_strs):
     bst = _resolve(handle)
-    _write_strings([m.name for m in bst._gbdt.train_metrics],
+    _write_strings(_expanded_eval_names(_ensure_train_metrics(bst)),
                    out_len, out_strs)
 
 
@@ -383,7 +413,8 @@ def _aslist(v):
 @_wrap
 def LGBM_BoosterGetEval(handle, data_idx, out_len, out_results):
     bst = _resolve(handle)
-    vals = _eval_values(bst._gbdt, int(getattr(data_idx, "value", data_idx)))
+    vals = _eval_values(_ensure_train_metrics(bst),
+                        int(getattr(data_idx, "value", data_idx)))
     _write_doubles(vals, out_len, out_results)
 
 
@@ -520,13 +551,19 @@ def _ival(v, default=0):
     return int(getattr(v, "value", v) if v is not None else default)
 
 
+# the v2 char** ABI carries no buffer size; callers (reference tests,
+# the R glue) allocate 256-byte slots, so names are capped to fit —
+# writing the full length would overrun the caller's buffers
+_NAME_BUF_LEN = 256
+
+
 def _write_strings(names, out_len, out_strs):
     _out(out_len).value = len(names)
     # NB: indexing a (c_char_p * n) array yields a bytes COPY — cast to
     # void-pointers so memmove hits the caller's buffers
     ptrs = ctypes.cast(out_strs, ctypes.POINTER(ctypes.c_void_p))
     for i, name in enumerate(names):
-        raw = name.encode("utf-8") + b"\0"
+        raw = name.encode("utf-8")[:_NAME_BUF_LEN - 1] + b"\0"
         ctypes.memmove(ptrs[i], raw, len(raw))
 
 

@@ -1,0 +1,90 @@
+# Training callbacks (the reference's R-package/R/callback.R factories;
+# each returns function(env) where env carries booster / iteration /
+# eval records — same CallbackEnv idiom as the Python package).
+
+#' env fields: booster, iteration, begin_iteration, end_iteration,
+#' eval_list (records from lgb.Booster.eval), met_early_stop (set by
+#' cb.early.stop to end the loop).
+CB_ENV_FIELDS <- c("booster", "iteration", "begin_iteration",
+                   "end_iteration", "eval_list", "met_early_stop")
+
+cb.print.evaluation <- function(period = 1L) {
+  function(env) {
+    if (period <= 0L || (env$iteration %% period) != 0L) return(invisible())
+    msgs <- vapply(env$eval_list, function(r) {
+      sprintf("%s's %s:%g", r$data_name, r$name, r$value)
+    }, character(1))
+    cat(sprintf("[%d]\t%s\n", env$iteration, paste(msgs, collapse = "\t")))
+  }
+}
+
+cb.record.evaluation <- function() {
+  function(env) {
+    bst <- env$booster
+    for (r in env$eval_list) {
+      d <- r$data_name
+      m <- r$name
+      if (is.null(bst$record_evals[[d]])) bst$record_evals[[d]] <- list()
+      if (is.null(bst$record_evals[[d]][[m]])) {
+        bst$record_evals[[d]][[m]] <- list(eval = list())
+      }
+      k <- length(bst$record_evals[[d]][[m]]$eval) + 1L
+      bst$record_evals[[d]][[m]]$eval[[k]] <- r$value
+    }
+  }
+}
+
+#' Reset parameters on a schedule: values are either a vector (one per
+#' iteration) or function(iteration, total) -> value.  Marked
+#' pre-iteration: lgb.train runs it BEFORE every boosting update so the
+#' schedule applies to the iteration about to train (the reference's
+#' before_iteration callback ordering).
+cb.reset.parameter <- function(new_params) {
+  stopifnot(is.list(new_params))
+  cb <- function(env) {
+    i <- env$iteration - env$begin_iteration + 1L
+    total <- env$end_iteration - env$begin_iteration + 1L
+    resolved <- lapply(new_params, function(spec) {
+      if (is.function(spec)) spec(i, total) else spec[[min(i, length(spec))]]
+    })
+    lgb.Booster.reset_parameter(env$booster, resolved)
+  }
+  attr(cb, "is_pre_iteration") <- TRUE
+  cb
+}
+
+#' Stop when the first validation metric stops improving for
+#' stopping_rounds iterations; stores best_iter/best_score on the
+#' booster and rolls back to it (reference cb.early.stop).
+cb.early.stop <- function(stopping_rounds, verbose = TRUE) {
+  best <- new.env(parent = emptyenv())
+  best$score <- NA_real_
+  best$iter <- -1L
+  best$since <- 0L
+  function(env) {
+    recs <- Filter(function(r) r$data_name != "train", env$eval_list)
+    if (length(recs) == 0L) return(invisible())
+    r <- recs[[1L]]
+    better <- if (is.na(best$score)) TRUE
+              else if (r$higher_better) r$value > best$score
+              else r$value < best$score
+    if (better) {
+      best$score <- r$value
+      best$iter <- env$iteration
+      best$since <- 0L
+    } else {
+      best$since <- best$since + 1L
+      if (best$since >= stopping_rounds) {
+        env$booster$best_iter <- best$iter
+        env$booster$best_score <- best$score
+        env$met_early_stop <- TRUE
+        if (verbose) {
+          cat(sprintf("Early stopping, best iteration: [%d] %s: %g\n",
+                      best$iter, r$name, best$score))
+        }
+      }
+    }
+    env$booster$best_iter <- best$iter
+    env$booster$best_score <- best$score
+  }
+}

@@ -1,0 +1,195 @@
+# lgb.Booster: the training/prediction handle over the C ABI (the
+# reference's R-package/R/lgb.Booster.R + lgb.Predictor.R roles on
+# plain environments; .Call glue in src/lightgbm_tpu_R.c).
+
+#' Internal constructor: exactly one of train_set / modelfile /
+#' model_str must be given (mirrors the reference Booster$initialize).
+Booster <- function(params = list(), train_set = NULL, modelfile = NULL,
+                    model_str = NULL) {
+  lgb.load_lib()
+  env <- new.env(parent = emptyenv())
+  env$params <- params
+  env$valid_sets <- list()
+  env$valid_names <- character(0)
+  env$record_evals <- list()
+  env$best_iter <- -1L
+  env$best_score <- NA_real_
+  if (!is.null(train_set)) {
+    stopifnot(lgb.is.Dataset(train_set))
+    lgb.Dataset.construct(train_set)
+    env$train_set <- train_set
+    env$handle <- .Call("LGBMR_BoosterCreate", train_set$handle,
+                        lgb.params2str(params))
+  } else if (!is.null(modelfile)) {
+    env$handle <- .Call("LGBMR_BoosterCreateFromModelfile", modelfile)
+  } else if (!is.null(model_str)) {
+    env$handle <- .Call("LGBMR_BoosterLoadModelFromString", model_str)
+  } else {
+    stop("Booster needs train_set, modelfile or model_str")
+  }
+  class(env) <- "lgb.Booster"
+  env
+}
+
+lgb.Booster.add_valid <- function(booster, data, name) {
+  stopifnot(lgb.is.Booster(booster), lgb.is.Dataset(data))
+  lgb.Dataset.construct(data)
+  .Call("LGBMR_BoosterAddValidData", booster$handle, data$handle)
+  booster$valid_sets[[length(booster$valid_sets) + 1L]] <- data
+  booster$valid_names <- c(booster$valid_names, name)
+  invisible(booster)
+}
+
+#' One boosting iteration; fobj(preds, train_set) -> list(grad, hess)
+#' switches to the custom-objective path (UpdateOneIterCustom).
+lgb.Booster.update <- function(booster, fobj = NULL) {
+  if (is.null(fobj)) {
+    finished <- .Call("LGBMR_BoosterUpdateOneIter", booster$handle)
+  } else {
+    preds <- lgb.Booster.inner_predict(booster, 0L)
+    gh <- fobj(preds, booster$train_set)
+    if (!is.list(gh) || is.null(gh$grad) || is.null(gh$hess)) {
+      stop("fobj must return list(grad = ..., hess = ...)")
+    }
+    finished <- .Call("LGBMR_BoosterUpdateOneIterCustom", booster$handle,
+                      as.double(gh$grad), as.double(gh$hess))
+  }
+  invisible(finished)
+}
+
+lgb.Booster.rollback_one_iter <- function(booster) {
+  .Call("LGBMR_BoosterRollbackOneIter", booster$handle)
+  invisible(booster)
+}
+
+lgb.Booster.current_iter <- function(booster) {
+  .Call("LGBMR_BoosterGetCurrentIteration", booster$handle)
+}
+
+#' Raw scores on the train (data_idx = 0) or a valid set (1-based after
+#' that) — the Booster::GetPredict path used by custom fobj/feval:
+#' reads the engine's incrementally-maintained scores, no re-binning or
+#' ensemble re-walk (the reference's __inner_predict).
+lgb.Booster.inner_predict <- function(booster, data_idx = 0L) {
+  .Call("LGBMR_BoosterGetPredict", booster$handle, as.integer(data_idx))
+}
+
+#' Evaluate on train + every added valid set; returns a list of
+#' records: list(data_name, name, value, higher_better).  A custom
+#' feval(preds, dataset) -> list(name, value, higher_better) runs on
+#' EVERY set (train raw scores + each valid's raw scores via
+#' GetPredict), like the reference's per-valid feval loop.
+lgb.Booster.eval <- function(booster, feval = NULL) {
+  names_ <- .Call("LGBMR_BoosterGetEvalNames", booster$handle)
+  sets <- c("train", booster$valid_names)
+  datasets <- c(list(booster$train_set), booster$valid_sets)
+  out <- list()
+  for (idx in seq_along(sets) - 1L) {
+    vals <- .Call("LGBMR_BoosterGetEval", booster$handle, idx)
+    for (j in seq_along(vals)) {
+      out[[length(out) + 1L]] <- list(
+        data_name = sets[idx + 1L], name = names_[j], value = vals[j],
+        higher_better = lgb.metric.higher_better(names_[j]))
+    }
+    if (!is.null(feval)) {
+      preds <- lgb.Booster.inner_predict(booster, idx)
+      fr <- feval(preds, datasets[[idx + 1L]])
+      out[[length(out) + 1L]] <- list(
+        data_name = sets[idx + 1L], name = fr[[1L]], value = fr[[2L]],
+        higher_better = isTRUE(fr[[3L]]))
+    }
+  }
+  out
+}
+
+#' Predict on a new matrix.
+#' @param rawscore,predleaf,predcontrib select the output type
+#'   (margin / leaf indices / per-feature SHAP contributions)
+predict.lgb.Booster <- function(object, data, num_iteration = -1L,
+                                rawscore = FALSE, predleaf = FALSE,
+                                predcontrib = FALSE, header = FALSE,
+                                reshape = TRUE, params = "", ...) {
+  if (is.data.frame(data)) data <- as.matrix(data)
+  if (!is.double(data)) storage.mode(data) <- "double"
+  ptype <- 0L
+  if (rawscore) ptype <- 1L
+  if (predleaf) ptype <- 2L
+  if (predcontrib) ptype <- 3L
+  out <- .Call("LGBMR_BoosterPredictForMat", object$handle, data, ptype,
+               as.integer(num_iteration), params)
+  n <- nrow(data)
+  if (reshape && length(out) > n && length(out) %% n == 0L) {
+    # multiclass / leaf / contrib outputs come back row-major
+    out <- matrix(out, nrow = n, byrow = TRUE)
+  }
+  out
+}
+
+lgb.Booster.save_model <- function(booster, filename,
+                                   num_iteration = -1L) {
+  .Call("LGBMR_BoosterSaveModel", booster$handle,
+        as.integer(num_iteration), filename)
+  invisible(booster)
+}
+
+lgb.Booster.to_string <- function(booster, num_iteration = -1L) {
+  .Call("LGBMR_BoosterSaveModelToString", booster$handle,
+        as.integer(num_iteration))
+}
+
+lgb.Booster.dump_model <- function(booster, num_iteration = -1L) {
+  .Call("LGBMR_BoosterDumpModel", booster$handle,
+        as.integer(num_iteration))
+}
+
+lgb.Booster.reset_parameter <- function(booster, params) {
+  .Call("LGBMR_BoosterResetParameter", booster$handle,
+        lgb.params2str(params))
+  booster$params <- utils::modifyList(booster$params, params)
+  invisible(booster)
+}
+
+#' Load a model from a text file written by save_model (also reads
+#' models written by the reference implementation — the two speak the
+#' same format, gbdt_model_text.cpp:244,343).
+lgb.load <- function(filename = NULL, model_str = NULL) {
+  if (!is.null(filename)) return(Booster(modelfile = filename))
+  if (!is.null(model_str)) return(Booster(model_str = model_str))
+  stop("either filename or model_str is required")
+}
+
+lgb.save <- function(booster, filename, num_iteration = -1L) {
+  lgb.Booster.save_model(booster, filename, num_iteration)
+}
+
+#' RDS round-trip: embed the model text so standard R serialization
+#' works on the otherwise-external handle (the reference's
+#' saveRDS.lgb.Booster / readRDS.lgb.Booster pair).
+saveRDS.lgb.Booster <- function(object, file, num_iteration = -1L, ...) {
+  raw_model <- lgb.Booster.to_string(object, num_iteration)
+  saveRDS(list(class = "lgb.Booster.raw", model_str = raw_model,
+               params = object$params, best_iter = object$best_iter,
+               record_evals = object$record_evals), file = file, ...)
+}
+
+readRDS.lgb.Booster <- function(file, ...) {
+  blob <- readRDS(file, ...)
+  stopifnot(identical(blob$class, "lgb.Booster.raw"))
+  booster <- Booster(model_str = blob$model_str)
+  booster$params <- blob$params
+  booster$best_iter <- blob$best_iter
+  booster$record_evals <- blob$record_evals
+  booster
+}
+
+#' Eval results recorded by lgb.train(record = TRUE).
+lgb.get.eval.result <- function(booster, data_name, eval_name,
+                                iters = NULL, is_err = FALSE) {
+  rec <- booster$record_evals[[data_name]][[eval_name]]
+  if (is.null(rec)) {
+    stop("no recorded results for ", data_name, "/", eval_name)
+  }
+  out <- unlist(rec$eval)
+  if (!is.null(iters)) out <- out[iters]
+  out
+}

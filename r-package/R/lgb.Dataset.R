@@ -1,0 +1,162 @@
+# lgb.Dataset: lazy-constructed training data over the C ABI (the
+# reference's R-package/R/lgb.Dataset.R role, rebuilt on plain
+# environments instead of R6 so the package has no hard dependencies).
+#
+# The object is an environment of fields + a NULL handle; construction
+# (binning) happens on first use, and a valid set constructed against a
+# reference shares its bin mappers through the ABI's reference argument
+# (c_api.h LGBM_DatasetCreateFromMat reference parameter).
+
+#' Create a lightgbm_tpu Dataset (not yet constructed/binned).
+#'
+#' @param data numeric matrix (column-major, as R stores it) or a path
+#'   to a text file (CSV/TSV/LibSVM) for the file loader
+#' @param label,weight,init_score numeric vectors, nrow(data) long
+#' @param group integer vector of per-query document counts (ranking)
+#' @param params named list of dataset parameters (max_bin, ...)
+#' @param reference an lgb.Dataset whose bin mappers this set must share
+#'   (validation sets); see lgb.Dataset.create.valid
+#' @param colnames feature names; defaults to colnames(data)
+#' @param categorical_feature names or 1-based indices of categoricals
+#' @param free_raw_data drop the raw matrix after construction
+lgb.Dataset <- function(data, label = NULL, weight = NULL, group = NULL,
+                        init_score = NULL, params = list(),
+                        reference = NULL, colnames = NULL,
+                        categorical_feature = NULL,
+                        free_raw_data = TRUE) {
+  if (!is.null(reference) && !lgb.is.Dataset(reference)) {
+    stop("reference must be an lgb.Dataset")
+  }
+  if (is.matrix(data) && !is.double(data)) storage.mode(data) <- "double"
+  env <- new.env(parent = emptyenv())
+  env$raw_data <- data
+  env$label <- label
+  env$weight <- weight
+  env$group <- group
+  env$init_score <- init_score
+  env$params <- params
+  env$reference <- reference
+  env$colnames <- if (!is.null(colnames)) colnames
+                  else if (is.matrix(data)) base::colnames(data)
+  env$categorical_feature <- categorical_feature
+  env$free_raw_data <- isTRUE(free_raw_data)
+  env$handle <- NULL
+  class(env) <- "lgb.Dataset"
+  env
+}
+
+#' Materialize the Dataset through the C ABI (idempotent).
+lgb.Dataset.construct <- function(dataset) {
+  stopifnot(lgb.is.Dataset(dataset))
+  if (!is.null(dataset$handle)) return(invisible(dataset))
+  lgb.load_lib()
+  params <- lgb.prep.categorical(dataset$params,
+                                 dataset$categorical_feature,
+                                 dataset$colnames)
+  pstr <- lgb.params2str(params)
+  ref_handle <- NULL
+  if (!is.null(dataset$reference)) {
+    lgb.Dataset.construct(dataset$reference)
+    ref_handle <- dataset$reference$handle
+  }
+  if (is.character(dataset$raw_data)) {
+    dataset$handle <- .Call("LGBMR_DatasetCreateFromFile",
+                            dataset$raw_data, pstr, ref_handle)
+  } else {
+    dataset$handle <- .Call("LGBMR_DatasetCreateFromMat",
+                            dataset$raw_data, pstr, ref_handle)
+  }
+  if (!is.null(dataset$label)) {
+    .Call("LGBMR_DatasetSetField", dataset$handle, "label",
+          as.double(dataset$label))
+  }
+  if (!is.null(dataset$weight)) {
+    .Call("LGBMR_DatasetSetField", dataset$handle, "weight",
+          as.double(dataset$weight))
+  }
+  if (!is.null(dataset$group)) {
+    .Call("LGBMR_DatasetSetField", dataset$handle, "group",
+          as.integer(dataset$group))
+  }
+  if (!is.null(dataset$init_score)) {
+    .Call("LGBMR_DatasetSetField", dataset$handle, "init_score",
+          as.double(dataset$init_score))
+  }
+  if (!is.null(dataset$colnames)) {
+    .Call("LGBMR_DatasetSetFeatureNames", dataset$handle,
+          as.character(dataset$colnames))
+  }
+  if (dataset$free_raw_data && !is.character(dataset$raw_data)) {
+    dataset$raw_data <- NULL
+  }
+  invisible(dataset)
+}
+
+#' A validation set binned with the same mappers as `dataset`
+#' (Dataset::CreateValid, the reference's lgb.Dataset.create.valid).
+lgb.Dataset.create.valid <- function(dataset, data, label = NULL,
+                                     weight = NULL, group = NULL,
+                                     init_score = NULL, params = list()) {
+  stopifnot(lgb.is.Dataset(dataset))
+  lgb.Dataset(data, label = label, weight = weight, group = group,
+              init_score = init_score, params = params,
+              reference = dataset)
+}
+
+#' Save the constructed Dataset in the fast binary format.
+lgb.Dataset.save <- function(dataset, fname) {
+  lgb.Dataset.construct(dataset)
+  .Call("LGBMR_DatasetSaveBinary", dataset$handle, fname)
+  invisible(dataset)
+}
+
+#' Update dataset parameters before construction.
+lgb.Dataset.set.reference <- function(dataset, reference) {
+  stopifnot(lgb.is.Dataset(dataset), lgb.is.Dataset(reference))
+  if (!is.null(dataset$handle)) {
+    stop("cannot set reference after the Dataset is constructed")
+  }
+  dataset$reference <- reference
+  invisible(dataset)
+}
+
+dim.lgb.Dataset <- function(x) {
+  if (!is.null(x$handle)) {
+    c(.Call("LGBMR_DatasetGetNumData", x$handle),
+      .Call("LGBMR_DatasetGetNumFeature", x$handle))
+  } else if (is.matrix(x$raw_data)) {
+    dim(x$raw_data)
+  } else {
+    stop("constructed handle or raw matrix required for dim()")
+  }
+}
+
+dimnames.lgb.Dataset <- function(x) list(NULL, x$colnames)
+
+#' getinfo / setinfo mirror the reference's S3 generics.
+getinfo <- function(dataset, ...) UseMethod("getinfo")
+getinfo.lgb.Dataset <- function(dataset, name, ...) {
+  lgb.Dataset.construct(dataset)
+  out <- .Call("LGBMR_DatasetGetField", dataset$handle, name)
+  if (name %in% c("group", "query")) {
+    # the ABI returns cumulative query boundaries; give back counts
+    out <- diff(as.integer(out))
+  }
+  out
+}
+
+setinfo <- function(dataset, ...) UseMethod("setinfo")
+setinfo.lgb.Dataset <- function(dataset, name, info, ...) {
+  if (is.null(dataset$handle)) {
+    # pre-construction: stash so construct() applies it
+    slot <- c(label = "label", weight = "weight", group = "group",
+              init_score = "init_score")[[name]]
+    assign(slot, info, envir = dataset)
+  } else if (name %in% c("group", "query")) {
+    .Call("LGBMR_DatasetSetField", dataset$handle, "group",
+          as.integer(info))
+  } else {
+    .Call("LGBMR_DatasetSetField", dataset$handle, name, as.double(info))
+  }
+  invisible(dataset)
+}

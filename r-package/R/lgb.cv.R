@@ -1,0 +1,126 @@
+# lgb.cv: k-fold cross-validation (reference R-package/R/lgb.cv.R),
+# training one booster per fold and aggregating per-iteration metric
+# mean/sd across folds.
+
+#' Stratified or plain fold assignment, or caller-provided folds
+#' (list of test-index vectors).
+lgb.make.folds <- function(label, nfold, stratified, seed) {
+  set.seed(seed)
+  n <- length(label)
+  if (stratified && length(unique(label)) <= max(32L, nfold)) {
+    # per-class round-robin like the reference/sklearn stratified KFold
+    fold_of <- integer(n)
+    for (cls in unique(label)) {
+      idx <- sample(which(label == cls))
+      fold_of[idx] <- rep_len(seq_len(nfold), length(idx))
+    }
+  } else {
+    fold_of <- rep_len(seq_len(nfold), n)[sample.int(n)]
+  }
+  lapply(seq_len(nfold), function(k) which(fold_of == k))
+}
+
+#' Cross validation.
+#' @return list(record_evals = per-iteration mean/sd per metric,
+#'   best_iter, boosters = the per-fold lgb.Booster list)
+lgb.cv <- function(params = list(), data, nrounds = 100L, nfold = 3L,
+                   label = NULL, folds = NULL, stratified = TRUE,
+                   obj = NULL, eval = NULL, verbose = 1L,
+                   eval_freq = 1L, early_stopping_rounds = NULL,
+                   seed = 0L, ...) {
+  if (!lgb.is.Dataset(data)) {
+    data <- lgb.Dataset(data, label = label)
+  }
+  if (is.null(data$raw_data) || is.character(data$raw_data)) {
+    stop("lgb.cv needs an unconstructed matrix-backed Dataset ",
+         "(folds re-bin per training split)")
+  }
+  X <- data$raw_data
+  y <- data$label
+  group <- data$group
+  if (!is.null(group) && !is.null(folds)) {
+    stop("grouped (ranking) data folds by query internally; ",
+         "caller-provided row folds would split queries — drop `folds`")
+  }
+  if (is.null(folds) && is.null(group)) {
+    folds <- lgb.make.folds(y, nfold, stratified, seed)
+  }
+
+  test_groups <- train_groups <- NULL
+  if (!is.null(group)) {
+    # ranking data folds by QUERY (splitting inside a query corrupts
+    # the list structure — the reference group-folds the same way):
+    # fold assignment is over queries, row indices derive from the
+    # per-query boundaries
+    nq <- length(group)
+    set.seed(seed)
+    qfold <- rep_len(seq_len(nfold), nq)[sample.int(nq)]
+    bounds <- c(0L, cumsum(group))
+    rows_of_query <- lapply(seq_len(nq),
+                            function(qi) (bounds[qi] + 1L):bounds[qi + 1L])
+    folds <- lapply(seq_len(nfold), function(k) {
+      unlist(rows_of_query[qfold == k], use.names = FALSE)
+    })
+    test_groups <- lapply(seq_len(nfold), function(k) group[qfold == k])
+    train_groups <- lapply(seq_len(nfold), function(k) group[qfold != k])
+  }
+
+  boosters <- list()
+  per_iter <- list()   # [[iter]][[metric]] -> numeric vector over folds
+  for (k in seq_along(folds)) {
+    test_idx <- folds[[k]]
+    dtrain <- lgb.Dataset(X[-test_idx, , drop = FALSE], label = y[-test_idx],
+                          weight = if (!is.null(data$weight))
+                            data$weight[-test_idx],
+                          init_score = if (!is.null(data$init_score))
+                            data$init_score[-test_idx],
+                          group = if (!is.null(group)) train_groups[[k]],
+                          params = data$params,
+                          categorical_feature = data$categorical_feature)
+    dtest <- lgb.Dataset.create.valid(
+      dtrain, X[test_idx, , drop = FALSE], label = y[test_idx],
+      weight = if (!is.null(data$weight)) data$weight[test_idx],
+      init_score = if (!is.null(data$init_score))
+        data$init_score[test_idx],
+      group = if (!is.null(group)) test_groups[[k]])
+    bst <- lgb.train(params = params, data = dtrain, nrounds = nrounds,
+                     valids = list(test = dtest), obj = obj, eval = eval,
+                     verbose = 0L, record = TRUE, eval_freq = eval_freq,
+                     early_stopping_rounds = early_stopping_rounds, ...)
+    boosters[[k]] <- bst
+    for (m in names(bst$record_evals[["test"]])) {
+      vals <- unlist(bst$record_evals[["test"]][[m]]$eval)
+      for (i in seq_along(vals)) {
+        key <- sprintf("%d", i)
+        if (is.null(per_iter[[key]])) per_iter[[key]] <- list()
+        per_iter[[key]][[m]] <- c(per_iter[[key]][[m]], vals[i])
+      }
+    }
+  }
+
+  record <- list()
+  niter <- length(per_iter)
+  metrics <- if (niter > 0L) names(per_iter[["1"]]) else character(0)
+  for (m in metrics) {
+    means <- vapply(seq_len(niter),
+                    function(i) mean(per_iter[[sprintf("%d", i)]][[m]]),
+                    numeric(1))
+    sds <- vapply(seq_len(niter),
+                  function(i) stats::sd(per_iter[[sprintf("%d", i)]][[m]]),
+                  numeric(1))
+    record[[paste0("test.", m, ".mean")]] <- means
+    record[[paste0("test.", m, ".sd")]] <- sds
+    if (verbose > 0L) {
+      cat(sprintf("[cv] %s final: %g+%g\n", m, means[niter], sds[niter]))
+    }
+  }
+  best_iter <- -1L
+  if (length(metrics) > 0L) {
+    m1 <- metrics[[1L]]
+    means <- record[[paste0("test.", m1, ".mean")]]
+    best_iter <- if (lgb.metric.higher_better(m1)) which.max(means)
+                 else which.min(means)
+  }
+  list(record_evals = record, best_iter = as.integer(best_iter),
+       boosters = boosters)
+}

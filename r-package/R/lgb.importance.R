@@ -1,0 +1,67 @@
+# Feature importance + per-prediction interpretation (the reference's
+# lgb.importance.R / lgb.interprete.R / lgb.plot.importance.R trio).
+
+#' Feature importance from the trained model.
+#' @param percentage normalize Gain/Cover/Frequency to fractions
+#' @return data.frame(Feature, Gain, Frequency) sorted by Gain
+lgb.importance <- function(model, percentage = TRUE) {
+  stopifnot(lgb.is.Booster(model))
+  names_ <- .Call("LGBMR_BoosterGetFeatureNames", model$handle)
+  split_ <- .Call("LGBMR_BoosterFeatureImportance", model$handle, -1L,
+                  0L)  # C_API_FEATURE_IMPORTANCE_SPLIT
+  gain_ <- .Call("LGBMR_BoosterFeatureImportance", model$handle, -1L,
+                 1L)   # C_API_FEATURE_IMPORTANCE_GAIN
+  if (percentage) {
+    if (sum(gain_) > 0) gain_ <- gain_ / sum(gain_)
+    if (sum(split_) > 0) split_ <- split_ / sum(split_)
+  }
+  out <- data.frame(Feature = names_, Gain = gain_, Frequency = split_,
+                    stringsAsFactors = FALSE)
+  out[order(-out$Gain), , drop = FALSE]
+}
+
+#' Per-prediction feature contributions for chosen rows, via TreeSHAP
+#' (predcontrib) — same additive-contribution semantics as the
+#' reference's lgb.interprete tree walk, computed by the device SHAP
+#' path instead.
+#' @param idxset 1-based row indices of `data` to explain
+#' @return list of data.frame(Feature, Contribution), one per index,
+#'   sorted by |Contribution|; the "BIAS" row is the expected value
+lgb.interprete <- function(model, data, idxset) {
+  stopifnot(lgb.is.Booster(model))
+  if (!is.matrix(data)) data <- as.matrix(data)
+  rows <- data[idxset, , drop = FALSE]
+  contrib <- predict(model, rows, predcontrib = TRUE, reshape = TRUE)
+  if (is.null(dim(contrib))) contrib <- matrix(contrib, nrow = 1L)
+  names_ <- c(.Call("LGBMR_BoosterGetFeatureNames", model$handle), "BIAS")
+  lapply(seq_along(idxset), function(i) {
+    row <- contrib[i, ]
+    # multiclass: contributions come back (F+1) per class; fold classes
+    if (length(row) > length(names_)) {
+      row <- rowSums(matrix(row, nrow = length(names_)))
+    }
+    df <- data.frame(Feature = names_, Contribution = row,
+                     stringsAsFactors = FALSE)
+    df[order(-abs(df$Contribution)), , drop = FALSE]
+  })
+}
+
+#' Barplot of lgb.importance output (base graphics; the reference uses
+#' ggplot-free base plotting here too).
+lgb.plot.importance <- function(tree_imp, top_n = 10L,
+                                measure = "Gain", ...) {
+  top <- utils::head(tree_imp[order(-tree_imp[[measure]]), ], top_n)
+  graphics::barplot(rev(top[[measure]]), names.arg = rev(top$Feature),
+                    horiz = TRUE, las = 1,
+                    main = paste("Feature importance by", measure), ...)
+  invisible(top)
+}
+
+#' Barplot of one lgb.interprete record.
+lgb.plot.interpretation <- function(tree_interpretation, top_n = 10L, ...) {
+  top <- utils::head(tree_interpretation, top_n)
+  graphics::barplot(rev(top$Contribution), names.arg = rev(top$Feature),
+                    horiz = TRUE, las = 1,
+                    main = "Feature contribution", ...)
+  invisible(top)
+}

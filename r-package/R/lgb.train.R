@@ -1,0 +1,97 @@
+# lgb.train: the main R training entry (reference R-package/R/lgb.train.R),
+# driving the Booster iteration loop with valids, metric recording,
+# callbacks and early stopping.
+
+#' Train a gbdt model.
+#'
+#' @param params named list of parameters (see docs/Parameters.md)
+#' @param data an lgb.Dataset
+#' @param nrounds boosting iterations
+#' @param valids named list of lgb.Dataset validation sets
+#' @param obj custom objective function(preds, dataset) ->
+#'   list(grad, hess); NULL uses params$objective
+#' @param eval custom metric function(preds, dataset) ->
+#'   list(name, value, higher_better)
+#' @param verbose <= 0 silences the per-eval_freq metric printing
+#' @param record keep eval results on booster$record_evals
+#' @param eval_freq evaluate every this many iterations
+#' @param init_model path or lgb.Booster to continue training from
+#' @param early_stopping_rounds stop when the first valid metric has
+#'   not improved this many rounds
+#' @param callbacks extra function(env) callbacks
+#' @return an lgb.Booster
+lgb.train <- function(params = list(), data, nrounds = 100L,
+                      valids = list(), obj = NULL, eval = NULL,
+                      verbose = 1L, record = TRUE, eval_freq = 1L,
+                      init_model = NULL, early_stopping_rounds = NULL,
+                      callbacks = list(), ...) {
+  stopifnot(lgb.is.Dataset(data))
+  extra <- list(...)
+  params <- utils::modifyList(params, extra)
+  if (!is.null(obj)) params$objective <- "none"
+
+  lgb.Dataset.construct(data)
+  booster <- Booster(params = params, train_set = data)
+  if (!is.null(init_model)) {
+    # continued training: merge the warm model's trees into the fresh
+    # booster (LGBM_BoosterMerge rebuilds train/valid scores, so the
+    # following updates boost on top of the warm ensemble)
+    warm <- if (lgb.is.Booster(init_model)) init_model
+            else Booster(modelfile = init_model)
+    .Call("LGBMR_BoosterMerge", booster$handle, warm$handle)
+  }
+  for (nm in names(valids)) {
+    lgb.Booster.add_valid(booster, valids[[nm]], nm)
+  }
+
+  cbs <- c(callbacks, list(if (record) cb.record.evaluation()),
+           list(if (verbose > 0L) cb.print.evaluation(eval_freq)),
+           list(if (!is.null(early_stopping_rounds) &&
+                    length(valids) > 0L)
+                  cb.early.stop(early_stopping_rounds,
+                                verbose = verbose > 0L)))
+  cbs <- Filter(Negate(is.null), cbs)
+  # pre-iteration callbacks (parameter schedules) run before EVERY
+  # update; the rest run after evaluation on eval_freq boundaries
+  pre_cbs <- Filter(function(cb) isTRUE(attr(cb, "is_pre_iteration")), cbs)
+  post_cbs <- Filter(function(cb) !isTRUE(attr(cb, "is_pre_iteration")),
+                     cbs)
+
+  env <- new.env()
+  env$booster <- booster
+  env$begin_iteration <- 1L
+  env$end_iteration <- as.integer(nrounds)
+  env$met_early_stop <- FALSE
+  for (i in seq_len(nrounds)) {
+    env$iteration <- i
+    for (cb in pre_cbs) cb(env)
+    lgb.Booster.update(booster, fobj = obj)
+    if ((i %% eval_freq) == 0L || i == nrounds) {
+      env$eval_list <- lgb.Booster.eval(booster, feval = eval)
+      for (cb in post_cbs) cb(env)
+      if (isTRUE(env$met_early_stop)) break
+    }
+  }
+  if (booster$best_iter > 0L) {
+    # roll the model back so predict() uses the best iteration
+    while (lgb.Booster.current_iter(booster) > booster$best_iter) {
+      lgb.Booster.rollback_one_iter(booster)
+    }
+  }
+  booster
+}
+
+#' The simple one-call interface (reference R-package/R/lightgbm.R):
+#' data/label in, trained booster out.
+lightgbm <- function(data, label = NULL, weight = NULL,
+                     params = list(), nrounds = 100L, verbose = 1L,
+                     objective = "regression", ...) {
+  if (!lgb.is.Dataset(data)) {
+    data <- lgb.Dataset(data, label = label, weight = weight)
+  }
+  params$objective <- params$objective %||% objective
+  lgb.train(params = params, data = data, nrounds = nrounds,
+            verbose = verbose, ...)
+}
+
+`%||%` <- function(a, b) if (is.null(a)) b else a

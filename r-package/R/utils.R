@@ -1,0 +1,72 @@
+# Internal helpers shared across the package (the lgb.params2str /
+# lgb.check.params role of the reference's R-package/R/utils.R, written
+# for this package's .Call glue).
+
+.lgb_env <- new.env(parent = emptyenv())
+.lgb_env$loaded <- FALSE
+
+#' Load the native libraries (the C ABI .so + the .Call glue).
+#' Called lazily by every entry point; safe to call repeatedly.
+lgb.load_lib <- function(lib_dir = NULL, glue_so = NULL) {
+  if (isTRUE(.lgb_env$loaded)) return(invisible(TRUE))
+  if (is.null(lib_dir)) {
+    lib_dir <- Sys.getenv("LIGHTGBM_TPU_LIB",
+                          file.path(dirname(getwd()), "native"))
+  }
+  dyn.load(file.path(lib_dir, "liblightgbm_tpu.so"), local = FALSE)
+  if (is.null(glue_so)) {
+    glue_so <- file.path("src", "lightgbm_tpu_R.so")
+    if (!file.exists(glue_so)) {
+      glue_so <- system.file("libs", "lightgbm_tpu_R.so",
+                             package = "lightgbmtpu")
+    }
+  }
+  dyn.load(glue_so)
+  .lgb_env$loaded <- TRUE
+  invisible(TRUE)
+}
+
+#' list(k = v) -> "k=v k2=v2,v3" parameter string for the C ABI
+#' (Config::Str2Map splits on spaces/newlines; vector values join with
+#' commas like the reference's lgb.params2str).
+lgb.params2str <- function(params) {
+  if (length(params) == 0L) return("")
+  stopifnot(is.list(params))
+  keys <- names(params)
+  if (is.null(keys) || any(!nzchar(keys))) {
+    stop("every parameter must be named")
+  }
+  one <- function(k) {
+    v <- params[[k]]
+    if (is.logical(v)) v <- tolower(as.character(v))
+    paste0(k, "=", paste(v, collapse = ","))
+  }
+  paste(vapply(keys, one, character(1)), collapse = " ")
+}
+
+#' Merge categorical_feature (1-based names or indices) into params as
+#' the 0-based categorical_feature list the config layer expects.
+lgb.prep.categorical <- function(params, categorical_feature, colnames) {
+  if (is.null(categorical_feature) || length(categorical_feature) == 0L) {
+    return(params)
+  }
+  if (is.character(categorical_feature)) {
+    idx <- match(categorical_feature, colnames)
+    if (anyNA(idx)) {
+      stop("categorical_feature names not in colnames: ",
+           paste(categorical_feature[is.na(idx)], collapse = ", "))
+    }
+  } else {
+    idx <- as.integer(categorical_feature)
+  }
+  params[["categorical_feature"]] <- paste(idx - 1L, collapse = ",")
+  params
+}
+
+lgb.is.Dataset <- function(x) inherits(x, "lgb.Dataset")
+lgb.is.Booster <- function(x) inherits(x, "lgb.Booster")
+
+#' Higher-is-better flag per metric name (metric.hpp max_metric lists)
+lgb.metric.higher_better <- function(name) {
+  grepl("^(auc|ndcg|map)", name)
+}

@@ -1,39 +1,90 @@
 /* .Call glue over the LGBM_* C ABI exported by
  * native/liblightgbm_tpu.so — the same thin argument-shuffle role as
- * the reference's R-package/src/lightgbm_R.cpp (1-625), written
- * against this framework's trampoline.  Build with:
+ * the reference's R-package/src/lightgbm_R.cpp (1-625), written from
+ * scratch against this framework's trampoline ABI (the extern
+ * signatures below are structurally checked against
+ * lightgbm_tpu/capi_abi.py by tests/test_r_package.py).
+ *
+ * Build with:
  *   R CMD SHLIB lightgbm_tpu_R.c -L../../native -llightgbm_tpu
  * (needs an R toolchain; see ../README.md for the validation story).
+ *
+ * Conventions: handles ride R external pointers with finalizers;
+ * R matrices are column-major doubles (is_row_major = 0, float64
+ * data_type = 1); label/weight fields convert to float32 (type 0),
+ * init_score stays float64 (type 1), group converts to int32 (type 2)
+ * — the reference R glue makes the same conversions.
  */
 #include <R.h>
 #include <Rinternals.h>
+#include <R_ext/Rdynload.h>
 #include <stdint.h>
 #include <string.h>
 
 typedef void *DatasetHandle;
 typedef void *BoosterHandle;
 
+/* ---- extern ABI (subset used by the R package) ---------------------- */
 extern const char *LGBM_GetLastError(void);
 extern int LGBM_DatasetCreateFromMat(const void *, int, int32_t, int32_t,
                                      int, const char *, const DatasetHandle,
                                      DatasetHandle *);
+extern int LGBM_DatasetCreateFromFile(const char *, const char *,
+                                      const DatasetHandle, DatasetHandle *);
+extern int LGBM_DatasetGetNumData(DatasetHandle, int32_t *);
+extern int LGBM_DatasetGetNumFeature(DatasetHandle, int32_t *);
 extern int LGBM_DatasetSetField(DatasetHandle, const char *, const void *,
                                 int32_t, int);
+extern int LGBM_DatasetGetField(DatasetHandle, const char *, int32_t *,
+                                const void **, int32_t *);
+extern int LGBM_DatasetSaveBinary(DatasetHandle, const char *);
+extern int LGBM_DatasetSetFeatureNames(DatasetHandle, const char **, int);
+extern int LGBM_DatasetGetFeatureNames(DatasetHandle, char **, int32_t *);
+extern int LGBM_DatasetUpdateParam(DatasetHandle, const char *);
 extern int LGBM_DatasetFree(DatasetHandle);
 extern int LGBM_BoosterCreate(const DatasetHandle, const char *,
                               BoosterHandle *);
-extern int LGBM_BoosterUpdateOneIter(BoosterHandle, int *);
+extern int LGBM_BoosterCreateFromModelfile(const char *, int32_t *,
+                                           BoosterHandle *);
+extern int LGBM_BoosterLoadModelFromString(const char *, int32_t *,
+                                           BoosterHandle *);
+extern int LGBM_BoosterAddValidData(BoosterHandle, const DatasetHandle);
+extern int LGBM_BoosterUpdateOneIter(BoosterHandle, int32_t *);
+extern int LGBM_BoosterUpdateOneIterCustom(BoosterHandle, const float *,
+                                           const float *, int32_t *);
+extern int LGBM_BoosterRollbackOneIter(BoosterHandle);
+extern int LGBM_BoosterGetCurrentIteration(BoosterHandle, int32_t *);
+extern int LGBM_BoosterGetNumClasses(BoosterHandle, int32_t *);
+extern int LGBM_BoosterGetNumFeature(BoosterHandle, int32_t *);
+extern int LGBM_BoosterGetEvalCounts(BoosterHandle, int32_t *);
+extern int LGBM_BoosterGetEvalNames(BoosterHandle, int32_t *, char **);
+extern int LGBM_BoosterGetFeatureNames(BoosterHandle, int32_t *, char **);
+extern int LGBM_BoosterGetEval(BoosterHandle, int, int32_t *, double *);
+extern int LGBM_BoosterGetNumPredict(BoosterHandle, int, int64_t *);
+extern int LGBM_BoosterGetPredict(BoosterHandle, int, int64_t *, double *);
+extern int LGBM_BoosterCalcNumPredict(BoosterHandle, int, int, int,
+                                      int64_t *);
 extern int LGBM_BoosterPredictForMat(BoosterHandle, const void *, int,
                                      int32_t, int32_t, int, int, int,
                                      const char *, int64_t *, double *);
 extern int LGBM_BoosterSaveModel(BoosterHandle, int, int, const char *);
-extern int LGBM_BoosterCreateFromModelfile(const char *, int *,
-                                           BoosterHandle *);
+extern int LGBM_BoosterSaveModelToString(BoosterHandle, int, int, int64_t,
+                                         int64_t *, char *);
+extern int LGBM_BoosterDumpModel(BoosterHandle, int, int, int64_t,
+                                 int64_t *, char *);
+extern int LGBM_BoosterFeatureImportance(BoosterHandle, int, int, double *);
+extern int LGBM_BoosterResetParameter(BoosterHandle, const char *);
+extern int LGBM_BoosterMerge(BoosterHandle, BoosterHandle);
 extern int LGBM_BoosterFree(BoosterHandle);
 
 #define CHECK_CALL(x) \
   if ((x) != 0) Rf_error("lightgbm_tpu: %s", LGBM_GetLastError())
 
+/* per-name buffer size: the v2 char** ABI carries no length, 256 bytes
+ * per name is the documented limit (reference basic.py uses 255) */
+#define NAME_LEN 256
+
+/* ---- handle plumbing ------------------------------------------------ */
 static void dataset_finalizer(SEXP ext) {
   DatasetHandle h = R_ExternalPtrAddr(ext);
   if (h != NULL) { LGBM_DatasetFree(h); R_ClearExternalPtr(ext); }
@@ -44,67 +95,394 @@ static void booster_finalizer(SEXP ext) {
   if (h != NULL) { LGBM_BoosterFree(h); R_ClearExternalPtr(ext); }
 }
 
-SEXP LGBMR_DatasetCreateFromMat(SEXP mat, SEXP nrow, SEXP ncol,
-                                SEXP params, SEXP label) {
-  DatasetHandle h = NULL;
-  int nr = Rf_asInteger(nrow), nc = Rf_asInteger(ncol);
-  /* R matrices are column-major: is_row_major = 0 */
-  CHECK_CALL(LGBM_DatasetCreateFromMat(REAL(mat), /*float64*/ 1, nr, nc, 0,
-                                       CHAR(Rf_asChar(params)), NULL, &h));
-  if (!Rf_isNull(label)) {
-    int n = Rf_length(label);
-    float *buf = (float *)R_alloc(n, sizeof(float));
-    double *src = REAL(label);
-    for (int i = 0; i < n; i++) buf[i] = (float)src[i];
-    CHECK_CALL(LGBM_DatasetSetField(h, "label", buf, n, /*float32*/ 0));
-  }
+static SEXP wrap_dataset(DatasetHandle h) {
   SEXP ext = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
   R_RegisterCFinalizerEx(ext, dataset_finalizer, TRUE);
   UNPROTECT(1);
   return ext;
 }
 
-SEXP LGBMR_BoosterCreate(SEXP ds, SEXP params) {
-  BoosterHandle h = NULL;
-  CHECK_CALL(LGBM_BoosterCreate(R_ExternalPtrAddr(ds),
-                                CHAR(Rf_asChar(params)), &h));
+static SEXP wrap_booster(BoosterHandle h) {
   SEXP ext = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
   R_RegisterCFinalizerEx(ext, booster_finalizer, TRUE);
   UNPROTECT(1);
   return ext;
 }
 
-SEXP LGBMR_BoosterUpdateOneIter(SEXP bst) {
-  int finished = 0;
-  CHECK_CALL(LGBM_BoosterUpdateOneIter(R_ExternalPtrAddr(bst), &finished));
-  return Rf_ScalarLogical(finished);
+static void *checked_ptr(SEXP ext) {
+  void *h = R_ExternalPtrAddr(ext);
+  if (h == NULL) Rf_error("lightgbm_tpu: handle is NULL (already freed?)");
+  return h;
 }
 
-SEXP LGBMR_BoosterPredictForMat(SEXP bst, SEXP mat, SEXP nrow, SEXP ncol) {
-  int nr = Rf_asInteger(nrow), nc = Rf_asInteger(ncol);
-  SEXP out = PROTECT(Rf_allocVector(REALSXP, nr));
-  int64_t out_len = 0;
-  CHECK_CALL(LGBM_BoosterPredictForMat(
-      R_ExternalPtrAddr(bst), REAL(mat), 1, nr, nc, 0,
-      /*normal*/ 0, /*all iters*/ -1, "", &out_len, REAL(out)));
-  if (out_len != nr) Rf_error("prediction length mismatch");
+/* names buffer for the unsized char** convention of the v2 ABI — the
+ * slot count MUST come from the matching count query (GetNumFeature /
+ * GetEvalCounts) or the callee writes past the array */
+static char **alloc_name_array(int n) {
+  if (n <= 0) n = 1;
+  char **arr = (char **)R_alloc(n, sizeof(char *));
+  char *blob = (char *)R_alloc((size_t)n * NAME_LEN, 1);
+  for (int i = 0; i < n; i++) arr[i] = blob + (size_t)i * NAME_LEN;
+  return arr;
+}
+
+static SEXP names_to_charvec(char **arr, int n) {
+  SEXP out = PROTECT(Rf_allocVector(STRSXP, n));
+  for (int i = 0; i < n; i++) SET_STRING_ELT(out, i, Rf_mkChar(arr[i]));
   UNPROTECT(1);
   return out;
 }
 
-SEXP LGBMR_BoosterSaveModel(SEXP bst, SEXP filename) {
-  CHECK_CALL(LGBM_BoosterSaveModel(R_ExternalPtrAddr(bst), 0, -1,
-                                   CHAR(Rf_asChar(filename))));
+/* ---- Dataset -------------------------------------------------------- */
+SEXP LGBMR_DatasetCreateFromMat(SEXP mat, SEXP params, SEXP ref) {
+  DatasetHandle h = NULL;
+  SEXP dims = Rf_getAttrib(mat, R_DimSymbol);
+  if (Rf_isNull(dims) || Rf_length(dims) != 2)
+    Rf_error("lightgbm_tpu: data must be a numeric matrix");
+  int nr = INTEGER(dims)[0], nc = INTEGER(dims)[1];
+  DatasetHandle refh = Rf_isNull(ref) ? NULL : checked_ptr(ref);
+  /* R matrices are column-major: is_row_major = 0, float64 = 1 */
+  CHECK_CALL(LGBM_DatasetCreateFromMat(REAL(mat), 1, nr, nc, 0,
+                                       CHAR(Rf_asChar(params)), refh, &h));
+  return wrap_dataset(h);
+}
+
+SEXP LGBMR_DatasetCreateFromFile(SEXP filename, SEXP params, SEXP ref) {
+  DatasetHandle h = NULL;
+  DatasetHandle refh = Rf_isNull(ref) ? NULL : checked_ptr(ref);
+  CHECK_CALL(LGBM_DatasetCreateFromFile(CHAR(Rf_asChar(filename)),
+                                        CHAR(Rf_asChar(params)), refh, &h));
+  return wrap_dataset(h);
+}
+
+SEXP LGBMR_DatasetGetNumData(SEXP ds) {
+  int32_t n = 0;
+  CHECK_CALL(LGBM_DatasetGetNumData(checked_ptr(ds), &n));
+  return Rf_ScalarInteger(n);
+}
+
+SEXP LGBMR_DatasetGetNumFeature(SEXP ds) {
+  int32_t n = 0;
+  CHECK_CALL(LGBM_DatasetGetNumFeature(checked_ptr(ds), &n));
+  return Rf_ScalarInteger(n);
+}
+
+SEXP LGBMR_DatasetSetField(SEXP ds, SEXP name, SEXP vec) {
+  const char *field = CHAR(Rf_asChar(name));
+  int n = Rf_length(vec);
+  DatasetHandle h = checked_ptr(ds);
+  if (strcmp(field, "group") == 0 || strcmp(field, "query") == 0) {
+    int32_t *buf = (int32_t *)R_alloc(n, sizeof(int32_t));
+    if (TYPEOF(vec) == INTSXP) {
+      memcpy(buf, INTEGER(vec), (size_t)n * sizeof(int32_t));
+    } else {
+      double *src = REAL(vec);
+      for (int i = 0; i < n; i++) buf[i] = (int32_t)src[i];
+    }
+    CHECK_CALL(LGBM_DatasetSetField(h, field, buf, n, /*int32*/ 2));
+  } else if (strcmp(field, "init_score") == 0) {
+    /* init_score is the one float64 field (metadata.cpp SetInitScore) */
+    SEXP dvec = PROTECT(Rf_coerceVector(vec, REALSXP));
+    CHECK_CALL(LGBM_DatasetSetField(h, field, REAL(dvec), n, /*f64*/ 1));
+    UNPROTECT(1);
+  } else {
+    float *buf = (float *)R_alloc(n, sizeof(float));
+    SEXP dvec = PROTECT(Rf_coerceVector(vec, REALSXP));
+    double *src = REAL(dvec);
+    for (int i = 0; i < n; i++) buf[i] = (float)src[i];
+    CHECK_CALL(LGBM_DatasetSetField(h, field, buf, n, /*f32*/ 0));
+    UNPROTECT(1);
+  }
   return R_NilValue;
+}
+
+SEXP LGBMR_DatasetGetField(SEXP ds, SEXP name) {
+  const char *field = CHAR(Rf_asChar(name));
+  int32_t out_len = 0, out_type = 0;
+  const void *ptr = NULL;
+  CHECK_CALL(LGBM_DatasetGetField(checked_ptr(ds), field, &out_len, &ptr,
+                                  &out_type));
+  if (out_len <= 0 || ptr == NULL) return Rf_allocVector(REALSXP, 0);
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, out_len));
+  double *dst = REAL(out);
+  if (out_type == 0) {          /* float32 */
+    const float *src = (const float *)ptr;
+    for (int i = 0; i < out_len; i++) dst[i] = (double)src[i];
+  } else if (out_type == 1) {   /* float64 */
+    memcpy(dst, ptr, (size_t)out_len * sizeof(double));
+  } else {                      /* int32 */
+    const int32_t *src = (const int32_t *)ptr;
+    for (int i = 0; i < out_len; i++) dst[i] = (double)src[i];
+  }
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMR_DatasetSaveBinary(SEXP ds, SEXP filename) {
+  CHECK_CALL(LGBM_DatasetSaveBinary(checked_ptr(ds),
+                                    CHAR(Rf_asChar(filename))));
+  return R_NilValue;
+}
+
+SEXP LGBMR_DatasetSetFeatureNames(SEXP ds, SEXP names) {
+  int n = Rf_length(names);
+  const char **arr = (const char **)R_alloc(n, sizeof(char *));
+  for (int i = 0; i < n; i++) arr[i] = CHAR(STRING_ELT(names, i));
+  CHECK_CALL(LGBM_DatasetSetFeatureNames(checked_ptr(ds), arr, n));
+  return R_NilValue;
+}
+
+SEXP LGBMR_DatasetGetFeatureNames(SEXP ds) {
+  DatasetHandle h = checked_ptr(ds);
+  int32_t nf = 0;
+  CHECK_CALL(LGBM_DatasetGetNumFeature(h, &nf));
+  char **arr = alloc_name_array(nf);
+  int32_t n = 0;
+  CHECK_CALL(LGBM_DatasetGetFeatureNames(h, arr, &n));
+  if (n > nf) Rf_error("lightgbm_tpu: feature-name count grew mid-call");
+  return names_to_charvec(arr, n);
+}
+
+SEXP LGBMR_DatasetUpdateParam(SEXP ds, SEXP params) {
+  CHECK_CALL(LGBM_DatasetUpdateParam(checked_ptr(ds),
+                                     CHAR(Rf_asChar(params))));
+  return R_NilValue;
+}
+
+/* ---- Booster -------------------------------------------------------- */
+SEXP LGBMR_BoosterCreate(SEXP ds, SEXP params) {
+  BoosterHandle h = NULL;
+  CHECK_CALL(LGBM_BoosterCreate(checked_ptr(ds), CHAR(Rf_asChar(params)),
+                                &h));
+  return wrap_booster(h);
 }
 
 SEXP LGBMR_BoosterCreateFromModelfile(SEXP filename) {
   BoosterHandle h = NULL;
-  int iters = 0;
+  int32_t iters = 0;
   CHECK_CALL(LGBM_BoosterCreateFromModelfile(CHAR(Rf_asChar(filename)),
                                              &iters, &h));
-  SEXP ext = PROTECT(R_MakeExternalPtr(h, R_NilValue, R_NilValue));
-  R_RegisterCFinalizerEx(ext, booster_finalizer, TRUE);
+  return wrap_booster(h);
+}
+
+SEXP LGBMR_BoosterLoadModelFromString(SEXP model_str) {
+  BoosterHandle h = NULL;
+  int32_t iters = 0;
+  CHECK_CALL(LGBM_BoosterLoadModelFromString(CHAR(Rf_asChar(model_str)),
+                                             &iters, &h));
+  return wrap_booster(h);
+}
+
+SEXP LGBMR_BoosterAddValidData(SEXP bst, SEXP ds) {
+  CHECK_CALL(LGBM_BoosterAddValidData(checked_ptr(bst), checked_ptr(ds)));
+  return R_NilValue;
+}
+
+SEXP LGBMR_BoosterUpdateOneIter(SEXP bst) {
+  int32_t finished = 0;
+  CHECK_CALL(LGBM_BoosterUpdateOneIter(checked_ptr(bst), &finished));
+  return Rf_ScalarLogical(finished);
+}
+
+SEXP LGBMR_BoosterUpdateOneIterCustom(SEXP bst, SEXP grad, SEXP hess) {
+  int n = Rf_length(grad);
+  if (Rf_length(hess) != n)
+    Rf_error("lightgbm_tpu: grad/hess length mismatch");
+  float *g = (float *)R_alloc(n, sizeof(float));
+  float *hs = (float *)R_alloc(n, sizeof(float));
+  double *gs = REAL(grad), *hsrc = REAL(hess);
+  for (int i = 0; i < n; i++) { g[i] = (float)gs[i]; hs[i] = (float)hsrc[i]; }
+  int32_t finished = 0;
+  CHECK_CALL(LGBM_BoosterUpdateOneIterCustom(checked_ptr(bst), g, hs,
+                                             &finished));
+  return Rf_ScalarLogical(finished);
+}
+
+SEXP LGBMR_BoosterRollbackOneIter(SEXP bst) {
+  CHECK_CALL(LGBM_BoosterRollbackOneIter(checked_ptr(bst)));
+  return R_NilValue;
+}
+
+SEXP LGBMR_BoosterGetCurrentIteration(SEXP bst) {
+  int32_t it = 0;
+  CHECK_CALL(LGBM_BoosterGetCurrentIteration(checked_ptr(bst), &it));
+  return Rf_ScalarInteger(it);
+}
+
+SEXP LGBMR_BoosterGetNumClasses(SEXP bst) {
+  int32_t n = 0;
+  CHECK_CALL(LGBM_BoosterGetNumClasses(checked_ptr(bst), &n));
+  return Rf_ScalarInteger(n);
+}
+
+SEXP LGBMR_BoosterGetNumFeature(SEXP bst) {
+  int32_t n = 0;
+  CHECK_CALL(LGBM_BoosterGetNumFeature(checked_ptr(bst), &n));
+  return Rf_ScalarInteger(n);
+}
+
+SEXP LGBMR_BoosterGetEvalNames(SEXP bst) {
+  BoosterHandle h = checked_ptr(bst);
+  int32_t cnt = 0;
+  CHECK_CALL(LGBM_BoosterGetEvalCounts(h, &cnt));
+  char **arr = alloc_name_array(cnt);
+  int32_t n = 0;
+  CHECK_CALL(LGBM_BoosterGetEvalNames(h, &n, arr));
+  if (n > cnt) Rf_error("lightgbm_tpu: eval-name count grew mid-call");
+  return names_to_charvec(arr, n);
+}
+
+SEXP LGBMR_BoosterGetFeatureNames(SEXP bst) {
+  BoosterHandle h = checked_ptr(bst);
+  int32_t nf = 0;
+  CHECK_CALL(LGBM_BoosterGetNumFeature(h, &nf));
+  char **arr = alloc_name_array(nf);
+  int32_t n = 0;
+  CHECK_CALL(LGBM_BoosterGetFeatureNames(h, &n, arr));
+  if (n > nf) Rf_error("lightgbm_tpu: feature-name count grew mid-call");
+  return names_to_charvec(arr, n);
+}
+
+SEXP LGBMR_BoosterGetEval(SEXP bst, SEXP data_idx) {
+  int32_t cnt = 0;
+  BoosterHandle h = checked_ptr(bst);
+  CHECK_CALL(LGBM_BoosterGetEvalCounts(h, &cnt));
+  if (cnt <= 0) return Rf_allocVector(REALSXP, 0);
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, cnt));
+  int32_t out_len = 0;
+  CHECK_CALL(LGBM_BoosterGetEval(h, Rf_asInteger(data_idx), &out_len,
+                                 REAL(out)));
+  if (out_len != cnt) Rf_error("lightgbm_tpu: eval count mismatch");
   UNPROTECT(1);
-  return ext;
+  return out;
+}
+
+/* raw training-state scores (data_idx 0 = train, then valids in add
+ * order) — the fast path for custom objectives: no re-binning, no
+ * re-walking the ensemble */
+SEXP LGBMR_BoosterGetPredict(SEXP bst, SEXP data_idx) {
+  BoosterHandle h = checked_ptr(bst);
+  int idx = Rf_asInteger(data_idx);
+  int64_t want = 0;
+  CHECK_CALL(LGBM_BoosterGetNumPredict(h, idx, &want));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)want));
+  int64_t got = 0;
+  CHECK_CALL(LGBM_BoosterGetPredict(h, idx, &got, REAL(out)));
+  if (got != want) Rf_error("lightgbm_tpu: predict length mismatch");
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMR_BoosterPredictForMat(SEXP bst, SEXP mat, SEXP predict_type,
+                                SEXP num_iteration, SEXP params) {
+  SEXP dims = Rf_getAttrib(mat, R_DimSymbol);
+  if (Rf_isNull(dims) || Rf_length(dims) != 2)
+    Rf_error("lightgbm_tpu: data must be a numeric matrix");
+  int nr = INTEGER(dims)[0], nc = INTEGER(dims)[1];
+  int pt = Rf_asInteger(predict_type), ni = Rf_asInteger(num_iteration);
+  BoosterHandle h = checked_ptr(bst);
+  int64_t want = 0;
+  CHECK_CALL(LGBM_BoosterCalcNumPredict(h, nr, pt, ni, &want));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, (R_xlen_t)want));
+  int64_t got = 0;
+  CHECK_CALL(LGBM_BoosterPredictForMat(h, REAL(mat), 1, nr, nc, 0, pt, ni,
+                                       CHAR(Rf_asChar(params)), &got,
+                                       REAL(out)));
+  if (got != want) Rf_error("lightgbm_tpu: prediction length mismatch");
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMR_BoosterSaveModel(SEXP bst, SEXP num_iteration, SEXP filename) {
+  CHECK_CALL(LGBM_BoosterSaveModel(checked_ptr(bst), 0,
+                                   Rf_asInteger(num_iteration),
+                                   CHAR(Rf_asChar(filename))));
+  return R_NilValue;
+}
+
+/* two-call buffer pattern shared by SaveModelToString / DumpModel */
+static SEXP string_from_two_call(int (*fn)(BoosterHandle, int, int, int64_t,
+                                           int64_t *, char *),
+                                 BoosterHandle h, int ni) {
+  int64_t need = 0;
+  CHECK_CALL(fn(h, 0, ni, 0, &need, NULL));
+  char *buf = (char *)R_alloc((size_t)need + 1, 1);
+  int64_t got = 0;
+  CHECK_CALL(fn(h, 0, ni, need + 1, &got, buf));
+  return Rf_mkString(buf);
+}
+
+SEXP LGBMR_BoosterSaveModelToString(SEXP bst, SEXP num_iteration) {
+  return string_from_two_call(LGBM_BoosterSaveModelToString,
+                              checked_ptr(bst), Rf_asInteger(num_iteration));
+}
+
+SEXP LGBMR_BoosterDumpModel(SEXP bst, SEXP num_iteration) {
+  return string_from_two_call(LGBM_BoosterDumpModel, checked_ptr(bst),
+                              Rf_asInteger(num_iteration));
+}
+
+SEXP LGBMR_BoosterFeatureImportance(SEXP bst, SEXP num_iteration,
+                                    SEXP importance_type) {
+  BoosterHandle h = checked_ptr(bst);
+  int32_t nf = 0;
+  CHECK_CALL(LGBM_BoosterGetNumFeature(h, &nf));
+  SEXP out = PROTECT(Rf_allocVector(REALSXP, nf));
+  CHECK_CALL(LGBM_BoosterFeatureImportance(h, Rf_asInteger(num_iteration),
+                                           Rf_asInteger(importance_type),
+                                           REAL(out)));
+  UNPROTECT(1);
+  return out;
+}
+
+SEXP LGBMR_BoosterResetParameter(SEXP bst, SEXP params) {
+  CHECK_CALL(LGBM_BoosterResetParameter(checked_ptr(bst),
+                                        CHAR(Rf_asChar(params))));
+  return R_NilValue;
+}
+
+SEXP LGBMR_BoosterMerge(SEXP bst, SEXP other) {
+  CHECK_CALL(LGBM_BoosterMerge(checked_ptr(bst), checked_ptr(other)));
+  return R_NilValue;
+}
+
+/* ---- registration --------------------------------------------------- */
+#define CALLDEF(name, n) {#name, (DL_FUNC)&name, n}
+static const R_CallMethodDef call_methods[] = {
+    CALLDEF(LGBMR_DatasetCreateFromMat, 3),
+    CALLDEF(LGBMR_DatasetCreateFromFile, 3),
+    CALLDEF(LGBMR_DatasetGetNumData, 1),
+    CALLDEF(LGBMR_DatasetGetNumFeature, 1),
+    CALLDEF(LGBMR_DatasetSetField, 3),
+    CALLDEF(LGBMR_DatasetGetField, 2),
+    CALLDEF(LGBMR_DatasetSaveBinary, 2),
+    CALLDEF(LGBMR_DatasetSetFeatureNames, 2),
+    CALLDEF(LGBMR_DatasetGetFeatureNames, 1),
+    CALLDEF(LGBMR_DatasetUpdateParam, 2),
+    CALLDEF(LGBMR_BoosterCreate, 2),
+    CALLDEF(LGBMR_BoosterCreateFromModelfile, 1),
+    CALLDEF(LGBMR_BoosterLoadModelFromString, 1),
+    CALLDEF(LGBMR_BoosterAddValidData, 2),
+    CALLDEF(LGBMR_BoosterUpdateOneIter, 1),
+    CALLDEF(LGBMR_BoosterUpdateOneIterCustom, 3),
+    CALLDEF(LGBMR_BoosterRollbackOneIter, 1),
+    CALLDEF(LGBMR_BoosterGetCurrentIteration, 1),
+    CALLDEF(LGBMR_BoosterGetNumClasses, 1),
+    CALLDEF(LGBMR_BoosterGetNumFeature, 1),
+    CALLDEF(LGBMR_BoosterGetEvalNames, 1),
+    CALLDEF(LGBMR_BoosterGetFeatureNames, 1),
+    CALLDEF(LGBMR_BoosterGetEval, 2),
+    CALLDEF(LGBMR_BoosterGetPredict, 2),
+    CALLDEF(LGBMR_BoosterPredictForMat, 5),
+    CALLDEF(LGBMR_BoosterSaveModel, 3),
+    CALLDEF(LGBMR_BoosterSaveModelToString, 2),
+    CALLDEF(LGBMR_BoosterDumpModel, 2),
+    CALLDEF(LGBMR_BoosterFeatureImportance, 3),
+    CALLDEF(LGBMR_BoosterResetParameter, 2),
+    CALLDEF(LGBMR_BoosterMerge, 2),
+    {NULL, NULL, 0}};
+
+void R_init_lightgbm_tpu_R(DllInfo *dll) {
+  R_registerRoutines(dll, NULL, call_methods, NULL, NULL);
+  R_useDynamicSymbols(dll, FALSE);
 }

@@ -1,0 +1,9 @@
+library(testthat)
+
+# Load the package sources directly (no install step in this repo):
+# the glue .so is built by `R CMD SHLIB` per ../README.md.
+for (f in list.files(file.path("..", "R"), full.names = TRUE)) source(f)
+lgb.load_lib(lib_dir = file.path("..", "..", "native"),
+             glue_so = file.path("..", "src", "lightgbm_tpu_R.so"))
+
+test_dir("testthat")

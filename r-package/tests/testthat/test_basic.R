@@ -1,0 +1,99 @@
+# Mirrors the reference R-package/tests/testthat/test_basic.R flow:
+# train / predict / save / reload / early stop on the agaricus-like
+# binary task, using the repo's committed sample data.
+
+context("lightgbmtpu basic train/predict")
+
+data_path <- file.path("..", "..", "..", "tests", "fixtures", "interop",
+                       "binary.test")
+raw <- as.matrix(read.table(data_path))
+y <- raw[, 1]
+X <- raw[, -1, drop = FALSE]
+
+test_that("train and predict binary classification", {
+  dtrain <- lgb.Dataset(X, label = y)
+  bst <- lgb.train(params = list(objective = "binary", verbose = -1),
+                   data = dtrain, nrounds = 20L, verbose = 0L)
+  expect_true(lgb.is.Booster(bst))
+  expect_equal(lgb.Booster.current_iter(bst), 20L)
+  pred <- predict(bst, X)
+  expect_equal(length(pred), nrow(X))
+  expect_true(all(pred >= 0 & pred <= 1))
+  auc <- local({
+    r <- rank(pred)
+    pos <- y > 0.5
+    (sum(r[pos]) - sum(pos) * (sum(pos) + 1) / 2) /
+      (sum(pos) * sum(!pos))
+  })
+  expect_gt(auc, 0.9)
+})
+
+test_that("save/load round trip preserves predictions", {
+  dtrain <- lgb.Dataset(X, label = y)
+  bst <- lgb.train(params = list(objective = "binary", verbose = -1),
+                   data = dtrain, nrounds = 10L, verbose = 0L)
+  pred <- predict(bst, X)
+  tmp <- tempfile(fileext = ".txt")
+  lgb.save(bst, tmp)
+  bst2 <- lgb.load(tmp)
+  expect_equal(predict(bst2, X), pred, tolerance = 1e-9)
+  # string round trip
+  s <- lgb.Booster.to_string(bst)
+  bst3 <- lgb.load(model_str = s)
+  expect_equal(predict(bst3, X), pred, tolerance = 1e-9)
+})
+
+test_that("RDS round trip via saveRDS.lgb.Booster", {
+  dtrain <- lgb.Dataset(X, label = y)
+  bst <- lgb.train(params = list(objective = "binary", verbose = -1),
+                   data = dtrain, nrounds = 5L, verbose = 0L)
+  pred <- predict(bst, X)
+  tmp <- tempfile(fileext = ".rds")
+  saveRDS.lgb.Booster(bst, tmp)
+  back <- readRDS.lgb.Booster(tmp)
+  expect_equal(predict(back, X), pred, tolerance = 1e-9)
+})
+
+test_that("validation metrics are recorded and early stopping works", {
+  n <- nrow(X)
+  idx <- seq_len(n %/% 2)
+  dtrain <- lgb.Dataset(X[idx, ], label = y[idx])
+  dvalid <- lgb.Dataset.create.valid(dtrain, X[-idx, ], label = y[-idx])
+  bst <- lgb.train(params = list(objective = "binary", metric = "auc",
+                                 verbose = -1),
+                   data = dtrain, nrounds = 50L,
+                   valids = list(valid = dvalid),
+                   early_stopping_rounds = 5L, verbose = 0L)
+  rec <- lgb.get.eval.result(bst, "valid", "auc")
+  expect_gt(length(rec), 0L)
+  expect_true(bst$best_iter > 0L)
+})
+
+test_that("feature importance and interpretation", {
+  dtrain <- lgb.Dataset(X, label = y,
+                        colnames = paste0("f", seq_len(ncol(X))))
+  bst <- lgb.train(params = list(objective = "binary", verbose = -1),
+                   data = dtrain, nrounds = 10L, verbose = 0L)
+  imp <- lgb.importance(bst)
+  expect_equal(nrow(imp), ncol(X))
+  expect_true(all(imp$Gain >= 0))
+  expect_equal(sum(imp$Gain), 1, tolerance = 1e-6)
+  inter <- lgb.interprete(bst, X, idxset = c(1L, 2L))
+  expect_equal(length(inter), 2L)
+  # contributions + bias sum to the raw prediction
+  raw1 <- predict(bst, X[1, , drop = FALSE], rawscore = TRUE)
+  expect_equal(sum(inter[[1L]]$Contribution), raw1, tolerance = 1e-4)
+})
+
+test_that("continued training from init_model adds trees", {
+  dtrain <- lgb.Dataset(X, label = y)
+  bst <- lgb.train(params = list(objective = "binary", verbose = -1),
+                   data = dtrain, nrounds = 5L, verbose = 0L)
+  tmp <- tempfile(fileext = ".txt")
+  lgb.save(bst, tmp)
+  dtrain2 <- lgb.Dataset(X, label = y)
+  bst2 <- lgb.train(params = list(objective = "binary", verbose = -1),
+                    data = dtrain2, nrounds = 5L, init_model = tmp,
+                    verbose = 0L)
+  expect_equal(lgb.Booster.current_iter(bst2), 10L)
+})

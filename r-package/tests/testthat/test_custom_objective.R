@@ -1,0 +1,32 @@
+# Mirrors reference tests/testthat/test_custom_objective.R: custom
+# fobj/feval through LGBM_BoosterUpdateOneIterCustom.
+
+context("custom objective")
+
+data_path <- file.path("..", "..", "..", "tests", "fixtures", "interop",
+                       "binary.test")
+raw <- as.matrix(read.table(data_path))
+y <- raw[, 1]
+X <- raw[, -1, drop = FALSE]
+
+logregobj <- function(preds, dtrain) {
+  labels <- getinfo(dtrain, "label")
+  p <- 1 / (1 + exp(-preds))
+  list(grad = p - labels, hess = p * (1 - p))
+}
+
+evalerror <- function(preds, dtrain) {
+  labels <- getinfo(dtrain, "label")
+  err <- mean((preds > 0) != (labels > 0.5))
+  list("error", err, FALSE)
+}
+
+test_that("custom objective trains and improves", {
+  dtrain <- lgb.Dataset(X, label = y, free_raw_data = FALSE)
+  bst <- lgb.train(params = list(metric = "none", verbose = -1),
+                   data = dtrain, nrounds = 30L, obj = logregobj,
+                   eval = evalerror, verbose = 0L)
+  preds <- predict(bst, X, rawscore = TRUE)
+  err <- mean((preds > 0) != (y > 0.5))
+  expect_lt(err, 0.3)
+})

@@ -1,0 +1,47 @@
+# Mirrors reference tests/testthat/test_dataset.R: field get/set,
+# dims, save_binary, valid-set mapper sharing.
+
+context("lgb.Dataset")
+
+data_path <- file.path("..", "..", "..", "tests", "fixtures", "interop",
+                       "binary.test")
+raw <- as.matrix(read.table(data_path))
+y <- raw[, 1]
+X <- raw[, -1, drop = FALSE]
+
+test_that("dim and colnames", {
+  ds <- lgb.Dataset(X, label = y,
+                    colnames = paste0("c", seq_len(ncol(X))))
+  expect_equal(dim(ds), dim(X))
+  lgb.Dataset.construct(ds)
+  expect_equal(dim(ds)[1], nrow(X))
+  expect_equal(dimnames(ds)[[2]], paste0("c", seq_len(ncol(X))))
+})
+
+test_that("getinfo/setinfo round trip", {
+  ds <- lgb.Dataset(X, label = y)
+  lgb.Dataset.construct(ds)
+  expect_equal(getinfo(ds, "label"), as.numeric(y), tolerance = 1e-6)
+  w <- runif(nrow(X))
+  setinfo(ds, "weight", w)
+  expect_equal(getinfo(ds, "weight"), w, tolerance = 1e-6)
+})
+
+test_that("save_binary writes a loadable file", {
+  ds <- lgb.Dataset(X, label = y)
+  tmp <- tempfile(fileext = ".bin")
+  lgb.Dataset.save(ds, tmp)
+  expect_true(file.exists(tmp))
+  expect_gt(file.info(tmp)$size, 0)
+})
+
+test_that("valid set shares mappers with its reference", {
+  idx <- seq_len(nrow(X) %/% 2)
+  dtrain <- lgb.Dataset(X[idx, ], label = y[idx])
+  dvalid <- lgb.Dataset.create.valid(dtrain, X[-idx, ], label = y[-idx])
+  bst <- lgb.train(params = list(objective = "binary", metric = "auc",
+                                 verbose = -1),
+                   data = dtrain, nrounds = 5L,
+                   valids = list(valid = dvalid), verbose = 0L)
+  expect_gt(lgb.get.eval.result(bst, "valid", "auc")[1], 0.5)
+})

@@ -1,0 +1,40 @@
+# Mirrors reference tests/testthat/test_parameters.R: parameter string
+# handling and cb.reset.parameter scheduling.
+
+context("parameters")
+
+data_path <- file.path("..", "..", "..", "tests", "fixtures", "interop",
+                       "binary.test")
+raw <- as.matrix(read.table(data_path))
+y <- raw[, 1]
+X <- raw[, -1, drop = FALSE]
+
+test_that("params2str formats scalars, vectors and logicals", {
+  expect_equal(lgb.params2str(list()), "")
+  expect_equal(lgb.params2str(list(a = 1, b = "x")), "a=1 b=x")
+  expect_equal(lgb.params2str(list(v = c(1, 3, 5))), "v=1,3,5")
+  expect_equal(lgb.params2str(list(f = TRUE)), "f=true")
+  expect_error(lgb.params2str(list(1)), "named")
+})
+
+test_that("learning rate schedule via cb.reset.parameter", {
+  dtrain <- lgb.Dataset(X, label = y)
+  bst <- lgb.train(
+    params = list(objective = "binary", verbose = -1,
+                  learning_rate = 0.1),
+    data = dtrain, nrounds = 6L, verbose = 0L,
+    callbacks = list(cb.reset.parameter(
+      list(learning_rate = function(i, total) 0.1 * 0.9^i))))
+  expect_equal(lgb.Booster.current_iter(bst), 6L)
+})
+
+test_that("cv aggregates across folds", {
+  cv <- lgb.cv(params = list(objective = "binary", metric = "auc",
+                             verbose = -1),
+               data = X, label = y, nrounds = 8L, nfold = 3L,
+               verbose = 0L)
+  expect_true("test.auc.mean" %in% names(cv$record_evals))
+  expect_equal(length(cv$record_evals$test.auc.mean), 8L)
+  expect_gt(cv$record_evals$test.auc.mean[8], 0.8)
+  expect_true(cv$best_iter >= 1L)
+})

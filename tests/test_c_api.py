@@ -361,3 +361,46 @@ def test_dataset_dump_text(rng, tmp_path):
 def test_set_last_error():
     assert LIB.LGBM_SetLastError(b"custom boom") == 0
     assert LIB.LGBM_GetLastError() == b"custom boom"
+
+
+def test_eval_counts_names_values_align_for_multivalue_metrics(rng):
+    """GetEvalCounts == len(GetEvalNames) == len(GetEval results) even
+    for metrics that expand to one value per position (ndcg@k / map@k)
+    — the reference sums Metric::GetName() sizes (metric.hpp), and a
+    mismatch overflows fixed-size caller buffers (the R glue sizes its
+    output from GetEvalCounts)."""
+    n, q = 600, 6
+    X = rng.rand(n, 5)
+    h = ctypes.c_void_p()
+    flat = np.ascontiguousarray(X.reshape(-1))
+    assert LIB.LGBM_DatasetCreateFromMat(
+        flat.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)), 1, n, 5, 1,
+        c_str("max_bin=31"), None, ctypes.byref(h)) == 0
+    y = rng.randint(0, 3, n).astype(np.float32)
+    assert LIB.LGBM_DatasetSetField(
+        h, c_str("label"), c_array(ctypes.c_float, y), n, 0) == 0
+    grp = np.full(q, n // q, np.int32)
+    assert LIB.LGBM_DatasetSetField(
+        h, c_str("group"),
+        grp.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), q, 2) == 0
+    bst = ctypes.c_void_p()
+    assert LIB.LGBM_BoosterCreate(
+        h, c_str("objective=lambdarank metric=ndcg,map verbose=-1"),
+        ctypes.byref(bst)) == 0
+    fin = ctypes.c_int(0)
+    assert LIB.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)) == 0
+
+    cnt = ctypes.c_int(0)
+    assert LIB.LGBM_BoosterGetEvalCounts(bst, ctypes.byref(cnt)) == 0
+    assert cnt.value == 10  # ndcg@1..5 + map@1..5
+    bufs = [ctypes.create_string_buffer(256) for _ in range(cnt.value)]
+    arr = (ctypes.c_char_p * cnt.value)(
+        *[ctypes.addressof(b) for b in bufs])
+    nn = ctypes.c_int(0)
+    assert LIB.LGBM_BoosterGetEvalNames(bst, ctypes.byref(nn), arr) == 0
+    names = [bufs[i].value.decode() for i in range(nn.value)]
+    assert names[:5] == ["ndcg@%d" % k for k in range(1, 6)]
+    vals = (ctypes.c_double * cnt.value)()
+    vn = ctypes.c_int(0)
+    assert LIB.LGBM_BoosterGetEval(bst, 0, ctypes.byref(vn), vals) == 0
+    assert vn.value == nn.value == cnt.value

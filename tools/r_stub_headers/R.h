@@ -1,0 +1,52 @@
+/* TEST-ONLY stub of the R C API, just rich enough to syntax-check
+ * r-package/src/lightgbm_tpu_R.c with `gcc -fsyntax-only` in an image
+ * without an R toolchain (tests/test_r_package.py).  NOT the real R.h:
+ * prototypes mirror the documented R API shapes; a real `R CMD SHLIB`
+ * build still happens wherever R exists.  */
+#ifndef R_STUB_R_H
+#define R_STUB_R_H
+#include <stddef.h>
+
+typedef struct SEXPREC *SEXP;
+typedef ptrdiff_t R_xlen_t;
+typedef enum { FALSE = 0, TRUE } Rboolean;
+
+extern SEXP R_NilValue;
+extern SEXP R_DimSymbol;
+
+#define INTSXP 13
+#define REALSXP 14
+#define STRSXP 16
+
+void Rf_error(const char *fmt, ...);
+int Rf_asInteger(SEXP);
+SEXP Rf_asChar(SEXP);
+const char *R_CHAR(SEXP);
+#define CHAR(x) R_CHAR(x)
+double *REAL(SEXP);
+int *INTEGER(SEXP);
+int TYPEOF(SEXP);
+int Rf_length(SEXP);
+int Rf_isNull(SEXP);
+SEXP Rf_coerceVector(SEXP, unsigned int);
+SEXP Rf_allocVector(unsigned int, R_xlen_t);
+SEXP Rf_protect(SEXP);
+void Rf_unprotect(int);
+#define PROTECT(x) Rf_protect(x)
+#define UNPROTECT(n) Rf_unprotect(n)
+SEXP Rf_getAttrib(SEXP, SEXP);
+SEXP Rf_mkChar(const char *);
+SEXP Rf_mkString(const char *);
+SEXP Rf_ScalarInteger(int);
+SEXP Rf_ScalarLogical(int);
+void SET_STRING_ELT(SEXP, R_xlen_t, SEXP);
+SEXP STRING_ELT(SEXP, R_xlen_t);
+char *R_alloc(size_t, int);
+
+SEXP R_MakeExternalPtr(void *, SEXP, SEXP);
+void *R_ExternalPtrAddr(SEXP);
+void R_ClearExternalPtr(SEXP);
+typedef void (*R_CFinalizer_t)(SEXP);
+void R_RegisterCFinalizerEx(SEXP, R_CFinalizer_t, int);
+
+#endif

@@ -1,0 +1,17 @@
+/* TEST-ONLY stub — see ../R.h in this directory. */
+#ifndef R_STUB_RDYNLOAD_H
+#define R_STUB_RDYNLOAD_H
+
+typedef void *(*DL_FUNC)(void);
+typedef struct _DllInfo DllInfo;
+typedef struct {
+  const char *name;
+  DL_FUNC fun;
+  int numArgs;
+} R_CallMethodDef;
+
+int R_registerRoutines(DllInfo *, const void *, const R_CallMethodDef *,
+                       const void *, const void *);
+int R_useDynamicSymbols(DllInfo *, int);
+
+#endif

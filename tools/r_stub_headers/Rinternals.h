@@ -1,0 +1,5 @@
+/* TEST-ONLY stub — see R.h in this directory. */
+#ifndef R_STUB_RINTERNALS_H
+#define R_STUB_RINTERNALS_H
+#include "R.h"
+#endif

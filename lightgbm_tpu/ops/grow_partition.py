@@ -656,7 +656,13 @@ def grow_tree_partition_impl(
         # came from tunnel-fetch-biased microbenches) confirms the fused
         # pass pays the radix contraction over the WHOLE parent stream
         # (+6.9 ms/4M rows) while the separate kernel touches only the
-        # compacted smaller child — O(small) beats O(parent) here
+        # compacted smaller child — O(small) beats O(parent) here.
+        # Round 5 re-tested a PARENT-SIZE-GATED fusion (in-kernel fh
+        # gate + small-parent fused path, partition_pallas fused_gate/
+        # raw_hist): ~10% WORSE end-to-end — requesting the hist output
+        # on every partition launch adds its buffer setup/writeback to
+        # all ~254 splits, which costs more than the separate kernel's
+        # fixed cost ever did.  Two launches stay the right shape here.
         arena, counts = part(state.arena, pred_dummy, s0, cntP, dstA, dstB,
                              decision=decision)
         small_hist = seg(arena, dstB,

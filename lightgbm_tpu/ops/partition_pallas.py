@@ -165,23 +165,27 @@ FLUSH_W = SUB          # flush chunk width; all HBM write offsets are
 CARRY_W = FLUSH_W + SUB    # per-stream carry width (append window)
 
 
-def _dual_stream_P(pref2, pred2, K: int):
-    """Destination one-hots for ALL subblocks of a tile in one build:
-    P_all [K, S, 2*SUB] bf16 — subblock k's valid rows map to column
-    posA (stream A, left half) or SUB + posB (stream B, right half),
-    both compacted to offset 0.  The carry-fill offset is NOT baked in
-    (it is applied later as a cheap VPU dynamic roll), so one
-    [C, S] @ [S, 2*SUB] MXU matmul moves BOTH streams — half the MACs
-    of two fill-positioned [S, CARRY_W] products — and the P builds
-    carry no dependency on the serial append state.
+def _sort_P(pref2, pred2, K: int):
+    """Stable-partition permutation one-hots for ALL subblocks of a tile
+    in one build: P_all [K, SUB, SUB] bf16 — subblock k's stream-A rows
+    map to columns [0, ca_k) (compacted, in order) and its stream-B rows
+    to columns [ca_k, ca_k + cb_k), i.e. ONE [C, S] @ [S, S] MXU matmul
+    per subblock SORTS the block into an A-prefix and a B-suffix.  Half
+    the MACs of the previous dual-stream [S, 2*SUB] product: the two
+    halves of that output were disjoint by construction, so the split
+    point ca_k (known before any matmul from the prefix scans) lets both
+    streams share one SUB-wide product; the appends separate them again
+    with cheap lane masks + the usual VPU carry roll.
 
     pref2/pred2: [2K, SUB] f32 — A-rows then B-rows (inclusive prefix
-    sums and 0/1 predicates)."""
+    sums and 0/1 predicates).  Invalid rows (neither stream) map
+    nowhere (all-zero P row)."""
     pA = pred2[:K]                                     # [K, S] f32 0/1
     vAB = pred2[:K] + pred2[K:]                        # valid (0/1)
+    ca = pref2[:K, SUB - 1].reshape(K, 1)              # [K, 1] f32
     pos = (pA * (pref2[:K] - 1.0)
-           + (1.0 - pA) * (pref2[K:] - 1.0 + SUB))     # [K, S] f32
-    t3 = jax.lax.broadcasted_iota(jnp.int32, (K, SUB, 2 * SUB), 2)
+           + (1.0 - pA) * (pref2[K:] - 1.0 + ca))      # [K, S] f32
+    t3 = jax.lax.broadcasted_iota(jnp.int32, (K, SUB, SUB), 2)
     # build the one-hot in f32 then cast: an i1 mask from 32-bit compares
     # can't relayout onto 16-bit vector selects in Mosaic
     return jnp.where(
@@ -193,7 +197,7 @@ def _dual_stream_P(pref2, pred2, K: int):
 def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
                       out_any, cnt_ref, *rest,
                       C: int, tile: int, hist_plan=None):
-    """sc_ref (SMEM [7] i32): start, cnt, dstA, dstB, mode, xr, hs —
+    """sc_ref (SMEM [8] i32): start, cnt, dstA, dstB, mode, xr, hs, fh —
     start, dstA and dstB must be multiples of `tile` resp. FLUSH_W (the
     bump allocator aligns).
     arena_any/out_any: [C, cap] bf16 in HBM, aliased (same buffer).
@@ -234,6 +238,10 @@ def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
     xr = sc_ref[5]    # XOR'd into the decision: 1 when the left child is
     #                   the smaller (stream-B) side
     hs = sc_ref[6]    # fused-histogram stream: 1 -> B, 0 -> A
+    fh = sc_ref[7]    # 1 -> actually accumulate the fused histogram;
+    #                   0 -> skip the radix work (big parents use the
+    #                   separate O(child) kernel instead; the gate makes
+    #                   the fusion free to request on every split)
     n_tiles = jax.lax.div(cnt + jnp.int32(tile - 1), jnp.int32(tile))
     K = tile // SUB
     lane_w = jax.lax.broadcasted_iota(jnp.int32, (C, CARRY_W), 1)
@@ -268,17 +276,24 @@ def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
     carryA[:] = jnp.zeros((C, CARRY_W), jnp.float32)
     carryB[:] = jnp.zeros((C, CARRY_W), jnp.float32)
 
-    def append_and_flush(carry, comp, ck, fill, written, dst, stream, fslot):
-        """Roll comp ([C, SUB] f32, compacted at offset 0) up to the
-        carry fill point, add it in, and flush filled FLUSH_W chunks
-        (up to ceil(SUB/FLUSH_W) per append when FLUSH_W < SUB).  The
-        carry is f32 precisely so the positioning can be a dynamic
-        pltpu.roll (32-bit-only op) instead of MXU MACs; values are
-        exact bf16 payloads so the f32->bf16 cast at flush is lossless.
+    def append_and_flush(carry, chunk, lo, ck, fill, written, dst, stream,
+                         fslot):
+        """chunk ([C, SUB] f32) holds this stream's rows at lanes
+        [lo, lo+ck), zeros elsewhere (masked OFF the serial chain, in
+        the parallel region after the sort matmuls); circular-roll them
+        onto carry lanes [fill, fill+ck) (fill + ck <= CARRY_W by the
+        flush invariant, so the rotation never wraps values).  Then
+        flush filled FLUSH_W chunks (up to ceil(SUB/FLUSH_W) per append
+        when FLUSH_W < SUB).  The carry is f32 precisely so the
+        positioning can be a dynamic pltpu.roll (32-bit-only op)
+        instead of MXU MACs; values are exact bf16 payloads so the
+        f32->bf16 cast at flush is lossless.
         Returns (fill', written', fslot')."""
         padded = jnp.concatenate(
-            [comp, jnp.zeros((C, CARRY_W - SUB), jnp.float32)], axis=1)
-        carry[:] = carry[:] + pltpu.roll(padded, fill, axis=1)
+            [chunk, jnp.zeros((C, CARRY_W - SUB), jnp.float32)], axis=1)
+        shift = jax.lax.rem(fill - lo + jnp.int32(CARRY_W),
+                            jnp.int32(CARRY_W))
+        carry[:] = carry[:] + pltpu.roll(padded, shift, axis=1)
         fill = fill + ck
 
         for _ in range(-(-SUB // FLUSH_W)):
@@ -342,32 +357,46 @@ def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
         predB = jnp.where(valid & ~on, jnp.float32(1.0), jnp.float32(0.0))
 
         if hist_plan is not None:
-            hs_f = hs.astype(jnp.float32)
-            hmask = (hs_f * predB + (1.0 - hs_f) * predA).astype(jnp.bfloat16)
-            nb_h, k_h, m_h, lo_h, hi_h = hist_plan
-            _radix_accumulate(hist_ref, block, hmask, n_blocks=nb_h, k=k_h,
-                              m=m_h, lo_n=lo_h, hi_n=hi_h, tile=tile)
+            @pl.when(fh == 1)
+            def _():
+                hs_f = hs.astype(jnp.float32)
+                hmask = (hs_f * predB
+                         + (1.0 - hs_f) * predA).astype(jnp.bfloat16)
+                nb_h, k_h, m_h, lo_h, hi_h = hist_plan
+                _radix_accumulate(hist_ref, block, hmask, n_blocks=nb_h,
+                                  k=k_h, m=m_h, lo_n=lo_h, hi_n=hi_h,
+                                  tile=tile)
 
         # ONE batched prefix scan for all subblocks of both streams — the
         # per-subblock scans were 2*K*log2(SUB) serial roll steps, the
         # kernel's dominant latency.  Then ONE batched P build and K
-        # dependency-free dual-stream matmuls: nothing on the MXU path
-        # waits on the serial carry/fill chain (that chain is cheap VPU
-        # roll+add work), so the systolic array stays fed.
+        # dependency-free SORT matmuls ([C,S]@[S,S]: A-prefix + B-suffix
+        # in a single product — half the MACs of the dual-stream [S,2S]
+        # build): nothing on the MXU path waits on the serial carry/fill
+        # chain (that chain is cheap VPU mask/roll/add work), so the
+        # systolic array stays fed.
         pred2 = jnp.concatenate(
             [predA.reshape(K, SUB), predB.reshape(K, SUB)], axis=0)
         pref2 = _prefix_scan_lanes(pred2)                  # [2K, SUB]
         cnt2 = pref2[:, SUB - 1].astype(jnp.int32)         # [2K]
-        P_all = _dual_stream_P(pref2, pred2, K)            # [K, S, 2S]
+        P_all = _sort_P(pref2, pred2, K)                   # [K, S, S]
         comps = [jax.lax.dot(block[:, k * SUB:(k + 1) * SUB], P_all[k],
                              preferred_element_type=jnp.float32)
-                 for k in range(K)]                        # [C, 2S] f32
+                 for k in range(K)]                        # [C, S] f32
+        # split each sorted block into its A-prefix / B-suffix OFF the
+        # serial carry chain (depends only on cnt2, not on fill); the
+        # B chunk is a subtraction, not a second select
+        lane_s = jax.lax.broadcasted_iota(jnp.int32, (1, SUB), 1)
+        chunksA = [jnp.where(lane_s < cnt2[k], comps[k], jnp.float32(0.0))
+                   for k in range(K)]
+        chunksB = [comps[k] - chunksA[k] for k in range(K)]
         for k in range(K):
             ca, cb = cnt2[k], cnt2[K + k]
             fillA, wA, fsA = append_and_flush(
-                carryA, comps[k][:, :SUB], ca, fillA, wA, dstA, 0, fsA)
+                carryA, chunksA[k], jnp.int32(0), ca, fillA, wA, dstA, 0,
+                fsA)
             fillB, wB, fsB = append_and_flush(
-                carryB, comps[k][:, SUB:], cb, fillB, wB, dstB, 1, fsB)
+                carryB, chunksB[k], ca, cb, fillB, wB, dstB, 1, fsB)
 
         @pl.when(j + 1 < n_tiles)
         def _():
@@ -409,9 +438,11 @@ def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
 
 
 @functools.partial(jax.jit, static_argnames=("tile", "interpret",
-                                             "num_features", "max_bin"))
+                                             "num_features", "max_bin",
+                                             "raw_hist"))
 def partition_segment(arena, pred, start, cnt, dstA, dstB,
-                      decision=None, hist_stream=None,
+                      decision=None, hist_stream=None, fused_gate=None,
+                      raw_hist: bool = False,
                       num_features: int = 0, max_bin: int = 0,
                       tile: int = TILE, interpret: bool = False):
     """Partition arena columns [start, start+cnt) into stream A at dstA
@@ -428,7 +459,13 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
     When hist_stream is given (0 -> stream A, 1 -> stream B; requires
     num_features/max_bin), the kernel also accumulates that stream's
     [F, max_bin, 3] histogram in the same pass and returns it third —
-    the partition + histogram fusion (used for the bagging root pass).
+    the partition + histogram fusion (bagging root pass, and the
+    small-parent split path).  fused_gate (traced 0/1, default 1) skips
+    the in-kernel radix work when 0 — big parents request the fusion
+    output buffer but do the histogram with the separate O(child)
+    kernel, so the grow loop can keep ONE partition call shape.
+    raw_hist=True returns the pre-epilogue radix buffer instead (the
+    caller runs split_radix_epilogue only on the branch that uses it).
 
     Returns (new_arena, counts[2] int32[, hist]).  Writes stay within
     align(count, FLUSH_W) columns of each stream's dst; reads overrun the
@@ -452,6 +489,8 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
                          ).astype(ARENA_DT)
     with_hist = hist_stream is not None
     tail.append(jnp.asarray(hist_stream if with_hist else 0, jnp.int32))
+    tail.append(jnp.asarray(1 if fused_gate is None else fused_gate,
+                            jnp.int32))
     sc = jnp.stack([jnp.asarray(start), jnp.asarray(cnt),
                     jnp.asarray(dstA), jnp.asarray(dstB)]
                    + tail).astype(jnp.int32)
@@ -499,6 +538,8 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
     )(sc, feat_onehot, goleft, arena, pred)
     if not with_hist:
         return outs[0], outs[1]
+    if raw_hist:
+        return outs[0], outs[1], outs[2]
     hist = split_radix_epilogue(outs[2], n_blocks * k, m, hi_n=hi_n,
                                 lo_n=lo_n)[:num_features, :max_bin, :]
     return outs[0], outs[1], hist

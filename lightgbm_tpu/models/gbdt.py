@@ -47,7 +47,13 @@ def _dense_matrix(X) -> np.ndarray:
 
 
 class _DatasetState:
-    """Device-side per-dataset state (ScoreUpdater, score_updater.hpp:17-120)."""
+    """Device-side per-dataset state (ScoreUpdater, score_updater.hpp:17-120).
+
+    `score` may be LAZY: the carried-arena fast path keeps scores as
+    arena channels and sets a materializer thunk instead of the array;
+    any read (metrics, snapshots, the bench's sync fetch) transparently
+    reconstructs the row-ordered score first.
+    """
 
     def __init__(self, ds: BinnedDataset, num_classes: int, dtype):
         self.ds = ds
@@ -57,8 +63,29 @@ class _DatasetState:
             np.array([m.default_bin for m in ds.bin_mappers], np.int32))
         self.missing_types = jnp.asarray(
             np.array([m.missing_type for m in ds.bin_mappers], np.int32))
-        self.score = jnp.zeros((num_classes, ds.num_data), dtype)
+        self._score = jnp.zeros((num_classes, ds.num_data), dtype)
+        self._score_thunk = None
+        self._score_written = False
         self.bundle = _bundle_maps(ds)
+
+    @property
+    def score(self):
+        if self._score_thunk is not None:
+            self._score = self._score_thunk()
+            self._score_thunk = None
+        return self._score
+
+    @score.setter
+    def score(self, value):
+        self._score = value
+        self._score_thunk = None
+        # external writes invalidate any arena-resident score planes;
+        # the carried fast path checks this flag and demotes itself
+        self._score_written = True
+
+    def defer_score(self, thunk) -> None:
+        """Install a materializer; the next `score` read calls it."""
+        self._score_thunk = thunk
 
     @property
     def hist_max_bin(self) -> int:
@@ -174,6 +201,13 @@ class GBDT:
         self._fused_fields = None
         self._fused_validated = False
         self._partition_validated = False
+        # carried-arena state is dataset-bound too: drop the trace and
+        # let eligibility re-engage against the new arena (BinaryLogloss
+        # is gated on exact type like L2's carry_fields, see objective.py)
+        self._carried_active = None
+        self._carried_fn = None
+        self._carried_key = None
+        self._carry_mat_fn = None
         # a booster that stopped on the OLD data (no splittable leaves)
         # must be trainable again on the new data
         self._deferred_stopped = False
@@ -339,10 +373,30 @@ class GBDT:
         # single-dispatch fast path: gradients + tree + score update fused
         no_bagging = (self.config.bagging_freq <= 0
                       or self.config.bagging_fraction >= 1.0)
-        if no_bagging and self._fused_eligible(deferred_ok, k, custom):
+        fused_ok = no_bagging and self._fused_eligible(deferred_ok, k, custom)
+        # carried-arena lifecycle: any iteration that will NOT run the
+        # carried path (custom gradients, bagging turned on mid-training
+        # via reset_parameter, lost fused eligibility) — or an external
+        # score write (rollback, refit, merge) — must demote NOW, firing
+        # the deferred materializer while the arena planes are still
+        # valid; the upcoming tree clobbers the carry slots.  The
+        # pristine block is untouched, so the standard paths resume
+        # seamlessly.
+        if getattr(self, "_carried_active", False):
+            if not fused_ok or self.train_state._score_written:
+                _ = self.train_state.score   # fire the thunk while valid
+                self._carried_active = False
+        if fused_ok:
             try:
+                if getattr(self, "_carried_active", None) is None:
+                    self._carried_active = False
+                    if self._carried_ok(k):
+                        self._init_carried()
                 with self.profiler.phase("fused_iter"):
-                    packed_per_class = self._run_fused_iter()
+                    if self._carried_active:
+                        packed_per_class = self._run_fused_iter_carried()
+                    else:
+                        packed_per_class = self._run_fused_iter()
                 # start every host copy BEFORE the first bookkeeping
                 # append: a fault surfacing mid-loop must not leave
                 # orphaned model slots behind for the fallback path
@@ -588,6 +642,200 @@ class GBDT:
         self.train_state.score = new_score
         self._last_truncated = jnp.asarray(False)   # flag rides ivec[-1]
         return list(zip(ivecs, fvecs))
+
+    # ---- carried-arena fast path -----------------------------------------
+    # Scores and the objective's per-row constants ride the arena as
+    # bf16 residue-plane channels, permuted along with the rows, so the
+    # per-tree boundary needs NO row-order recovery: the finished tree's
+    # segments are compacted (full channels) into the other root slot
+    # and the next tree roots there.  This removes the O(n log^2 n)
+    # rowid sort from every iteration (~64 ms at 10.5M rows); the
+    # row-ordered score is reconstructed lazily on first read.
+
+    def _carried_ok(self, k: int) -> bool:
+        if (k != 1 or self.objective is None
+                or getattr(self, "_grower", None) is not None
+                or self._bins_t is None):
+            return False
+        spec = self.objective.carry_fields()
+        if spec is None:
+            return False
+        from ..ops import partition_pallas as _pp
+        G = self._bins_t.shape[0]
+        base = _pp.feature_channels(G) + _pp.N_AUX
+        need = 3 + sum(p for _a, p in spec)
+        C, cap = self._arena.shape
+        if C - base < need:
+            return False
+        n = self._bins_t.shape[1]
+        n_al = -(-n // _pp.TILE) * _pp.TILE
+        slot0 = _pp.pristine_work0(n)
+        bump0 = slot0 + 2 * (n_al + _pp.TILE)
+        # the bump region must keep enough headroom for a tree's child
+        # allocations (~1.5n typical); demand >= 2n so eligibility never
+        # trades the sort for truncation fallbacks
+        return cap - bump0 >= 2 * n_al
+
+    def _init_carried(self):
+        from ..ops import partition_pallas as _pp
+        n = self._bins_t.shape[1]
+        G = self._bins_t.shape[0]
+        n_al = -(-n // _pp.TILE) * _pp.TILE
+        self._carry_base = _pp.feature_channels(G) + _pp.N_AUX
+        self._carry_slots = (_pp.pristine_work0(n),
+                             _pp.pristine_work0(n) + n_al + _pp.TILE)
+        self._carry_bump0 = self._carry_slots[1] + n_al + _pp.TILE
+        self._carry_parity = 0
+        spec = self.objective.carry_fields()
+        planes = []
+        for arr, np_ in spec:
+            if np_ == 1:
+                planes.append(jnp.asarray(arr, _pp.ARENA_DT)[None, :])
+            else:
+                planes.append(jnp.stack(
+                    _pp.split_f32(jnp.asarray(arr, jnp.float32))))
+        score0 = jnp.asarray(self.train_state.score[0], jnp.float32)
+        payload = jnp.concatenate(
+            [jnp.stack(_pp.split_f32(score0))] + planes, axis=0)
+        # root slot 0 = copy of the pristine block (bins + rowids in row
+        # order) + the carry planes; pristine itself stays intact so a
+        # demotion back to the standard fused path needs no re-init
+        block = jax.lax.dynamic_slice(
+            self._arena, (0, 0), (self._arena.shape[0], n))
+        block = jax.lax.dynamic_update_slice(
+            block, payload.astype(_pp.ARENA_DT), (self._carry_base, 0))
+        self._arena = jax.lax.dynamic_update_slice(
+            self._arena, block, (0, self._carry_slots[0]))
+        self.train_state._score_written = False
+        self._carried_active = True
+
+    def _build_fused_iter_carried(self):
+        from ..ops import grow_partition as gp
+        from ..ops import partition_pallas as _pp
+        objective = self.objective
+        interpret = jax.default_backend() != "tpu"
+        n = self._bins_t.shape[1]
+        base = self._carry_base
+        bump0 = self._carry_bump0
+        spec = objective.carry_fields()
+        n_planes = [p for _a, p in spec]
+        L = self.config.num_leaves
+        self._fused_fields = self._objective_device_fields()
+        fields_io = self._fused_fields
+
+        def merge(planes):
+            return sum(planes[i].astype(jnp.float32)
+                       for i in range(planes.shape[0]))
+
+        def fused(arena, bins_t, root0, dst, field_vals, row0, fmask,
+                  num_bins, default_bins, missing_types, sparams,
+                  monotone, penalty, shrink):
+            olds = [getattr(h, a) for h, a in fields_io]
+            for (h, a), v in zip(fields_io, field_vals):
+                setattr(h, a, v)
+            try:
+                score = merge(jax.lax.dynamic_slice(
+                    arena, (jnp.int32(base), root0), (3, n)))
+                off = base + 3
+                fields = []
+                for np_ in n_planes:
+                    fields.append(merge(jax.lax.dynamic_slice(
+                        arena, (jnp.int32(off), root0), (np_, n))))
+                    off += np_
+                grad, hess = objective.carry_gradients(score, fields)
+            finally:
+                for (h, a), v in zip(fields_io, olds):
+                    setattr(h, a, v)
+            arrays, _used, arena, trunc = gp.grow_tree_partition_impl(
+                arena, bins_t, jnp.asarray(grad, jnp.float32),
+                jnp.asarray(hess, jnp.float32), row0, fmask,
+                num_bins, default_bins, missing_types, sparams,
+                monotone, penalty, None, None, self.is_categorical,
+                self.train_state.bundle,
+                max_leaves=L, max_depth=self.config.max_depth,
+                max_bin=self.max_bin, emit="carry", full_bag=True,
+                max_cat_threshold=self.config.max_cat_threshold,
+                hist_slots=self._hist_slots,
+                forced_splits=self._forced_splits,
+                pristine=False, carried_root=root0, carry_dst=dst,
+                carried_bump0=bump0, interpret=interpret)
+            # per-row leaf value over the compacted order (leaf-index
+            # segments): boundary scatter + cumsum, no gather
+            lv = arrays.leaf_value.astype(jnp.float32)
+            lc = arrays.leaf_count
+            bounds = jnp.cumsum(lc)
+            diffs = jnp.zeros((n,), jnp.float32).at[0].add(lv[0])
+            diffs = diffs.at[bounds[:-1]].add(lv[1:] - lv[:-1],
+                                              mode="drop")
+            delta = jnp.cumsum(diffs)
+            sc_new = merge(jax.lax.dynamic_slice(
+                arena, (jnp.int32(base), dst), (3, n))) + shrink * delta
+            arena = jax.lax.dynamic_update_slice(
+                arena, jnp.stack(_pp.split_f32(sc_new)).astype(
+                    _pp.ARENA_DT), (jnp.int32(base), dst))
+            ivec, fvec = grow_ops.pack_tree_arrays(arrays)
+            ivec = jnp.concatenate([ivec, trunc.astype(jnp.int32)[None]])
+            return ivec, fvec, arena
+
+        return jax.jit(fused, donate_argnums=(0,))
+
+    def _run_fused_iter_carried(self):
+        key = (self.config.num_leaves, self.config.max_depth, self.max_bin,
+               self.config.max_cat_threshold)
+        if (getattr(self, "_carried_fn", None) is None
+                or getattr(self, "_carried_key", None) != key):
+            self._carried_fn = self._build_fused_iter_carried()
+            self._carried_key = key
+        sh = jnp.asarray(self.shrinkage_rate, self.dtype)
+        fmask = self._feature_sample()
+        field_vals = [getattr(h, a) for h, a in self._fused_fields]
+        p = self._carry_parity
+        root0 = jnp.int32(self._carry_slots[p])
+        dst = jnp.int32(self._carry_slots[1 - p])
+        ivec, fvec, arena = self._carried_fn(
+            self._arena, self._bins_t, root0, dst, field_vals,
+            self._row_all_in, fmask,
+            self.train_state.num_bins, self.train_state.default_bins,
+            self.train_state.missing_types, self.split_params,
+            self.monotone, self.penalty, sh)
+        if not getattr(self, "_fused_validated", False):
+            int(ivec[-1])
+            self._fused_validated = True
+        self._arena = arena
+        self._carry_parity = 1 - p
+        self._last_truncated = jnp.asarray(False)
+        self.train_state.defer_score(self._materialize_carried_score)
+        self.train_state._score_written = False   # defer isn't a write
+        return [(ivec, fvec)]
+
+    def _materialize_carried_score(self):
+        """Row-ordered [1, n] score from the arena's rowid + score
+        planes (one sort; only paid when something reads the score)."""
+        from ..ops import partition_pallas as _pp
+        if getattr(self, "_carry_mat_fn", None) is None:
+            n = self._bins_t.shape[1]
+            base = self._carry_base
+            fp6 = _pp.feature_channels(self._bins_t.shape[0]) + 6
+            dtype = self.dtype
+
+            @jax.jit
+            def mat(arena, root):
+                rid_pl = jax.lax.dynamic_slice(
+                    arena, (jnp.int32(fp6), root), (3, n))
+                rid = (rid_pl[0].astype(jnp.float32) * 65536.0
+                       + rid_pl[1].astype(jnp.float32) * 256.0
+                       + rid_pl[2].astype(jnp.float32)).astype(jnp.int32)
+                sc_pl = jax.lax.dynamic_slice(
+                    arena, (jnp.int32(base), root), (3, n))
+                sc = (sc_pl[0].astype(jnp.float32)
+                      + sc_pl[1].astype(jnp.float32)
+                      + sc_pl[2].astype(jnp.float32))
+                _, sv = jax.lax.sort((rid, sc), num_keys=1)
+                return sv[None, :].astype(dtype)
+
+            self._carry_mat_fn = mat
+        return self._carry_mat_fn(
+            self._arena, jnp.int32(self._carry_slots[self._carry_parity]))
 
     def _rebuild_train_score(self):
         """Recompute training scores from the materialized model — used

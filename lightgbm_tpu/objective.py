@@ -96,6 +96,26 @@ class ObjectiveFunction:
             grad, hess = self._apply_weights(grad, hess)
         return grad, hess
 
+    # -- carried-arena support (partition engine fast path) ----------------
+    # Pointwise objectives whose per-row gradient depends only on
+    # (score, a few per-row constants) can ride the carried arena: the
+    # constants are stored as bf16 residue planes next to the score
+    # planes and permuted along with the rows, so gradients are computed
+    # in ARENA order with no per-tree row-order recovery.  Return None
+    # (the default) to opt out — ranking/multiclass objectives need
+    # row-structured context and use the standard path.
+    def carry_fields(self):
+        """[(row-order [n] f32 array, n_planes)] or None.  n_planes=1
+        demands bf16-exact values (small ints, +-1 flags); n_planes=3 is
+        a full f32 residue split."""
+        return None
+
+    def carry_gradients(self, score, fields):
+        """(grad, hess) from ARENA-ordered score + carried fields;
+        must compute the exact same elementwise math as
+        get_gradients."""
+        raise NotImplementedError
+
     def _apply_weights(self, grad, hess):
         return grad * self.weights, hess * self.weights
 
@@ -149,6 +169,17 @@ class RegressionL2Loss(ObjectiveFunction):
 
     def _raw_gradients(self, score):
         return score - self.label, jnp.ones_like(score)
+
+    def carry_fields(self):
+        # subclasses (huber/fair/poisson/...) override _raw_gradients
+        # but inherit this method — gate on the exact class so they
+        # never silently train with plain L2 carried gradients
+        if type(self) is not RegressionL2Loss or self.weights is not None:
+            return None
+        return [(jnp.asarray(self.label, jnp.float32), 3)]
+
+    def carry_gradients(self, score, fields):
+        return score - fields[0], jnp.ones_like(score)
 
     def boost_from_score(self, class_id: int = 0) -> float:
         label = np.asarray(self.label, np.float64)
@@ -390,6 +421,22 @@ class BinaryLogloss(ObjectiveFunction):
         grad = response * self._label_weight
         hess = abs_resp * (self.sigmoid - abs_resp) * self._label_weight
         return grad, hess
+
+    def carry_fields(self):
+        # exact-type gate: a subclass overriding _raw_gradients must opt
+        # into the carried path itself (see RegressionL2Loss.carry_fields)
+        if (type(self) is not BinaryLogloss or self.weights is not None
+                or not self.need_train):
+            return None
+        # signed label is +-1 (bf16-exact, one plane); the per-row class
+        # weight is a full f32 (is_unbalance/scale_pos_weight ratios)
+        return [(self._signed_label, 1), (self._label_weight, 3)]
+
+    def carry_gradients(self, score, fields):
+        sl, lw = fields
+        response = -sl * self.sigmoid / (1.0 + jnp.exp(sl * self.sigmoid * score))
+        abs_resp = jnp.abs(response)
+        return response * lw, abs_resp * (self.sigmoid - abs_resp) * lw
 
     def boost_from_score(self, class_id: int = 0) -> float:
         if not self.need_train:

@@ -117,6 +117,15 @@ def grow_tree_partition_impl(
         hist_slots: int = 0,
         forced_splits: tuple = (),
         pristine: bool = False,
+        carried_root=None,            # traced col offset of an ALREADY-
+        #   assembled root segment (carried-arena mode): bins/rowids AND
+        #   score/label planes live at [carried_root, carried_root+n);
+        #   assembly only refreshes the g/h planes there.  Requires
+        #   full_bag; emit="carry" compacts the finished tree's segments
+        #   to carry_dst for the next iteration's root.
+        carry_dst=None,               # traced col offset for emit="carry"
+        carried_bump0: int = 0,       # static first bump column (past
+        #                               both root slots) in carried mode
         interpret: bool = False):
     """Grow one leaf-wise tree.
 
@@ -174,11 +183,21 @@ def grow_tree_partition_impl(
     # columns beyond n are never read (kernels mask by segment counts).
     adt = pp.ARENA_DT
     n_al = _align(n, pp.TILE)
+    carried = carried_root is not None
+    if carried and (not full_bag or dist):
+        raise ValueError("carried-arena mode requires full_bag serial")
     work0 = pp.pristine_work0(n) if pristine else 0
     gh = jnp.concatenate(
         [c[None] for c in pp.split_f32(grad)]
         + [c[None] for c in pp.split_f32(hess)], axis=0)
-    if pristine:
+    if carried:
+        # bins/rowids AND the score/label planes already sit at the
+        # carried root (compacted there by the previous tree's
+        # emit="carry"); only the g/h planes need this tree's gradients
+        arena = jax.lax.dynamic_update_slice(
+            arena_buf, gh, (jnp.int32(Fp),
+                            jnp.asarray(carried_root, jnp.int32)))
+    elif pristine:
         arena = jax.lax.dynamic_update_slice(arena_buf, gh, (Fp, 0))
     else:
         chans = [bins_t.astype(adt)]
@@ -202,8 +221,12 @@ def grow_tree_partition_impl(
         # assembled prefix — skip the O(n) compaction pass and the
         # OOB dump region entirely
         root_c = jnp.int32(n)
-        root_s0 = jnp.int32(0)
-        cursor0 = jnp.int32(work0 + n_al if pristine else n_al + pp.TILE)
+        if carried:
+            root_s0 = jnp.asarray(carried_root, jnp.int32)
+            cursor0 = jnp.int32(carried_bump0)
+        else:
+            root_s0 = jnp.int32(0)
+            cursor0 = jnp.int32(work0 + n_al if pristine else n_al + pp.TILE)
     else:
         in_bag = (row_leaf_init == 0)
         pred0 = jnp.pad(in_bag.astype(dtype), (0, cap - n))[None, :]
@@ -223,7 +246,7 @@ def grow_tree_partition_impl(
         cursor0 = jnp.int32(oob_dst + n_al)  # past the oob dump space
 
     if full_bag:
-        root_hist = seg(arena, jnp.int32(0), root_c)
+        root_hist = seg(arena, root_s0, root_c)
     else:
         root_hist = root_hist_b.astype(dtype)
     root_c_local = root_c
@@ -863,6 +886,19 @@ def grow_tree_partition_impl(
         is_cat=nm[:, 9] > 0.5,
         cat_mask=state.node_cat > 0.5)
 
+    if emit == "carry":
+        # carried-arena boundary: compact the live segments (leaf-index
+        # order, full channels incl. score/label planes) into the other
+        # root slot — NO row-order recovery, NO sort; the caller updates
+        # the score planes from leaf_value/leaf_count and roots the next
+        # tree at carry_dst (per-row leaf values derive from
+        # cumsum(leaf_count) over the same leaf order)
+        arena2, used = pp.compact_carry(
+            state.arena, lm[:, 6].astype(jnp.int32),
+            lm[:, 7].astype(jnp.int32), state.nl,
+            jnp.asarray(carry_dst, jnp.int32), interpret=interpret)
+        return tree, used, arena2, state.truncated
+
     # ---- recover per-row outputs from the final segments -----------------
     # The compact kernel streams ONLY the live segments (O(n) work,
     # independent of cap — the old step-function recovery paid three
@@ -903,5 +939,6 @@ def grow_tree_partition_impl(
 grow_tree_partition = partial(jax.jit, static_argnames=(
     "max_leaves", "max_depth", "max_bin", "emit", "full_bag",
     "max_cat_threshold", "axis_name", "learner", "num_machines", "top_k",
-    "hist_slots", "forced_splits", "pristine", "interpret"),
+    "hist_slots", "forced_splits", "pristine", "carried_bump0",
+    "interpret"),
     donate_argnums=(0,))(grow_tree_partition_impl)

@@ -197,7 +197,7 @@ def _sort_P(pref2, pred2, K: int):
 def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
                       out_any, cnt_ref, *rest,
                       C: int, tile: int, hist_plan=None):
-    """sc_ref (SMEM [8] i32): start, cnt, dstA, dstB, mode, xr, hs, fh —
+    """sc_ref (SMEM [7] i32): start, cnt, dstA, dstB, mode, xr, hs —
     start, dstA and dstB must be multiples of `tile` resp. FLUSH_W (the
     bump allocator aligns).
     arena_any/out_any: [C, cap] bf16 in HBM, aliased (same buffer).
@@ -238,10 +238,6 @@ def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
     xr = sc_ref[5]    # XOR'd into the decision: 1 when the left child is
     #                   the smaller (stream-B) side
     hs = sc_ref[6]    # fused-histogram stream: 1 -> B, 0 -> A
-    fh = sc_ref[7]    # 1 -> actually accumulate the fused histogram;
-    #                   0 -> skip the radix work (big parents use the
-    #                   separate O(child) kernel instead; the gate makes
-    #                   the fusion free to request on every split)
     n_tiles = jax.lax.div(cnt + jnp.int32(tile - 1), jnp.int32(tile))
     K = tile // SUB
     lane_w = jax.lax.broadcasted_iota(jnp.int32, (C, CARRY_W), 1)
@@ -357,15 +353,11 @@ def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
         predB = jnp.where(valid & ~on, jnp.float32(1.0), jnp.float32(0.0))
 
         if hist_plan is not None:
-            @pl.when(fh == 1)
-            def _():
-                hs_f = hs.astype(jnp.float32)
-                hmask = (hs_f * predB
-                         + (1.0 - hs_f) * predA).astype(jnp.bfloat16)
-                nb_h, k_h, m_h, lo_h, hi_h = hist_plan
-                _radix_accumulate(hist_ref, block, hmask, n_blocks=nb_h,
-                                  k=k_h, m=m_h, lo_n=lo_h, hi_n=hi_h,
-                                  tile=tile)
+            hs_f = hs.astype(jnp.float32)
+            hmask = (hs_f * predB + (1.0 - hs_f) * predA).astype(jnp.bfloat16)
+            nb_h, k_h, m_h, lo_h, hi_h = hist_plan
+            _radix_accumulate(hist_ref, block, hmask, n_blocks=nb_h, k=k_h,
+                              m=m_h, lo_n=lo_h, hi_n=hi_h, tile=tile)
 
         # ONE batched prefix scan for all subblocks of both streams — the
         # per-subblock scans were 2*K*log2(SUB) serial roll steps, the
@@ -438,11 +430,9 @@ def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
 
 
 @functools.partial(jax.jit, static_argnames=("tile", "interpret",
-                                             "num_features", "max_bin",
-                                             "raw_hist"))
+                                             "num_features", "max_bin"))
 def partition_segment(arena, pred, start, cnt, dstA, dstB,
-                      decision=None, hist_stream=None, fused_gate=None,
-                      raw_hist: bool = False,
+                      decision=None, hist_stream=None,
                       num_features: int = 0, max_bin: int = 0,
                       tile: int = TILE, interpret: bool = False):
     """Partition arena columns [start, start+cnt) into stream A at dstA
@@ -459,13 +449,10 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
     When hist_stream is given (0 -> stream A, 1 -> stream B; requires
     num_features/max_bin), the kernel also accumulates that stream's
     [F, max_bin, 3] histogram in the same pass and returns it third —
-    the partition + histogram fusion (bagging root pass, and the
-    small-parent split path).  fused_gate (traced 0/1, default 1) skips
-    the in-kernel radix work when 0 — big parents request the fusion
-    output buffer but do the histogram with the separate O(child)
-    kernel, so the grow loop can keep ONE partition call shape.
-    raw_hist=True returns the pre-epilogue radix buffer instead (the
-    caller runs split_radix_epilogue only on the branch that uses it).
+    the partition + histogram fusion (used for the bagging root pass;
+    a parent-size-gated fusion on the split path was measured ~10%
+    WORSE end-to-end in round 5 — the hist output's per-launch setup
+    outweighs the separate O(child) kernel's fixed cost).
 
     Returns (new_arena, counts[2] int32[, hist]).  Writes stay within
     align(count, FLUSH_W) columns of each stream's dst; reads overrun the
@@ -489,8 +476,6 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
                          ).astype(ARENA_DT)
     with_hist = hist_stream is not None
     tail.append(jnp.asarray(hist_stream if with_hist else 0, jnp.int32))
-    tail.append(jnp.asarray(1 if fused_gate is None else fused_gate,
-                            jnp.int32))
     sc = jnp.stack([jnp.asarray(start), jnp.asarray(cnt),
                     jnp.asarray(dstA), jnp.asarray(dstB)]
                    + tail).astype(jnp.int32)
@@ -538,11 +523,164 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
     )(sc, feat_onehot, goleft, arena, pred)
     if not with_hist:
         return outs[0], outs[1]
-    if raw_hist:
-        return outs[0], outs[1], outs[2]
     hist = split_radix_epilogue(outs[2], n_blocks * k, m, hi_n=hi_n,
                                 lo_n=lo_n)[:num_features, :max_bin, :]
     return outs[0], outs[1], hist
+
+
+def _compact_carry_kernel(sc_ref, starts_ref, cnts_ref, arena_any, out_any,
+                          used_ref, in_buf, carry, flush_buf,
+                          read_sems, write_sems, *, C: int, tile: int):
+    """Compact the live leaf segments' FULL channel rows into one dense
+    contiguous block at dst0 — the carried-arena tree boundary: instead
+    of extracting (rowid, value) pairs and sorting scores back to row
+    order (O(n log^2 n) bitonic, ~64 ms at 10.5M rows), the next tree
+    simply roots at the compacted block, and score/label planes ride
+    along as channels.  Valid rows are a PREFIX of every segment tile,
+    so appends need no permutation matmul: static SUB-wide slices roll
+    into the carry window exactly like the partition kernel's append
+    (same FLUSH_W-aligned write discipline; dst0 must be FLUSH_W-aligned
+    and the destination block must not overlap any live segment).
+
+    sc_ref (SMEM [2] i32): num_live_leaves, dst0.
+    starts/cnts (SMEM [L] i32): per-leaf segment start and count; the
+    output packs segments in LEAF-INDEX order (callers derive per-row
+    leaf values from cumsum(cnts)).
+    used_ref (SMEM [1] i32): rows written (= sum of cnts).
+    """
+    nseg, dst0 = sc_ref[0], sc_ref[1]
+    K = tile // SUB
+    lane_w = jax.lax.broadcasted_iota(jnp.int32, (C, CARRY_W), 1)
+    lane_s = jax.lax.broadcasted_iota(jnp.int32, (1, SUB), 1)
+
+    def read_dma(start, j, slot):
+        src = pl.multiple_of(start + j * tile, 128)
+        return pltpu.make_async_copy(
+            arena_any.at[:, pl.ds(src, tile)],
+            in_buf.at[slot], read_sems.at[slot])
+
+    def flush_dma(slot, dst_col):
+        return pltpu.make_async_copy(
+            flush_buf.at[slot],
+            out_any.at[:, pl.ds(pl.multiple_of(dst_col, 128), FLUSH_W)],
+            write_sems.at[slot])
+
+    carry[:] = jnp.zeros((C, CARRY_W), jnp.float32)
+
+    def append(chunk, ck, fill, written, fslot):
+        """The partition kernel's append/flush, single-stream, lo=0."""
+        padded = jnp.concatenate(
+            [chunk, jnp.zeros((C, CARRY_W - SUB), jnp.float32)], axis=1)
+        carry[:] = carry[:] + pltpu.roll(padded, fill, axis=1)
+        fill = fill + ck
+        for _ in range(-(-SUB // FLUSH_W)):
+            @pl.when(fill >= FLUSH_W)
+            def _(fill=fill, written=written, fslot=fslot):
+                @pl.when(written >= 2 * FLUSH_W)
+                def _():
+                    flush_dma(fslot, 0).wait()
+                flush_buf[fslot] = carry[:, 0:FLUSH_W].astype(ARENA_DT)
+                flush_dma(fslot, dst0 + written).start()
+                shifted = jnp.concatenate(
+                    [carry[:, FLUSH_W:CARRY_W],
+                     jnp.zeros((C, FLUSH_W), jnp.float32)], axis=1)
+                carry[:] = jnp.where(lane_w < fill - FLUSH_W, shifted,
+                                     jnp.float32(0.0))
+            flushed = fill >= FLUSH_W
+            fill = jnp.where(flushed, fill - FLUSH_W, fill)
+            written = jnp.where(flushed, written + FLUSH_W, written)
+            fslot = jnp.where(flushed, 1 - fslot, fslot)
+        return fill, written, fslot
+
+    def seg_body(s, st):
+        fill, written, fslot, rd = st
+        start, cnt = starts_ref[s], cnts_ref[s]
+        n_t = jax.lax.div(cnt + jnp.int32(tile - 1), jnp.int32(tile))
+
+        @pl.when(n_t > 0)
+        def _():
+            read_dma(start, 0, jax.lax.rem(rd, jnp.int32(2))).start()
+
+        def tile_body(j, st2):
+            fill, written, fslot, rd = st2
+            rslot = jax.lax.rem(rd, jnp.int32(2))
+            read_dma(start, j, rslot).wait()
+
+            @pl.when(j + 1 < n_t)
+            def _():
+                read_dma(start, j + 1, 1 - rslot).start()
+            vt = cnt - j * tile          # valid prefix of this tile
+            block = in_buf[rslot]
+            for k2 in range(K):
+                ck = jnp.clip(vt - k2 * SUB, 0, SUB)
+                chunk = jnp.where(
+                    lane_s < ck,
+                    block[:, k2 * SUB:(k2 + 1) * SUB].astype(jnp.float32),
+                    jnp.float32(0.0))
+                fill, written, fslot = append(chunk, ck, fill, written,
+                                              fslot)
+            return fill, written, fslot, rd + 1
+
+        return jax.lax.fori_loop(0, n_t, tile_body,
+                                 (fill, written, fslot, rd))
+
+    z = jnp.int32(0)
+    fill, written, fslot, _rd = jax.lax.fori_loop(
+        0, nseg, seg_body, (z, z, z, z))
+
+    @pl.when(fill > 0)
+    def _():
+        @pl.when(written >= 2 * FLUSH_W)
+        def _():
+            flush_dma(fslot, 0).wait()
+        flush_buf[fslot] = carry[:, 0:FLUSH_W].astype(ARENA_DT)
+        flush_dma(fslot, dst0 + written).start()
+        flush_dma(fslot, 0).wait()
+
+    @pl.when((fill == 0) & (written >= 2 * FLUSH_W))
+    def _():
+        flush_dma(fslot, 0).wait()
+
+    @pl.when(written >= FLUSH_W)
+    def _():
+        flush_dma(1 - fslot, 0).wait()
+
+    used_ref[0] = written + fill
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def compact_carry(arena, starts, cnts, num_live, dst0,
+                  tile: int = TILE, interpret: bool = False):
+    """Compact live segments (leaf-index order) into a dense full-channel
+    block at dst0; returns (arena', rows_written).  dst0 must be
+    FLUSH_W-aligned and its block disjoint from every live segment."""
+    C, cap = arena.shape
+    sc = jnp.stack([jnp.asarray(num_live),
+                    jnp.asarray(dst0)]).astype(jnp.int32)
+    kernel = functools.partial(_compact_carry_kernel, C=C, tile=tile)
+    out, used = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)),
+        out_shape=(jax.ShapeDtypeStruct((C, cap), ARENA_DT),
+                   jax.ShapeDtypeStruct((1,), jnp.int32)),
+        scratch_shapes=[
+            pltpu.VMEM((2, C, tile), ARENA_DT),
+            pltpu.VMEM((C, CARRY_W), jnp.float32),
+            pltpu.VMEM((2, C, FLUSH_W), ARENA_DT),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        input_output_aliases={3: 0},
+        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        interpret=interpret,
+    )(sc, jnp.asarray(starts, jnp.int32), jnp.asarray(cnts, jnp.int32),
+      arena)
+    return out, used[0]
 
 
 def _compact_rows_kernel(sc_ref, starts_ref, cnts_ref, vals_ref, arena_any,

@@ -53,10 +53,13 @@ def _kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, out_any, cnt_ref,
     carryA[:] = jnp.zeros((C, CARRY_W), jnp.float32)
     carryB[:] = jnp.zeros((C, CARRY_W), jnp.float32)
 
-    def append_and_flush(carry, comp, ck, fill, written, dst, stream, fslot):
+    def append_and_flush(carry, chunk, lo, ck, fill, written, dst, stream,
+                         fslot):
         padded = jnp.concatenate(
-            [comp, jnp.zeros((C, CARRY_W - SUB), jnp.float32)], axis=1)
-        carry[:] = carry[:] + pltpu.roll(padded, fill, axis=1)
+            [chunk, jnp.zeros((C, CARRY_W - SUB), jnp.float32)], axis=1)
+        shift = jax.lax.rem(fill - lo + jnp.int32(CARRY_W),
+                            jnp.int32(CARRY_W))
+        carry[:] = carry[:] + pltpu.roll(padded, shift, axis=1)
         fill = fill + ck
 
         @pl.when(fill >= FLUSH_W)
@@ -118,7 +121,7 @@ def _kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, out_any, cnt_ref,
                 if stage == "scan":
                     chk = chk + pref2[0, 0]
                 else:
-                    P_all = pp._dual_stream_P(pref2, pred2, K)
+                    P_all = pp._sort_P(pref2, pred2, K)
                     if stage == "pbuild":
                         chk = chk + jnp.sum(P_all[0, 0:1, 0:1].astype(jnp.float32))
                     else:
@@ -129,13 +132,20 @@ def _kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, out_any, cnt_ref,
                         if stage == "matmul":
                             chk = chk + comps[0][0, 0]
                         else:
+                            lane_s = jax.lax.broadcasted_iota(
+                                jnp.int32, (1, SUB), 1)
+                            chunksA = [jnp.where(lane_s < cnt2[k],
+                                                 comps[k], jnp.float32(0.0))
+                                       for k in range(K)]
+                            chunksB = [comps[k] - chunksA[k]
+                                       for k in range(K)]
                             for k in range(K):
                                 ca, cb = cnt2[k], cnt2[K + k]
                                 fillA, wA, fsA = append_and_flush(
-                                    carryA, comps[k][:, :SUB], ca,
+                                    carryA, chunksA[k], jnp.int32(0), ca,
                                     fillA, wA, dstA, 0, fsA)
                                 fillB, wB, fsB = append_and_flush(
-                                    carryB, comps[k][:, SUB:], cb,
+                                    carryB, chunksB[k], ca, cb,
                                     fillB, wB, dstB, 1, fsB)
 
         @pl.when(j + 1 < n_tiles)

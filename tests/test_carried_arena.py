@@ -94,3 +94,50 @@ def test_carried_lazy_score_materializes(rng):
     # training-state score (deferred pipeline drains on predict)
     raw = bst.predict(X, raw_score=True)
     np.testing.assert_allclose(score, raw, rtol=1e-3, atol=1e-5)
+
+
+def test_carried_with_forced_splits(rng, tmp_path):
+    """Forced splits inject cache rows before the grow loop — the
+    carried root must serve them identically to the pristine path."""
+    import json
+    X, y = _data(rng)
+    fs = {"feature": 0, "threshold": 0.0,
+          "left": {"feature": 1, "threshold": 0.0}}
+    p = tmp_path / "forced.json"
+    p.write_text(json.dumps(fs))
+    preds = {}
+    for eng in ("partition", "label"):
+        params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+                  "min_data_in_leaf": 5, "tpu_tree_engine": eng,
+                  "forcedsplits_filename": str(p)}
+        bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=6)
+        model = bst._gbdt.models[0]
+        assert int(model.split_feature[0]) == 0       # root forced
+        preds[eng] = bst.predict(X)
+    np.testing.assert_allclose(preds["partition"], preds["label"],
+                               rtol=1e-3, atol=1e-5)
+
+
+def test_carried_with_efb_bundles(rng):
+    """EFB-bundled group columns ride the carried arena: bins_t holds
+    GROUP columns and the carry planes sit after the group block."""
+    n = 4000
+    num = rng.randn(n, 3).astype(np.float32)
+    cats = rng.randint(0, 3, (n, 6))
+    onehot = np.zeros((n, 18), np.float32)
+    onehot[np.arange(n)[:, None], cats + np.arange(6) * 3] = 1.0
+    X = np.column_stack([num, onehot])
+    y = (num[:, 0] + (cats[:, 0] == 1) + 0.3 * rng.randn(n) > 0.5
+         ).astype(np.float32)
+    preds = {}
+    for eng in ("partition", "label"):
+        params = {"objective": "binary", "num_leaves": 31, "verbose": -1,
+                  "min_data_in_leaf": 5, "tpu_tree_engine": eng,
+                  "enable_bundle": True}
+        bst = lgb.train(params, lgb.Dataset(X, y), num_boost_round=8)
+        if eng == "partition":
+            assert getattr(bst._gbdt, "_carried_active", False) is True
+            assert bst._gbdt.train_state.bundle is not None
+        preds[eng] = bst.predict(X)
+    np.testing.assert_allclose(preds["partition"], preds["label"],
+                               rtol=1e-3, atol=1e-5)

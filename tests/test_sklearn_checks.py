@@ -18,18 +18,10 @@ sklearn = pytest.importorskip("sklearn")
 from sklearn.utils.estimator_checks import check_estimator  # noqa: E402
 
 
-@pytest.fixture(autouse=True, scope="module")
-def _fresh_jit_caches():
-    """The ~80 tiny fits per estimator check ride on top of every jit
-    executable the preceding suite accumulated; with the full suite's
-    prefix the XLA-CPU client deterministically SEGFAULTS in
-    backend_compile_and_load here (observed at the same check twice,
-    exit 139; the 5-file tail alone passes).  Dropping the accumulated
-    executables before this module keeps the full-suite run inside
-    whatever client limit is being tripped."""
-    import jax
-    jax.clear_caches()
-    yield
+# (the jit-cache segfault workaround that lived here moved to
+# conftest._clear_jax_caches_per_module: round 5's extra tests made the
+# accumulation crash EARLIER than this module, so the clear now runs at
+# every module boundary)
 
 # Documented skips — each one has a reason, mirroring the reference's
 # filtered harness (the reference skips check_estimators_nan_inf with

@@ -161,7 +161,10 @@ def _prefix_scan_lanes(x):
 
 
 FLUSH_W = SUB          # flush chunk width; all HBM write offsets are
-#                        multiples of FLUSH_W (tiled-memref alignment)
+#                        multiples of FLUSH_W (tiled-memref alignment).
+#                        128 RE-TESTED with the sort-P kernel (round 5):
+#                        21.8 vs 22.9 Mrows*iter/s — narrower carries
+#                        don't pay for the doubled flush DMAs here either
 CARRY_W = FLUSH_W + SUB    # per-stream carry width (append window)
 
 

@@ -1,0 +1,56 @@
+"""The two artifacts the build driver executes every round must never
+break: bench.py (headline JSON line) and __graft_entry__.py (single-chip
+compile check + multi-chip dryrun).  A regression in either costs a
+whole round, so they run here on the CPU mesh at smoke shapes."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_smoke_json_contract():
+    """bench.py on the CPU backend: one JSON line, schema fields
+    present, quality_ok true, exit 0.  The CPU platform must be FORCED
+    in-process (sitecustomize pre-registers the tunnel TPU and a plain
+    JAX_PLATFORMS env var loses to it — NOTES.md)."""
+    runner = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');\n"
+        "import jax.extend.backend; jax.extend.backend.clear_backends();\n"
+        "import runpy, sys; sys.argv = ['bench.py'];\n"
+        "runpy.run_path(%r, run_name='__main__')\n"
+        % os.path.join(REPO, "bench.py"))
+    res = subprocess.run([sys.executable, "-c", runner],
+                         capture_output=True, text=True,
+                         cwd=REPO, timeout=1200)
+    assert res.returncode == 0, (res.stdout[-2000:], res.stderr[-2000:])
+    line = res.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    for key in ("metric", "value", "unit", "vs_baseline", "detail"):
+        assert key in out, key
+    d = out["detail"]
+    assert d["quality_ok"] is True
+    assert d["higgs"]["quality_ok"] and d["lambdarank"]["quality_ok"]
+    assert out["unit"] == "Mrows*iter/s"
+
+
+def test_graft_entry_single_chip():
+    import jax
+
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    jax.jit(fn).lower(*args).compile()
+
+
+def test_graft_entry_multichip_dryrun():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("conftest provides the 8-device CPU mesh")
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)

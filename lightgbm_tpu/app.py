@@ -52,7 +52,8 @@ class Application:
     def __init__(self, argv: List[str]):
         self.raw_params = parse_argv(argv)
         self.config = Config(self.raw_params)
-        if not self.config.data and self.config.task != "convert_model":
+        if not self.config.data and self.config.task not in ("convert_model",
+                                                             "serve"):
             log.fatal("No training/prediction data, application quit")
 
     def run(self) -> None:
@@ -63,6 +64,8 @@ class Application:
             self.predict()
         elif task == "convert_model":
             self.convert_model()
+        elif task == "serve":
+            self.serve()
         else:
             log.fatal("Unknown task type %s" % task)
 
@@ -188,6 +191,26 @@ class Application:
         booster.refit_inplace(d.X, d.label, weight=d.weight, group=d.group)
         booster.save_model(cfg.output_model)
         log.info("Finished refit; model saved to %s", cfg.output_model)
+
+    def serve(self) -> None:
+        """task=serve: load input_model into the inference server and
+        block on the HTTP frontend (lightgbm_tpu/serving; no reference
+        analogue — the CLI face of the ROADMAP's heavy-traffic goal).
+
+            python -m lightgbm_tpu task=serve input_model=model.txt \\
+                serve_port=9109 serve_max_batch_rows=256
+        """
+        cfg = self.config
+        if not cfg.input_model:
+            log.fatal("Need input_model for serve task")
+        from .serving import Server
+        server = Server(cfg)
+        entry = server.load_model(cfg.serve_model_name,
+                                  model_file=cfg.input_model)
+        log.info("Loaded %s v%d (%d trees); serving on %s:%d",
+                 entry.name, entry.version, entry.num_trees,
+                 cfg.serve_host, cfg.serve_port)
+        server.serve_http(block=True)
 
     def convert_model(self) -> None:
         """task=convert_model: model file -> standalone C++ if-else code

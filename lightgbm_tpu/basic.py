@@ -99,6 +99,22 @@ class Dataset:
         self._predictor = None  # set when continuing training (init_model)
         self._stream_mapper: Optional[BinnedDataset] = None
         self._stream_bins: Optional[np.ndarray] = None
+        self._attrs: Dict[str, str] = {}
+
+    # -- free-form attributes (xgboost-style attr/set_attr surface) --------
+    def attr(self, key: str) -> Optional[str]:
+        """The attribute string stored under `key`, or None when unset."""
+        return self._attrs.get(str(key))
+
+    def set_attr(self, **kwargs) -> "Dataset":
+        """Set string attributes on the dataset; a value of None deletes
+        the key.  Non-string values are stored via str()."""
+        for k, v in kwargs.items():
+            if v is None:
+                self._attrs.pop(str(k), None)
+            else:
+                self._attrs[str(k)] = str(v)
+        return self
 
     @classmethod
     def for_streaming(cls, sample: np.ndarray, num_total_row: int,
@@ -457,6 +473,35 @@ class Booster:
         self._gbdt.rollback_one_iter()
         return self
 
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """Reset config parameters on the live booster
+        (Booster.reset_parameter -> LGBM_BoosterResetParameter,
+        c_api.cpp).  learning_rate-only updates take a cheap path (the
+        shrinkage scalar is a traced input, no retrace); anything else
+        rebuilds the growth params and drops the fused trace so the
+        next iteration picks the new statics up."""
+        from .config import alias_transform
+        g = self._gbdt
+        updates = alias_transform(dict(params))
+        merged = dict(self.params or {})
+        merged.update(params)
+        self.params = merged
+        if set(updates) <= {"learning_rate"}:
+            lr = updates.get("learning_rate")
+            if lr is not None:
+                lr = float(lr)
+                self.config.learning_rate = lr
+                g.config.learning_rate = lr
+                g.shrinkage_rate = lr
+            return self
+        g._sync_model()
+        self.config = Config(merged)
+        g.config = self.config
+        g.shrinkage_rate = g.config.learning_rate
+        g._refresh_split_params()   # growth reads split_params, not config
+        g._fused_fn = None          # statics may have changed; retrace lazily
+        return self
+
     @property
     def current_iteration(self) -> int:
         return self._gbdt.current_iteration
@@ -538,6 +583,20 @@ class Booster:
     def model_to_string(self, num_iteration: int = -1,
                         start_iteration: int = 0) -> str:
         return self._gbdt.save_model_to_string(start_iteration, num_iteration)
+
+    def model_from_string(self, model_str: str) -> "Booster":
+        """Load a model from text into THIS booster post-construction
+        (Booster.model_from_string, python-package basic.py:2023-2039);
+        re-dispatches the boosting class from the text header, so a gbdt
+        shell can take a dart/rf model."""
+        self._init_from_string(model_str)
+        self.best_iteration = -1
+        return self
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """Raw output value of one leaf (Booster.get_leaf_output,
+        python-package basic.py:2140-2155)."""
+        return self._gbdt.get_leaf_output(tree_id, leaf_id)
 
     def feature_importance(self, importance_type: str = "split",
                            iteration: int = -1) -> np.ndarray:

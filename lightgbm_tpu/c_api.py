@@ -815,18 +815,9 @@ def LGBM_BoosterResetTrainingData(handle, train_data):
 
 @_wrap
 def LGBM_BoosterResetParameter(handle, parameters):
-    from .config import Config
-    bst = _resolve(handle)
-    params = _parse_params(parameters)
-    g = bst._gbdt
-    g._sync_model()
-    merged = dict(bst.params or {})
-    merged.update(params)
-    bst.params = merged
-    g.config = Config(merged)
-    g.shrinkage_rate = g.config.learning_rate
-    g._refresh_split_params()   # growth reads split_params, not config
-    g._fused_fn = None     # statics may have changed; retrace lazily
+    # one implementation for the python and C surfaces: the callback
+    # scheduler (callback.reset_parameter) and the ABI both route here
+    _resolve(handle).reset_parameter(_parse_params(parameters))
 
 
 @_wrap

@@ -95,11 +95,14 @@ def reset_parameter(**kwargs) -> Callable:
                    for k, v in kwargs.items()}
         if not updates:
             return
-        lr = updates.get("learning_rate")
-        if lr is not None:
-            targets = getattr(env.model, "boosters", None) or [env.model]
-            for bst in targets:
-                bst._gbdt.shrinkage_rate = lr
+        # EVERY scheduled parameter goes through Booster.reset_parameter
+        # (-> LGBM_BoosterResetParameter), not just learning_rate: the
+        # growth params (lambda_l2, min_data_in_leaf, ...) only act via
+        # the booster's split-param refresh, so a bare env.params update
+        # would silently schedule nothing
+        targets = getattr(env.model, "boosters", None) or [env.model]
+        for bst in targets:
+            bst.reset_parameter(updates)
         env.params.update(updates)
 
     _callback.before_iteration = True

@@ -151,6 +151,22 @@ _SCHEMA = [
     #   sync per phase, so only enable when measuring)
     ("tpu_profile_trace_dir", str, ""),      # non-empty -> jax.profiler trace of training
     ("num_devices", int, 0),                 # 0 = use all local devices for parallel learners
+    # --- serving parameters (no reference analogue)
+    # task=serve: TPU-resident inference server (lightgbm_tpu/serving) —
+    # adaptive micro-batching over the compiled signature-matmul
+    # predictor; see docs/Serving.md for tuning guidance.
+    ("serve_host", str, "127.0.0.1"),        # HTTP bind address
+    ("serve_port", int, 9109),               # HTTP port (0 = ephemeral)
+    ("serve_model_name", str, "default"),    # registry name for input_model
+    ("serve_max_batch_rows", int, 256),      # coalesced batch cap (rounded up to pow2)
+    ("serve_batch_wait_ms", float, 2.0),     # max wait to fill a batch before dispatch
+    ("serve_queue_rows", int, 4096),         # bounded queue (rows); beyond -> 429/fallback
+    ("serve_request_timeout_ms", float, 1000.0),  # per-request deadline incl. queue wait
+    ("serve_max_models", int, 4),            # registry capacity; LRU eviction beyond
+    ("serve_warmup_buckets", "vec_int", []),  # row buckets to pre-compile; [] = pow2 up to max batch
+    ("serve_min_device_work", int, 1 << 22),  # per-batch rows*trees floor for the device path
+    ("serve_host_fallback", bool, True),     # overflow/small traffic -> host walk instead of 429
+    ("serve_fallback_max_rows", int, 16),    # biggest request served host-side under overload
 ]
 
 # alias -> canonical name (src/io/config_auto.cpp:4-157)
@@ -243,6 +259,12 @@ ALIAS_TABLE: Dict[str, str] = {
     "machine_list_file": "machine_list_filename", "machine_list": "machine_list_filename",
     "mlist": "machine_list_filename",
     "workers": "machines", "nodes": "machines",
+    "serving_host": "serve_host", "serve_address": "serve_host",
+    "serving_port": "serve_port",
+    "serve_max_batch": "serve_max_batch_rows",
+    "serve_max_wait_ms": "serve_batch_wait_ms",
+    "serve_queue_size": "serve_queue_rows",
+    "serve_timeout_ms": "serve_request_timeout_ms",
 }
 
 PARAMETER_TYPES: Dict[str, Any] = {name: typ for name, typ, _ in _SCHEMA}
@@ -436,6 +458,16 @@ class Config:
             log.fatal("top_rate + other_rate must be <= 1.0 for GOSS")
         if self.top_k <= 0:
             log.fatal("top_k must be > 0, got %d" % self.top_k)
+        if self.serve_max_batch_rows < 1:
+            log.fatal("serve_max_batch_rows must be >= 1, got %d"
+                      % self.serve_max_batch_rows)
+        if self.serve_queue_rows < self.serve_max_batch_rows:
+            log.fatal("serve_queue_rows (%d) must be >= serve_max_batch_rows "
+                      "(%d)" % (self.serve_queue_rows,
+                                self.serve_max_batch_rows))
+        if self.serve_batch_wait_ms < 0 or self.serve_request_timeout_ms <= 0:
+            log.fatal("serve_batch_wait_ms must be >= 0 and "
+                      "serve_request_timeout_ms > 0")
 
     def is_single_machine(self) -> bool:
         return self.num_machines <= 1

@@ -1331,7 +1331,12 @@ class GBDT:
     # ------------------------------------------------------------------ #
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1,
                     early_stop: bool = False, early_stop_freq: int = 10,
-                    early_stop_margin: float = 10.0) -> np.ndarray:
+                    early_stop_margin: float = 10.0,
+                    device: Optional[bool] = None) -> np.ndarray:
+        """device: None = auto by MIN_DEVICE_WORK; True forces the
+        batched device ensemble (host walk only if the ensemble cannot
+        build); False forces the host walk (the serving fallback path
+        needs the choice pinned per batch, not per global threshold)."""
         self._sync_model()
         from ..io.dataset import _issparse
         if _issparse(X):
@@ -1341,7 +1346,7 @@ class GBDT:
             parts = [self.predict_raw(
                 np.asarray(X[i:i + step].todense()), num_iteration,
                 early_stop=early_stop, early_stop_freq=early_stop_freq,
-                early_stop_margin=early_stop_margin)
+                early_stop_margin=early_stop_margin, device=device)
                 for i in range(0, X.shape[0], step)]
             return np.concatenate(parts, axis=0)
         X = np.ascontiguousarray(np.asarray(X, np.float64))
@@ -1357,8 +1362,10 @@ class GBDT:
         # batched device walk for real workloads (gbdt_prediction.cpp
         # redesign, ops/predict.py): all (tree, row) pairs in parallel;
         # the host loop below keeps early-stop and small-input duty
-        if not early_stop and n * max(len(self.models), 1) \
-                >= predict_ops.MIN_DEVICE_WORK:
+        want_device = (device if device is not None
+                       else n * max(len(self.models), 1)
+                       >= predict_ops.MIN_DEVICE_WORK)
+        if not early_stop and want_device:
             ens = self._device_ensemble()
             if ens is not None:
                 out = ens.predict_sum(X, iters)
@@ -1423,15 +1430,70 @@ class GBDT:
     def predict(self, X: np.ndarray, num_iteration: int = -1,
                 raw_score: bool = False, early_stop: bool = False,
                 early_stop_freq: int = 10,
-                early_stop_margin: float = 10.0) -> np.ndarray:
+                early_stop_margin: float = 10.0,
+                device: Optional[bool] = None) -> np.ndarray:
         raw = self.predict_raw(X, num_iteration, early_stop=early_stop,
                                early_stop_freq=early_stop_freq,
-                               early_stop_margin=early_stop_margin)
+                               early_stop_margin=early_stop_margin,
+                               device=device)
+        return self._convert_output(raw, raw_score)
+
+    def _convert_output(self, raw: np.ndarray, raw_score: bool) -> np.ndarray:
         if raw_score or self.objective is None:
             return raw
         if self.num_tree_per_iteration > 1:
             return np.asarray(self.objective.convert_output_multi(raw))
         return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+
+    def predict_bucketed(self, X: np.ndarray, num_iteration: int = -1,
+                         raw_score: bool = False,
+                         max_bucket: int = 1 << 20) -> np.ndarray:
+        """Serving hot path: rows padded to the power-of-two bucket so
+        concurrent request sizes share ONE compiled executable per
+        bucket (ops/predict.py predict_bucketed).  Per-row outputs are
+        bitwise identical to the device path of predict(); falls back
+        to the host walk when the ensemble cannot run on device."""
+        self._sync_model()
+        X = np.ascontiguousarray(np.asarray(X, np.float64))
+        if X.ndim != 2 or X.shape[1] <= self.max_feature_idx:
+            log.fatal("The number of features in data (%d) is not the same as "
+                      "it was in training data (%d)"
+                      % (X.shape[1] if X.ndim == 2 else 0,
+                         self.max_feature_idx + 1))
+        ens = self._device_ensemble()
+        if ens is None:
+            return self.predict(X, num_iteration, raw_score=raw_score,
+                                device=False)
+        k = self.num_tree_per_iteration
+        total_iters = len(self.models) // max(k, 1)
+        iters = (total_iters if num_iteration <= 0
+                 else min(num_iteration, total_iters))
+        out = ens.predict_bucketed(X, iters, max_bucket=max_bucket)
+        if self.average_output:
+            out /= max(iters, 1)
+        raw = out[0] if k == 1 else out.T
+        return self._convert_output(raw, raw_score)
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """Raw output of one leaf (Booster.get_leaf_output, python-package
+        basic.py -> LGBM_BoosterGetLeafValue)."""
+        self._sync_model()
+        if not 0 <= tree_id < len(self.models):
+            log.fatal("tree_id %d out of range [0, %d)" % (tree_id,
+                                                           len(self.models)))
+        tree = self.models[tree_id]
+        if not 0 <= leaf_id < tree.num_leaves:
+            log.fatal("leaf_id %d out of range [0, %d)" % (leaf_id,
+                                                           tree.num_leaves))
+        return float(tree.leaf_value[leaf_id])
+
+    def model_from_string(self, text: str) -> "GBDT":
+        """Replace this booster's model in place from model text — the
+        post-constructor reload path (LGBM_BoosterLoadModelFromString
+        semantics on an existing handle); caches (device ensemble,
+        fused trace) are invalidated by load_model_from_string."""
+        self.load_model_from_string(text)
+        return self
 
     def predict_contrib(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
         self._sync_model()

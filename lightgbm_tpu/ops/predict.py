@@ -51,6 +51,24 @@ def _next_pow2(x: int) -> int:
     return p
 
 
+def bucket_rows(n: int, max_bucket: int = 1 << 20) -> int:
+    """Row-count bucket for executable reuse: the next power of two,
+    capped so giant requests chunk through predict_sum instead of
+    compiling a bespoke one-off executable."""
+    return min(_next_pow2(max(n, 1)), _next_pow2(max_bucket))
+
+
+def pow2_buckets(max_batch: int) -> List[int]:
+    """All power-of-two bucket sizes up to (and including) max_batch —
+    the default warmup set for serving."""
+    out, b = [], 1
+    top = _next_pow2(max(max_batch, 1))
+    while b <= top:
+        out.append(b)
+        b *= 2
+    return out
+
+
 class DeviceEnsemble:
     """Stacked ensemble for device prediction; built once per model state
     (callers cache on len(models))."""
@@ -194,6 +212,36 @@ class DeviceEnsemble:
         # a blocking device sync per chunk (remote-attached TPUs)
         out = np.array(jnp.concatenate(parts, axis=1), np.float64)
         return out[:, :n]
+
+    # -- serving hooks ----------------------------------------------- #
+    def predict_bucketed(self, X: np.ndarray, num_iteration: int,
+                         max_bucket: int = 1 << 20) -> np.ndarray:
+        """predict_sum with rows padded to the power-of-two bucket, so
+        every request size between buckets reuses ONE compiled
+        executable (the serving hot path; per-row results are unchanged
+        by padding — reductions are row-independent).  Returns [k, n]."""
+        n = X.shape[0]
+        B = bucket_rows(n, max_bucket)
+        if B > n:
+            Xp = np.zeros((B, X.shape[1]), X.dtype)
+            Xp[:n] = X
+        else:
+            Xp = X
+        return self.predict_sum(Xp, num_iteration)[:, :n]
+
+    def warmup_buckets(self, num_features: int, buckets,
+                       num_iteration: int) -> List[int]:
+        """Pre-compile the per-bucket executables a server will hit, so
+        the first real request never waits on XLA.  Returns the bucket
+        sizes actually compiled."""
+        done = []
+        for b in sorted(set(int(x) for x in buckets)):
+            if b <= 0:
+                continue
+            self.predict_sum(np.zeros((b, num_features), np.float64),
+                             num_iteration)
+            done.append(b)
+        return done
 
 
 @partial(jax.jit, static_argnames=("k", "T", "N"))

@@ -1,0 +1,22 @@
+"""lightgbm_tpu.serving — TPU-resident inference serving.
+
+A model registry with versioned hot-swap (registry.py), an adaptive
+micro-batcher amortizing the ~100 ms device dispatch floor across
+concurrent requests (batcher.py), an in-process + stdlib-HTTP frontend
+(server.py, CLI task=serve), request-path observability (metrics.py)
+and a small client (client.py).  See docs/Serving.md.
+"""
+from .batcher import (BatcherStoppedError, MicroBatcher,  # noqa: F401
+                      QueueFullError, RequestTimeoutError)
+from .client import ServingClient, ServingError  # noqa: F401
+from .metrics import Histogram, ModelStats  # noqa: F401
+from .registry import (ModelEntry, ModelNotFoundError,  # noqa: F401
+                       ModelRegistry)
+from .server import Server  # noqa: F401
+
+__all__ = [
+    "Server", "ServingClient", "ServingError",
+    "ModelRegistry", "ModelEntry", "ModelNotFoundError",
+    "MicroBatcher", "QueueFullError", "RequestTimeoutError",
+    "BatcherStoppedError", "ModelStats", "Histogram",
+]

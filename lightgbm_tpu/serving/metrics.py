@@ -1,0 +1,159 @@
+"""Request-path observability: counters + histograms per served model.
+
+The serving analogue of the training-side TIMETAG profiler
+(utils/profiling.py): every request, batch dispatch, rejection and
+fallback increments lock-guarded accumulators, and /stats renders one
+JSON snapshot — request counts, batch-size distribution, latency
+percentiles, live queue depth — cheap enough to leave on in production
+(two dict updates per request; no locks on the predict dispatch itself).
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence
+
+# Latency buckets (ms): roughly log-spaced around the ~100 ms blocking
+# device-dispatch floor measured in NOTES.md, so the histogram resolves
+# both the coalesced-fast-path and the compile-stall tail.
+DEFAULT_LATENCY_BOUNDS_MS = (
+    0.5, 1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000, 5000)
+# Batch-size buckets: power-of-two edges matching the batcher's row
+# buckets, so the histogram reads as "which executables are hot".
+DEFAULT_BATCH_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+
+
+class Histogram:
+    """Fixed-boundary histogram with percentile estimation.
+
+    observe() is O(log buckets); percentile() linearly interpolates
+    inside the winning bucket (Prometheus histogram_quantile style), so
+    p50/p99 come out of bounded memory without storing samples.
+    """
+
+    def __init__(self, bounds: Sequence[float]):
+        self.bounds: List[float] = sorted(float(b) for b in bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.n = 0
+        self.total = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.n += 1
+        self.total += v
+        self.min = v if self.min is None else min(self.min, v)
+        self.max = v if self.max is None else max(self.max, v)
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Estimated q-th percentile (q in [0, 100]); None when empty."""
+        if self.n == 0:
+            return None
+        rank = q / 100.0 * self.n
+        seen = 0
+        for i, c in enumerate(self.counts):
+            if seen + c >= rank and c > 0:
+                lo = self.bounds[i - 1] if i > 0 else (self.min or 0.0)
+                hi = self.bounds[i] if i < len(self.bounds) else \
+                    (self.max if self.max is not None else lo)
+                frac = (rank - seen) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            seen += c
+        return self.max
+
+    def snapshot(self) -> Dict:
+        return {
+            "count": self.n,
+            "sum": round(self.total, 6),
+            "mean": round(self.total / self.n, 6) if self.n else None,
+            "min": self.min,
+            "max": self.max,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+            "buckets": {
+                ("le_%g" % self.bounds[i]) if i < len(self.bounds)
+                else "inf": c
+                for i, c in enumerate(self.counts) if c
+            },
+        }
+
+
+class ModelStats:
+    """Per-model request-path accumulators; one per registry name."""
+
+    def __init__(self,
+                 latency_bounds_ms: Sequence[float] = DEFAULT_LATENCY_BOUNDS_MS,
+                 batch_bounds: Sequence[float] = DEFAULT_BATCH_BOUNDS):
+        self._lock = threading.Lock()
+        self.requests = 0            # requests admitted
+        self.rows = 0                # total rows predicted
+        self.batches = 0             # coalesced dispatches
+        self.device_batches = 0      # dispatches that rode the device path
+        self.host_batches = 0        # dispatches on the host walk
+        self.host_fallback = 0       # overload requests served host-side
+        self.rejected_queue_full = 0  # 429-style rejections
+        self.timeouts = 0            # requests that missed their deadline
+        self.errors = 0              # predict-path exceptions
+        self.queue_depth = 0         # live gauge (rows waiting)
+        self.latency_ms = Histogram(latency_bounds_ms)
+        self.batch_size = Histogram(batch_bounds)
+
+    def record_request(self, rows: int) -> None:
+        with self._lock:
+            self.requests += 1
+            self.rows += rows
+
+    def record_batch(self, rows: int, device: bool) -> None:
+        with self._lock:
+            self.batches += 1
+            if device:
+                self.device_batches += 1
+            else:
+                self.host_batches += 1
+            self.batch_size.observe(rows)
+
+    def record_latency(self, ms: float) -> None:
+        with self._lock:
+            self.latency_ms.observe(ms)
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self.rejected_queue_full += 1
+
+    def record_timeout(self) -> None:
+        with self._lock:
+            self.timeouts += 1
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def record_fallback(self) -> None:
+        with self._lock:
+            self.host_fallback += 1
+
+    def set_queue_depth(self, rows: int) -> None:
+        with self._lock:
+            self.queue_depth = rows
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "requests": self.requests,
+                "rows": self.rows,
+                "batches": self.batches,
+                "device_batches": self.device_batches,
+                "host_batches": self.host_batches,
+                "host_fallback": self.host_fallback,
+                "rejected_queue_full": self.rejected_queue_full,
+                "timeouts": self.timeouts,
+                "errors": self.errors,
+                "queue_depth": self.queue_depth,
+                "rows_per_batch": round(self.rows / self.batches, 3)
+                if self.batches else None,
+                "latency_ms": self.latency_ms.snapshot(),
+                "batch_size": self.batch_size.snapshot(),
+            }

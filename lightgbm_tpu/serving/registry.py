@@ -1,0 +1,196 @@
+"""Versioned model registry for the serving subsystem.
+
+TF-Serving-style model lifecycle on top of Booster: load a model from
+text (file or string), warm up the compiled signature-matmul predictor
+for every power-of-two batch bucket the batcher can emit (so the first
+real request never waits on XLA), then install it atomically as the
+CURRENT version of its name.  Re-loading the same name hot-swaps: the
+version counter increments, in-flight batches finish on the old entry
+(plain references keep it alive), and the next dispatch sees the new
+one.  Bounded capacity with least-recently-used eviction keeps a
+many-model box from accumulating dead ensembles in device memory.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..basic import Booster
+from ..ops import predict as predict_ops
+from ..utils import log
+from ..utils.profiling import Profiler
+
+
+class ModelNotFoundError(KeyError):
+    """No model registered under this name — map to HTTP 404."""
+
+
+class ModelEntry:
+    """One immutable (name, version) pair: a loaded Booster plus the
+    per-batch device/host dispatch decision."""
+
+    def __init__(self, name: str, version: int, booster: Booster,
+                 min_device_work: int, max_bucket: int):
+        self.name = name
+        self.version = version
+        self.booster = booster
+        self.min_device_work = int(min_device_work)
+        self.max_bucket = int(max_bucket)
+        self.loaded_at = time.time()
+        self.warmed_buckets: List[int] = []
+        g = booster._gbdt
+        self.num_features = g.max_feature_idx + 1
+        self.num_trees = len(g.models)
+        self.num_class = max(g.num_tree_per_iteration, 1)
+
+    def use_device(self, n_rows: int) -> bool:
+        """Per-BATCH dispatch decision: the device path only pays off
+        once rows x trees clears the work floor (MIN_DEVICE_WORK
+        rationale, ops/predict.py); below it the host walk is cheaper
+        than a dispatch — and never waits on compilation."""
+        return n_rows * max(self.num_trees, 1) >= self.min_device_work
+
+    def predict(self, X: np.ndarray, raw_score: bool = False):
+        """Batch predict with the per-batch device/host choice.  Device
+        batches ride the bucket-padded compiled executable; host
+        batches walk the trees exactly like Booster.predict on small
+        inputs — both bitwise-identical to the corresponding
+        Booster.predict path."""
+        g = self.booster._gbdt
+        if self.use_device(X.shape[0]):
+            return self.predict_device(X, raw_score=raw_score), True
+        return g.predict(X, raw_score=raw_score, device=False), False
+
+    def predict_device(self, X: np.ndarray, raw_score: bool = False):
+        return self.booster._gbdt.predict_bucketed(
+            X, raw_score=raw_score, max_bucket=self.max_bucket)
+
+    def warmup(self, buckets) -> List[int]:
+        """Compile the bucket executables this entry can be dispatched
+        at (only those clearing the device-work floor — host-walk
+        buckets have nothing to compile)."""
+        g = self.booster._gbdt
+        ens = g._device_ensemble()
+        if ens is None:
+            return []
+        device_buckets = [b for b in buckets if self.use_device(b)]
+        if device_buckets:
+            self.warmed_buckets = ens.warmup_buckets(
+                self.num_features, device_buckets, len(g.models)
+                // max(g.num_tree_per_iteration, 1))
+        return self.warmed_buckets
+
+    def info(self) -> Dict:
+        return {
+            "name": self.name,
+            "version": self.version,
+            "num_trees": self.num_trees,
+            "num_features": self.num_features,
+            "num_class": self.num_class,
+            "loaded_at": self.loaded_at,
+            "warmed_buckets": list(self.warmed_buckets),
+            "device_eligible": self.booster._gbdt._device_ensemble()
+            is not None,
+        }
+
+
+class ModelRegistry:
+    """name -> current ModelEntry, with versioned hot-swap and LRU
+    eviction past `max_models` names."""
+
+    def __init__(self, max_models: int = 4,
+                 min_device_work: int = predict_ops.MIN_DEVICE_WORK,
+                 max_batch_rows: int = 256,
+                 warmup_buckets: Optional[List[int]] = None,
+                 profiler: Optional[Profiler] = None):
+        self.max_models = max(int(max_models), 1)
+        self.min_device_work = int(min_device_work)
+        self.max_batch_rows = int(max_batch_rows)
+        # [] / None -> every pow2 bucket the batcher can emit
+        self.warmup_bucket_list = (list(warmup_buckets) if warmup_buckets
+                                   else predict_ops.pow2_buckets(
+                                       self.max_batch_rows))
+        self.profiler = profiler or Profiler(enabled=True)
+        self._lock = threading.Lock()
+        self._entries: Dict[str, ModelEntry] = {}
+        self._versions: Dict[str, int] = {}
+        self._last_used: Dict[str, float] = {}
+
+    # -- lifecycle ----------------------------------------------------- #
+    def load(self, name: str, model_str: Optional[str] = None,
+             model_file: Optional[str] = None,
+             params: Optional[Dict] = None, warmup: bool = True) -> ModelEntry:
+        """Load + warm a model and install it as the current version of
+        `name` (hot-swap when the name exists).  The expensive parts —
+        parse, ensemble build, bucket compiles — happen OUTSIDE the
+        registry lock, so serving traffic on other models never stalls
+        behind a load."""
+        if (model_str is None) == (model_file is None):
+            raise ValueError("load() needs exactly one of model_str / "
+                             "model_file")
+        with self.profiler.phase("serve/model_load"):
+            booster = (Booster(model_file=model_file, params=params)
+                       if model_file is not None
+                       else Booster(model_str=model_str, params=params))
+        with self._lock:
+            version = self._versions.get(name, 0) + 1
+            self._versions[name] = version
+        entry = ModelEntry(name, version, booster,
+                           self.min_device_work, self.max_batch_rows)
+        if warmup:
+            with self.profiler.phase("serve/model_warmup"):
+                entry.warmup(self.warmup_bucket_list)
+        evicted: List[str] = []
+        with self._lock:
+            current = self._versions.get(name, 0)
+            if version < current:
+                # a newer load for the same name raced past us while we
+                # compiled; the freshest version stays installed
+                log.warning("stale load of %s v%d discarded (v%d is live)",
+                            name, version, current)
+                return self._entries[name]
+            self._entries[name] = entry
+            self._last_used[name] = time.time()
+            while len(self._entries) > self.max_models:
+                lru = min((n for n in self._entries if n != name),
+                          key=lambda n: self._last_used.get(n, 0.0))
+                del self._entries[lru]
+                self._last_used.pop(lru, None)
+                evicted.append(lru)
+        for n in evicted:
+            log.warning("registry over capacity (%d): evicted %s",
+                        self.max_models, n)
+        log.info("registry: %s v%d live (%d trees, %d features, "
+                 "buckets %s)", name, entry.version, entry.num_trees,
+                 entry.num_features, entry.warmed_buckets or "host-only")
+        return entry
+
+    def get(self, name: str) -> ModelEntry:
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                raise ModelNotFoundError(name)
+            self._last_used[name] = time.time()
+            return entry
+
+    def evict(self, name: str) -> bool:
+        with self._lock:
+            existed = self._entries.pop(name, None) is not None
+            self._last_used.pop(name, None)
+            # keep the version counter: a re-load of the same name must
+            # not reuse a version clients may have already seen
+        if existed:
+            log.info("registry: evicted %s", name)
+        return existed
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._entries)
+
+    def info(self) -> Dict:
+        with self._lock:
+            entries = list(self._entries.values())
+        return {e.name: e.info() for e in entries}

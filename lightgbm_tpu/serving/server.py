@@ -1,0 +1,288 @@
+"""TPU-resident inference server: in-process API + stdlib HTTP frontend.
+
+Composition of the serving subsystem (docs/Serving.md has the full
+architecture):
+
+    HTTP POST /predict ─┐
+                        ├─> Server.predict() ─> MicroBatcher (per model)
+    in-process callers ─┘           │                  │ coalesce
+                                    │                  v
+                                    │        ModelRegistry.get(name)
+                                    │                  │
+                                    │        ModelEntry.predict(batch)
+                                    │          device bucket path OR
+                                    │          host walk (small batch)
+                                    └─ backpressure: queue full ->
+                                       host fallback (small) / 429
+
+Everything is stdlib (http.server + json) — the box serving the model
+has no web framework, matching the repo's no-new-deps constraint.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..config import Config
+from ..utils import log
+from ..utils.profiling import Profiler
+from .batcher import (BatcherStoppedError, MicroBatcher, QueueFullError,
+                      RequestTimeoutError)
+from .metrics import ModelStats
+from .registry import ModelEntry, ModelNotFoundError, ModelRegistry
+
+
+class Server:
+    """In-process serving frontend; one MicroBatcher + ModelStats per
+    registered model name, all models sharing one registry/profiler."""
+
+    def __init__(self, config: Optional[Config] = None, **overrides):
+        if isinstance(config, Config) and not overrides:
+            cfg = config
+        elif isinstance(config, Config):
+            cfg = Config(dict(config.raw_params, **overrides))
+        else:
+            cfg = Config(dict(config or {}, **overrides))
+        self.config = cfg
+        self.profiler = Profiler(enabled=True)
+        self.registry = ModelRegistry(
+            max_models=cfg.serve_max_models,
+            min_device_work=cfg.serve_min_device_work,
+            max_batch_rows=cfg.serve_max_batch_rows,
+            warmup_buckets=cfg.serve_warmup_buckets or None,
+            profiler=self.profiler)
+        self._batchers: Dict[str, MicroBatcher] = {}
+        self._stats: Dict[str, ModelStats] = {}
+        self._lock = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._start_t = time.time()
+
+    # -- model lifecycle ---------------------------------------------- #
+    def load_model(self, name: Optional[str] = None,
+                   model_str: Optional[str] = None,
+                   model_file: Optional[str] = None,
+                   params: Optional[Dict] = None) -> ModelEntry:
+        """Load/hot-swap a model under `name` and make it servable."""
+        name = name or self.config.serve_model_name
+        entry = self.registry.load(name, model_str=model_str,
+                                   model_file=model_file, params=params)
+        with self._lock:
+            if name not in self._batchers:
+                stats = ModelStats()
+                self._stats[name] = stats
+                cfg = self.config
+                self._batchers[name] = MicroBatcher(
+                    lambda X, _n=name: self._batch_predict(_n, X),
+                    max_batch_rows=cfg.serve_max_batch_rows,
+                    max_wait_ms=cfg.serve_batch_wait_ms,
+                    max_queue_rows=cfg.serve_queue_rows,
+                    timeout_ms=cfg.serve_request_timeout_ms,
+                    stats=stats, name=name).start()
+        return entry
+
+    def evict_model(self, name: str) -> bool:
+        existed = self.registry.evict(name)
+        with self._lock:
+            batcher = self._batchers.pop(name, None)
+            self._stats.pop(name, None)
+        if batcher is not None:
+            batcher.stop()
+        return existed
+
+    # -- predict path -------------------------------------------------- #
+    def _batch_predict(self, name: str, X: np.ndarray) -> np.ndarray:
+        """The batcher's dispatch fn: resolve the CURRENT version at
+        batch time (hot-swaps apply to the very next batch) and record
+        which path the batch rode."""
+        entry = self.registry.get(name)
+        with self.profiler.phase("serve/batch_predict"):
+            out, device = entry.predict(X)
+        stats = self._stats.get(name)
+        if stats is not None:
+            stats.record_batch(X.shape[0], device)
+        return np.asarray(out)
+
+    def predict(self, rows, model: Optional[str] = None,
+                timeout_ms: Optional[float] = None) -> np.ndarray:
+        """Blocking predict through the coalescing queue.  `rows` is
+        [n, features] (a single 1-D row is auto-wrapped).  Returns the
+        per-row outputs ([n] scores or [n, k] multiclass)."""
+        name = model or self.config.serve_model_name
+        X = np.ascontiguousarray(np.asarray(rows, np.float64))
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise ValueError("rows must be [n, features] with n >= 1")
+        with self._lock:
+            batcher = self._batchers.get(name)
+            stats = self._stats.get(name)
+        if batcher is None:
+            raise ModelNotFoundError(name)
+        stats.record_request(X.shape[0])
+        t0 = time.perf_counter()
+        try:
+            out = batcher.submit(X, timeout_ms=timeout_ms)
+        except QueueFullError:
+            # graceful degradation: saturated queue + small request ->
+            # serve it on the host walk RIGHT NOW on this thread; the
+            # host path never waits on compilation, so overflow traffic
+            # degrades to reference-speed instead of erroring
+            if not (self.config.serve_host_fallback
+                    and X.shape[0] <= self.config.serve_fallback_max_rows):
+                raise
+            entry = self.registry.get(name)
+            with self.profiler.phase("serve/host_fallback"):
+                out = entry.booster._gbdt.predict(X, device=False)
+            stats.record_fallback()
+            stats.record_batch(X.shape[0], device=False)
+        stats.record_latency((time.perf_counter() - t0) * 1e3)
+        return np.asarray(out)
+
+    # -- observability ------------------------------------------------- #
+    def stats_snapshot(self) -> Dict:
+        with self._lock:
+            stats = dict(self._stats)
+            batchers = dict(self._batchers)
+        return {
+            "uptime_s": round(time.time() - self._start_t, 3),
+            "models": {name: dict(s.snapshot(),
+                                  queue_depth=batchers[name]
+                                  .queue_depth_rows()
+                                  if name in batchers else 0)
+                       for name, s in stats.items()},
+            "registry": self.registry.info(),
+            "phases": self.profiler.snapshot(),
+        }
+
+    # -- HTTP frontend ------------------------------------------------- #
+    def serve_http(self, host: Optional[str] = None,
+                   port: Optional[int] = None,
+                   block: bool = True) -> ThreadingHTTPServer:
+        host = host if host is not None else self.config.serve_host
+        port = port if port is not None else self.config.serve_port
+        self._httpd = ThreadingHTTPServer((host, port), _make_handler(self))
+        self._httpd.daemon_threads = True
+        bound = self._httpd.server_address
+        log.info("serving on http://%s:%d (POST /predict, GET /stats)",
+                 bound[0], bound[1])
+        if block:
+            try:
+                self._httpd.serve_forever()
+            except KeyboardInterrupt:
+                log.info("interrupt: shutting down server")
+            finally:
+                self.shutdown()
+        else:
+            self._http_thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name="lgbm-serve-http")
+            self._http_thread.start()
+        return self._httpd
+
+    @property
+    def http_port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def shutdown(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        with self._lock:
+            batchers = list(self._batchers.values())
+            self._batchers.clear()
+        for b in batchers:
+            b.stop()
+
+
+def _make_handler(server: Server):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route through our logger
+            log.debug("http: " + fmt, *args)
+
+        def _reply(self, code: int, payload: Dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_json(self) -> Dict:
+            length = int(self.headers.get("Content-Length") or 0)
+            if length <= 0:
+                return {}
+            return json.loads(self.rfile.read(length).decode() or "{}")
+
+        def do_GET(self):
+            path = self.path.split("?", 1)[0]
+            if path == "/stats":
+                self._reply(200, server.stats_snapshot())
+            elif path == "/models":
+                self._reply(200, {"models": server.registry.info()})
+            elif path in ("/healthz", "/health"):
+                self._reply(200, {"status": "ok",
+                                  "models": server.registry.names()})
+            else:
+                self._reply(404, {"error": "unknown path %s" % path})
+
+        def do_POST(self):
+            path = self.path.split("?", 1)[0]
+            try:
+                payload = self._read_json()
+            except (ValueError, json.JSONDecodeError) as e:
+                self._reply(400, {"error": "bad JSON: %s" % e})
+                return
+            try:
+                if path == "/predict":
+                    self._predict(payload)
+                elif path == "/models/load":
+                    self._load(payload)
+                elif path == "/models/evict":
+                    name = payload.get("name") or ""
+                    self._reply(200 if server.evict_model(name) else 404,
+                                {"name": name})
+                else:
+                    self._reply(404, {"error": "unknown path %s" % path})
+            except ModelNotFoundError as e:
+                self._reply(404, {"error": "unknown model %s" % e})
+            except QueueFullError as e:
+                self._reply(429, {"error": str(e)})
+            except RequestTimeoutError as e:
+                self._reply(504, {"error": str(e)})
+            except BatcherStoppedError as e:
+                self._reply(503, {"error": str(e)})
+            except (ValueError, TypeError, log.LightGBMError) as e:
+                self._reply(400, {"error": str(e)})
+
+        def _predict(self, payload: Dict) -> None:
+            rows = payload.get("rows")
+            if rows is None and "row" in payload:
+                rows = [payload["row"]]
+            if rows is None:
+                raise ValueError('payload needs "rows" ([[...], ...]) '
+                                 'or "row" ([...])')
+            name = payload.get("model") or server.config.serve_model_name
+            out = server.predict(rows, model=name,
+                                 timeout_ms=payload.get("timeout_ms"))
+            version = server.registry.get(name).version
+            self._reply(200, {"model": name, "version": version,
+                              "predictions": np.asarray(out).tolist()})
+
+        def _load(self, payload: Dict) -> None:
+            name = payload.get("name") or server.config.serve_model_name
+            entry = server.load_model(
+                name, model_str=payload.get("model_str"),
+                model_file=payload.get("model_file"))
+            self._reply(200, {"model": name, "version": entry.version,
+                              "info": entry.info()})
+
+    return Handler

@@ -21,6 +21,7 @@ in start_trace/stop_trace for TensorBoard-level analysis.
 """
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 from typing import Dict, Optional
@@ -35,6 +36,9 @@ class Profiler:
     stops (a scalar device fetch), so asynchronously dispatched work is
     charged to the phase that launched it.  Without it, phases measure
     dispatch time only — still useful for host-overhead attribution.
+
+    Accumulation is lock-guarded: the serving request path updates one
+    shared Profiler from many HTTP worker threads.
     """
 
     def __init__(self, enabled: bool = False, sync_fn=None):
@@ -42,6 +46,7 @@ class Profiler:
         self.sync_fn = sync_fn
         self.totals: Dict[str, float] = {}
         self.counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
         self._t0 = time.perf_counter()
 
     @contextmanager
@@ -59,8 +64,24 @@ class Profiler:
                 except Exception:  # noqa: BLE001 — timing must not kill train
                     pass
             dt = time.perf_counter() - start
-            self.totals[name] = self.totals.get(name, 0.0) + dt
-            self.counts[name] = self.counts.get(name, 0) + 1
+            with self._lock:
+                self.totals[name] = self.totals.get(name, 0.0) + dt
+                self.counts[name] = self.counts.get(name, 0) + 1
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Machine-readable view of the accumulators (the /stats wire
+        format of the serving subsystem): {phase: {total_s, calls,
+        ms_per_call}}."""
+        with self._lock:
+            return {
+                name: {
+                    "total_s": round(total, 6),
+                    "calls": self.counts[name],
+                    "ms_per_call": round(
+                        1e3 * total / max(self.counts[name], 1), 3),
+                }
+                for name, total in self.totals.items()
+            }
 
     def report(self, header: str = "profile") -> Optional[str]:
         if not self.enabled or not self.totals:

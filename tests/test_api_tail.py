@@ -1,0 +1,145 @@
+"""API-tail coverage: Booster.model_from_string (post-ctor),
+Booster.get_leaf_output, Dataset.attr/set_attr round-trip, and the
+reset_parameter callback routing EVERY scheduled parameter through
+Booster.reset_parameter (not just learning_rate)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import callback
+
+
+def _fit(params=None, n=300, iters=6, seed=0, **train_kw):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 6)
+    y = X[:, 0] * 2 - X[:, 1] + 0.05 * rng.randn(n)
+    base = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+            "min_data_in_leaf": 5}
+    base.update(params or {})
+    return lgb.train(base, lgb.Dataset(X, label=y),
+                     num_boost_round=iters, **train_kw), X
+
+
+# --------------------------------------------------------------------- #
+# Booster.model_from_string (post-constructor re-init)
+# --------------------------------------------------------------------- #
+def test_model_from_string_post_ctor():
+    bst_a, X = _fit(seed=0)
+    bst_b, _ = _fit({"num_leaves": 7}, iters=12, seed=1)
+    ref_b = bst_b.predict(X)
+    # overwrite bst_a in place with bst_b's model text
+    out = bst_a.model_from_string(bst_b.model_to_string())
+    assert out is bst_a                      # chainable, reference API shape
+    np.testing.assert_array_equal(bst_a.predict(X), ref_b)
+    assert bst_a.num_trees() == bst_b.num_trees()
+    assert bst_a.best_iteration == -1        # stale state reset
+
+
+def test_model_from_string_roundtrip_identity():
+    bst, X = _fit(seed=2)
+    ref = bst.predict(X)
+    bst.model_from_string(bst.model_to_string())
+    np.testing.assert_array_equal(bst.predict(X), ref)
+
+
+# --------------------------------------------------------------------- #
+# Booster.get_leaf_output
+# --------------------------------------------------------------------- #
+def test_get_leaf_output_matches_tree_and_c_api():
+    from lightgbm_tpu import c_api
+    import ctypes
+    bst, X = _fit(seed=3)
+    # same model through the C API surface for cross-checking
+    niter, handle = ctypes.c_int(), ctypes.c_void_p()
+    c_api.LGBM_BoosterLoadModelFromString(
+        bst.model_to_string().encode(), ctypes.byref(niter),
+        ctypes.byref(handle))
+    try:
+        g = bst._gbdt
+        for tree_id in (0, len(g.models) - 1):
+            tree = g.models[tree_id]
+            for leaf_id in (0, tree.num_leaves - 1):
+                got = bst.get_leaf_output(tree_id, leaf_id)
+                assert got == float(tree.leaf_value[leaf_id])
+                out = ctypes.c_double()
+                c_api.LGBM_BoosterGetLeafValue(handle, tree_id, leaf_id,
+                                               ctypes.byref(out))
+                assert got == out.value
+    finally:
+        c_api.LGBM_BoosterFree(handle)
+
+
+def test_leaf_outputs_sum_to_raw_prediction():
+    bst, X = _fit(seed=4)
+    leaves = np.asarray(bst.predict(X[:5], pred_leaf=True), int)
+    raw = bst.predict(X[:5], raw_score=True)
+    for i in range(5):
+        total = sum(bst.get_leaf_output(t, int(leaves[i, t]))
+                    for t in range(leaves.shape[1]))
+        np.testing.assert_allclose(total, raw[i], rtol=1e-12)
+
+
+def test_get_leaf_output_bounds_checked():
+    bst, _ = _fit(seed=5)
+    from lightgbm_tpu.utils import log
+    with pytest.raises(log.LightGBMError):
+        bst.get_leaf_output(10_000, 0)
+    with pytest.raises(log.LightGBMError):
+        bst.get_leaf_output(0, 10_000)
+
+
+# --------------------------------------------------------------------- #
+# Dataset.attr / set_attr
+# --------------------------------------------------------------------- #
+def test_dataset_attr_roundtrip():
+    ds = lgb.Dataset(np.random.rand(20, 3), label=np.zeros(20))
+    assert ds.attr("missing") is None
+    out = ds.set_attr(source="unit-test", rows=20)
+    assert out is ds                           # chainable
+    assert ds.attr("source") == "unit-test"
+    assert ds.attr("rows") == "20"             # str coercion
+    ds.set_attr(source=None)                   # None deletes
+    assert ds.attr("source") is None
+    assert ds.attr("rows") == "20"
+
+
+# --------------------------------------------------------------------- #
+# reset_parameter callback: ALL scheduled params take effect
+# --------------------------------------------------------------------- #
+def test_reset_parameter_callback_routes_all_params():
+    lam = [0.0, 0.5, 1.0, 2.0, 4.0, 8.0]
+    bst, _ = _fit(iters=6, seed=6,
+                  callbacks=[callback.reset_parameter(lambda_l2=lam)])
+    # the schedule's FINAL value must be live on the booster, proving the
+    # callback reached Booster.reset_parameter -> split params, not just
+    # a mutated learning_rate
+    assert bst._gbdt.split_params.lambda_l2 == lam[-1]
+    assert bst.params["lambda_l2"] == lam[-1]
+
+
+def test_reset_parameter_callback_learning_rate_schedule():
+    lrs = [0.3, 0.2, 0.1, 0.05]
+    bst, _ = _fit(iters=4, seed=7,
+                  callbacks=[callback.reset_parameter(learning_rate=lrs)])
+    assert bst._gbdt.shrinkage_rate == lrs[-1]
+    assert bst._gbdt.config.learning_rate == lrs[-1]
+
+
+def test_reset_parameter_changes_training_outcome():
+    # an extreme lambda_l2 schedule must actually alter the trees; if the
+    # callback silently dropped non-lr params both runs would be identical
+    sched = callback.reset_parameter(
+        lambda_l2=lambda it: 0.0 if it < 3 else 1e6)
+    bst_a, X = _fit(iters=6, seed=8)
+    bst_b, _ = _fit(iters=6, seed=8, callbacks=[sched])
+    assert not np.array_equal(bst_a.predict(X), bst_b.predict(X))
+    # heavy shrinkage-by-regularization: later trees are near-constant
+    last = bst_b._gbdt.models[-1]
+    assert np.max(np.abs(last.leaf_value[:last.num_leaves])) < 1e-3
+
+
+def test_booster_reset_parameter_direct():
+    bst, _ = _fit(iters=2, seed=9)
+    bst.reset_parameter({"lambda_l1": 0.25, "learning_rate": 0.07})
+    assert bst._gbdt.split_params.lambda_l1 == 0.25
+    assert bst._gbdt.shrinkage_rate == 0.07

@@ -1,0 +1,138 @@
+"""Compile-and-compare oracle for convert_model codegen: train a small
+model, emit C++ via the task=convert_model CLI path, compile it with the
+system compiler (skip cleanly when none), and assert the compiled
+predictions match the interpreter — the tests/cpp_test oracle of the
+reference CI (.ci/test.sh:52-58)."""
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.app import Application
+
+_MAIN = r"""
+#include <cstdio>
+#include <cstdlib>
+
+void PredictRaw(const double* arr, double* output);
+void Predict(const double* arr, double* output);
+int NumPredictOutputs();
+
+int main() {
+  int n, nf;
+  if (std::scanf("%d %d", &n, &nf) != 2) return 1;
+  int k = NumPredictOutputs();
+  std::vector<double> row(nf), out(k);
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j < nf; ++j)
+      if (std::scanf("%lf", &row[j]) != 1) return 1;
+    PredictRaw(row.data(), out.data());
+    for (int c = 0; c < k; ++c) std::printf("%.17g ", out[c]);
+    Predict(row.data(), out.data());
+    for (int c = 0; c < k; ++c) std::printf("%.17g ", out[c]);
+    std::printf("\n");
+  }
+  return 0;
+}
+"""
+
+
+def _compiler():
+    for name in ("g++", "c++", "clang++"):
+        path = shutil.which(name)
+        if path:
+            return path
+    return None
+
+
+def _compile_and_run(tmp_path, booster, X):
+    """convert_model CLI -> append main() -> compile -> run over X.
+    Returns (raw, transformed) arrays of shape [n, k]."""
+    cxx = _compiler()
+    if cxx is None:
+        pytest.skip("no C++ compiler on PATH")
+    model_path = tmp_path / "model.txt"
+    cpp_path = tmp_path / "model.cpp"
+    booster.save_model(str(model_path))
+    Application(["task=convert_model", "input_model=%s" % model_path,
+                 "convert_model=%s" % cpp_path]).run()
+    code = cpp_path.read_text()
+    assert "PredictRaw" in code and "NumPredictOutputs" in code
+    cpp_path.write_text(code + _MAIN)
+    exe = tmp_path / "model_bin"
+    subprocess.run([cxx, "-O1", "-o", str(exe), str(cpp_path)], check=True,
+                   capture_output=True, timeout=300)
+    n, nf = X.shape
+    feed = ["%d %d" % (n, nf)]
+    for row in X:
+        feed.append(" ".join("nan" if np.isnan(v) else "%.17g" % v
+                             for v in row))
+    proc = subprocess.run([str(exe)], input="\n".join(feed),
+                          capture_output=True, text=True, check=True,
+                          timeout=120)
+    vals = np.array([[float(t) for t in line.split()]
+                     for line in proc.stdout.strip().splitlines()])
+    k = vals.shape[1] // 2
+    return vals[:, :k], vals[:, k:]
+
+
+def _data(seed, n=300, nf=6):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, nf)
+    X[:, 3] = rng.randint(0, 8, n)           # categorical-ish column
+    return X, rng
+
+
+def test_compiled_regression_matches_interpreter(tmp_path):
+    X, rng = _data(0)
+    y = 3.0 * X[:, 0] + np.sin(4 * X[:, 1]) + 0.1 * rng.randn(len(X))
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y,
+                                categorical_feature=[3]),
+                    num_boost_round=10)
+    Xt = _data(1, n=64)[0]
+    Xt[::7, 1] = np.nan                       # exercise missing handling
+    c_raw, c_pred = _compile_and_run(tmp_path, bst, Xt)
+    py_raw = bst.predict(Xt, raw_score=True)
+    np.testing.assert_allclose(c_raw[:, 0], py_raw, rtol=0, atol=1e-12)
+    np.testing.assert_allclose(c_pred[:, 0], bst.predict(Xt),
+                               rtol=1e-10, atol=1e-12)
+
+
+def test_compiled_binary_matches_interpreter(tmp_path):
+    X, rng = _data(2)
+    y = (X[:, 0] + 0.3 * rng.randn(len(X)) > 0.5).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1,
+                     "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    Xt = _data(3, n=50)[0]
+    c_raw, c_pred = _compile_and_run(tmp_path, bst, Xt)
+    np.testing.assert_allclose(c_raw[:, 0], bst.predict(Xt, raw_score=True),
+                               rtol=0, atol=1e-12)
+    probs = bst.predict(Xt)
+    np.testing.assert_allclose(c_pred[:, 0], probs, rtol=1e-10, atol=1e-12)
+    assert np.all((c_pred[:, 0] > 0) & (c_pred[:, 0] < 1))
+
+
+def test_compiled_multiclass_matches_interpreter(tmp_path):
+    X, rng = _data(4)
+    y = np.digitize(X[:, 0] + 0.1 * rng.randn(len(X)), [0.33, 0.66])
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    Xt = _data(5, n=40)[0]
+    c_raw, c_pred = _compile_and_run(tmp_path, bst, Xt)
+    assert c_raw.shape == (40, 3)
+    np.testing.assert_allclose(c_raw, bst.predict(Xt, raw_score=True),
+                               rtol=0, atol=1e-12)
+    np.testing.assert_allclose(c_pred, bst.predict(Xt),
+                               rtol=1e-10, atol=1e-12)
+    np.testing.assert_allclose(c_pred.sum(axis=1), 1.0, rtol=1e-12)
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -1,0 +1,376 @@
+"""lightgbm_tpu.serving: batcher coalescing/deadline/backpressure, registry
+hot-swap + eviction, device/host bitwise identity, HTTP smoke — all on the
+fast tier (JAX_PLATFORMS=cpu, conftest)."""
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import (MicroBatcher, ModelNotFoundError,
+                                  ModelRegistry, ModelStats, QueueFullError,
+                                  RequestTimeoutError, Server, ServingClient,
+                                  ServingError)
+from lightgbm_tpu.serving.metrics import Histogram
+
+
+def _train(params, n=400, nf=8, iters=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, nf)
+    y = 2.0 * X[:, 0] - X[:, 1] + 0.05 * rng.randn(n)
+    base = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+            "min_data_in_leaf": 5}
+    base.update(params)
+    bst = lgb.Booster(params=base, train_set=lgb.Dataset(X, label=y))
+    for _ in range(iters):
+        bst.update()
+    return bst
+
+
+@pytest.fixture(scope="module")
+def booster():
+    return _train({})
+
+
+@pytest.fixture(scope="module")
+def booster_v2():
+    return _train({"num_leaves": 7}, iters=16, seed=1)
+
+
+# --------------------------------------------------------------------- #
+# MicroBatcher on a fake predictor (no jax in the loop)
+# --------------------------------------------------------------------- #
+class _FakePredictor:
+    def __init__(self, delay_s=0.0):
+        self.batch_sizes = []
+        self.delay_s = delay_s
+        self.lock = threading.Lock()
+
+    def __call__(self, X):
+        with self.lock:
+            self.batch_sizes.append(X.shape[0])
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return X[:, 0] * 10.0
+
+
+def test_batcher_coalesces_concurrent_requests():
+    fake = _FakePredictor(delay_s=0.005)
+    b = MicroBatcher(fake, max_batch_rows=64, max_wait_ms=50.0,
+                     timeout_ms=5000.0).start()
+    rows = [np.array([[float(i), 1.0]]) for i in range(32)]
+    with ThreadPoolExecutor(32) as pool:
+        outs = list(pool.map(b.submit, rows))
+    for i, out in enumerate(outs):
+        np.testing.assert_array_equal(out, [10.0 * i])
+    assert sum(fake.batch_sizes) == 32
+    # coalescing must actually happen: far fewer dispatches than requests
+    assert len(fake.batch_sizes) < 32
+    assert max(fake.batch_sizes) > 1
+    b.stop()
+
+
+def test_batcher_deadline_flushes_partial_batch():
+    fake = _FakePredictor()
+    b = MicroBatcher(fake, max_batch_rows=1024, max_wait_ms=20.0,
+                     timeout_ms=5000.0).start()
+    t0 = time.perf_counter()
+    out = b.submit(np.ones((1, 2)))
+    elapsed = time.perf_counter() - t0
+    np.testing.assert_array_equal(out, [10.0])
+    # dispatched at the max-wait deadline, nowhere near the timeout
+    assert elapsed < 2.0
+    assert fake.batch_sizes == [1]
+    b.stop()
+
+
+def test_batcher_full_batch_dispatches_before_deadline():
+    fake = _FakePredictor(delay_s=0.01)
+    b = MicroBatcher(fake, max_batch_rows=8, max_wait_ms=10_000.0,
+                     timeout_ms=5000.0).start()
+    rows = [np.full((1, 2), float(i)) for i in range(16)]
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(16) as pool:
+        list(pool.map(b.submit, rows))
+    # a 10 s max-wait must NOT gate full batches
+    assert time.perf_counter() - t0 < 5.0
+    assert max(fake.batch_sizes) <= 8
+    b.stop()
+
+
+def test_batcher_backpressure_queue_full():
+    fake = _FakePredictor(delay_s=0.2)
+    b = MicroBatcher(fake, max_batch_rows=4, max_wait_ms=0.0,
+                     max_queue_rows=4, timeout_ms=10_000.0).start()
+    # head-of-line batch occupies the worker; then fill the queue
+    with ThreadPoolExecutor(12) as pool:
+        futs = [pool.submit(b.submit, np.ones((1, 2))) for _ in range(12)]
+        rejected = 0
+        for f in futs:
+            try:
+                f.result()
+            except QueueFullError:
+                rejected += 1
+    assert rejected > 0
+    assert b.stats.rejected_queue_full == rejected
+    b.stop()
+
+
+def test_batcher_request_timeout():
+    fake = _FakePredictor(delay_s=0.5)
+    b = MicroBatcher(fake, max_batch_rows=4, max_wait_ms=0.0,
+                     timeout_ms=60.0).start()
+    with pytest.raises(RequestTimeoutError):
+        # the first dispatch takes 500 ms; a second rider with a 60 ms
+        # deadline expires while the worker is busy
+        with ThreadPoolExecutor(2) as pool:
+            f1 = pool.submit(b.submit, np.ones((1, 2)), 5000.0)
+            time.sleep(0.05)
+            f2 = pool.submit(b.submit, np.ones((1, 2)), 60.0)
+            f2.result()
+            f1.result()
+    assert b.stats.timeouts >= 1
+    b.stop()
+
+
+def test_batcher_oversize_request_goes_alone():
+    fake = _FakePredictor()
+    b = MicroBatcher(fake, max_batch_rows=8, max_wait_ms=1.0,
+                     max_queue_rows=64, timeout_ms=5000.0).start()
+    out = b.submit(np.ones((20, 2)))
+    assert out.shape[0] == 20
+    assert 20 in fake.batch_sizes
+    b.stop()
+
+
+def test_batcher_predictor_error_propagates():
+    def boom(X):
+        raise RuntimeError("kaboom")
+    b = MicroBatcher(boom, max_batch_rows=4, max_wait_ms=0.0,
+                     timeout_ms=5000.0).start()
+    with pytest.raises(RuntimeError, match="kaboom"):
+        b.submit(np.ones((1, 2)))
+    assert b.stats.errors == 1
+    b.stop()
+
+
+# --------------------------------------------------------------------- #
+# Metrics
+# --------------------------------------------------------------------- #
+def test_histogram_percentiles():
+    h = Histogram([1, 2, 5, 10])
+    for v in [0.5, 1.5, 1.5, 3.0, 8.0, 20.0]:
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 6
+    assert snap["min"] == 0.5 and snap["max"] == 20.0
+    assert 0 < snap["p50"] <= 5
+    assert snap["p99"] >= 10
+    assert h.percentile(0) is not None
+    assert Histogram([1]).percentile(50) is None   # empty
+
+
+def test_model_stats_snapshot_shape():
+    s = ModelStats()
+    s.record_request(3)
+    s.record_batch(3, device=True)
+    s.record_latency(12.5)
+    snap = s.snapshot()
+    assert snap["requests"] == 1 and snap["rows"] == 3
+    assert snap["device_batches"] == 1
+    assert snap["latency_ms"]["count"] == 1
+    assert snap["batch_size"]["count"] == 1
+
+
+# --------------------------------------------------------------------- #
+# Registry
+# --------------------------------------------------------------------- #
+def test_registry_hot_swap_equivalence(booster, booster_v2):
+    reg = ModelRegistry(min_device_work=0, max_batch_rows=64,
+                        warmup_buckets=[1, 8])
+    e1 = reg.load("m", model_str=booster.model_to_string())
+    X = np.random.RandomState(3).rand(9, 8)
+    out1, dev1 = e1.predict(X)
+    assert dev1 is True
+    np.testing.assert_array_equal(out1, booster._gbdt.predict(X, device=True))
+    e2 = reg.load("m", model_str=booster_v2.model_to_string())
+    assert e2.version == e1.version + 1
+    out2, _ = reg.get("m").predict(X)
+    np.testing.assert_array_equal(out2,
+                                  booster_v2._gbdt.predict(X, device=True))
+    assert not np.array_equal(out1, out2)
+    # the OLD entry still predicts the old model (in-flight batches)
+    old, _ = e1.predict(X)
+    np.testing.assert_array_equal(old, out1)
+
+
+def test_registry_lru_eviction(booster):
+    reg = ModelRegistry(max_models=2, min_device_work=1 << 62,
+                        warmup_buckets=[1])
+    s = booster.model_to_string()
+    reg.load("a", model_str=s)
+    reg.load("b", model_str=s)
+    reg.get("a")                        # refresh a: b becomes LRU
+    reg.load("c", model_str=s)
+    assert reg.names() == ["a", "c"]
+    with pytest.raises(ModelNotFoundError):
+        reg.get("b")
+
+
+def test_registry_evict_and_version_monotonic(booster):
+    reg = ModelRegistry(warmup_buckets=[1], min_device_work=1 << 62)
+    s = booster.model_to_string()
+    v1 = reg.load("m", model_str=s).version
+    assert reg.evict("m") and not reg.evict("m")
+    v2 = reg.load("m", model_str=s).version
+    assert v2 > v1                      # versions never reused after evict
+
+
+# --------------------------------------------------------------------- #
+# Server: bitwise identity + degradation + HTTP
+# --------------------------------------------------------------------- #
+def _server(booster, **over):
+    params = {"serve_batch_wait_ms": 5.0, "serve_warmup_buckets": [1, 8, 32],
+              "serve_request_timeout_ms": 30_000.0}
+    params.update(over)
+    srv = Server(params)
+    srv.load_model("default", model_str=booster.model_to_string())
+    return srv
+
+
+def test_server_device_path_bitwise_identical(booster):
+    srv = _server(booster, serve_min_device_work=0)
+    X = np.random.RandomState(5).rand(11, 8)
+    try:
+        out = srv.predict(X)
+        ref = booster._gbdt.predict(X, device=True)   # same path, unpadded
+        np.testing.assert_array_equal(out, ref)
+        snap = srv.stats_snapshot()["models"]["default"]
+        assert snap["device_batches"] >= 1 and snap["host_batches"] == 0
+    finally:
+        srv.shutdown()
+
+
+def test_server_host_fallback_bitwise_identical(booster):
+    srv = _server(booster, serve_min_device_work=1 << 62)
+    X = np.random.RandomState(6).rand(11, 8)
+    try:
+        out = srv.predict(X)
+        np.testing.assert_array_equal(out, booster.predict(X))  # host walk
+        snap = srv.stats_snapshot()["models"]["default"]
+        assert snap["host_batches"] >= 1 and snap["device_batches"] == 0
+    finally:
+        srv.shutdown()
+
+
+def test_server_concurrent_clients_coalesce_and_match(booster):
+    srv = _server(booster, serve_min_device_work=0,
+                  serve_batch_wait_ms=20.0)
+    X = np.random.RandomState(7).rand(8, 8)
+    ref = booster._gbdt.predict(X, device=True)
+    try:
+        def one(i):
+            return srv.predict(X[i % 8])
+        with ThreadPoolExecutor(32) as pool:
+            outs = list(pool.map(one, range(32)))
+        for i, out in enumerate(outs):
+            np.testing.assert_array_equal(out, ref[i % 8:i % 8 + 1])
+        snap = srv.stats_snapshot()["models"]["default"]
+        assert snap["requests"] == 32
+        assert snap["batches"] < 32          # coalescing happened
+        assert snap["latency_ms"]["count"] == 32
+    finally:
+        srv.shutdown()
+
+
+def test_server_queue_full_host_fallback(booster):
+    srv = _server(booster, serve_queue_rows=1, serve_max_batch_rows=1,
+                  serve_batch_wait_ms=0.0, serve_host_fallback=True)
+    X = np.random.RandomState(8).rand(1, 8)
+    try:
+        # saturate the 1-row queue, then verify overflow requests still
+        # answer (host fallback), bitwise equal to the host walk
+        with ThreadPoolExecutor(8) as pool:
+            outs = list(pool.map(lambda _: srv.predict(X), range(8)))
+        ref = booster.predict(X)
+        for out in outs:
+            np.testing.assert_array_equal(out, ref)
+    finally:
+        srv.shutdown()
+
+
+def test_server_unknown_model_raises(booster):
+    srv = _server(booster)
+    try:
+        with pytest.raises(ModelNotFoundError):
+            srv.predict(np.zeros((1, 8)), model="nope")
+    finally:
+        srv.shutdown()
+
+
+def test_http_endpoint_smoke(booster, booster_v2):
+    srv = _server(booster, serve_min_device_work=0)
+    httpd = srv.serve_http(port=0, block=False)
+    try:
+        client = ServingClient(port=httpd.server_address[1])
+        assert client.health()["status"] == "ok"
+        X = np.random.RandomState(9).rand(5, 8)
+        out = client.predict(X)
+        # JSON float round-trip is exact (repr shortest-roundtrip)
+        np.testing.assert_array_equal(out,
+                                      booster._gbdt.predict(X, device=True))
+        # single row spelling
+        one = client.predict(X[0])
+        np.testing.assert_array_equal(one, out[:1])
+        # stats surface: request counts, batch histogram, latency pcts
+        stats = client.stats()
+        m = stats["models"]["default"]
+        assert m["requests"] >= 2
+        assert m["batch_size"]["count"] >= 1
+        assert m["latency_ms"]["p50"] is not None
+        assert "serve/batch_predict" in stats["phases"]
+        assert stats["registry"]["default"]["version"] == 1
+        # hot swap over HTTP, then predictions follow the new model
+        v2 = client.load_model("default",
+                              model_str=booster_v2.model_to_string())
+        assert v2 == 2
+        out2 = client.predict(X)
+        np.testing.assert_array_equal(
+            out2, booster_v2._gbdt.predict(X, device=True))
+        assert client.models()["default"]["version"] == 2
+        # unknown model -> 404 ServingError
+        with pytest.raises(ServingError) as ei:
+            client.predict(X, model="nope")
+        assert ei.value.status == 404
+    finally:
+        srv.shutdown()
+
+
+def test_cli_serve_task_over_http(tmp_path, booster):
+    """python -m lightgbm_tpu task=serve ... end-to-end: conf-file
+    driven like the reference CLI, ephemeral port, served predictions
+    match Booster.predict."""
+    model_path = tmp_path / "model.txt"
+    booster.save_model(str(model_path))
+    conf = tmp_path / "serve.conf"
+    conf.write_text("task = serve\n"
+                    "input_model = %s\n"
+                    "serve_port = 0\n"
+                    "serve_min_device_work = 0\n"
+                    "serve_warmup_buckets = 1,8\n" % model_path)
+    from lightgbm_tpu.app import Application
+    app = Application(["config=%s" % conf])
+    assert app.config.task == "serve"
+    srv = Server(app.config)
+    srv.load_model(app.config.serve_model_name,
+                   model_file=app.config.input_model)
+    httpd = srv.serve_http(block=False)
+    try:
+        client = ServingClient(port=httpd.server_address[1])
+        X = np.random.RandomState(10).rand(4, 8)
+        np.testing.assert_array_equal(
+            client.predict(X), booster._gbdt.predict(X, device=True))
+    finally:
+        srv.shutdown()

@@ -1,0 +1,124 @@
+"""Serving throughput/latency bench: offered-load QPS vs p50/p99 at
+several client concurrency levels through the micro-batching server,
+against a sequential single-row baseline (one request at a time, no
+coalescing benefit).
+
+The acceptance bar: >= 5x throughput for 32 concurrent 1-row clients vs
+sequential single-row predicts.  Works on any backend (JAX_PLATFORMS=cpu
+is fine for CI); on TPU the coalescing win is larger because the ~100 ms
+dispatch floor dominates single-row latency.
+
+Usage: python tools/serve_bench.py [requests_per_level] [model_trees]
+Emits one BENCH-style JSON line:
+  {"metric": "serve_concurrency_speedup_x32", "value": ..., "unit": "x",
+   "vs_baseline": ..., "detail": {...}}
+"""
+import json
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+sys.path.insert(0, ".")
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.serving import Server  # noqa: E402
+
+LEVELS = (1, 8, 32)
+
+
+def _train(trees):
+    rng = np.random.RandomState(0)
+    X = rng.rand(20_000, 28).astype(np.float64)
+    w = rng.randn(28) / np.sqrt(28)
+    y = X @ w + 0.1 * rng.randn(len(X))
+    params = {"objective": "regression", "num_leaves": 63, "verbose": -1,
+              "min_data_in_leaf": 20}
+    return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=trees)
+
+
+def _percentiles(lat_ms):
+    lat = np.sort(np.asarray(lat_ms))
+    return (float(np.percentile(lat, 50)), float(np.percentile(lat, 99)))
+
+
+def _run_level(server, rows, concurrency, requests):
+    """`requests` 1-row predicts spread over `concurrency` client
+    threads; returns (qps, p50_ms, p99_ms)."""
+    lat = []
+
+    def one(i):
+        t0 = time.perf_counter()
+        server.predict(rows[i % len(rows)])
+        lat.append((time.perf_counter() - t0) * 1e3)
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(concurrency) as pool:
+        list(pool.map(one, range(requests)))
+    wall = time.perf_counter() - t0
+    p50, p99 = _percentiles(lat)
+    return requests / wall, p50, p99
+
+
+def main():
+    requests = int(sys.argv[1]) if len(sys.argv) > 1 else 256
+    trees = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    bst = _train(trees)
+    rng = np.random.RandomState(1)
+    rows = [rng.rand(1, 28) for _ in range(64)]
+
+    server = Server({"serve_model_name": "bench",
+                     "serve_min_device_work": 0,
+                     "serve_batch_wait_ms": 2.0,
+                     "serve_max_batch_rows": 256,
+                     "serve_request_timeout_ms": 60_000.0,
+                     "serve_warmup_buckets": [1, 2, 4, 8, 16, 32, 64, 128,
+                                              256]})
+    server.load_model("bench", model_str=bst.model_to_string())
+    # settle the dispatch path
+    _run_level(server, rows, 4, 32)
+
+    # sequential single-row baseline: one in-flight request, every row
+    # pays the full dispatch latency alone
+    seq_qps, seq_p50, seq_p99 = _run_level(server, rows, 1, requests)
+    print("sequential: %.1f qps  p50=%.2f ms  p99=%.2f ms"
+          % (seq_qps, seq_p50, seq_p99))
+
+    levels = {}
+    for c in LEVELS:
+        qps, p50, p99 = _run_level(server, rows, c, requests)
+        levels[c] = {"qps": round(qps, 1), "p50_ms": round(p50, 3),
+                     "p99_ms": round(p99, 3),
+                     "speedup_vs_sequential": round(qps / seq_qps, 3)}
+        print("c=%-3d %8.1f qps  p50=%.2f ms  p99=%.2f ms  (%.2fx)"
+              % (c, qps, p50, p99, qps / seq_qps))
+
+    snap = server.stats_snapshot()["models"]["bench"]
+    server.shutdown()
+
+    speedup32 = levels[32]["speedup_vs_sequential"]
+    result = {
+        "metric": "serve_concurrency_speedup_x32",
+        "value": speedup32,
+        "unit": "x",
+        # acceptance bar: >= 5x for 32 concurrent 1-row clients
+        "vs_baseline": round(speedup32 / 5.0, 4),
+        "detail": {
+            "requests_per_level": requests,
+            "model_trees": trees,
+            "sequential": {"qps": round(seq_qps, 1),
+                           "p50_ms": round(seq_p50, 3),
+                           "p99_ms": round(seq_p99, 3)},
+            "levels": {str(k): v for k, v in levels.items()},
+            "batches": snap["batches"],
+            "device_batches": snap["device_batches"],
+            "batch_p50": snap["batch_size"]["p50"],
+            "quality_ok": speedup32 >= 5.0,
+        },
+    }
+    print(json.dumps(result))
+    return 0 if speedup32 >= 5.0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,6 +52,10 @@ class Application:
     def __init__(self, argv: List[str]):
         self.raw_params = parse_argv(argv)
         self.config = Config(self.raw_params)
+        if self.config.tpu_log_json:
+            # before the first task log line so the whole run is one
+            # consistent stream of JSON records (utils/log)
+            log.set_json_mode(True)
         if not self.config.data and self.config.task not in ("convert_model",
                                                              "serve"):
             log.fatal("No training/prediction data, application quit")
@@ -155,6 +159,19 @@ class Application:
             init_model=cfg.input_model or None,
             callbacks=callbacks or None)
         booster.save_model(cfg.output_model)
+        if cfg.tpu_telemetry_path:
+            # the CLI's one-shot analogue of GET /metrics: dump the final
+            # counter/gauge/histogram state next to the JSONL event log
+            from .obs import default_registry
+            prom_path = cfg.tpu_telemetry_path + ".prom"
+            try:
+                with open(prom_path, "w") as f:
+                    f.write(default_registry().render_prometheus())
+                log.info("Telemetry written: events in %s, final metrics "
+                         "in %s", cfg.tpu_telemetry_path, prom_path)
+            except OSError as e:
+                log.warning("Could not write telemetry dump %s: %s",
+                            prom_path, e)
         log.info("Finished training; model saved to %s", cfg.output_model)
 
     def predict(self) -> None:

@@ -69,6 +69,29 @@ def record_evaluation(eval_result: dict) -> Callable:
     return _callback
 
 
+def telemetry(recorder=None) -> Callable:
+    """Feed each round's evaluation results into the training telemetry
+    recorder (obs/recorder.py), merging metric values into the pending
+    per-iteration JSONL event.  With no explicit recorder the callback
+    resolves the booster's own (engine.train auto-injects it whenever
+    Config.tpu_telemetry_path is set); a model without one — cv's
+    _CVBooster, telemetry disabled — makes this a no-op."""
+
+    def _callback(env: CallbackEnv) -> None:
+        rec = recorder
+        if rec is None:
+            gbdt = getattr(env.model, "_gbdt", None)
+            rec = getattr(gbdt, "recorder", None)
+        if rec is not None and env.evaluation_result_list:
+            rec.record_eval(env.iteration, env.evaluation_result_list)
+
+    # after print/record (10/20) so the event sees what the user saw,
+    # before early_stopping (30) so the final round's metrics are
+    # captured even when the stop exception ends the loop
+    _callback.order = 25
+    return _callback
+
+
 def _resolve_schedule(key: str, spec, round_idx: int, num_rounds: int):
     """A per-round parameter value from a list (one entry per round) or a
     callable round_idx -> value."""

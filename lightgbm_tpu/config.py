@@ -151,6 +151,16 @@ _SCHEMA = [
     #   sync per phase, so only enable when measuring)
     ("tpu_profile_trace_dir", str, ""),      # non-empty -> jax.profiler trace of training
     ("num_devices", int, 0),                 # 0 = use all local devices for parallel learners
+    # --- telemetry parameters (no reference analogue)
+    # Unified observability layer (lightgbm_tpu/obs): per-iteration JSONL
+    # event log + metrics registry; see docs/Observability.md.
+    ("tpu_telemetry_path", str, ""),         # non-empty -> append one JSONL event per
+    #   boosting iteration (metrics, phase times, tree shape, compile counts);
+    #   training output is bitwise-identical with it on or off
+    ("tpu_telemetry_device_stats", bool, True),  # sample live-buffer/jit-cache
+    #   gauges into each iteration event
+    ("tpu_log_json", bool, False),           # structured JSON log lines with bound
+    #   context fields (utils/log.set_json_mode)
     # --- serving parameters (no reference analogue)
     # task=serve: TPU-resident inference server (lightgbm_tpu/serving) —
     # adaptive micro-batching over the compiled signature-matmul
@@ -216,6 +226,8 @@ ALIAS_TABLE: Dict[str, str] = {
     "data_seed": "data_random_seed",
     "model_output": "output_model", "model_out": "output_model",
     "save_period": "snapshot_freq",
+    "telemetry_path": "tpu_telemetry_path",
+    "telemetry_file": "tpu_telemetry_path",
     "model_input": "input_model", "model_in": "input_model",
     "predict_result": "output_result", "prediction_result": "output_result",
     "predict_name": "output_result", "prediction_name": "output_result",

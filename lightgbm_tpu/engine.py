@@ -106,6 +106,10 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         callbacks.add(callback_mod.reset_parameter(learning_rate=learning_rates))
     if evals_result is not None:
         callbacks.add(callback_mod.record_evaluation(evals_result))
+    if getattr(booster._gbdt, "recorder", None) is not None:
+        # tpu_telemetry_path is set: merge each round's metric values
+        # into the per-iteration JSONL event (obs/recorder.py)
+        callbacks.add(callback_mod.telemetry())
 
     cb_before = {cb for cb in callbacks
                  if getattr(cb, "before_iteration", False)}
@@ -155,6 +159,10 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         if finished:
             break
 
+    # close the telemetry event log BEFORE best_iteration is derived:
+    # finish_telemetry drains the pipeline (same sync num_trees() would
+    # do) and flushes the last pending event + summary to disk
+    booster._gbdt.finish_telemetry()
     if booster.best_iteration <= 0:
         # end-of-training count must be the SYNCED one: current_iteration
         # reports undrained pipeline slots for cheap in-loop callbacks,

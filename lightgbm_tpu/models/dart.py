@@ -23,9 +23,10 @@ class DART(GBDT):
         self.sum_weight = 0.0
         self._drop_index: List[int] = []
 
-    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+    def _train_one_iter_impl(self, gradients=None, hessians=None) -> bool:
+        # overrides the impl (not the telemetry shell, GBDT.train_one_iter)
         self._dropping_trees()
-        ret = super().train_one_iter(gradients, hessians)
+        ret = super()._train_one_iter_impl(gradients, hessians)
         if ret:
             return ret
         self._normalize()

@@ -11,6 +11,7 @@ so models round-trip with the reference's parsers.
 """
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -161,11 +162,28 @@ class GBDT:
         self._inflight: List[dict] = []
         self._deferred_stopped = False
         # per-phase timers (TIMETAG analogue); sync_fn charges async
-        # dispatch to the phase that launched it
+        # dispatch to the phase that launched it.  Telemetry-only runs
+        # enable the profiler WITHOUT the sync: phases then measure
+        # dispatch time, but the training stream is untouched (the
+        # telemetry contract is a bitwise-identical model).
         from ..utils.profiling import Profiler, TraceSession
-        self.profiler = Profiler(enabled=config.tpu_profile,
-                                 sync_fn=self._profile_sync)
+        telemetry_path = getattr(config, "tpu_telemetry_path", "")
+        self.profiler = Profiler(
+            enabled=config.tpu_profile or bool(telemetry_path),
+            sync_fn=self._profile_sync if config.tpu_profile else None)
         self._trace = TraceSession(config.tpu_profile_trace_dir)
+        # per-iteration JSONL event log (obs/recorder.py); recorder
+        # failures demote to a warning and disable themselves — they can
+        # never fail a training run
+        self.recorder = None
+        self._bag_count: Optional[int] = None
+        if telemetry_path:
+            try:
+                from ..obs.recorder import TrainingRecorder
+                self.recorder = TrainingRecorder(telemetry_path, config)
+            except Exception as exc:  # noqa: BLE001
+                log.warning("telemetry disabled: recorder init failed (%s)",
+                            exc)
 
         if train_set is not None:
             self._setup_train(train_set)
@@ -180,9 +198,30 @@ class GBDT:
     def profile_report(self):
         return self.profiler.report(header="tpu_profile")
 
+    def finish_telemetry(self) -> None:
+        """Drain the pipeline and close the telemetry event log (flushes
+        the last pending event, backfills deferred tree stats, writes the
+        summary).  Idempotent; engine.train calls it after the loop and
+        __del__ covers direct Booster.update users."""
+        recorder, self.recorder = self.recorder, None
+        if recorder is None:
+            return
+        try:
+            self._sync_model()
+            recorder.finalize(self)
+        except Exception as exc:  # noqa: BLE001 — telemetry must not raise
+            log.warning("telemetry finalize failed: %s", exc)
+
     def __del__(self):
         try:
-            if getattr(self, "profiler", None) is not None:
+            if getattr(self, "recorder", None) is not None:
+                self.finish_telemetry()
+            # teardown report only for explicit tpu_profile runs: a
+            # telemetry-only profiler is an implementation detail of the
+            # event log, not a request for the console report
+            if getattr(self, "profiler", None) is not None \
+                    and getattr(getattr(self, "config", None),
+                                "tpu_profile", False):
                 self.profile_report()
             if getattr(self, "_trace", None) is not None:
                 self._trace.stop()
@@ -318,8 +357,10 @@ class GBDT:
             mask = np.full(n, -1, np.int32)
             mask[idx] = 0
             self._bag_mask = jnp.asarray(mask)
+            self._bag_count = bag_cnt       # telemetry: rows in this bag
         elif cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
             self._bag_mask = None
+            self._bag_count = None
         return self._bag_mask if self._bag_mask is not None else self._row_all_in
 
     def _feature_sample(self) -> jnp.ndarray:
@@ -338,7 +379,26 @@ class GBDT:
     # ------------------------------------------------------------------ #
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
-        """Returns True when training cannot continue (no splittable leaves)."""
+        """Returns True when training cannot continue (no splittable
+        leaves).  Thin telemetry shell around _train_one_iter_impl (which
+        subclasses override): times the round and hands the recorder one
+        event per iteration, for every boosting mode."""
+        if self.recorder is None:
+            return self._train_one_iter_impl(gradients, hessians)
+        it = self.iter
+        t0 = time.perf_counter()
+        finished = self._train_one_iter_impl(gradients, hessians)
+        wall = time.perf_counter() - t0
+        try:
+            self.recorder.on_iteration(self, it, wall, finished)
+        except Exception as exc:  # noqa: BLE001 — telemetry must not kill train
+            log.warning("telemetry recorder failed (%s); disabling it", exc)
+            self.recorder = None
+        return finished
+
+    def _train_one_iter_impl(self, gradients: Optional[np.ndarray] = None,
+                             hessians: Optional[np.ndarray] = None) -> bool:
+        """One boosting round (the body of the reference's TrainOneIter)."""
         # Materialize pending deferred trees only every _DRAIN_EVERY
         # iterations: each drain pays a host round-trip, and a degenerate
         # iteration detected late is harmless — with unchanged scores every

@@ -58,6 +58,7 @@ class GOSS(GBDT):
         n = self.num_data
         if self.iter < int(1.0 / max(cfg.learning_rate, 1e-12)):
             self._bag_mask = None  # warm-up: use all rows
+            self._goss_counts = None
             return grad, hess
         top_k = max(1, int(n * cfg.top_rate))
         other_k = max(1, int(n * cfg.other_rate))
@@ -68,4 +69,5 @@ class GOSS(GBDT):
             jnp.asarray(multiply, grad.dtype),
             top_k=top_k, other_k=other_k)
         self._bag_mask = mask
+        self._goss_counts = (top_k, other_k)   # telemetry: sample sizes
         return grad, hess

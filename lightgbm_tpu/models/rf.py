@@ -39,7 +39,8 @@ class RF(GBDT):
         self._rf_grad = (jnp.reshape(grad, (k, n)).astype(self.dtype),
                          jnp.reshape(hess, (k, n)).astype(self.dtype))
 
-    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+    def _train_one_iter_impl(self, gradients=None, hessians=None) -> bool:
+        # overrides the impl (not the telemetry shell, GBDT.train_one_iter)
         if gradients is not None or hessians is not None:
             log.fatal("RF mode does not support custom objective")
         if self._rf_grad is None:

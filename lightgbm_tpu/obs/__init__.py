@@ -1,0 +1,39 @@
+"""lightgbm_tpu.obs — the unified telemetry layer.
+
+One subsystem for every number the framework emits:
+
+- registry:  thread-safe MetricsRegistry of counters / gauges /
+             histograms with Prometheus text exposition (the shared
+             store training and serving both report into);
+- recorder:  TrainingRecorder — one structured JSONL event per boosting
+             round (Config.tpu_telemetry_path);
+- device:    XLA compile/retrace listeners + live-buffer probe;
+- adapters:  publishers wiring ModelStats, SocketComm and the device
+             probe into the registry.
+
+The process-wide default registry is what `GET /metrics` on the serving
+server and the CLI end-of-training dump render.
+"""
+from __future__ import annotations
+
+from .registry import Counter, Gauge, Histogram, MetricsRegistry
+from .recorder import TrainingRecorder
+
+_default_registry = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-wide registry every subsystem publishes into."""
+    return _default_registry
+
+
+def reset_default_registry() -> MetricsRegistry:
+    """Clear the default registry (test isolation); the instance is kept
+    so handles held by long-lived objects keep pointing at it."""
+    _default_registry.reset()
+    return _default_registry
+
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "TrainingRecorder", "default_registry",
+           "reset_default_registry"]

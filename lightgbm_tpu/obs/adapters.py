@@ -1,0 +1,110 @@
+"""Glue between the metrics registry and the subsystems that feed it.
+
+Each publisher registers set_fn-backed children, so a /metrics scrape or
+an end-of-training dump pulls LIVE values from the owning object
+(ModelStats, the device probe, the compile listeners) — no refresh
+thread, no double accounting, and eviction removes exactly the evicted
+model's children.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from . import device as device_mod
+from .registry import MetricsRegistry
+
+COMM_COUNTERS = (
+    ("lgbm_comm_bytes_sent_total", "Bytes written to comm sockets"),
+    ("lgbm_comm_bytes_received_total", "Bytes read from comm sockets"),
+    ("lgbm_comm_allgather_total", "Allgather rounds completed"),
+    ("lgbm_comm_sync_wait_seconds_total",
+     "Seconds blocked waiting on comm peers"),
+)
+
+
+def ensure_device_metrics(reg: MetricsRegistry) -> None:
+    """Device gauges + compile counters, pulled live at scrape time."""
+    device_mod.install_compile_listeners()
+    reg.gauge("lgbm_device_live_buffers",
+              help="Live device arrays").set_fn(
+        lambda: device_mod.device_stats()["live_buffers"])
+    reg.gauge("lgbm_device_live_bytes",
+              help="Bytes held by live device arrays").set_fn(
+        lambda: device_mod.device_stats()["live_bytes"])
+    reg.gauge("lgbm_jit_cache_entries",
+              help="Entries in the pjit call cache").set_fn(
+        device_mod.jit_cache_size)
+    reg.counter("lgbm_xla_backend_compiles_total",
+                help="XLA backend compilations").set_fn(
+        lambda: device_mod.compile_counts()["backend_compiles"])
+    reg.counter("lgbm_xla_traces_total",
+                help="jaxpr traces (retraces included)").set_fn(
+        lambda: device_mod.compile_counts()["traces"])
+
+
+def ensure_comm_metrics(reg: MetricsRegistry, rank: int = 0,
+                        world: int = 1) -> Dict[str, object]:
+    """Create the comm counter families for (rank, world) — SocketComm
+    calls this with its real coordinates; the serving server calls it
+    with the (0, 1) defaults so /metrics always exposes the families."""
+    labels = dict(rank=str(rank), world=str(world))
+    return {name: reg.counter(name, help=help_text, **labels)
+            for name, help_text in COMM_COUNTERS}
+
+
+def comm_totals(reg: MetricsRegistry) -> Optional[Dict[str, float]]:
+    """Cumulative comm traffic across every rank this process has seen,
+    or None when no comm layer ever registered."""
+    out = {}
+    for name, _help in COMM_COUNTERS:
+        total = reg.family_sum(name)
+        if total is not None:
+            out[name[len("lgbm_comm_"):-len("_total")]
+                if name.endswith("_total") else name] = round(total, 6)
+    return out or None
+
+
+def publish_model_stats(reg: MetricsRegistry, name: str, stats,
+                        queue_depth_fn: Optional[Callable[[], int]] = None
+                        ) -> None:
+    """Expose one serving ModelStats through the registry, labeled
+    model=<name>.  Counters pull the live attribute; histograms attach
+    the stats' own instances so observations render without copying."""
+    def pull(attr: str) -> Callable[[], float]:
+        return lambda: getattr(stats, attr)
+
+    reg.counter("lgbm_serve_requests_total",
+                help="Requests admitted", model=name).set_fn(pull("requests"))
+    reg.counter("lgbm_serve_rows_total",
+                help="Rows predicted", model=name).set_fn(pull("rows"))
+    reg.counter("lgbm_serve_batches_total",
+                help="Coalesced batch dispatches", model=name,
+                path="device").set_fn(pull("device_batches"))
+    reg.counter("lgbm_serve_batches_total", model=name,
+                path="host").set_fn(pull("host_batches"))
+    reg.counter("lgbm_serve_host_fallback_total",
+                help="Overflow requests served on the host walk",
+                model=name).set_fn(pull("host_fallback"))
+    reg.counter("lgbm_serve_rejected_total",
+                help="Queue-full rejections",
+                model=name).set_fn(pull("rejected_queue_full"))
+    reg.counter("lgbm_serve_timeouts_total",
+                help="Requests that missed their deadline",
+                model=name).set_fn(pull("timeouts"))
+    reg.counter("lgbm_serve_errors_total",
+                help="Predict-path exceptions", model=name).set_fn(
+        pull("errors"))
+    reg.gauge("lgbm_serve_queue_depth_rows",
+              help="Rows waiting in the batcher queue", model=name).set_fn(
+        queue_depth_fn if queue_depth_fn is not None else pull("queue_depth"))
+    reg.attach("lgbm_serve_latency_ms", stats.latency_ms,
+               help="End-to-end request latency (ms)", model=name)
+    reg.attach("lgbm_serve_batch_size", stats.batch_size,
+               help="Rows per coalesced dispatch", model=name)
+    reg.attach("lgbm_serve_wait_ms", stats.wait_ms,
+               help="Queue wait before dispatch (ms)", model=name)
+
+
+def unpublish_model_stats(reg: MetricsRegistry, name: str) -> int:
+    """Drop every child labeled model=<name> (model eviction)."""
+    return reg.remove(model=name)

@@ -1,0 +1,102 @@
+"""Device-side observability: XLA compile/retrace counters + live-buffer probe.
+
+On TPU the dominant hidden cost is not FLOPs but compilation: a retrace
+in the middle of training stalls every iteration behind XLA.  jax ships
+the hooks to see it — `jax.monitoring` fires named events for every
+backend compile and jaxpr trace — but nothing in the stack counts them
+per process.  This module installs ONE process-wide listener (idempotent)
+into plain int counters, and exposes a cheap probe of live device state
+(buffer count/bytes via jax.live_arrays, jit cache occupancy via the
+pjit inference cache) for the per-iteration telemetry events and the
+/metrics gauges.
+
+Everything is guarded: a jax version without an event name, without
+jax.monitoring, or without the private pjit cache degrades to zeros,
+never to an exception — telemetry must not be able to kill training.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_installed = False
+_counts = {
+    "backend_compiles": 0,   # XLA backend compilations (the expensive ones)
+    "traces": 0,             # jaxpr traces (retraces included)
+    "cache_hits": 0,         # compilation-cache hits
+}
+
+# event name fragments -> counter key; matched by substring so minor
+# renames across jax versions keep counting instead of silently zeroing
+_EVENT_MAP = (
+    ("backend_compile", "backend_compiles"),
+    ("trace", "traces"),
+    ("use_cache", "cache_hits"),
+    ("using_cache", "cache_hits"),
+)
+
+
+def _on_event(event: str, *_args, **_kw) -> None:
+    for frag, key in _EVENT_MAP:
+        if frag in event:
+            with _lock:
+                _counts[key] += 1
+            return
+
+
+def install_compile_listeners() -> bool:
+    """Register the jax.monitoring listeners once per process; safe to
+    call from every GBDT/Server constructor.  Returns True when the
+    hooks are live."""
+    global _installed
+    with _lock:
+        if _installed:
+            return True
+    try:
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(
+            lambda event, dur, **kw: _on_event(event))
+        monitoring.register_event_listener(
+            lambda event, **kw: _on_event(event))
+    except Exception:  # noqa: BLE001 — no monitoring API -> zeros
+        return False
+    with _lock:
+        _installed = True
+    return True
+
+
+def compile_counts() -> Dict[str, int]:
+    """Cumulative compile/trace/cache counts since process start (or
+    since the listeners were installed)."""
+    with _lock:
+        return dict(_counts)
+
+
+def jit_cache_size() -> int:
+    """Entries in the pjit call cache — growth across iterations means
+    the training loop is retracing (shape instability)."""
+    try:
+        from jax._src.pjit import _infer_params_cached
+        return int(_infer_params_cached.cache_info().currsize)
+    except Exception:  # noqa: BLE001 — private API; absent -> 0
+        return 0
+
+
+def device_stats() -> Dict[str, int]:
+    """Live device-memory view: buffer count, total bytes, jit cache
+    occupancy.  Cheap (host-side bookkeeping only, no device sync)."""
+    buffers = 0
+    nbytes = 0
+    try:
+        import jax
+        for a in jax.live_arrays():
+            buffers += 1
+            try:
+                nbytes += int(a.nbytes)
+            except Exception:  # noqa: BLE001 — deleted/donated arrays
+                pass
+    except Exception:  # noqa: BLE001
+        pass
+    return {"live_buffers": buffers, "live_bytes": nbytes,
+            "jit_cache_entries": jit_cache_size()}

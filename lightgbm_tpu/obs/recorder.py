@@ -1,0 +1,223 @@
+"""Per-iteration training event log: one JSONL line per boosting round.
+
+The offline twin of the TIMETAG teardown report (serial_tree_learner.cpp:
+15-42): where the reference prints aggregate phase totals once at
+destruction, the recorder appends a structured event per iteration —
+metric values, per-phase time deltas from the Profiler, tree shape,
+sample sizes, cumulative XLA compile/retrace counts, live device state
+and comm traffic — to Config.tpu_telemetry_path, so a training run can
+be replayed, diffed and regression-tracked after the fact
+(tools/telemetry_report.py renders the summary table).
+
+Event stream (schema v1; every line is one JSON object):
+- {"event": "start", ...}       run header: params diff, rank/world
+- {"event": "iteration", ...}   one per boosting round
+- {"event": "tree_stats", ...}  backfill for rounds whose trees were
+                                still deferred (pipelined) when their
+                                iteration event flushed
+- {"event": "summary", ...}     cumulative phase totals + final counts
+
+Buffering contract: the iteration event is held PENDING until the next
+round starts (or finalize), because the eval callback delivers this
+round's metric values after train_one_iter returns — engine.py runs
+callbacks after update().  Deferred-pipeline rounds flush with
+trees=null, deferred=true, and finalize() backfills their tree stats
+once the caller has drained the pipeline (_sync_model).
+
+The recorder is strictly read-only on the training state: it never
+forces a device sync, never drains the pipeline, and the driver wraps
+every call in a try/except — telemetry failure degrades to a warning,
+never to a failed run.  Models train bitwise-identically with it on or
+off (tests/test_obs.py asserts this).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils import log
+from . import adapters, device
+from .registry import MetricsRegistry
+
+SCHEMA_VERSION = 1
+
+
+def tree_summary(tree) -> Dict[str, float]:
+    """Shape stats for one host tree: leaf count, max depth (edges on
+    the longest root->leaf path), total split gain."""
+    nl = int(tree.num_leaves)
+    if nl <= 1:
+        return {"leaves": nl, "depth": 0, "gain": 0.0}
+    gain = float(np.sum(tree.split_gain[:nl - 1]))
+    depth = 0
+    stack = [(0, 1)]          # (internal node, depth of its children)
+    while stack:
+        node, d = stack.pop()
+        for child in (int(tree.left_child[node]),
+                      int(tree.right_child[node])):
+            if child < 0:     # ~leaf encoding
+                depth = max(depth, d)
+            else:
+                stack.append((child, d + 1))
+    return {"leaves": nl, "depth": depth, "gain": round(gain, 6)}
+
+
+class TrainingRecorder:
+    """Appends the event stream for ONE booster to `path`."""
+
+    def __init__(self, path: str, config, registry: Optional[MetricsRegistry] = None):
+        from . import default_registry
+        self.path = path
+        self.config = config
+        self.registry = registry if registry is not None else default_registry()
+        self.sample_device_stats = bool(
+            getattr(config, "tpu_telemetry_device_stats", True))
+        self._file = None
+        self._pending: Optional[Dict] = None
+        self._last_phases: Dict[str, Dict[str, float]] = {}
+        self._deferred_iters: List[int] = []
+        self._closed = False
+        adapters.ensure_device_metrics(self.registry)
+        self._m_iters = self.registry.counter(
+            "lgbm_train_iterations_total", help="Boosting rounds completed")
+        self._m_seconds = self.registry.counter(
+            "lgbm_train_seconds_total", help="Wall seconds spent in update()")
+        self._m_trees = self.registry.counter(
+            "lgbm_train_trees_total", help="Trees added to the ensemble")
+        self._write({
+            "event": "start", "schema": SCHEMA_VERSION,
+            "boosting": getattr(config, "boosting", ""),
+            "objective": getattr(config, "objective", ""),
+            "num_leaves": getattr(config, "num_leaves", 0),
+            "learning_rate": getattr(config, "learning_rate", 0.0),
+            "rank": max(int(getattr(config, "machine_rank", -1)), 0),
+            "world": max(int(getattr(config, "num_machines", 1)), 1),
+        })
+
+    # -- event construction -------------------------------------------- #
+    def on_iteration(self, gbdt, iteration: int, wall_s: float,
+                     finished: bool) -> None:
+        """Called by the driver after every train_one_iter; `iteration`
+        is the round index BEFORE the iter counter moved."""
+        self._flush_pending()
+        k = max(gbdt.num_tree_per_iteration, 1)
+        slot = gbdt.models[iteration * k:(iteration + 1) * k]
+        deferred = any(t is None for t in slot)
+        trees = (None if deferred
+                 else [tree_summary(t) for t in slot])
+        if deferred:
+            self._deferred_iters.append(iteration)
+        event: Dict = {
+            "event": "iteration",
+            "iter": iteration,
+            "wall_ms": round(wall_s * 1e3, 3),
+            "finished": bool(finished),
+            "deferred": deferred,
+            "trees": trees,
+            "metrics": {},
+            "phases": self._phase_deltas(gbdt.profiler),
+            "sample": self._sample_stats(gbdt),
+            "compile": device.compile_counts(),
+        }
+        if self.sample_device_stats:
+            event["device"] = device.device_stats()
+        comm = adapters.comm_totals(self.registry)
+        if comm is not None:
+            event["comm"] = comm
+        self._m_iters.inc()
+        self._m_seconds.inc(wall_s)
+        if not finished:
+            self._m_trees.inc(len(slot))
+        self._pending = event
+
+    def record_eval(self, iteration: int, results) -> None:
+        """Merge (dataset, metric, value, ...) tuples from the engine's
+        eval pass into the pending event for `iteration`."""
+        if self._pending is None or self._pending.get("iter") != iteration:
+            return
+        metrics = self._pending["metrics"]
+        for v in results or ():
+            metrics.setdefault(str(v[0]), {})[str(v[1])] = float(v[2])
+
+    def finalize(self, gbdt) -> None:
+        """Flush the last pending event, backfill tree stats for rounds
+        that were deferred (the caller must have drained the pipeline
+        first — GBDT.finish_telemetry does), write the summary, close."""
+        if self._closed:
+            return
+        self._flush_pending()
+        k = max(gbdt.num_tree_per_iteration, 1)
+        for it in self._deferred_iters:
+            slot = [t for t in gbdt.models[it * k:(it + 1) * k]
+                    if t is not None]
+            self._write({"event": "tree_stats", "iter": it,
+                         "trees": [tree_summary(t) for t in slot]})
+        self._deferred_iters = []
+        summary: Dict = {
+            "event": "summary",
+            "iterations": int(gbdt.iter),
+            "num_trees": len(gbdt.models),
+            "phases": gbdt.profiler.snapshot(),
+            "compile": device.compile_counts(),
+        }
+        comm = adapters.comm_totals(self.registry)
+        if comm is not None:
+            summary["comm"] = comm
+        self._write(summary)
+        self._closed = True
+        if self._file is not None:
+            try:
+                self._file.close()
+            finally:
+                self._file = None
+        log.debug("telemetry: event log written to %s", self.path)
+
+    # -- internals ------------------------------------------------------ #
+    def _phase_deltas(self, profiler) -> Dict[str, Dict[str, float]]:
+        snap = profiler.snapshot()
+        out: Dict[str, Dict[str, float]] = {}
+        for name, cur in snap.items():
+            prev = self._last_phases.get(name, {"total_s": 0.0, "calls": 0})
+            d_total = cur["total_s"] - prev["total_s"]
+            d_calls = cur["calls"] - prev["calls"]
+            if d_calls > 0 or d_total > 1e-9:
+                out[name] = {"ms": round(d_total * 1e3, 3), "calls": d_calls}
+                self.registry.counter(
+                    "lgbm_train_phase_seconds_total",
+                    help="Per-phase training seconds",
+                    phase=name).inc(d_total)
+        self._last_phases = snap
+        return out
+
+    def _sample_stats(self, gbdt) -> Dict:
+        out: Dict = {"rows": int(gbdt.num_data)}
+        bag = getattr(gbdt, "_bag_count", None)
+        out["bagging_rows"] = int(bag) if bag is not None else None
+        goss = getattr(gbdt, "_goss_counts", None)
+        if goss is not None:
+            out["goss_top"], out["goss_other"] = int(goss[0]), int(goss[1])
+        return out
+
+    def _flush_pending(self) -> None:
+        if self._pending is not None:
+            event, self._pending = self._pending, None
+            self._write(event)
+
+    def _write(self, event: Dict) -> None:
+        if self._closed:
+            return
+        if self._file is None:
+            self._file = open(self.path, "a")
+        self._file.write(json.dumps(event, default=_json_default,
+                                    separators=(",", ":")) + "\n")
+        self._file.flush()
+
+
+def _json_default(o):
+    if hasattr(o, "item") and not hasattr(o, "__len__"):
+        return o.item()
+    if hasattr(o, "tolist"):
+        return o.tolist()
+    return str(o)

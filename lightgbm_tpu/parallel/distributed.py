@@ -45,6 +45,7 @@ import json
 import os
 import socket
 import struct
+import time
 from typing import List, Optional, Tuple
 
 from ..utils import log
@@ -173,6 +174,9 @@ def initialize_from_config(config, rank: Optional[int] = None
     import jax
     jax.distributed.initialize(coordinator_address=machines[0],
                                num_processes=world, process_id=r)
+    # every JSON-mode log line from here on carries this process's
+    # cluster coordinates (utils/log.bind_context)
+    log.bind_context(rank=r, world=world)
     log.info("Connected to %d-machine cluster as rank %d (%d devices "
              "visible)", world, r, jax.device_count())
     return r, world
@@ -208,6 +212,16 @@ class SocketComm:
         host, port = machines[0].rsplit(":", 1)
         port = int(port) + port_offset
         self._peers: List[socket.socket] = []
+        # comm counters (bytes in/out, allgather rounds, sync-wait
+        # seconds) tagged rank/world in the process-wide registry —
+        # the comm quarter of the unified telemetry layer
+        from ..obs import adapters as obs_adapters
+        from ..obs import default_registry
+        m = obs_adapters.ensure_comm_metrics(default_registry(), rank, world)
+        self._m_sent = m["lgbm_comm_bytes_sent_total"]
+        self._m_recv = m["lgbm_comm_bytes_received_total"]
+        self._m_allgather = m["lgbm_comm_allgather_total"]
+        self._m_wait = m["lgbm_comm_sync_wait_seconds_total"]
         if world == 1:
             return
         if rank == 0:
@@ -237,19 +251,25 @@ class SocketComm:
             srv.listen(world - 1)
             srv.settimeout(timeout_s)
             by_rank = {}
+            t0 = time.monotonic()
             for _ in range(world - 1):
                 conn, _addr = srv.accept()
                 conn.settimeout(timeout_s)
                 r = struct.unpack("!i", _recv_exact(conn, 4))[0]
                 by_rank[r] = conn
+            # waiting for world-1 spokes to dial in is the hub's share
+            # of cluster-formation skew; the 4-byte rank handshakes are
+            # the first wire traffic
+            self._m_wait.inc(time.monotonic() - t0)
+            self._m_recv.inc(4 * (world - 1))
             srv.close()
             self._peers = [by_rank[r] for r in range(1, world)]
         else:
             # retry-connect until the hub binds (every host launches the
             # same command, so spokes may start before rank 0 listens —
             # the reference's linkers retry the same way)
-            import time
             deadline = time.monotonic() + timeout_s
+            t0 = time.monotonic()
             while True:
                 s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
                 s.settimeout(min(5.0, timeout_s))
@@ -261,8 +281,10 @@ class SocketComm:
                     if time.monotonic() >= deadline:
                         raise
                     time.sleep(0.25)
+            self._m_wait.inc(time.monotonic() - t0)
             s.settimeout(timeout_s)
             s.sendall(struct.pack("!i", rank))
+            self._m_sent.inc(4)
             self._peers = [s]
 
     # LocalComm-compatible surface -------------------------------------
@@ -271,19 +293,35 @@ class SocketComm:
         return self.allgather
 
     def allgather(self, payload: dict) -> List[dict]:
+        self._m_allgather.inc()
         if self.world == 1:
             return [payload]
         if self.rank == 0:
             out: List[Optional[dict]] = [None] * self.world
             out[0] = payload
             for i, conn in enumerate(self._peers, start=1):
-                out[i] = _recv_msg(conn)
+                out[i] = self._recv_counted(conn)
             blob = _encode(out)
             for conn in self._peers:
                 _send_blob(conn, blob)
+                self._m_sent.inc(len(blob) + 8)
             return out  # type: ignore[return-value]
-        _send_msg(self._peers[0], payload)
-        return _recv_msg(self._peers[0])
+        self._send_counted(self._peers[0], payload)
+        return self._recv_counted(self._peers[0])
+
+    # counted wire helpers: every frame is 8-byte length prefix + blob,
+    # and blocking-recv time IS the rank-skew sync wait at this seam
+    def _send_counted(self, sock: socket.socket, obj) -> None:
+        blob = _encode(obj)
+        _send_blob(sock, blob)
+        self._m_sent.inc(len(blob) + 8)
+
+    def _recv_counted(self, sock: socket.socket):
+        t0 = time.monotonic()
+        blob = _recv_frame(sock)
+        self._m_wait.inc(time.monotonic() - t0)
+        self._m_recv.inc(len(blob) + 8)
+        return json.loads(blob.decode("utf-8"))
 
     def close(self) -> None:
         for s in self._peers:
@@ -331,7 +369,7 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_msg(sock: socket.socket):
+def _recv_frame(sock: socket.socket) -> bytes:
     (n,) = struct.unpack("!q", _recv_exact(sock, 8))
     if n < 0 or n > _MAX_MSG:
         raise ConnectionError(
@@ -339,7 +377,11 @@ def _recv_msg(sock: socket.socket):
             "length prefix, or a dataset so wide its mapper exchange "
             "exceeds the cap — raise distributed._MAX_MSG if the latter"
             % (n, _MAX_MSG))
-    return json.loads(_recv_exact(sock, n).decode("utf-8"))
+    return _recv_exact(sock, n)
+
+
+def _recv_msg(sock: socket.socket):
+    return json.loads(_recv_frame(sock).decode("utf-8"))
 
 
 # mapper payloads are a few KB/feature and the hub broadcast carries

@@ -196,6 +196,7 @@ class MicroBatcher:
                     req.cancelled = True    # expired in queue: don't pay
                     continue                # the dispatch for a dead rider
                 live.append(req)
+                self.stats.record_wait((now - req.enqueue_t) * 1e3)
             if not live:
                 continue
             try:

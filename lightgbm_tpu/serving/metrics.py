@@ -6,12 +6,19 @@ fallback increments lock-guarded accumulators, and /stats renders one
 JSON snapshot — request counts, batch-size distribution, latency
 percentiles, live queue depth — cheap enough to leave on in production
 (two dict updates per request; no locks on the predict dispatch itself).
+
+The Histogram implementation moved to the shared telemetry layer
+(lightgbm_tpu/obs/registry.py) so training and serving report through
+one type; it is re-exported here for API compatibility.  ModelStats
+stays the serving-local accumulator; obs/adapters.publish_model_stats
+exposes it through the MetricsRegistry for `GET /metrics`.
 """
 from __future__ import annotations
 
-import bisect
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Sequence
+
+from ..obs.registry import Histogram  # noqa: F401 — shared impl, re-exported
 
 # Latency buckets (ms): roughly log-spaced around the ~100 ms blocking
 # device-dispatch floor measured in NOTES.md, so the histogram resolves
@@ -21,64 +28,6 @@ DEFAULT_LATENCY_BOUNDS_MS = (
 # Batch-size buckets: power-of-two edges matching the batcher's row
 # buckets, so the histogram reads as "which executables are hot".
 DEFAULT_BATCH_BOUNDS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
-
-
-class Histogram:
-    """Fixed-boundary histogram with percentile estimation.
-
-    observe() is O(log buckets); percentile() linearly interpolates
-    inside the winning bucket (Prometheus histogram_quantile style), so
-    p50/p99 come out of bounded memory without storing samples.
-    """
-
-    def __init__(self, bounds: Sequence[float]):
-        self.bounds: List[float] = sorted(float(b) for b in bounds)
-        self.counts = [0] * (len(self.bounds) + 1)
-        self.n = 0
-        self.total = 0.0
-        self.min: Optional[float] = None
-        self.max: Optional[float] = None
-
-    def observe(self, value: float) -> None:
-        v = float(value)
-        self.counts[bisect.bisect_left(self.bounds, v)] += 1
-        self.n += 1
-        self.total += v
-        self.min = v if self.min is None else min(self.min, v)
-        self.max = v if self.max is None else max(self.max, v)
-
-    def percentile(self, q: float) -> Optional[float]:
-        """Estimated q-th percentile (q in [0, 100]); None when empty."""
-        if self.n == 0:
-            return None
-        rank = q / 100.0 * self.n
-        seen = 0
-        for i, c in enumerate(self.counts):
-            if seen + c >= rank and c > 0:
-                lo = self.bounds[i - 1] if i > 0 else (self.min or 0.0)
-                hi = self.bounds[i] if i < len(self.bounds) else \
-                    (self.max if self.max is not None else lo)
-                frac = (rank - seen) / c
-                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
-            seen += c
-        return self.max
-
-    def snapshot(self) -> Dict:
-        return {
-            "count": self.n,
-            "sum": round(self.total, 6),
-            "mean": round(self.total / self.n, 6) if self.n else None,
-            "min": self.min,
-            "max": self.max,
-            "p50": self.percentile(50),
-            "p90": self.percentile(90),
-            "p99": self.percentile(99),
-            "buckets": {
-                ("le_%g" % self.bounds[i]) if i < len(self.bounds)
-                else "inf": c
-                for i, c in enumerate(self.counts) if c
-            },
-        }
 
 
 class ModelStats:
@@ -100,6 +49,7 @@ class ModelStats:
         self.queue_depth = 0         # live gauge (rows waiting)
         self.latency_ms = Histogram(latency_bounds_ms)
         self.batch_size = Histogram(batch_bounds)
+        self.wait_ms = Histogram(latency_bounds_ms)   # queue wait per rider
 
     def record_request(self, rows: int) -> None:
         with self._lock:
@@ -118,6 +68,10 @@ class ModelStats:
     def record_latency(self, ms: float) -> None:
         with self._lock:
             self.latency_ms.observe(ms)
+
+    def record_wait(self, ms: float) -> None:
+        with self._lock:
+            self.wait_ms.observe(ms)
 
     def record_reject(self) -> None:
         with self._lock:
@@ -156,4 +110,5 @@ class ModelStats:
                 if self.batches else None,
                 "latency_ms": self.latency_ms.snapshot(),
                 "batch_size": self.batch_size.snapshot(),
+                "wait_ms": self.wait_ms.snapshot(),
             }

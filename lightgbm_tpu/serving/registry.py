@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..basic import Booster
+from ..obs import default_registry
 from ..ops import predict as predict_ops
 from ..utils import log
 from ..utils.profiling import Profiler
@@ -166,6 +167,10 @@ class ModelRegistry:
         log.info("registry: %s v%d live (%d trees, %d features, "
                  "buckets %s)", name, entry.version, entry.num_trees,
                  entry.num_features, entry.warmed_buckets or "host-only")
+        default_registry().counter(
+            "lgbm_serve_model_loads_total",
+            help="Models loaded into the serving registry",
+            model=name).inc()
         return entry
 
     def get(self, name: str) -> ModelEntry:

@@ -29,6 +29,8 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..config import Config
+from ..obs import adapters as obs_adapters
+from ..obs import default_registry
 from ..utils import log
 from ..utils.profiling import Profiler
 from .batcher import (BatcherStoppedError, MicroBatcher, QueueFullError,
@@ -58,6 +60,13 @@ class Server:
             profiler=self.profiler)
         self._batchers: Dict[str, MicroBatcher] = {}
         self._stats: Dict[str, ModelStats] = {}
+        # GET /metrics renders the process-wide registry: per-model
+        # request counters published below, plus the device gauges and
+        # comm counter families (rank-0 defaults so the exposition
+        # always covers all four groups even single-machine)
+        self.metrics = default_registry()
+        obs_adapters.ensure_device_metrics(self.metrics)
+        obs_adapters.ensure_comm_metrics(self.metrics)
         self._lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._http_thread: Optional[threading.Thread] = None
@@ -84,6 +93,9 @@ class Server:
                     max_queue_rows=cfg.serve_queue_rows,
                     timeout_ms=cfg.serve_request_timeout_ms,
                     stats=stats, name=name).start()
+                obs_adapters.publish_model_stats(
+                    self.metrics, name, stats,
+                    queue_depth_fn=self._batchers[name].queue_depth_rows)
         return entry
 
     def evict_model(self, name: str) -> bool:
@@ -93,6 +105,7 @@ class Server:
             self._stats.pop(name, None)
         if batcher is not None:
             batcher.stop()
+        obs_adapters.unpublish_model_stats(self.metrics, name)
         return existed
 
     # -- predict path -------------------------------------------------- #
@@ -160,6 +173,11 @@ class Server:
             "phases": self.profiler.snapshot(),
         }
 
+    def metrics_text(self) -> str:
+        """The registry in Prometheus text exposition format 0.0.4
+        (GET /metrics)."""
+        return self.metrics.render_prometheus()
+
     # -- HTTP frontend ------------------------------------------------- #
     def serve_http(self, host: Optional[str] = None,
                    port: Optional[int] = None,
@@ -169,8 +187,8 @@ class Server:
         self._httpd = ThreadingHTTPServer((host, port), _make_handler(self))
         self._httpd.daemon_threads = True
         bound = self._httpd.server_address
-        log.info("serving on http://%s:%d (POST /predict, GET /stats)",
-                 bound[0], bound[1])
+        log.info("serving on http://%s:%d (POST /predict, GET /stats, "
+                 "GET /metrics)", bound[0], bound[1])
         if block:
             try:
                 self._httpd.serve_forever()
@@ -222,9 +240,20 @@ def _make_handler(server: Server):
                 return {}
             return json.loads(self.rfile.read(length).decode() or "{}")
 
+        def _reply_text(self, code: int, body: str, content_type: str) -> None:
+            data = body.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_GET(self):
             path = self.path.split("?", 1)[0]
-            if path == "/stats":
+            if path == "/metrics":
+                self._reply_text(200, server.metrics_text(),
+                                 "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/stats":
                 self._reply(200, server.stats_snapshot())
             elif path == "/models":
                 self._reply(200, {"models": server.registry.info()})

@@ -46,6 +46,8 @@ class Profiler:
         self.sync_fn = sync_fn
         self.totals: Dict[str, float] = {}
         self.counts: Dict[str, int] = {}
+        self.mins: Dict[str, float] = {}
+        self.maxs: Dict[str, float] = {}
         self._lock = threading.Lock()
         self._t0 = time.perf_counter()
 
@@ -67,11 +69,26 @@ class Profiler:
             with self._lock:
                 self.totals[name] = self.totals.get(name, 0.0) + dt
                 self.counts[name] = self.counts.get(name, 0) + 1
+                if dt < self.mins.get(name, float("inf")):
+                    self.mins[name] = dt
+                if dt > self.maxs.get(name, float("-inf")):
+                    self.maxs[name] = dt
+
+    def reset(self) -> None:
+        """Zero every accumulator and restart the wall clock — serving
+        /stats and long-running boosters can re-baseline instead of
+        accumulating unboundedly stale totals."""
+        with self._lock:
+            self.totals.clear()
+            self.counts.clear()
+            self.mins.clear()
+            self.maxs.clear()
+            self._t0 = time.perf_counter()
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         """Machine-readable view of the accumulators (the /stats wire
         format of the serving subsystem): {phase: {total_s, calls,
-        ms_per_call}}."""
+        ms_per_call, min_ms, max_ms}}."""
         with self._lock:
             return {
                 name: {
@@ -79,6 +96,8 @@ class Profiler:
                     "calls": self.counts[name],
                     "ms_per_call": round(
                         1e3 * total / max(self.counts[name], 1), 3),
+                    "min_ms": round(1e3 * self.mins[name], 3),
+                    "max_ms": round(1e3 * self.maxs[name], 3),
                 }
                 for name, total in self.totals.items()
             }

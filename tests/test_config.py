@@ -64,15 +64,16 @@ def test_inert_params_warn_once(capsys):
     # two_round and histogram_pool_size act now; only the storage
     # knobs remain inert
     Config({"sparse_threshold": 0.5, "is_enable_sparse": False})
-    out = capsys.readouterr().out
-    assert "sparse_threshold" in out and "is_enable_sparse" in out
+    # warnings go to stderr (utils/log routes Warning/Fatal there)
+    err = capsys.readouterr().err
+    assert "sparse_threshold" in err and "is_enable_sparse" in err
     # once per process only
     Config({"sparse_threshold": 0.5})
-    assert "sparse_threshold" not in capsys.readouterr().out
+    assert "sparse_threshold" not in capsys.readouterr().err
     # default values stay silent
     config_mod._INERT_WARNED.clear()
     Config({"sparse_threshold": 0.8})
-    assert "sparse_threshold" not in capsys.readouterr().out
+    assert "sparse_threshold" not in capsys.readouterr().err
 
 
 def test_initscore_file_loading(tmp_path):

@@ -1,0 +1,155 @@
+"""Render a training telemetry event log (JSONL) as a summary report.
+
+The offline reader for the stream lightgbm_tpu/obs/recorder.py writes
+when ``tpu_telemetry_path`` is set: a run header, per-iteration totals,
+a per-phase time table aggregated across iterations, tree-shape trends
+and the cumulative XLA compile/retrace counts — the TIMETAG teardown
+report (serial_tree_learner.cpp:15-42) reconstructed from the event
+log after the fact, so runs can be compared without re-running them.
+
+Usage:
+    python tools/telemetry_report.py train.telemetry.jsonl
+    python tools/telemetry_report.py --iterations train.telemetry.jsonl
+"""
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, List
+
+
+def load_events(path: str) -> List[dict]:
+    events = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError as e:
+                raise SystemExit("%s:%d: not valid JSON (%s)"
+                                 % (path, lineno, e))
+    if not events:
+        raise SystemExit("%s: empty event log" % path)
+    return events
+
+
+def _fmt_ms(v: float) -> str:
+    return "%.1f" % v if v < 100 else "%.0f" % v
+
+
+def render(events: List[dict], show_iterations: bool = False) -> str:
+    start = next((e for e in events if e.get("event") == "start"), {})
+    iters = [e for e in events if e.get("event") == "iteration"]
+    summary = next((e for e in events if e.get("event") == "summary"), {})
+    backfill = {e["iter"]: e["trees"]
+                for e in events if e.get("event") == "tree_stats"}
+
+    lines: List[str] = []
+    lines.append("run: boosting=%s objective=%s num_leaves=%s "
+                 "learning_rate=%s rank=%s/%s"
+                 % (start.get("boosting", "?"), start.get("objective", "?"),
+                    start.get("num_leaves", "?"),
+                    start.get("learning_rate", "?"),
+                    start.get("rank", 0), start.get("world", 1)))
+
+    if iters:
+        wall = [e.get("wall_ms", 0.0) for e in iters]
+        lines.append("iterations: %d   wall %.3fs total, %s ms/iter "
+                     "(min %s, max %s)"
+                     % (len(iters), sum(wall) / 1e3,
+                        _fmt_ms(sum(wall) / len(wall)),
+                        _fmt_ms(min(wall)), _fmt_ms(max(wall))))
+
+        # tree shape: per-iteration events, deferred rounds backfilled
+        leaves, depths = [], []
+        for e in iters:
+            trees = e.get("trees")
+            if trees is None:
+                trees = backfill.get(e.get("iter"), [])
+            for t in trees or []:
+                leaves.append(t.get("leaves", 0))
+                depths.append(t.get("depth", 0))
+        if leaves:
+            lines.append("trees: %d   leaves avg %.1f (max %d)   "
+                         "depth avg %.1f (max %d)"
+                         % (len(leaves), sum(leaves) / len(leaves),
+                            max(leaves), sum(depths) / len(depths),
+                            max(depths)))
+
+    # per-phase table: the summary event carries the full Profiler
+    # snapshot; without one (truncated log), re-aggregate the deltas
+    phases: Dict[str, Dict[str, float]] = {}
+    if summary.get("phases"):
+        for name, p in summary["phases"].items():
+            phases[name] = {"ms": p.get("total_s", 0.0) * 1e3,
+                            "calls": p.get("calls", 0)}
+    else:
+        for e in iters:
+            for name, p in (e.get("phases") or {}).items():
+                agg = phases.setdefault(name, {"ms": 0.0, "calls": 0})
+                agg["ms"] += p.get("ms", 0.0)
+                agg["calls"] += p.get("calls", 0)
+    if phases:
+        lines.append("phases:")
+        width = max(len(n) for n in phases)
+        for name, p in sorted(phases.items(), key=lambda kv: -kv[1]["ms"]):
+            calls = int(p["calls"])
+            lines.append("  %-*s %10.3fs  (%6d calls, %7.2f ms/call)"
+                         % (width, name, p["ms"] / 1e3, calls,
+                            p["ms"] / max(calls, 1)))
+
+    compile_counts = summary.get("compile") or (
+        iters[-1].get("compile") if iters else None)
+    if compile_counts:
+        lines.append("xla: %d backend compiles, %d traces, %d cache hits"
+                     % (compile_counts.get("backend_compiles", 0),
+                        compile_counts.get("traces", 0),
+                        compile_counts.get("cache_hits", 0)))
+
+    comm = summary.get("comm") or (iters[-1].get("comm") if iters else None)
+    if comm:
+        lines.append("comm: %d allgathers, %d B sent, %d B received, "
+                     "%.3fs sync wait"
+                     % (comm.get("allgather", 0), comm.get("bytes_sent", 0),
+                        comm.get("bytes_received", 0),
+                        comm.get("sync_wait_seconds", 0.0)))
+
+    if show_iterations and iters:
+        lines.append("")
+        lines.append("%6s %10s %8s %8s  %s"
+                     % ("iter", "wall_ms", "leaves", "depth", "metrics"))
+        for e in iters:
+            trees = e.get("trees")
+            if trees is None:
+                trees = backfill.get(e.get("iter"))
+            nl = max((t.get("leaves", 0) for t in trees), default=0) \
+                if trees else 0
+            dp = max((t.get("depth", 0) for t in trees), default=0) \
+                if trees else 0
+            metrics = "  ".join(
+                "%s/%s=%.6g" % (ds, m, v)
+                for ds, series in sorted((e.get("metrics") or {}).items())
+                for m, v in sorted(series.items()))
+            lines.append("%6d %10s %8d %8d  %s"
+                         % (e.get("iter", -1), _fmt_ms(e.get("wall_ms", 0.0)),
+                            nl, dp, metrics))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    show_iterations = "--iterations" in argv
+    argv = [a for a in argv if a != "--iterations"]
+    if len(argv) != 1:
+        sys.stderr.write(
+            "usage: python tools/telemetry_report.py [--iterations] "
+            "<telemetry.jsonl>\n")
+        return 2
+    print(render(load_events(argv[0]), show_iterations=show_iterations))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

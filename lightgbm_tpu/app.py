@@ -152,12 +152,28 @@ class Application:
                     env.model.save_model(path)
                     log.info("Saved snapshot to %s", path)
             callbacks.append(snapshot_cb)
+        resume_from = None
+        if cfg.tpu_checkpoint_path:
+            # crash-restart semantics: relaunching the same command picks
+            # up from the newest valid checkpoint automatically (engine
+            # injects the checkpoint-writing callback from the config)
+            from .resilience import CheckpointManager
+            resume_from = CheckpointManager.latest(cfg.tpu_checkpoint_path)
+            if resume_from is not None:
+                if cfg.input_model:
+                    log.warning("Both input_model and a checkpoint under "
+                                "%s exist; resuming from the checkpoint "
+                                "and ignoring input_model",
+                                cfg.tpu_checkpoint_path)
+                log.info("Resuming from checkpoint %s", resume_from)
         booster = engine.train(
             dict(self.raw_params), train_set,
             num_boost_round=cfg.num_iterations,
             valid_sets=valid_sets, valid_names=valid_names,
-            init_model=cfg.input_model or None,
-            callbacks=callbacks or None)
+            init_model=(cfg.input_model or None) if resume_from is None
+            else None,
+            callbacks=callbacks or None,
+            resume_from=resume_from)
         booster.save_model(cfg.output_model)
         if cfg.tpu_telemetry_path:
             # the CLI's one-shot analogue of GET /metrics: dump the final
@@ -218,12 +234,19 @@ class Application:
                 serve_port=9109 serve_max_batch_rows=256
         """
         cfg = self.config
-        if not cfg.input_model:
-            log.fatal("Need input_model for serve task")
+        if not cfg.input_model and not cfg.tpu_checkpoint_path:
+            log.fatal("Need input_model (or tpu_checkpoint_path) for "
+                      "serve task")
         from .serving import Server
         server = Server(cfg)
-        entry = server.load_model(cfg.serve_model_name,
-                                  model_file=cfg.input_model)
+        if cfg.input_model:
+            entry = server.load_model(cfg.serve_model_name,
+                                      model_file=cfg.input_model)
+        else:
+            # serve straight from the newest training checkpoint — the
+            # crash-restart story for the serving half of the system
+            entry = server.load_model(cfg.serve_model_name,
+                                      checkpoint_dir=cfg.tpu_checkpoint_path)
         log.info("Loaded %s v%d (%d trees); serving on %s:%d",
                  entry.name, entry.version, entry.num_trees,
                  cfg.serve_host, cfg.serve_port)

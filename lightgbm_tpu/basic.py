@@ -569,6 +569,10 @@ class Booster:
     # -- model IO ----------------------------------------------------------
     def save_model(self, filename: str, num_iteration: int = -1,
                    start_iteration: int = 0) -> "Booster":
+        """Save the model text to ``filename``.  The write is atomic on
+        local filesystems (same-dir temp + fsync + os.replace,
+        io/file_io.atomic_write_text): a crash mid-save leaves any
+        previous model file intact instead of a truncated one."""
         self._gbdt.save_model_to_file(filename, start_iteration, num_iteration)
         return self
 

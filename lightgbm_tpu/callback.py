@@ -92,6 +92,26 @@ def telemetry(recorder=None) -> Callable:
     return _callback
 
 
+def checkpoint(manager) -> Callable:
+    """Write an atomic checkpoint every `manager.interval` rounds
+    (resilience/checkpoint.CheckpointManager).  engine.train auto-injects
+    this whenever Config.tpu_checkpoint_path is set; pass a manager
+    explicitly for custom paths/retention:
+
+        mgr = CheckpointManager("ckpts/", interval=25, keep_last_n=5)
+        engine.train(params, ds, callbacks=[callback.checkpoint(mgr)])
+    """
+
+    def _callback(env: CallbackEnv) -> None:
+        manager.maybe_save(env.model, env.iteration)
+
+    # after telemetry (25) so the round's event is complete before the
+    # snapshot, before early_stopping (30) so the round that triggers a
+    # stop is still durably captured
+    _callback.order = 28
+    return _callback
+
+
 def _resolve_schedule(key: str, spec, round_idx: int, num_rounds: int):
     """A per-round parameter value from a list (one entry per round) or a
     callable round_idx -> value."""

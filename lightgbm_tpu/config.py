@@ -177,6 +177,21 @@ _SCHEMA = [
     ("serve_min_device_work", int, 1 << 22),  # per-batch rows*trees floor for the device path
     ("serve_host_fallback", bool, True),     # overflow/small traffic -> host walk instead of 429
     ("serve_fallback_max_rows", int, 16),    # biggest request served host-side under overload
+    # --- resilience parameters (no reference analogue)
+    # Checkpoint/resume + comm retry (lightgbm_tpu/resilience): periodic
+    # atomic snapshots with deterministic restart — a resumed run's model
+    # file is byte-identical to the uninterrupted run; see
+    # docs/Resilience.md.
+    ("tpu_checkpoint_path", str, ""),        # non-empty -> checkpoint every
+    #   tpu_checkpoint_interval rounds into this directory; the CLI
+    #   auto-resumes from the newest valid checkpoint found there
+    ("tpu_checkpoint_interval", int, 10),    # rounds between checkpoints
+    ("tpu_checkpoint_keep", int, 3),         # retention: keep newest N checkpoints
+    ("tpu_comm_retries", int, 4),            # comm op retries after the first attempt
+    ("tpu_comm_backoff_ms", float, 50.0),    # first-retry backoff (doubles per retry)
+    ("tpu_comm_backoff_max_ms", float, 2000.0),  # backoff cap
+    ("tpu_comm_op_timeout_s", float, 0.0),   # per send/recv cap; 0 = inherit setup timeout
+    ("tpu_comm_heartbeat_s", float, 0.0),    # >0 -> rank-liveness probe every N seconds
 ]
 
 # alias -> canonical name (src/io/config_auto.cpp:4-157)
@@ -277,6 +292,15 @@ ALIAS_TABLE: Dict[str, str] = {
     "serve_max_wait_ms": "serve_batch_wait_ms",
     "serve_queue_size": "serve_queue_rows",
     "serve_timeout_ms": "serve_request_timeout_ms",
+    "checkpoint_path": "tpu_checkpoint_path",
+    "checkpoint_dir": "tpu_checkpoint_path",
+    "checkpoint_interval": "tpu_checkpoint_interval",
+    "checkpoint_freq": "tpu_checkpoint_interval",
+    "checkpoint_keep": "tpu_checkpoint_keep",
+    "keep_last_n": "tpu_checkpoint_keep",
+    "comm_retries": "tpu_comm_retries",
+    "comm_backoff_ms": "tpu_comm_backoff_ms",
+    "comm_heartbeat_s": "tpu_comm_heartbeat_s",
 }
 
 PARAMETER_TYPES: Dict[str, Any] = {name: typ for name, typ, _ in _SCHEMA}
@@ -480,6 +504,19 @@ class Config:
         if self.serve_batch_wait_ms < 0 or self.serve_request_timeout_ms <= 0:
             log.fatal("serve_batch_wait_ms must be >= 0 and "
                       "serve_request_timeout_ms > 0")
+        if self.tpu_checkpoint_path:
+            if self.tpu_checkpoint_interval < 1:
+                log.fatal("tpu_checkpoint_interval must be >= 1, got %d"
+                          % self.tpu_checkpoint_interval)
+            if self.tpu_checkpoint_keep < 1:
+                log.fatal("tpu_checkpoint_keep must be >= 1, got %d"
+                          % self.tpu_checkpoint_keep)
+        if self.tpu_comm_retries < 0:
+            log.fatal("tpu_comm_retries must be >= 0, got %d"
+                      % self.tpu_comm_retries)
+        if self.tpu_comm_backoff_ms < 0 or self.tpu_comm_backoff_max_ms < 0:
+            log.fatal("tpu_comm_backoff_ms / tpu_comm_backoff_max_ms must "
+                      "be >= 0")
 
     def is_single_machine(self) -> bool:
         return self.num_machines <= 1

@@ -33,8 +33,21 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
           feature_name="auto", categorical_feature="auto",
           early_stopping_rounds: Optional[int] = None, evals_result=None,
           verbose_eval=True, learning_rates=None,
-          keep_training_booster: bool = False, callbacks=None):
-    """Mirror of engine.py:19-243."""
+          keep_training_booster: bool = False, callbacks=None,
+          resume_from: Optional[str] = None):
+    """Mirror of engine.py:19-243.
+
+    resume_from: a checkpoint directory (or a CheckpointManager root,
+    then the newest valid checkpoint is used) written by the
+    `checkpoint` callback / tpu_checkpoint_path.  The booster is
+    restored and training continues from the checkpointed round up to
+    `num_boost_round` TOTAL rounds, producing a model byte-identical to
+    the uninterrupted run (resume is refused on config/dataset
+    mismatch).  Mutually exclusive with init_model — continued training
+    on NEW data is init_model's job; resume is a restart of the SAME
+    run.  Note early-stopping metric history restarts at the resume
+    point, so the byte-identity guarantee applies to fixed-round runs.
+    """
     params = dict(params) if params else {}
     num_boost_round = int(_pop_param(params, "num_iterations", num_boost_round))
     esr = _pop_param(params, "early_stopping_round", early_stopping_rounds)
@@ -48,6 +61,16 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         train_set.feature_name = feature_name
     if categorical_feature != "auto":
         train_set.categorical_feature = categorical_feature
+
+    ckpt = None
+    if resume_from is not None:
+        if init_model is not None:
+            raise LightGBMError(
+                "resume_from and init_model are mutually exclusive: resume "
+                "restarts the SAME run from its checkpoint; init_model "
+                "seeds continued training on top of a finished model")
+        from .resilience import CheckpointManager
+        ckpt = CheckpointManager.load(resume_from)
 
     predictor = None
     init_iters = 0
@@ -93,6 +116,25 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
             m.init(train_set._binned.metadata, train_set._binned.num_data)
             booster._gbdt.train_metrics.append(m)
 
+    if ckpt is not None:
+        # restore AFTER valid sets attach so their score planes exist to
+        # be overwritten with the checkpointed arrays
+        from .resilience import CheckpointManager
+        restored_round = CheckpointManager.restore(booster, ckpt)
+        # loop bounds below: train rounds [restored_round, num_boost_round)
+        # — num_boost_round is the TOTAL round count of the run being
+        # resumed, exactly as the uninterrupted run would iterate — and
+        # callbacks see begin_iteration=0 so lr schedules index by
+        # ABSOLUTE round
+        begin_round, end_round, begin_cb = restored_round, num_boost_round, 0
+        if restored_round >= num_boost_round:
+            log.warning("checkpoint at round %d already covers "
+                        "num_boost_round=%d; nothing to train",
+                        restored_round, num_boost_round)
+    else:
+        begin_round = begin_cb = init_iters
+        end_round = init_iters + num_boost_round
+
     # callbacks
     callbacks = set(callbacks) if callbacks else set()
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
@@ -110,6 +152,15 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         # tpu_telemetry_path is set: merge each round's metric values
         # into the per-iteration JSONL event (obs/recorder.py)
         callbacks.add(callback_mod.telemetry())
+    if cfg.tpu_checkpoint_path:
+        # periodic atomic checkpoints (resilience/checkpoint.py); resume
+        # with resume_from=cfg.tpu_checkpoint_path (the CLI does this
+        # automatically)
+        from .resilience import CheckpointManager
+        callbacks.add(callback_mod.checkpoint(CheckpointManager(
+            cfg.tpu_checkpoint_path,
+            interval=cfg.tpu_checkpoint_interval,
+            keep_last_n=cfg.tpu_checkpoint_keep)))
 
     cb_before = {cb for cb in callbacks
                  if getattr(cb, "before_iteration", False)}
@@ -117,11 +168,11 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     cb_before = sorted(cb_before, key=lambda cb: getattr(cb, "order", 0))
     cb_after = sorted(cb_after, key=lambda cb: getattr(cb, "order", 0))
 
-    for i in range(init_iters, init_iters + num_boost_round):
+    for i in range(begin_round, end_round):
         for cb in cb_before:
             cb(callback_mod.CallbackEnv(model=booster, params=params,
-                                        iteration=i, begin_iteration=init_iters,
-                                        end_iteration=init_iters + num_boost_round,
+                                        iteration=i, begin_iteration=begin_cb,
+                                        end_iteration=end_round,
                                         evaluation_result_list=None))
         finished = booster.update(fobj=fobj)
 
@@ -149,8 +200,8 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
             for cb in cb_after:
                 cb(callback_mod.CallbackEnv(model=booster, params=params,
                                             iteration=i,
-                                            begin_iteration=init_iters,
-                                            end_iteration=init_iters + num_boost_round,
+                                            begin_iteration=begin_cb,
+                                            end_iteration=end_round,
                                             evaluation_result_list=evaluation_result_list))
         except callback_mod.EarlyStopException as es:
             booster.best_iteration = es.best_iteration + 1

@@ -22,6 +22,8 @@ anything else (permissions, network faults) as a loud failure.
 from __future__ import annotations
 
 import builtins
+import os
+import tempfile
 from typing import Callable, Dict
 
 _BACKENDS: Dict[str, Callable] = {}
@@ -53,6 +55,39 @@ def v_open(path, mode: str = "r"):
             % (path, path.split("://", 1)[0] + "://",
                path.split("://", 1)[0]))
     return builtins.open(path, mode)
+
+
+def atomic_write_text(path, text: str) -> None:
+    """Write `text` to `path` so readers never observe a partial file.
+
+    Local paths get the full crash-safe sequence: temp file in the same
+    directory (so the final rename is same-filesystem), flush + fsync,
+    ``os.replace`` over the destination.  A process killed mid-save
+    leaves either the old file or the new one, never a truncated model.
+    Paths served by a registered backend (gs://, hdfs://, ...) fall back
+    to a plain v_open write — object stores are already
+    all-or-nothing per PUT, and POSIX rename doesn't exist there.
+    """
+    path = str(path)
+    if "://" in path or any(path.startswith(p) for p in _BACKENDS):
+        with v_open(path, "w") as f:
+            f.write(text)
+        return
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".tmp.", dir=directory)
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def enable_fsspec(*protocols: str) -> None:

@@ -6,7 +6,8 @@ from typing import List
 import numpy as np
 
 from ..utils import log
-from .gbdt import GBDT, K_EPSILON, _add_tree_score
+from .gbdt import (GBDT, K_EPSILON, _add_tree_score, _rng_state_from_json,
+                   _rng_state_to_json)
 
 
 class DART(GBDT):
@@ -34,6 +35,70 @@ class DART(GBDT):
             self.tree_weight.append(self.shrinkage_rate)
             self.sum_weight += self.shrinkage_rate
         return False
+
+    # -- resilience hooks (resilience/checkpoint.py) -----------------------
+    def _aux_state_extra(self):
+        # drop history lives in _drop_rng's stream + the per-tree weights;
+        # _drop_index is recomputed at the top of every iteration
+        return {"drop_rng": _rng_state_to_json(self._drop_rng),
+                "tree_weight": [float(w) for w in self.tree_weight],
+                "sum_weight": float(self.sum_weight)}
+
+    def _restore_aux_extra(self, state):
+        self._drop_rng = _rng_state_from_json(state["drop_rng"])
+        self.tree_weight = [float(w) for w in state.get("tree_weight", [])]
+        self.sum_weight = float(state.get("sum_weight", 0.0))
+        self._drop_index = []
+
+    def capture_score_arrays(self):
+        # DART keeps mutating OLD trees after a checkpoint (_normalize
+        # shrinks dropped trees in place), and the model text serializes
+        # internal_value/shrinkage at %g precision — not enough for the
+        # post-resume multiplications to stay bitwise.  Snapshot the
+        # exact mutable per-tree doubles alongside the score planes and
+        # restore them over the text-parsed trees.
+        out = super().capture_score_arrays()
+        for i, t in enumerate(self.models):
+            out["dart_tree:%d:leaf_value" % i] = np.asarray(
+                t.leaf_value, np.float64)
+            out["dart_tree:%d:internal_value" % i] = np.asarray(
+                t.internal_value, np.float64)
+            out["dart_tree:%d:shrinkage" % i] = np.float64(t.shrinkage)
+            # bin-space traversal fields: Tree.from_string cannot recover
+            # them from the text (thresholds serialize in raw feature
+            # space), and dropping trees from the device scores traverses
+            # the BINNED data — without these a restored tree mis-walks
+            out["dart_tree:%d:split_feature_inner" % i] = np.asarray(
+                t.split_feature_inner, np.int32)
+            out["dart_tree:%d:threshold_in_bin" % i] = np.asarray(
+                t.threshold_in_bin, np.int32)
+            if t.num_cat > 0:
+                out["dart_tree:%d:cat_boundaries_inner" % i] = np.asarray(
+                    t.cat_boundaries_inner, np.int64)
+                out["dart_tree:%d:cat_threshold_inner" % i] = np.asarray(
+                    t.cat_threshold_inner, np.int64)
+        return out
+
+    def restore_score_arrays(self, scores):
+        super().restore_score_arrays(scores)
+        for i, t in enumerate(self.models):
+            key = "dart_tree:%d:leaf_value" % i
+            if key in scores:
+                t.leaf_value = np.asarray(scores[key], np.float64)
+                t.internal_value = np.asarray(
+                    scores["dart_tree:%d:internal_value" % i], np.float64)
+                t.shrinkage = float(scores["dart_tree:%d:shrinkage" % i])
+                t.split_feature_inner = np.asarray(
+                    scores["dart_tree:%d:split_feature_inner" % i], np.int32)
+                t.threshold_in_bin = np.asarray(
+                    scores["dart_tree:%d:threshold_in_bin" % i], np.int32)
+                ck = "dart_tree:%d:cat_boundaries_inner" % i
+                if ck in scores:
+                    t.cat_boundaries_inner = [
+                        int(v) for v in scores[ck]]
+                    t.cat_threshold_inner = [
+                        int(v) for v in
+                        scores["dart_tree:%d:cat_threshold_inner" % i]]
 
     # -- dropping (dart.hpp:88-140) ---------------------------------------
     def _dropping_trees(self) -> None:

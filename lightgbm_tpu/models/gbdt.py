@@ -20,7 +20,7 @@ import jax.numpy as jnp
 
 from ..config import Config
 from ..io.dataset import BinnedDataset
-from ..io.file_io import v_open
+from ..io.file_io import atomic_write_text, v_open
 from ..metric import Metric
 from ..objective import ObjectiveFunction
 from ..ops import grow as grow_ops
@@ -1654,8 +1654,11 @@ class GBDT:
 
     def save_model_to_file(self, filename: str, start_iteration: int = 0,
                            num_iteration: int = -1) -> None:
-        with v_open(filename, "w") as f:
-            f.write(self.save_model_to_string(start_iteration, num_iteration))
+        # atomic (tmp + fsync + os.replace for local paths): a crash
+        # mid-save never leaves a truncated model file behind
+        atomic_write_text(
+            filename, self.save_model_to_string(start_iteration,
+                                                num_iteration))
         log.info("Saved model to %s", filename)
 
     def load_model_from_string(self, text: str) -> None:
@@ -1710,6 +1713,65 @@ class GBDT:
                 body = body[:body.index("end of trees")]
             self.models.append(Tree.from_string(body))
         self.iter = len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    # ------------------------------------------------------------------ #
+    # Resilience state hooks (lightgbm_tpu/resilience/checkpoint.py)
+    # ------------------------------------------------------------------ #
+    def capture_aux_state(self) -> Dict:
+        """Everything a deterministic resume needs BEYOND the model
+        string: round index, shrinkage, and every RNG stream that feeds
+        future rounds.  Drains the deferred-tree pipeline first so the
+        model string cut right after this is complete."""
+        self._sync_model()
+        state: Dict = {
+            "round": int(self.iter),
+            "boosting": type(self).__name__.lower(),
+            "shrinkage_rate": float(self.shrinkage_rate),
+            "bag_rng": _rng_state_to_json(self._bag_rng),
+            "feat_rng": _rng_state_to_json(self._feat_rng),
+        }
+        state.update(self._aux_state_extra())
+        return state
+
+    def restore_aux_state(self, state: Dict) -> None:
+        """Inverse of capture_aux_state, applied after
+        load_model_from_string on a freshly constructed booster bound to
+        the same (identically binned) training set."""
+        if int(state["round"]) != self.iter:
+            raise ValueError(
+                "aux state is for round %d but the loaded model holds %d "
+                "iterations" % (int(state["round"]), self.iter))
+        self.shrinkage_rate = float(state["shrinkage_rate"])
+        self._bag_rng = _rng_state_from_json(state["bag_rng"])
+        self._feat_rng = _rng_state_from_json(state["feat_rng"])
+        self._restore_aux_extra(state)
+
+    def _aux_state_extra(self) -> Dict:
+        """Subclass hook: persistent state beyond the base RNG streams
+        (DART drop history/weights, GOSS sampling key)."""
+        return {}
+
+    def _restore_aux_extra(self, state: Dict) -> None:
+        """Subclass hook, inverse of _aux_state_extra."""
+
+    def capture_score_arrays(self) -> Dict[str, np.ndarray]:
+        """Exact raw score planes for train + every valid set.  Restored
+        verbatim (not replayed through tree prediction) so resumed
+        gradients match the uninterrupted run to the last ulp."""
+        out: Dict[str, np.ndarray] = {}
+        if self.train_state is not None:
+            out["train"] = np.asarray(self.train_state.score)
+        for name, vs, _m in self.valid_states:
+            out["valid:%s" % name] = np.asarray(vs.score)
+        return out
+
+    def restore_score_arrays(self, scores: Dict[str, np.ndarray]) -> None:
+        if self.train_state is not None and "train" in scores:
+            self.train_state.score = jnp.asarray(scores["train"])
+        for name, vs, _m in self.valid_states:
+            key = "valid:%s" % name
+            if key in scores:
+                vs.score = jnp.asarray(scores[key])
 
     # ------------------------------------------------------------------ #
     def refit(self, X: np.ndarray, label: np.ndarray,
@@ -1940,3 +2002,20 @@ def _feature_infos(ds: BinnedDataset) -> List[str]:
 
 def _repr_g(v: float) -> str:
     return np.format_float_positional(v, precision=17, trim="-", fractional=False)
+
+
+def _rng_state_to_json(rng: np.random.RandomState) -> Dict:
+    """np.random.RandomState state tuple -> JSONable dict (the 624-word
+    Mersenne key round-trips exactly as a list of ints)."""
+    name, keys, pos, has_gauss, cached = rng.get_state()
+    return {"name": str(name), "keys": np.asarray(keys).tolist(),
+            "pos": int(pos), "has_gauss": int(has_gauss),
+            "cached_gaussian": float(cached)}
+
+
+def _rng_state_from_json(d: Dict) -> np.random.RandomState:
+    rng = np.random.RandomState()
+    rng.set_state((d["name"], np.asarray(d["keys"], np.uint32),
+                   int(d["pos"]), int(d["has_gauss"]),
+                   float(d["cached_gaussian"])))
+    return rng

@@ -47,6 +47,18 @@ class GOSS(GBDT):
         log.info("Using GOSS")
         self._goss_key = jax.random.PRNGKey(config.bagging_seed)
 
+    # -- resilience hooks (resilience/checkpoint.py) -----------------------
+    def _aux_state_extra(self):
+        # the raw uint32 PRNG key restores the jax.random.split chain
+        # exactly, so post-warm-up sampling picks the same rows after
+        # resume (warm-up itself gates on the restored self.iter)
+        return {"goss_key": np.asarray(self._goss_key, np.uint32).tolist()}
+
+    def _restore_aux_extra(self, state):
+        if "goss_key" in state:
+            self._goss_key = jnp.asarray(
+                np.asarray(state["goss_key"], np.uint32))
+
     def _bagging(self, it: int):
         # GOSS replaces bagging; the row mask was computed from gradients in
         # _sample_gradients just before this is called

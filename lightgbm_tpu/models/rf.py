@@ -25,6 +25,14 @@ class RF(GBDT):
         self._rf_init_scores = [0.0] * max(self.num_tree_per_iteration, 1)
         self._rf_grad = None
 
+    # -- resilience hooks (resilience/checkpoint.py) -----------------------
+    def _restore_aux_extra(self, state):
+        # RF keeps no extra persistent RNG: the base bagging streams are
+        # restored by GBDT.restore_aux_state and _rf_grad is a pure
+        # function of the objective, lazily recomputed.  Clearing it here
+        # just documents that a restored booster starts from scratch.
+        self._rf_grad = None
+
     def _compute_rf_gradients(self):
         """Gradients against the constant init score (rf.hpp:75-93)."""
         k = self.num_tree_per_iteration

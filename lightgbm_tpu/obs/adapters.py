@@ -19,6 +19,10 @@ COMM_COUNTERS = (
     ("lgbm_comm_allgather_total", "Allgather rounds completed"),
     ("lgbm_comm_sync_wait_seconds_total",
      "Seconds blocked waiting on comm peers"),
+    ("lgbm_comm_retries_total",
+     "Comm operations retried after a transient failure"),
+    ("lgbm_comm_failures_total",
+     "Comm operations aborted after exhausting the retry budget"),
 )
 
 

@@ -141,6 +141,17 @@ class TrainingRecorder:
         for v in results or ():
             metrics.setdefault(str(v[0]), {})[str(v[1])] = float(v[2])
 
+    def record_checkpoint(self, round_idx: int, path: str,
+                          wall_s: float) -> None:
+        """One event per checkpoint written (resilience.CheckpointManager
+        calls this after the atomic rename lands)."""
+        if self._closed:
+            return
+        self._flush_pending()
+        self._write({"event": "checkpoint", "round": int(round_idx),
+                     "path": str(path),
+                     "wall_ms": round(wall_s * 1e3, 3)})
+
     def finalize(self, gbdt) -> None:
         """Flush the last pending event, backfill tree stats for rounds
         that were deferred (the caller must have drained the pipeline

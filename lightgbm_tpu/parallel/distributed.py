@@ -43,12 +43,19 @@ from __future__ import annotations
 import errno
 import json
 import os
+import select
 import socket
 import struct
 import time
 from typing import List, Optional, Tuple
 
+from ..resilience.comm import CommFailure, FaultInjector, Heartbeat, RetryPolicy
 from ..utils import log
+
+# sentinel returned by _with_retry when the fault injector swallowed the
+# frame (drop): callers treat the operation as "done" and the PEER's
+# op-timeout machinery is what notices the loss
+_DROPPED = object()
 
 RANK_ENV = "LIGHTGBM_TPU_RANK"   # explicit override, highest priority
 
@@ -201,20 +208,38 @@ class SocketComm:
     """
 
     def __init__(self, rank: int, world: int, machines: List[str],
-                 timeout_s: float = 120.0, port_offset: int = 1):
+                 timeout_s: float = 120.0, port_offset: int = 1,
+                 retry: Optional[RetryPolicy] = None,
+                 op_timeout_s: float = 0.0,
+                 heartbeat_s: float = 0.0,
+                 injector: Optional[FaultInjector] = None):
         """port_offset: the machine-list port belongs to the JAX
         coordination service (initialize_from_config) — binding the hub
         there would EADDRINUSE against it, so the find-bin comm uses
         port + 1 by default (pass 0 when jax.distributed is not in
-        play)."""
+        play).
+
+        retry: RetryPolicy wrapping every post-setup wire operation
+        (default RetryPolicy()); op_timeout_s > 0 caps each individual
+        send/recv (default: inherit timeout_s); heartbeat_s > 0 starts
+        the rank-liveness probe thread; injector is the test-only
+        FaultInjector hook consulted before each wire op.
+        """
         self.rank, self.world = rank, world
         self.timeout = timeout_s
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.op_timeout = op_timeout_s if op_timeout_s > 0 else timeout_s
+        self._injector = injector
+        self._heartbeat: Optional[Heartbeat] = None
         host, port = machines[0].rsplit(":", 1)
         port = int(port) + port_offset
         self._peers: List[socket.socket] = []
+        # hub peers arrive rank-ordered 1..world-1; a spoke's single
+        # peer is the hub (rank 0) — CommFailure names ranks from this
+        self._peer_ranks: List[int] = []
         # comm counters (bytes in/out, allgather rounds, sync-wait
-        # seconds) tagged rank/world in the process-wide registry —
-        # the comm quarter of the unified telemetry layer
+        # seconds, retries/aborts) tagged rank/world in the process-wide
+        # registry — the comm quarter of the unified telemetry layer
         from ..obs import adapters as obs_adapters
         from ..obs import default_registry
         m = obs_adapters.ensure_comm_metrics(default_registry(), rank, world)
@@ -222,6 +247,8 @@ class SocketComm:
         self._m_recv = m["lgbm_comm_bytes_received_total"]
         self._m_allgather = m["lgbm_comm_allgather_total"]
         self._m_wait = m["lgbm_comm_sync_wait_seconds_total"]
+        self._m_retries = m["lgbm_comm_retries_total"]
+        self._m_failures = m["lgbm_comm_failures_total"]
         if world == 1:
             return
         if rank == 0:
@@ -264,6 +291,7 @@ class SocketComm:
             self._m_recv.inc(4 * (world - 1))
             srv.close()
             self._peers = [by_rank[r] for r in range(1, world)]
+            self._peer_ranks = list(range(1, world))
         else:
             # retry-connect until the hub binds (every host launches the
             # same command, so spokes may start before rank 0 listens —
@@ -286,6 +314,93 @@ class SocketComm:
             s.sendall(struct.pack("!i", rank))
             self._m_sent.inc(4)
             self._peers = [s]
+            self._peer_ranks = [0]
+        # setup handshakes above ran under the generous timeout_s; from
+        # here every individual send/recv is capped at op_timeout so a
+        # hung peer surfaces as a retryable timeout, not a 2-minute stall
+        for s in self._peers:
+            s.settimeout(self.op_timeout)
+        if heartbeat_s > 0:
+            self.start_heartbeat(heartbeat_s)
+
+    @classmethod
+    def from_config(cls, rank: int, world: int, machines: List[str],
+                    config, **kwargs) -> "SocketComm":
+        """Construct with the resilience knobs resolved from a Config
+        (tpu_comm_retries / tpu_comm_backoff_ms / tpu_comm_backoff_max_ms /
+        tpu_comm_op_timeout_s / tpu_comm_heartbeat_s)."""
+        kwargs.setdefault("retry", RetryPolicy.from_config(config))
+        kwargs.setdefault("op_timeout_s",
+                          float(getattr(config, "tpu_comm_op_timeout_s", 0.0)))
+        kwargs.setdefault("heartbeat_s",
+                          float(getattr(config, "tpu_comm_heartbeat_s", 0.0)))
+        return cls(rank, world, machines, **kwargs)
+
+    # -- retry / liveness ----------------------------------------------
+    def _with_retry(self, op: str, peer_rank: int, fn):
+        """Run one whole-frame wire operation under the retry policy.
+
+        The injector (when armed) fires BEFORE the wire is touched, so
+        injected faults retry protocol-cleanly; a real failure after
+        partial frame traffic means the peer is gone and the remaining
+        attempts fail fast until CommFailure names it.  Returns fn()'s
+        value, or the _DROPPED sentinel for an injected drop.
+        """
+        attempts = self.retry.retries + 1
+        last: Optional[BaseException] = None
+        for attempt in range(1, attempts + 1):
+            try:
+                if self._injector is not None:
+                    if self._injector.check(op) == FaultInjector.DROP:
+                        return _DROPPED
+                return fn()
+            except CommFailure:
+                raise
+            except (OSError, ConnectionError) as exc:
+                last = exc
+                if attempt >= attempts:
+                    break
+                self._m_retries.inc()
+                delay = self.retry.backoff_s(attempt)
+                log.warning("comm %s to rank %d failed (%s); retry %d/%d "
+                            "in %.0f ms", op, peer_rank, exc, attempt,
+                            self.retry.retries, delay * 1e3)
+                time.sleep(delay)
+        self._m_failures.inc()
+        raise CommFailure(op, peer_rank, attempts, last)
+
+    def start_heartbeat(self, interval_s: float) -> Optional[Heartbeat]:
+        """Start (or return the running) rank-liveness probe thread."""
+        if self.world == 1:
+            return None
+        if self._heartbeat is None:
+            from ..obs import default_registry
+            self._heartbeat = Heartbeat(
+                self._peer_liveness, interval_s, rank=self.rank,
+                world=self.world, registry=default_registry()).start()
+        return self._heartbeat
+
+    def _peer_liveness(self) -> List[int]:
+        """Passive socket health probe: a peer whose socket is readable
+        with zero bytes (EOF) or errored is reported dead.  Pending
+        legitimate frame data reads as alive (MSG_PEEK does not consume
+        it)."""
+        dead: List[int] = []
+        for idx, s in enumerate(self._peers):
+            r = self._peer_ranks[idx] if idx < len(self._peer_ranks) else idx
+            try:
+                readable, _, errored = select.select([s], [], [s], 0)
+                if errored:
+                    dead.append(r)
+                elif readable and s.recv(1, socket.MSG_PEEK) == b"":
+                    dead.append(r)
+            except (OSError, ValueError):
+                dead.append(r)
+        return dead
+
+    def dead_ranks(self) -> List[int]:
+        hb = self._heartbeat
+        return hb.dead_ranks() if hb is not None else []
 
     # LocalComm-compatible surface -------------------------------------
     def allgather_fn(self, rank: int):
@@ -300,14 +415,21 @@ class SocketComm:
             out: List[Optional[dict]] = [None] * self.world
             out[0] = payload
             for i, conn in enumerate(self._peers, start=1):
-                out[i] = self._recv_counted(conn)
+                got = self._with_retry(
+                    "allgather", i, lambda c=conn: self._recv_counted(c))
+                out[i] = None if got is _DROPPED else got
             blob = _encode(out)
-            for conn in self._peers:
-                _send_blob(conn, blob)
-                self._m_sent.inc(len(blob) + 8)
+            for i, conn in enumerate(self._peers, start=1):
+                sent = self._with_retry(
+                    "send", i, lambda c=conn: _send_blob(c, blob))
+                if sent is not _DROPPED:
+                    self._m_sent.inc(len(blob) + 8)
             return out  # type: ignore[return-value]
-        self._send_counted(self._peers[0], payload)
-        return self._recv_counted(self._peers[0])
+        self._with_retry(
+            "send", 0, lambda: self._send_counted(self._peers[0], payload))
+        got = self._with_retry(
+            "allgather", 0, lambda: self._recv_counted(self._peers[0]))
+        return None if got is _DROPPED else got
 
     # counted wire helpers: every frame is 8-byte length prefix + blob,
     # and blocking-recv time IS the rank-skew sync wait at this seam
@@ -324,12 +446,16 @@ class SocketComm:
         return json.loads(blob.decode("utf-8"))
 
     def close(self) -> None:
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
         for s in self._peers:
             try:
                 s.close()
             except OSError:
                 pass
         self._peers = []
+        self._peer_ranks = []
 
 
 def _json_default(o):

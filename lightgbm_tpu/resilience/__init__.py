@@ -1,0 +1,25 @@
+"""lightgbm_tpu.resilience — survive process kills and flaky sockets.
+
+Two halves:
+
+- ``checkpoint``: atomic round-level snapshots (model string + trainer
+  aux state + exact score planes) with manifest hashes, retention and
+  deterministic ``engine.train(..., resume_from=...)`` restore — the
+  resumed model file is byte-identical to the uninterrupted run.
+- ``comm``: retry policy / fault injector / typed ``CommFailure`` /
+  rank-liveness heartbeat that ``parallel.distributed.SocketComm``
+  wraps around its wire operations.
+
+See docs/Resilience.md for the checkpoint format and failure modes.
+"""
+from .checkpoint import (CheckpointData, CheckpointError, CheckpointManager,
+                         CheckpointMismatchError, config_hash,
+                         dataset_fingerprint, list_checkpoints, verify)
+from .comm import CommFailure, FaultInjector, Heartbeat, RetryPolicy
+
+__all__ = [
+    "CheckpointData", "CheckpointError", "CheckpointManager",
+    "CheckpointMismatchError", "CommFailure", "FaultInjector", "Heartbeat",
+    "RetryPolicy", "config_hash", "dataset_fingerprint", "list_checkpoints",
+    "verify",
+]

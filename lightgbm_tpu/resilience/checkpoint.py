@@ -1,0 +1,406 @@
+"""Round-level training checkpoints with deterministic resume.
+
+A checkpoint is one directory under the manager root:
+
+    <root>/ckpt_00000012/
+        MANIFEST.json    round, schema, config hash, dataset fingerprint,
+                         per-file sha256 + byte sizes
+        model.txt        the model string (save_model_to_string)
+        state.json       trainer auxiliary state: round index, bagging /
+                         feature / GOSS / DART RNG state, DART tree
+                         weights, shrinkage (GBDT.capture_aux_state)
+        scores.npz       raw training (and valid) score planes, exact
+                         dtype — restored directly so resumed gradients
+                         are bitwise-identical to the uninterrupted run
+
+Writes are atomic: everything lands in a dot-tmp sibling directory,
+every file is fsync'd, the directory is renamed into place and the
+parent fsync'd — a crash mid-save leaves either the previous checkpoint
+set or a ``.tmp`` directory the next save sweeps away, never a
+half-written checkpoint.  ``keep_last_n`` retention prunes old rounds
+after each successful save.
+
+Resume contract (the guarantee the obs PR established for telemetry,
+extended to restarts): ``engine.train(..., resume_from=...)`` restores
+the booster from the newest valid checkpoint and continues training so
+the final model file is byte-identical to the uninterrupted run — for
+gbdt, dart and goss (tests/test_resilience.py asserts this).  Resume is
+REFUSED with ``CheckpointMismatchError`` when the config hash or the
+dataset bin-mapper fingerprint differs: silently continuing against
+different binning or different training parameters would produce a
+model that looks resumed but is neither run.
+
+Early stopping and learning-rate schedules are evaluated from absolute
+round indices, so schedules continue correctly; early-stopping METRIC
+HISTORY restarts at resume (trackers are in-callback state), so the
+byte-identity guarantee applies to fixed-round runs.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import shutil
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils import log
+
+SCHEMA_VERSION = 1
+_CKPT_PREFIX = "ckpt_"
+_TMP_PREFIX = ".tmp_"
+MANIFEST = "MANIFEST.json"
+MODEL_FILE = "model.txt"
+STATE_FILE = "state.json"
+SCORES_FILE = "scores.npz"
+
+# Params that do not change what the booster computes per round: run
+# control, IO paths, telemetry/serving/resilience knobs, predict-only
+# settings.  Everything else is part of the config hash, so a resumed
+# run with (say) a different num_leaves or lambda_l2 is refused.
+CONFIG_HASH_EXCLUDE = frozenset({
+    "config", "task", "data", "valid", "num_iterations",
+    "early_stopping_round", "snapshot_freq", "verbosity",
+    "output_model", "input_model", "output_result",
+    "initscore_filename", "valid_data_initscores",
+    "convert_model", "convert_model_language",
+    "num_iteration_predict", "predict_raw_score", "predict_leaf_index",
+    "predict_contrib", "pred_early_stop", "pred_early_stop_freq",
+    "pred_early_stop_margin",
+    "machine_rank", "machines", "machine_list_filename",
+    "local_listen_port", "time_out",
+    "tpu_profile", "tpu_profile_trace_dir", "tpu_log_json",
+    "tpu_telemetry_path", "tpu_telemetry_device_stats",
+    "tpu_checkpoint_path", "tpu_checkpoint_interval", "tpu_checkpoint_keep",
+    "tpu_comm_retries", "tpu_comm_backoff_ms", "tpu_comm_backoff_max_ms",
+    "tpu_comm_op_timeout_s", "tpu_comm_heartbeat_s",
+})
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be written, read or verified."""
+
+
+class CheckpointMismatchError(CheckpointError):
+    """Resume refused: the checkpoint was taken under a different config
+    or against a differently-binned dataset."""
+
+
+def config_hash(config) -> str:
+    """Stable hash over the training-relevant half of the config."""
+    from ..config import PARAMETER_SET
+    payload = {name: getattr(config, name) for name in sorted(PARAMETER_SET)
+               if name not in CONFIG_HASH_EXCLUDE}
+    blob = json.dumps(payload, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+def dataset_fingerprint(binned) -> str:
+    """Hash of the binned dataset identity: row/feature counts plus the
+    full serialized bin-mapper state.  Two datasets with the same
+    fingerprint bin every value identically, which is exactly what the
+    restored score planes and parsed trees assume."""
+    payload = {
+        "num_data": int(binned.num_data),
+        "num_features": int(binned.num_features),
+        "mappers": [m.to_state() for m in binned.bin_mappers],
+    }
+    blob = json.dumps(payload, sort_keys=True)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # not all filesystems allow O_RDONLY on dirs
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_fsync(path: str, data: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+class CheckpointData:
+    """One loaded checkpoint: manifest + model text + aux state + score
+    arrays, hash-verified at load time."""
+
+    def __init__(self, path: str, manifest: Dict, model_str: str,
+                 state: Dict, scores: Dict[str, np.ndarray]):
+        self.path = path
+        self.manifest = manifest
+        self.model_str = model_str
+        self.state = state
+        self.scores = scores
+
+    @property
+    def round(self) -> int:
+        return int(self.manifest["round"])
+
+
+class CheckpointManager:
+    """Atomic periodic snapshots + deterministic restore.
+
+    Instantiate with the checkpoint root for the save side (the
+    ``checkpoint`` callback calls ``maybe_save`` each round); the load
+    side is classmethod-only (``latest`` / ``load`` / ``restore``) so
+    resume never needs a manager instance.
+    """
+
+    def __init__(self, path: str, interval: int = 10, keep_last_n: int = 3,
+                 registry=None):
+        if not path:
+            raise CheckpointError("CheckpointManager needs a directory path")
+        self.path = str(path)
+        self.interval = int(interval)
+        self.keep_last_n = max(int(keep_last_n), 1)
+        if registry is None:
+            from ..obs import default_registry
+            registry = default_registry()
+        self._m_saves = registry.counter(
+            "lgbm_checkpoint_saves_total", help="Checkpoints written")
+        self._m_seconds = registry.counter(
+            "lgbm_checkpoint_seconds_total",
+            help="Wall seconds spent writing checkpoints")
+        self._m_last_round = registry.gauge(
+            "lgbm_checkpoint_last_round",
+            help="Round index of the newest checkpoint written")
+
+    # -- save side ------------------------------------------------------ #
+    def maybe_save(self, booster, iteration: int) -> Optional[str]:
+        """Checkpoint after round ``iteration`` (0-based) when it closes
+        an interval; the checkpoint callback routes here every round."""
+        if self.interval <= 0 or (iteration + 1) % self.interval:
+            return None
+        return self.save(booster)
+
+    def save(self, booster) -> str:
+        """Write one atomic checkpoint of the booster's CURRENT state
+        (model + trainer aux + scores), then apply retention."""
+        t0 = time.monotonic()
+        gbdt = getattr(booster, "_gbdt", booster)
+        # _sync_model first (inside capture_aux_state): deferred pipeline
+        # trees must be materialized before the model text is cut
+        state = gbdt.capture_aux_state()
+        model_str = gbdt.save_model_to_string()
+        scores = gbdt.capture_score_arrays()
+        round_idx = int(state["round"])
+
+        os.makedirs(self.path, exist_ok=True)
+        self._sweep_tmp()
+        name = "%s%08d" % (_CKPT_PREFIX, round_idx)
+        tmp = os.path.join(self.path, _TMP_PREFIX + name)
+        final = os.path.join(self.path, name)
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)
+        os.makedirs(tmp)
+        try:
+            _write_fsync(os.path.join(tmp, MODEL_FILE),
+                         model_str.encode("utf-8"))
+            _write_fsync(os.path.join(tmp, STATE_FILE),
+                         json.dumps(state, sort_keys=True).encode("utf-8"))
+            buf = io.BytesIO()
+            np.savez(buf, **scores)
+            _write_fsync(os.path.join(tmp, SCORES_FILE), buf.getvalue())
+            manifest = {
+                "schema": SCHEMA_VERSION,
+                "round": round_idx,
+                "boosting": state.get("boosting", ""),
+                "num_trees": model_str.count("\nTree="),
+                "config_hash": config_hash(gbdt.config),
+                "dataset_fingerprint": dataset_fingerprint(gbdt.train_set),
+                "created_at": time.time(),
+                "files": {
+                    fn: {"sha256": _sha256_file(os.path.join(tmp, fn)),
+                         "bytes": os.path.getsize(os.path.join(tmp, fn))}
+                    for fn in (MODEL_FILE, STATE_FILE, SCORES_FILE)
+                },
+            }
+            _write_fsync(os.path.join(tmp, MANIFEST),
+                         json.dumps(manifest, sort_keys=True,
+                                    indent=1).encode("utf-8"))
+            _fsync_dir(tmp)
+            if os.path.isdir(final):
+                # re-checkpointing the same round (resume overlap):
+                # replace wholesale
+                shutil.rmtree(final)
+            os.rename(tmp, final)
+            _fsync_dir(self.path)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._retain()
+        wall = time.monotonic() - t0
+        self._m_saves.inc()
+        self._m_seconds.inc(wall)
+        self._m_last_round.set(round_idx)
+        recorder = getattr(gbdt, "recorder", None)
+        if recorder is not None:
+            try:
+                recorder.record_checkpoint(round_idx, final, wall)
+            except Exception as exc:  # noqa: BLE001 — telemetry never raises
+                log.warning("checkpoint telemetry failed: %s", exc)
+        log.info("Checkpoint round %d written to %s (%.0f ms)",
+                 round_idx, final, wall * 1e3)
+        return final
+
+    def _retain(self) -> None:
+        ckpts = list_checkpoints(self.path)
+        for path, _round in ckpts[:-self.keep_last_n]:
+            shutil.rmtree(path, ignore_errors=True)
+            log.debug("checkpoint retention: removed %s", path)
+
+    def _sweep_tmp(self) -> None:
+        for entry in os.listdir(self.path):
+            if entry.startswith(_TMP_PREFIX):
+                shutil.rmtree(os.path.join(self.path, entry),
+                              ignore_errors=True)
+
+    # -- load side ------------------------------------------------------ #
+    @staticmethod
+    def latest(path: str) -> Optional[str]:
+        """Newest checkpoint directory under ``path`` that passes hash
+        verification, or None.  A corrupt newest checkpoint (crash
+        mid-rename races are impossible, but disk rot is not) falls back
+        to the next older one with a warning."""
+        for ckpt, _round in reversed(list_checkpoints(path)):
+            try:
+                verify(ckpt)
+                return ckpt
+            except CheckpointError as exc:
+                log.warning("skipping corrupt checkpoint %s: %s", ckpt, exc)
+        return None
+
+    @staticmethod
+    def latest_model_file(path: str) -> str:
+        """Model file inside the newest valid checkpoint (the serving
+        registry's load-from-checkpoint seam)."""
+        ckpt = CheckpointManager.latest(path)
+        if ckpt is None:
+            raise CheckpointError("no valid checkpoint under %s" % path)
+        return os.path.join(ckpt, MODEL_FILE)
+
+    @staticmethod
+    def load(path: str) -> CheckpointData:
+        """Load a checkpoint: ``path`` is either one checkpoint directory
+        or a manager root (then the newest valid checkpoint is used)."""
+        if os.path.isfile(os.path.join(path, MANIFEST)):
+            ckpt = path
+        else:
+            ckpt = CheckpointManager.latest(path)
+            if ckpt is None:
+                raise CheckpointError(
+                    "no valid checkpoint found under %s" % path)
+        manifest = verify(ckpt)
+        with open(os.path.join(ckpt, MODEL_FILE)) as f:
+            model_str = f.read()
+        with open(os.path.join(ckpt, STATE_FILE)) as f:
+            state = json.load(f)
+        with np.load(os.path.join(ckpt, SCORES_FILE)) as z:
+            scores = {k: z[k] for k in z.files}
+        return CheckpointData(ckpt, manifest, model_str, state, scores)
+
+    @staticmethod
+    def restore(booster, ckpt: CheckpointData) -> int:
+        """Restore a freshly constructed booster (same params, same
+        dataset) to the checkpointed round.  Returns the round index to
+        resume the boosting loop from.  Refuses on config-hash or
+        dataset-fingerprint mismatch."""
+        gbdt = getattr(booster, "_gbdt", booster)
+        want, have = ckpt.manifest["config_hash"], config_hash(gbdt.config)
+        if want != have:
+            raise CheckpointMismatchError(
+                "config mismatch: checkpoint %s was taken with config hash "
+                "%s but this run resolves to %s — resume needs identical "
+                "training parameters (run-control params like "
+                "num_iterations/paths may differ)"
+                % (ckpt.path, want[:12], have[:12]))
+        want = ckpt.manifest["dataset_fingerprint"]
+        have = dataset_fingerprint(gbdt.train_set)
+        if want != have:
+            raise CheckpointMismatchError(
+                "dataset mismatch: checkpoint %s was taken against a "
+                "dataset with bin-mapper fingerprint %s but this run's "
+                "train set fingerprints to %s — resume needs the same "
+                "data binned the same way" % (ckpt.path, want[:12], have[:12]))
+        boosting = ckpt.state.get("boosting", "")
+        if boosting and boosting != type(gbdt).__name__.lower():
+            raise CheckpointMismatchError(
+                "boosting mismatch: checkpoint is %r, booster is %r"
+                % (boosting, type(gbdt).__name__.lower()))
+        gbdt.load_model_from_string(ckpt.model_str)
+        if gbdt.iter != ckpt.round:
+            raise CheckpointError(
+                "checkpoint %s claims round %d but its model holds %d "
+                "iterations" % (ckpt.path, ckpt.round, gbdt.iter))
+        gbdt.restore_aux_state(ckpt.state)
+        gbdt.restore_score_arrays(ckpt.scores)
+        log.info("Restored checkpoint %s: round %d, %d trees",
+                 ckpt.path, ckpt.round, len(gbdt.models))
+        return ckpt.round
+
+
+def list_checkpoints(path: str) -> List:
+    """[(dir, round)] under ``path``, oldest first."""
+    out = []
+    if not os.path.isdir(path):
+        return out
+    for entry in os.listdir(path):
+        if not entry.startswith(_CKPT_PREFIX):
+            continue
+        try:
+            rnd = int(entry[len(_CKPT_PREFIX):])
+        except ValueError:
+            continue
+        full = os.path.join(path, entry)
+        if os.path.isdir(full):
+            out.append((full, rnd))
+    out.sort(key=lambda pr: pr[1])
+    return out
+
+
+def verify(ckpt_dir: str) -> Dict:
+    """Check a checkpoint's manifest against its files (existence, size,
+    sha256).  Returns the manifest; raises CheckpointError on any
+    mismatch.  tools/ckpt_inspect.py is the CLI face of this."""
+    mpath = os.path.join(ckpt_dir, MANIFEST)
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as exc:
+        raise CheckpointError("unreadable manifest %s: %s" % (mpath, exc))
+    files = manifest.get("files", {})
+    if not files:
+        raise CheckpointError("manifest %s lists no files" % mpath)
+    for fn, meta in files.items():
+        full = os.path.join(ckpt_dir, fn)
+        if not os.path.isfile(full):
+            raise CheckpointError("checkpoint file missing: %s" % full)
+        size = os.path.getsize(full)
+        if size != meta.get("bytes"):
+            raise CheckpointError(
+                "size mismatch for %s: manifest says %s bytes, file has %d"
+                % (full, meta.get("bytes"), size))
+        digest = _sha256_file(full)
+        if digest != meta.get("sha256"):
+            raise CheckpointError(
+                "content hash mismatch for %s: manifest %s, file %s"
+                % (full, str(meta.get("sha256"))[:12], digest[:12]))
+    return manifest

@@ -1,0 +1,217 @@
+"""Comm robustness primitives: retry policy, fault injection, liveness.
+
+The reference aborts all ranks when one socket operation fails
+(src/network/linkers_socket.cpp has no retry beyond the initial connect
+loop).  For a fleet-scale TPU deployment that is the wrong trade: a
+transient RST during the find-bin exchange kills a run that would have
+retraced hours of XLA compiles on restart.  This module supplies the
+pieces `parallel/distributed.SocketComm` wraps around its wire ops:
+
+- ``RetryPolicy``     exponential backoff + jitter with a bounded budget
+- ``FaultInjector``   deterministic test hook (fail-next-N, delay, drop)
+- ``CommFailure``     typed abort naming the dead peer rank
+- ``Heartbeat``       background rank-liveness probe thread
+
+Retry semantics are whole-frame: an operation that fails before its
+frame hits the wire (connection refused, peer reset, injected fault)
+retries cleanly; a peer that stays dead exhausts the budget and raises
+``CommFailure`` carrying the peer rank, the operation name and the last
+underlying error.  Retries and aborts are counted in the process-wide
+obs registry (``lgbm_comm_retries_total`` / ``lgbm_comm_failures_total``)
+so they surface in /metrics scrapes and TrainingRecorder events.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..utils import log
+
+
+class CommFailure(ConnectionError):
+    """A comm operation exhausted its retry budget against one peer.
+
+    Carries enough to act on: ``rank`` (the peer observed dead), ``op``
+    (send/recv/allgather), ``attempts`` and the last underlying error.
+    """
+
+    def __init__(self, op: str, rank: int, attempts: int,
+                 cause: Optional[BaseException] = None):
+        self.op = op
+        self.rank = int(rank)
+        self.attempts = int(attempts)
+        self.cause = cause
+        super().__init__(
+            "comm %s failed against rank %d after %d attempt(s): %s"
+            % (op, rank, attempts, cause))
+
+
+class RetryPolicy:
+    """Bounded exponential backoff with jitter.
+
+    ``retries`` is the number of RE-tries after the first attempt, so a
+    policy with retries=4 makes at most 5 attempts.  Delay for attempt
+    ``n`` (1-based) is ``base_ms * 2**(n-1)`` capped at ``max_ms``, then
+    scaled by a uniform jitter in [0.5, 1.0] so a whole fleet retrying
+    the same dead hub does not thundering-herd in lockstep.  Jitter
+    affects timing only — never training output — so the seeded RNG here
+    has no bearing on model determinism.
+    """
+
+    def __init__(self, retries: int = 4, base_ms: float = 50.0,
+                 max_ms: float = 2000.0, jitter: float = 0.5,
+                 seed: Optional[int] = None):
+        self.retries = max(int(retries), 0)
+        self.base_ms = max(float(base_ms), 0.0)
+        self.max_ms = max(float(max_ms), self.base_ms)
+        self.jitter = min(max(float(jitter), 0.0), 1.0)
+        self._rng = random.Random(seed)
+
+    def backoff_s(self, attempt: int) -> float:
+        """Sleep before retry `attempt` (1-based), in seconds."""
+        raw = min(self.base_ms * (2.0 ** max(attempt - 1, 0)), self.max_ms)
+        scale = 1.0 - self.jitter * self._rng.random()
+        return raw * scale / 1e3
+
+    @classmethod
+    def from_config(cls, config) -> "RetryPolicy":
+        return cls(retries=getattr(config, "tpu_comm_retries", 4),
+                   base_ms=getattr(config, "tpu_comm_backoff_ms", 50.0),
+                   max_ms=getattr(config, "tpu_comm_backoff_max_ms", 2000.0))
+
+
+class FaultInjector:
+    """Deterministic fault hook for the comm layer, used by tests.
+
+    Armed per (operation name); ``check(op)`` is called by SocketComm
+    immediately before the real wire operation and either raises (fail),
+    sleeps (delay), or tells the caller to silently lose the frame
+    (drop).  Unarmed operations cost one dict lookup.
+
+        inj = FaultInjector()
+        inj.fail("allgather", count=2)        # next 2 allgathers raise
+        inj.delay("send", count=1, seconds=0.2)
+        inj.drop("send", count=1)             # frame silently lost
+        comm = SocketComm(..., injector=inj)
+    """
+
+    OK, DROP = "ok", "drop"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._faults: Dict[str, List[dict]] = {}
+        self.injected = 0
+
+    def fail(self, op: str, count: int = 1,
+             exc_factory: Optional[Callable[[], BaseException]] = None) -> None:
+        self._arm(op, {"kind": "fail", "count": int(count),
+                       "exc": exc_factory})
+
+    def delay(self, op: str, count: int = 1, seconds: float = 0.05) -> None:
+        self._arm(op, {"kind": "delay", "count": int(count),
+                       "seconds": float(seconds)})
+
+    def drop(self, op: str, count: int = 1) -> None:
+        self._arm(op, {"kind": "drop", "count": int(count)})
+
+    def reset(self) -> None:
+        with self._lock:
+            self._faults.clear()
+
+    def armed(self, op: Optional[str] = None) -> bool:
+        with self._lock:
+            if op is None:
+                return any(self._faults.values())
+            return bool(self._faults.get(op))
+
+    def _arm(self, op: str, fault: dict) -> None:
+        with self._lock:
+            self._faults.setdefault(op, []).append(fault)
+
+    def check(self, op: str) -> str:
+        """Consume one armed fault for `op`.  Returns OK or DROP; raises
+        for fail faults (a ConnectionError by default, so the retry loop
+        treats it exactly like a real transient wire error)."""
+        with self._lock:
+            queue = self._faults.get(op)
+            if not queue:
+                return self.OK
+            fault = queue[0]
+            fault["count"] -= 1
+            if fault["count"] <= 0:
+                queue.pop(0)
+            self.injected += 1
+        kind = fault["kind"]
+        if kind == "delay":
+            time.sleep(fault["seconds"])
+            return self.OK
+        if kind == "drop":
+            return self.DROP
+        exc_factory = fault.get("exc")
+        raise (exc_factory() if exc_factory is not None
+               else ConnectionError("injected fault: %s" % op))
+
+
+class Heartbeat:
+    """Rank-liveness monitor: a daemon thread calling ``probe()`` every
+    ``interval_s`` seconds.  ``probe`` returns the list of peer ranks
+    currently considered dead (SocketComm supplies a passive socket
+    health check); newly dead ranks are logged once and published as the
+    ``lgbm_comm_alive_ranks`` gauge, giving operators a liveness signal
+    BEFORE the next collective blocks on the dead peer."""
+
+    def __init__(self, probe: Callable[[], List[int]], interval_s: float,
+                 rank: int = 0, world: int = 1, registry=None):
+        self.probe = probe
+        self.interval_s = max(float(interval_s), 1e-3)
+        self.rank, self.world = int(rank), int(world)
+        self._dead: set = set()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._gauge = None
+        if registry is not None:
+            self._gauge = registry.gauge(
+                "lgbm_comm_alive_ranks",
+                help="Ranks the heartbeat currently considers alive",
+                rank=str(rank), world=str(world))
+            self._gauge.set(world)
+
+    def start(self) -> "Heartbeat":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="lgbm-heartbeat", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval_s + 1.0)
+            self._thread = None
+
+    def dead_ranks(self) -> List[int]:
+        return sorted(self._dead)
+
+    def alive(self) -> bool:
+        return not self._dead
+
+    def poll_once(self) -> List[int]:
+        """One probe round (also what the thread loop runs)."""
+        try:
+            dead = set(self.probe())
+        except Exception as exc:  # noqa: BLE001 — liveness must not raise
+            log.debug("heartbeat probe failed: %s", exc)
+            return self.dead_ranks()
+        for r in sorted(dead - self._dead):
+            log.warning("heartbeat: rank %d looks dead (peer socket "
+                        "closed/errored)", r)
+        self._dead = dead
+        if self._gauge is not None:
+            self._gauge.set(self.world - len(dead))
+        return self.dead_ranks()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.poll_once()

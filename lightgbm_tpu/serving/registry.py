@@ -123,12 +123,26 @@ class ModelRegistry:
     # -- lifecycle ----------------------------------------------------- #
     def load(self, name: str, model_str: Optional[str] = None,
              model_file: Optional[str] = None,
-             params: Optional[Dict] = None, warmup: bool = True) -> ModelEntry:
+             params: Optional[Dict] = None, warmup: bool = True,
+             checkpoint_dir: Optional[str] = None) -> ModelEntry:
         """Load + warm a model and install it as the current version of
         `name` (hot-swap when the name exists).  The expensive parts —
         parse, ensemble build, bucket compiles — happen OUTSIDE the
         registry lock, so serving traffic on other models never stalls
-        behind a load."""
+        behind a load.
+
+        checkpoint_dir: serve the newest hash-verified training
+        checkpoint under that directory (resilience/checkpoint.py) —
+        the crash-restart path when no exported model file exists yet.
+        """
+        if checkpoint_dir is not None:
+            if model_str is not None or model_file is not None:
+                raise ValueError("load() takes checkpoint_dir OR "
+                                 "model_str/model_file, not both")
+            from ..resilience import CheckpointManager
+            model_file = CheckpointManager.latest_model_file(checkpoint_dir)
+            log.info("registry: %s loading from checkpoint %s", name,
+                     model_file)
         if (model_str is None) == (model_file is None):
             raise ValueError("load() needs exactly one of model_str / "
                              "model_file")

@@ -76,11 +76,13 @@ class Server:
     def load_model(self, name: Optional[str] = None,
                    model_str: Optional[str] = None,
                    model_file: Optional[str] = None,
-                   params: Optional[Dict] = None) -> ModelEntry:
+                   params: Optional[Dict] = None,
+                   checkpoint_dir: Optional[str] = None) -> ModelEntry:
         """Load/hot-swap a model under `name` and make it servable."""
         name = name or self.config.serve_model_name
         entry = self.registry.load(name, model_str=model_str,
-                                   model_file=model_file, params=params)
+                                   model_file=model_file, params=params,
+                                   checkpoint_dir=checkpoint_dir)
         with self._lock:
             if name not in self._batchers:
                 stats = ModelStats()

@@ -1,0 +1,116 @@
+"""Inspect and verify training checkpoints on disk.
+
+The offline reader for the directories lightgbm_tpu/resilience/
+checkpoint.py writes when ``tpu_checkpoint_path`` is set: list every
+checkpoint under a root (round, size, retention order), print one
+checkpoint's manifest (schema, boosting, config hash, dataset
+fingerprint, per-file sha256), and re-hash the payload files against
+the manifest so a checkpoint can be trusted BEFORE a resume or a
+serving restart bets on it.
+
+Usage:
+    python tools/ckpt_inspect.py /path/to/ckpt_root          # list all
+    python tools/ckpt_inspect.py /path/to/ckpt_root/ckpt_00000010
+    python tools/ckpt_inspect.py --verify /path/to/ckpt_root
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from lightgbm_tpu.resilience import checkpoint as ckpt_mod  # noqa: E402
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return "%.1f %s" % (n, unit) if unit != "B" else "%d B" % n
+        n /= 1024.0
+    return "%d B" % n
+
+
+def describe(ckpt_dir: str, verify: bool) -> bool:
+    """Print one checkpoint's manifest; returns hash-check success."""
+    manifest_path = os.path.join(ckpt_dir, ckpt_mod.MANIFEST)
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        print("%s: unreadable manifest (%s)" % (ckpt_dir, e))
+        return False
+    print("checkpoint %s" % ckpt_dir)
+    print("  schema=%s round=%s boosting=%s num_trees=%s"
+          % (manifest.get("schema"), manifest.get("round"),
+             manifest.get("boosting"), manifest.get("num_trees")))
+    print("  config_hash=%s" % manifest.get("config_hash"))
+    print("  dataset_fingerprint=%s" % manifest.get("dataset_fingerprint"))
+    if manifest.get("created_at"):
+        print("  created_at=%s" % manifest["created_at"])
+    ok = True
+    for name, meta in sorted((manifest.get("files") or {}).items()):
+        path = os.path.join(ckpt_dir, name)
+        status = ""
+        if verify:
+            if not os.path.exists(path):
+                status, ok = "MISSING", False
+            elif os.path.getsize(path) != meta.get("bytes"):
+                status, ok = "SIZE MISMATCH", False
+            elif ckpt_mod._sha256_file(path) != meta.get("sha256"):
+                status, ok = "HASH MISMATCH", False
+            else:
+                status = "ok"
+        print("  %-12s %10s  sha256=%s%s"
+              % (name, _fmt_bytes(int(meta.get("bytes", 0))),
+                 (meta.get("sha256") or "?")[:16],
+                 ("  [%s]" % status) if status else ""))
+    if verify:
+        print("  verify: %s" % ("PASS" if ok else "FAIL"))
+    return ok
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Inspect/verify lightgbm_tpu training checkpoints")
+    p.add_argument("path", help="checkpoint root directory or a single "
+                   "ckpt_NNNNNNNN directory")
+    p.add_argument("--verify", action="store_true",
+                   help="re-hash payload files against the manifest")
+    args = p.parse_args(argv)
+
+    path = args.path.rstrip("/")
+    if not os.path.isdir(path):
+        print("%s: not a directory" % path, file=sys.stderr)
+        return 2
+    if os.path.exists(os.path.join(path, ckpt_mod.MANIFEST)):
+        return 0 if describe(path, args.verify) else 1
+
+    ckpts = ckpt_mod.list_checkpoints(path)
+    if not ckpts:
+        print("%s: no checkpoints" % path)
+        return 1
+    keep_hint = {d for d, _ in ckpts[-1:]}
+    print("%d checkpoint(s) under %s (oldest first):" % (len(ckpts), path))
+    all_ok = True
+    for ckpt_dir, round_idx in ckpts:
+        tag = "  <- latest" if ckpt_dir in keep_hint else ""
+        print("- round %d: %s%s" % (round_idx, os.path.basename(ckpt_dir),
+                                    tag))
+    print()
+    for ckpt_dir, _round_idx in ckpts:
+        all_ok = describe(ckpt_dir, args.verify) and all_ok
+        print()
+    stale = [n for n in os.listdir(path)
+             if n.startswith(ckpt_mod._TMP_PREFIX)]
+    if stale:
+        print("warning: %d stale temp dir(s) from interrupted saves: %s"
+              % (len(stale), ", ".join(sorted(stale))))
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -268,6 +268,41 @@ def bench_lambdarank(lgb, sync, on_tpu):
     return out
 
 
+def trace_smoke(lgb):
+    """Tiny traced run + trace_check summary (one line in `detail`).
+
+    Proves the span tracer stays wired end to end — file written, valid
+    trace-event JSON, phases present — without touching the timed runs.
+    Never fails the bench: any problem is reported as the summary.
+    """
+    import os
+    import tempfile
+    path = os.path.join(tempfile.mkdtemp(prefix="lgbm_bench_trace"),
+                        "bench.trace")
+    rng = np.random.RandomState(3)
+    X = rng.randn(400, 8).astype(np.float32)
+    y = (X[:, 0] + 0.2 * rng.randn(400) > 0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "min_data_in_leaf": 5, "tpu_trace_path": path}
+    try:
+        booster = lgb.train(params, lgb.Dataset(X, y), num_boost_round=3)
+        booster._gbdt.finish_telemetry()
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools"))
+        try:
+            import trace_check
+        finally:
+            sys.path.pop(0)
+        with open(path) as f:
+            s = trace_check.summarize(json.load(f))
+        return ("%d events, %.1f ms wall, %d phases, %d backend compiles, "
+                "%d dropped"
+                % (s["events"], s["wall_ms"], len(s["phases"]),
+                   s["backend_compiles"], s["dropped_events"]))
+    except Exception as e:  # noqa: BLE001 — smoke only, never fatal
+        return "FAILED: %s" % e
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -295,6 +330,7 @@ def main():
             "higgs": higgs,
             "lambdarank": rank,
             "quality_ok": ok,
+            "trace_smoke": trace_smoke(lgb),
         },
     }
     print(json.dumps(result))

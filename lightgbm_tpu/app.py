@@ -188,6 +188,13 @@ class Application:
             except OSError as e:
                 log.warning("Could not write telemetry dump %s: %s",
                             prom_path, e)
+        if cfg.tpu_trace_path:
+            # point the operator at the timeline and the tools that read
+            # it (finish_telemetry already flushed the file)
+            log.info("Span trace written under %s — open in Perfetto / "
+                     "chrome://tracing, summarize with "
+                     "tools/trace_check.py, fuse ranks with "
+                     "tools/trace_merge.py", cfg.tpu_trace_path)
         log.info("Finished training; model saved to %s", cfg.output_model)
 
     def predict(self) -> None:

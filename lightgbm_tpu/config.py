@@ -161,6 +161,15 @@ _SCHEMA = [
     #   gauges into each iteration event
     ("tpu_log_json", bool, False),           # structured JSON log lines with bound
     #   context fields (utils/log.set_json_mode)
+    ("tpu_trace_path", str, ""),             # non-empty -> record a structured span
+    #   timeline (Chrome trace-event JSON, openable in Perfetto /
+    #   chrome://tracing); distributed runs write one file per rank
+    #   (<path>.rankN) fusable with tools/trace_merge.py.  Training
+    #   output is bitwise-identical with it on or off
+    ("tpu_trace_max_events", int, 500000),   # in-memory span buffer cap; overflow
+    #   is counted and reported in the trace metadata, never unbounded
+    ("tpu_trace_xla_analysis", bool, True),  # attach XLA cost/memory analysis
+    #   (flops, bytes accessed, peak HBM) to each fused-iter retrace span
     # --- serving parameters (no reference analogue)
     # task=serve: TPU-resident inference server (lightgbm_tpu/serving) —
     # adaptive micro-batching over the compiled signature-matmul
@@ -243,6 +252,8 @@ ALIAS_TABLE: Dict[str, str] = {
     "save_period": "snapshot_freq",
     "telemetry_path": "tpu_telemetry_path",
     "telemetry_file": "tpu_telemetry_path",
+    "trace_path": "tpu_trace_path",
+    "trace_file": "tpu_trace_path",
     "model_input": "input_model", "model_in": "input_model",
     "predict_result": "output_result", "prediction_result": "output_result",
     "predict_name": "output_result", "prediction_name": "output_result",
@@ -517,6 +528,9 @@ class Config:
         if self.tpu_comm_backoff_ms < 0 or self.tpu_comm_backoff_max_ms < 0:
             log.fatal("tpu_comm_backoff_ms / tpu_comm_backoff_max_ms must "
                       "be >= 0")
+        if self.tpu_trace_max_events < 1024:
+            log.fatal("tpu_trace_max_events must be >= 1024, got %d"
+                      % self.tpu_trace_max_events)
 
     def is_single_machine(self) -> bool:
         return self.num_machines <= 1

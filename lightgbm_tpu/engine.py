@@ -168,52 +168,57 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     cb_before = sorted(cb_before, key=lambda cb: getattr(cb, "order", 0))
     cb_after = sorted(cb_after, key=lambda cb: getattr(cb, "order", 0))
 
-    for i in range(begin_round, end_round):
-        for cb in cb_before:
-            cb(callback_mod.CallbackEnv(model=booster, params=params,
-                                        iteration=i, begin_iteration=begin_cb,
-                                        end_iteration=end_round,
-                                        evaluation_result_list=None))
-        finished = booster.update(fobj=fobj)
-
-        evaluation_result_list = []
-        if valid_sets is not None or booster._gbdt.train_metrics:
-            if is_valid_contain_train or booster._gbdt.train_metrics:
-                for nm, mname, v, bigger in booster.eval_train(feval):
-                    evaluation_result_list.append(
-                        (train_data_name, mname, v, bigger))
-            evaluation_result_list.extend(booster.eval_valid(feval))
-        if feval is not None:
-            gbdt = booster._gbdt
-            if is_valid_contain_train:
-                res = feval(gbdt.raw_scores("training"), train_set)
-                evaluation_result_list.extend(
-                    _normalize_feval(res, train_data_name))
-            for name, vs, _m in gbdt.valid_states:
-                vds = None
-                if valid_sets:
-                    vidx = [v for v in valid_sets if v is not train_set]
-                    vds = vidx[[nm for nm, _s, _mm in gbdt.valid_states].index(name)]
-                res = feval(gbdt.raw_scores(name), vds)
-                evaluation_result_list.extend(_normalize_feval(res, name))
-        try:
-            for cb in cb_after:
+    # the loop runs under try/finally: finish_telemetry must close the
+    # event log, stop any live jax profiler session and flush the span
+    # trace even when an iteration (or a callback) raises — a leaked
+    # start_trace would poison every later training run in the process
+    try:
+        for i in range(begin_round, end_round):
+            for cb in cb_before:
                 cb(callback_mod.CallbackEnv(model=booster, params=params,
-                                            iteration=i,
-                                            begin_iteration=begin_cb,
+                                            iteration=i, begin_iteration=begin_cb,
                                             end_iteration=end_round,
-                                            evaluation_result_list=evaluation_result_list))
-        except callback_mod.EarlyStopException as es:
-            booster.best_iteration = es.best_iteration + 1
-            _record_best(booster, es.best_score)
-            break
-        if finished:
-            break
+                                            evaluation_result_list=None))
+            finished = booster.update(fobj=fobj)
 
-    # close the telemetry event log BEFORE best_iteration is derived:
-    # finish_telemetry drains the pipeline (same sync num_trees() would
-    # do) and flushes the last pending event + summary to disk
-    booster._gbdt.finish_telemetry()
+            evaluation_result_list = []
+            if valid_sets is not None or booster._gbdt.train_metrics:
+                if is_valid_contain_train or booster._gbdt.train_metrics:
+                    for nm, mname, v, bigger in booster.eval_train(feval):
+                        evaluation_result_list.append(
+                            (train_data_name, mname, v, bigger))
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            if feval is not None:
+                gbdt = booster._gbdt
+                if is_valid_contain_train:
+                    res = feval(gbdt.raw_scores("training"), train_set)
+                    evaluation_result_list.extend(
+                        _normalize_feval(res, train_data_name))
+                for name, vs, _m in gbdt.valid_states:
+                    vds = None
+                    if valid_sets:
+                        vidx = [v for v in valid_sets if v is not train_set]
+                        vds = vidx[[nm for nm, _s, _mm in gbdt.valid_states].index(name)]
+                    res = feval(gbdt.raw_scores(name), vds)
+                    evaluation_result_list.extend(_normalize_feval(res, name))
+            try:
+                for cb in cb_after:
+                    cb(callback_mod.CallbackEnv(model=booster, params=params,
+                                                iteration=i,
+                                                begin_iteration=begin_cb,
+                                                end_iteration=end_round,
+                                                evaluation_result_list=evaluation_result_list))
+            except callback_mod.EarlyStopException as es:
+                booster.best_iteration = es.best_iteration + 1
+                _record_best(booster, es.best_score)
+                break
+            if finished:
+                break
+    finally:
+        # close the telemetry event log BEFORE best_iteration is derived:
+        # finish_telemetry drains the pipeline (same sync num_trees()
+        # would do) and flushes the last pending event + summary to disk
+        booster._gbdt.finish_telemetry()
     if booster.best_iteration <= 0:
         # end-of-training count must be the SYNCED one: current_iteration
         # reports undrained pipeline slots for cheap in-loop callbacks,

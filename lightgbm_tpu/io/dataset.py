@@ -16,6 +16,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs import tracing
 from ..utils import log
 from .bin_mapper import CATEGORICAL, NUMERICAL, BinMapper
 from .file_io import v_open
@@ -85,6 +86,26 @@ class BinnedDataset:
         602-747) — the dense [n, F] float matrix is never materialized,
         and with EFB the binned output is [n, num_groups] directly.
         """
+        # datasets are binned before the booster exists, so this is the
+        # earliest call site that can arm the tracer from the config —
+        # without it the data/* spans of a tpu_trace_path run would be
+        # lost to an unarmed tracer
+        tracing.configure_from_config(config)
+        with tracing.span("data/construct", "data",
+                          reference=reference is not None):
+            return cls._construct_impl(
+                X, config, metadata=metadata,
+                categorical_features=categorical_features,
+                feature_names=feature_names, reference=reference,
+                sample_indices=sample_indices, find_bin_comm=find_bin_comm,
+                bin_rows=bin_rows)
+
+    @classmethod
+    def _construct_impl(cls, X, config, metadata=None,
+                        categorical_features=(), feature_names=None,
+                        reference=None, sample_indices=None,
+                        find_bin_comm=None,
+                        bin_rows: bool = True) -> "BinnedDataset":
         if _issparse(X):
             import scipy.sparse as sp
             X = X.tocsr()
@@ -155,22 +176,26 @@ class BinnedDataset:
             # serialized mappers are allgathered and merged — compute
             # sharding, identical mappers to a single-rank load
             rank, world, allgather = find_bin_comm
-            per = -(-num_raw // world)
-            lo, hi = rank * per, min((rank + 1) * per, num_raw)
-            mine = {f: _find_one(f).to_state() for f in range(lo, hi)}
-            merged: dict = {}
-            for part in allgather(mine):
-                # normalize keys: a byte transport (e.g. JSON) may have
-                # stringified the int feature ids
-                merged.update({int(k): v for k, v in part.items()})
-            missing = [f for f in range(num_raw) if f not in merged]
-            if missing:
-                log.fatal("distributed find-bin allgather is missing "
-                          "mappers for features %s" % missing[:10])
-            mappers: List[BinMapper] = [BinMapper.from_state(merged[f])
-                                        for f in range(num_raw)]
+            with tracing.span("data/find_bin", "data", features=num_raw,
+                              distributed=True):
+                per = -(-num_raw // world)
+                lo, hi = rank * per, min((rank + 1) * per, num_raw)
+                mine = {f: _find_one(f).to_state() for f in range(lo, hi)}
+                merged: dict = {}
+                for part in allgather(mine):
+                    # normalize keys: a byte transport (e.g. JSON) may have
+                    # stringified the int feature ids
+                    merged.update({int(k): v for k, v in part.items()})
+                missing = [f for f in range(num_raw) if f not in merged]
+                if missing:
+                    log.fatal("distributed find-bin allgather is missing "
+                              "mappers for features %s" % missing[:10])
+                mappers: List[BinMapper] = [BinMapper.from_state(merged[f])
+                                            for f in range(num_raw)]
         else:
-            mappers = [_find_one(f) for f in range(num_raw)]
+            with tracing.span("data/find_bin", "data", features=num_raw,
+                              distributed=False):
+                mappers = [_find_one(f) for f in range(num_raw)]
 
         # --- drop trivial features (dataset.cpp Construct) ----------------
         ds.used_feature_map = [-1] * num_raw
@@ -289,11 +314,13 @@ class BinnedDataset:
         return bins
 
     def _bin_all(self, X) -> None:
-        if _issparse(X):
-            self._bin_all_sparse(X)
-            return
-        self.bins = self.bin_block(np.asarray(X))
-        self._device_cache.clear()
+        with tracing.span("data/bin", "data", rows=self.num_data,
+                          sparse=_issparse(X)):
+            if _issparse(X):
+                self._bin_all_sparse(X)
+                return
+            self.bins = self.bin_block(np.asarray(X))
+            self._device_cache.clear()
 
     def _bin_all_sparse(self, X) -> None:
         """Column-wise binning from CSC stored entries (c_api.cpp:602-747

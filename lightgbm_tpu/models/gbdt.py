@@ -26,6 +26,7 @@ from ..objective import ObjectiveFunction
 from ..ops import grow as grow_ops
 from ..ops import predict as predict_ops
 from ..ops.split import SplitParams
+from ..obs import tracing as obs_tracing
 from ..utils import log
 from .tree import Tree
 
@@ -172,6 +173,15 @@ class GBDT:
             enabled=config.tpu_profile or bool(telemetry_path),
             sync_fn=self._profile_sync if config.tpu_profile else None)
         self._trace = TraceSession(config.tpu_profile_trace_dir)
+        # span timeline (obs/tracing.py): arming the process tracer makes
+        # every Profiler.phase site a nested span; like the recorder it
+        # never touches the training stream (bitwise-identical model)
+        self._tracing = obs_tracing.configure_from_config(config) is not None
+        if self._tracing:
+            obs_tracing.get_tracer().set_metadata(
+                tree_learner=config.tree_learner,
+                boosting=config.boosting,
+                objective=getattr(config, "objective", ""))
         # per-iteration JSONL event log (obs/recorder.py); recorder
         # failures demote to a warning and disable themselves — they can
         # never fail a training run
@@ -199,22 +209,37 @@ class GBDT:
         return self.profiler.report(header="tpu_profile")
 
     def finish_telemetry(self) -> None:
-        """Drain the pipeline and close the telemetry event log (flushes
-        the last pending event, backfills deferred tree stats, writes the
-        summary).  Idempotent; engine.train calls it after the loop and
-        __del__ covers direct Booster.update users."""
+        """Drain the pipeline and close the telemetry surfaces: the JSONL
+        event log (flushes the last pending event, backfills deferred
+        tree stats, writes the summary), the jax profiler session, and
+        the span-trace file.  Idempotent; engine.train calls it in a
+        `finally` so even a raising training loop cannot leak a live
+        profiler session or an unwritten trace, and __del__ covers
+        direct Booster.update users."""
         recorder, self.recorder = self.recorder, None
-        if recorder is None:
-            return
+        if recorder is not None:
+            try:
+                self._sync_model()
+                recorder.finalize(self)
+            except Exception as exc:  # noqa: BLE001 — telemetry never raises
+                log.warning("telemetry finalize failed: %s", exc)
         try:
-            self._sync_model()
-            recorder.finalize(self)
-        except Exception as exc:  # noqa: BLE001 — telemetry must not raise
-            log.warning("telemetry finalize failed: %s", exc)
+            self._trace.stop()
+        except Exception:  # noqa: BLE001
+            pass
+        if getattr(self, "_tracing", False):
+            self._tracing = False
+            try:
+                path = obs_tracing.get_tracer().flush()
+                if path:
+                    log.info("trace: span timeline written to %s", path)
+            except Exception as exc:  # noqa: BLE001
+                log.warning("trace flush failed: %s", exc)
 
     def __del__(self):
         try:
-            if getattr(self, "recorder", None) is not None:
+            if (getattr(self, "recorder", None) is not None
+                    or getattr(self, "_tracing", False)):
                 self.finish_telemetry()
             # teardown report only for explicit tpu_profile runs: a
             # telemetry-only profiler is an implementation detail of the
@@ -383,11 +408,13 @@ class GBDT:
         leaves).  Thin telemetry shell around _train_one_iter_impl (which
         subclasses override): times the round and hands the recorder one
         event per iteration, for every boosting mode."""
-        if self.recorder is None:
-            return self._train_one_iter_impl(gradients, hessians)
         it = self.iter
+        if self.recorder is None:
+            with obs_tracing.span("train/iteration", "train", iter=it):
+                return self._train_one_iter_impl(gradients, hessians)
         t0 = time.perf_counter()
-        finished = self._train_one_iter_impl(gradients, hessians)
+        with obs_tracing.span("train/iteration", "train", iter=it):
+            finished = self._train_one_iter_impl(gradients, hessians)
         wall = time.perf_counter() - t0
         try:
             self.recorder.on_iteration(self, it, wall, finished)
@@ -678,20 +705,33 @@ class GBDT:
         # reset_parameter callback changed them mid-training
         key = (self.config.num_leaves, self.config.max_depth, self.max_bin,
                self.config.max_cat_threshold)
-        if (getattr(self, "_fused_fn", None) is None
-                or getattr(self, "_fused_key", None) != key):
+        rebuilt = (getattr(self, "_fused_fn", None) is None
+                   or getattr(self, "_fused_key", None) != key)
+        if rebuilt:
             self._fused_fn = self._build_fused_iter()
             self._fused_key = key
         sh = jnp.asarray(self.shrinkage_rate, self.dtype)
         k = max(self.num_tree_per_iteration, 1)
         fmasks = jnp.stack([self._feature_sample() for _ in range(k)])
         field_vals = [getattr(h, a) for h, a in self._fused_fields]
-        ivecs, fvecs, new_score, arena = self._fused_fn(
-            self._arena, self._bins_t, self.train_state.score,
-            field_vals, self._row_all_in, fmasks,
-            self.train_state.num_bins, self.train_state.default_bins,
-            self.train_state.missing_types, self.split_params,
-            self.monotone, self.penalty, sh)
+        args = (self._arena, self._bins_t, self.train_state.score,
+                field_vals, self._row_all_in, fmasks,
+                self.train_state.num_bins, self.train_state.default_bins,
+                self.train_state.missing_types, self.split_params,
+                self.monotone, self.penalty, sh)
+        if rebuilt and getattr(self, "_tracing", False) \
+                and getattr(self.config, "tpu_trace_xla_analysis", True):
+            # kernel attribution: one "compile" span per retrace carrying
+            # flops / bytes / peak-HBM estimates for the fused step,
+            # tagged with the shape signature that triggered the rebuild.
+            # Must run BEFORE the executing call — arena and score are
+            # donated, so their buffers are dead afterwards.
+            from ..obs import device as obs_device
+            obs_device.analyze_compiled(
+                self._fused_fn, args,
+                signature="leaves=%d depth=%d bin=%d cat=%d rows=%d" % (
+                    key + (self.num_data,)))
+        ivecs, fvecs, new_score, arena = self._fused_fn(*args)
         if not getattr(self, "_fused_validated", False):
             # force materialization once so a device runtime fault raises
             # HERE (inside the fallback guard) instead of at a later

@@ -9,7 +9,10 @@ One subsystem for every number the framework emits:
              round (Config.tpu_telemetry_path);
 - device:    XLA compile/retrace listeners + live-buffer probe;
 - adapters:  publishers wiring ModelStats, SocketComm and the device
-             probe into the registry.
+             probe into the registry;
+- tracing:   SpanTracer — nested-span timeline emitted as Chrome
+             trace-event JSON (Config.tpu_trace_path), with cross-rank
+             correlation ids carried in the SocketComm frame header.
 
 The process-wide default registry is what `GET /metrics` on the serving
 server and the CLI end-of-training dump render.
@@ -18,6 +21,7 @@ from __future__ import annotations
 
 from .registry import Counter, Gauge, Histogram, MetricsRegistry
 from .recorder import TrainingRecorder
+from .tracing import SpanTracer, get_tracer
 
 _default_registry = MetricsRegistry()
 
@@ -35,5 +39,5 @@ def reset_default_registry() -> MetricsRegistry:
 
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "TrainingRecorder", "default_registry",
-           "reset_default_registry"]
+           "SpanTracer", "TrainingRecorder", "default_registry",
+           "get_tracer", "reset_default_registry"]

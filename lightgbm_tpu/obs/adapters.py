@@ -44,6 +44,9 @@ def ensure_device_metrics(reg: MetricsRegistry) -> None:
     reg.counter("lgbm_xla_traces_total",
                 help="jaxpr traces (retraces included)").set_fn(
         lambda: device_mod.compile_counts()["traces"])
+    reg.counter("lgbm_xla_cache_hits_total",
+                help="Compilation-cache hits").set_fn(
+        lambda: device_mod.compile_counts()["cache_hits"])
 
 
 def ensure_comm_metrics(reg: MetricsRegistry, rank: int = 0,

@@ -33,12 +33,13 @@ off (tests/test_obs.py asserts this).
 from __future__ import annotations
 
 import json
+import os
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..utils import log
-from . import adapters, device
+from . import adapters, device, tracing
 from .registry import MetricsRegistry
 
 SCHEMA_VERSION = 1
@@ -77,8 +78,10 @@ class TrainingRecorder:
         self._file = None
         self._pending: Optional[Dict] = None
         self._last_phases: Dict[str, Dict[str, float]] = {}
+        self._last_spans: Dict[str, Dict[str, float]] = {}
         self._deferred_iters: List[int] = []
         self._closed = False
+        self._write_failed = False
         adapters.ensure_device_metrics(self.registry)
         self._m_iters = self.registry.counter(
             "lgbm_train_iterations_total", help="Boosting rounds completed")
@@ -121,6 +124,9 @@ class TrainingRecorder:
             "sample": self._sample_stats(gbdt),
             "compile": device.compile_counts(),
         }
+        spans = self._span_deltas()
+        if spans is not None:
+            event["spans"] = spans
         if self.sample_device_stats:
             event["device"] = device.device_stats()
         comm = adapters.comm_totals(self.registry)
@@ -180,9 +186,18 @@ class TrainingRecorder:
         self._closed = True
         if self._file is not None:
             try:
+                # durability: flush + fsync before close so a crash right
+                # after training still leaves every event on disk
+                self._file.flush()
+                os.fsync(self._file.fileno())
+            except Exception as exc:  # noqa: BLE001 — telemetry never raises
+                log.warning("telemetry: fsync of %s failed: %s",
+                            self.path, exc)
+            try:
                 self._file.close()
-            finally:
-                self._file = None
+            except Exception:  # noqa: BLE001
+                pass
+            self._file = None
         log.debug("telemetry: event log written to %s", self.path)
 
     # -- internals ------------------------------------------------------ #
@@ -211,19 +226,51 @@ class TrainingRecorder:
             out["goss_top"], out["goss_other"] = int(goss[0]), int(goss[1])
         return out
 
+    def _span_deltas(self) -> Optional[Dict[str, Dict[str, float]]]:
+        """Per-round span summary: the tracer's cumulative per-kind
+        rollup diffed against last round's.  None when tracing is off."""
+        tracer = tracing.get_tracer()
+        if not tracer.enabled:
+            return None
+        snap = tracer.kind_snapshot()
+        out: Dict[str, Dict[str, float]] = {}
+        for kind, cur in snap.items():
+            prev = self._last_spans.get(kind, {"ms": 0.0, "count": 0})
+            d_count = cur["count"] - prev["count"]
+            if d_count > 0:
+                out[kind] = {"ms": round(cur["ms"] - prev["ms"], 3),
+                             "count": d_count}
+        self._last_spans = snap
+        return out
+
     def _flush_pending(self) -> None:
         if self._pending is not None:
             event, self._pending = self._pending, None
             self._write(event)
 
     def _write(self, event: Dict) -> None:
-        if self._closed:
+        """Append one event line.  A failing write (disk full, path
+        yanked) degrades to ONE warning and stops the stream — prior
+        lines stay intact, training never sees the exception."""
+        if self._closed or self._write_failed:
             return
-        if self._file is None:
-            self._file = open(self.path, "a")
-        self._file.write(json.dumps(event, default=_json_default,
-                                    separators=(",", ":")) + "\n")
-        self._file.flush()
+        try:
+            if self._file is None:
+                self._file = open(self.path, "a")
+            self._file.write(json.dumps(event, default=_json_default,
+                                        separators=(",", ":")) + "\n")
+            self._file.flush()
+        except Exception as exc:  # noqa: BLE001 — telemetry never raises
+            self._write_failed = True
+            log.warning("telemetry: write to %s failed (%s); event "
+                        "recording stopped, prior events intact",
+                        self.path, exc)
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._file = None
 
 
 def _json_default(o):

@@ -41,14 +41,18 @@ or from Python:
 from __future__ import annotations
 
 import errno
+import hashlib
 import json
 import os
 import select
 import socket
 import struct
 import time
+import uuid
+from contextlib import nullcontext
 from typing import List, Optional, Tuple
 
+from ..obs import tracing as obs_tracing
 from ..resilience.comm import CommFailure, FaultInjector, Heartbeat, RetryPolicy
 from ..utils import log
 
@@ -205,6 +209,18 @@ class SocketComm:
     allgather round every spoke sends its payload, the hub replies with
     the full rank-ordered list.  Setup-phase traffic only (a few KB of
     serialized BinMapper state) — hot-path collectives are XLA's job.
+
+    Wire format (v2, span-trace aware): the spoke handshake is
+    ``!id`` (rank, local wall clock) and the hub replies ``!16sdd``
+    (comm session id, recv time, send time) — an NTP-style exchange
+    whose midpoint estimates each spoke's clock offset against the hub
+    for tools/trace_merge.py.  Every frame is then an 8-byte ``!q``
+    length + 16-byte trace-id + 8-byte ``!q`` span-id header + JSON
+    blob; the trace fields carry the sender's collective trace-id and
+    live span so per-rank trace files correlate (all zeros when tracing
+    is off — the header is always present, keeping the protocol
+    uniform; every rank runs the same code, so there is no version
+    skew).
     """
 
     def __init__(self, rank: int, world: int, machines: List[str],
@@ -249,7 +265,16 @@ class SocketComm:
         self._m_wait = m["lgbm_comm_sync_wait_seconds_total"]
         self._m_retries = m["lgbm_comm_retries_total"]
         self._m_failures = m["lgbm_comm_failures_total"]
+        # span-trace correlation state: the comm session id (minted by
+        # the hub, learned by spokes in the handshake) + a per-instance
+        # collective sequence number derive cluster-unique collective
+        # trace ids; clock offset is this rank's wall clock vs the hub's
+        self._session = uuid.uuid4().bytes
+        self._seq = 0
+        self._clock_offset_s = 0.0
+        self._clock_rtt_s = 0.0
         if world == 1:
+            self._publish_trace_identity()
             return
         if rank == 0:
             srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -282,15 +307,24 @@ class SocketComm:
             for _ in range(world - 1):
                 conn, _addr = srv.accept()
                 conn.settimeout(timeout_s)
-                r = struct.unpack("!i", _recv_exact(conn, 4))[0]
-                by_rank[r] = conn
+                # 12-byte spoke handshake: rank + the spoke's wall clock
+                # at send time (t0 of the NTP-style offset exchange)
+                r, _peer_t0 = struct.unpack("!id", _recv_exact(conn, 12))
+                by_rank[r] = (conn, time.time())
             # waiting for world-1 spokes to dial in is the hub's share
-            # of cluster-formation skew; the 4-byte rank handshakes are
+            # of cluster-formation skew; the 12-byte rank handshakes are
             # the first wire traffic
             self._m_wait.inc(time.monotonic() - t0)
-            self._m_recv.inc(4 * (world - 1))
+            self._m_recv.inc(12 * (world - 1))
             srv.close()
-            self._peers = [by_rank[r] for r in range(1, world)]
+            # reply to every spoke: session id + (t1 recv time, t2 send
+            # time) so each spoke closes its own offset estimate
+            for r in range(1, world):
+                conn, t1 = by_rank[r]
+                conn.sendall(struct.pack("!16sdd", self._session, t1,
+                                         time.time()))
+            self._m_sent.inc(32 * (world - 1))
+            self._peers = [by_rank[r][0] for r in range(1, world)]
             self._peer_ranks = list(range(1, world))
         else:
             # retry-connect until the hub binds (every host launches the
@@ -311,10 +345,20 @@ class SocketComm:
                     time.sleep(0.25)
             self._m_wait.inc(time.monotonic() - t0)
             s.settimeout(timeout_s)
-            s.sendall(struct.pack("!i", rank))
-            self._m_sent.inc(4)
+            wall_t0 = time.time()
+            s.sendall(struct.pack("!id", rank, wall_t0))
+            self._m_sent.inc(12)
+            self._session, t1, t2 = struct.unpack(
+                "!16sdd", _recv_exact(s, 32))
+            wall_t3 = time.time()
+            self._m_recv.inc(32)
+            # NTP midpoint: hub clock minus this rank's clock; add it to
+            # local wall timestamps to express them in hub time
+            self._clock_offset_s = ((t1 - wall_t0) + (t2 - wall_t3)) / 2.0
+            self._clock_rtt_s = (wall_t3 - wall_t0) - (t2 - t1)
             self._peers = [s]
             self._peer_ranks = [0]
+        self._publish_trace_identity()
         # setup handshakes above ran under the generous timeout_s; from
         # here every individual send/recv is capped at op_timeout so a
         # hung peer surfaces as a retryable timeout, not a 2-minute stall
@@ -402,6 +446,26 @@ class SocketComm:
         hb = self._heartbeat
         return hb.dead_ranks() if hb is not None else []
 
+    # -- span-trace correlation ----------------------------------------
+    def _publish_trace_identity(self) -> None:
+        """Hand the process tracer this rank's comm coordinates: session
+        id for collective-id derivation, clock offset for trace_merge's
+        cross-rank time alignment.  No-op when tracing is off."""
+        tr = obs_tracing.get_tracer()
+        if not tr.enabled:
+            return
+        tr.set_metadata(comm_session=self._session.hex(),
+                        comm_rank=self.rank, comm_world=self.world)
+        tr.set_clock_offset(self._clock_offset_s, self._clock_rtt_s)
+
+    def _collective_id(self) -> str:
+        """Deterministic 32-hex id for the NEXT collective: every rank
+        hashes (session, seq) and all ranks issue collectives in the
+        same order, so matching allgather spans across ranks share it."""
+        self._seq += 1
+        return hashlib.md5(
+            self._session + struct.pack("!q", self._seq)).hexdigest()
+
     # LocalComm-compatible surface -------------------------------------
     def allgather_fn(self, rank: int):
         assert rank == self.rank
@@ -409,40 +473,70 @@ class SocketComm:
 
     def allgather(self, payload: dict) -> List[dict]:
         self._m_allgather.inc()
-        if self.world == 1:
-            return [payload]
+        tr = obs_tracing.get_tracer()
+        if not tr.enabled:
+            if self.world == 1:
+                return [payload]
+            return self._allgather_impl(payload, None, _ZERO_TRACE, 0, "")
+        cid = self._collective_id()
+        with tr.span("comm/allgather", "comm",
+                     {"trace_id": cid, "seq": self._seq,
+                      "world": self.world}) as sp:
+            if self.world == 1:
+                return [payload]
+            return self._allgather_impl(payload, tr, bytes.fromhex(cid),
+                                        sp.span_id, cid)
+
+    def _allgather_impl(self, payload: dict, tr, trace_id: bytes,
+                        span_id: int, cid: str) -> List[dict]:
         if self.rank == 0:
             out: List[Optional[dict]] = [None] * self.world
             out[0] = payload
             for i, conn in enumerate(self._peers, start=1):
-                got = self._with_retry(
-                    "allgather", i, lambda c=conn: self._recv_counted(c))
+                with _maybe_span(tr, "comm/wait", peer=i, trace_id=cid):
+                    got = self._with_retry(
+                        "allgather", i, lambda c=conn: self._recv_counted(c))
                 out[i] = None if got is _DROPPED else got
             blob = _encode(out)
             for i, conn in enumerate(self._peers, start=1):
-                sent = self._with_retry(
-                    "send", i, lambda c=conn: _send_blob(c, blob))
+                with _maybe_span(tr, "comm/send", peer=i, trace_id=cid,
+                                 nbytes=len(blob)):
+                    sent = self._with_retry(
+                        "send", i,
+                        lambda c=conn: _send_blob(c, blob, trace_id, span_id))
                 if sent is not _DROPPED:
-                    self._m_sent.inc(len(blob) + 8)
+                    self._m_sent.inc(len(blob) + _FRAME_OVERHEAD)
             return out  # type: ignore[return-value]
-        self._with_retry(
-            "send", 0, lambda: self._send_counted(self._peers[0], payload))
-        got = self._with_retry(
-            "allgather", 0, lambda: self._recv_counted(self._peers[0]))
+        with _maybe_span(tr, "comm/send", peer=0, trace_id=cid):
+            self._with_retry(
+                "send", 0, lambda: self._send_counted(
+                    self._peers[0], payload, trace_id, span_id))
+        with _maybe_span(tr, "comm/wait", peer=0, trace_id=cid):
+            got = self._with_retry(
+                "allgather", 0, lambda: self._recv_counted(self._peers[0]))
         return None if got is _DROPPED else got
 
-    # counted wire helpers: every frame is 8-byte length prefix + blob,
-    # and blocking-recv time IS the rank-skew sync wait at this seam
-    def _send_counted(self, sock: socket.socket, obj) -> None:
+    # counted wire helpers: every frame is 8-byte length prefix +
+    # 24-byte trace header + blob, and blocking-recv time IS the
+    # rank-skew sync wait at this seam
+    def _send_counted(self, sock: socket.socket, obj,
+                      trace_id: bytes = None, span_id: int = 0) -> None:
         blob = _encode(obj)
-        _send_blob(sock, blob)
-        self._m_sent.inc(len(blob) + 8)
+        _send_blob(sock, blob, trace_id if trace_id is not None
+                   else _ZERO_TRACE, span_id)
+        self._m_sent.inc(len(blob) + _FRAME_OVERHEAD)
 
     def _recv_counted(self, sock: socket.socket):
         t0 = time.monotonic()
-        blob = _recv_frame(sock)
+        blob, peer_trace, peer_span = _recv_frame(sock)
         self._m_wait.inc(time.monotonic() - t0)
-        self._m_recv.inc(len(blob) + 8)
+        self._m_recv.inc(len(blob) + _FRAME_OVERHEAD)
+        if peer_span:
+            # mark the arrival with the SENDER's ids so the merged
+            # timeline can connect this rank's wait to the peer's send
+            obs_tracing.instant("comm/recv", "comm",
+                                trace_id=peer_trace.hex(),
+                                peer_span=peer_span, nbytes=len(blob))
         return json.loads(blob.decode("utf-8"))
 
     def close(self) -> None:
@@ -474,8 +568,18 @@ def _encode(obj) -> bytes:
     return json.dumps(obj, default=_json_default).encode("utf-8")
 
 
-def _send_blob(sock: socket.socket, blob: bytes) -> None:
-    sock.sendall(struct.pack("!q", len(blob)) + blob)
+def _maybe_span(tr, name: str, **args):
+    """A comm-leg span when the tracer rode in, else a free nullcontext."""
+    if tr is None:
+        return nullcontext()
+    return tr.span(name, "comm", args)
+
+
+def _send_blob(sock: socket.socket, blob: bytes,
+               trace_id: bytes = None, span_id: int = 0) -> None:
+    sock.sendall(struct.pack("!q", len(blob))
+                 + (trace_id if trace_id is not None else _ZERO_TRACE)
+                 + struct.pack("!q", span_id) + blob)
 
 
 def _send_msg(sock: socket.socket, obj) -> None:
@@ -495,7 +599,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def _recv_frame(sock: socket.socket) -> bytes:
+def _recv_frame(sock: socket.socket):
+    """-> (blob, sender trace-id bytes, sender span id)."""
     (n,) = struct.unpack("!q", _recv_exact(sock, 8))
     if n < 0 or n > _MAX_MSG:
         raise ConnectionError(
@@ -503,11 +608,13 @@ def _recv_frame(sock: socket.socket) -> bytes:
             "length prefix, or a dataset so wide its mapper exchange "
             "exceeds the cap — raise distributed._MAX_MSG if the latter"
             % (n, _MAX_MSG))
-    return _recv_exact(sock, n)
+    hdr = _recv_exact(sock, 24)
+    (span_id,) = struct.unpack("!q", hdr[16:24])
+    return _recv_exact(sock, n), hdr[:16], span_id
 
 
 def _recv_msg(sock: socket.socket):
-    return json.loads(_recv_frame(sock).decode("utf-8"))
+    return json.loads(_recv_frame(sock)[0].decode("utf-8"))
 
 
 # mapper payloads are a few KB/feature and the hub broadcast carries
@@ -515,3 +622,6 @@ def _recv_msg(sock: socket.socket):
 # features) while still bounding what a garbage length prefix can make
 # us allocate
 _MAX_MSG = 8 << 30
+# per-frame wire overhead: 8-byte length + 16-byte trace-id + 8-byte span-id
+_FRAME_OVERHEAD = 32
+_ZERO_TRACE = b"\x00" * 16

@@ -32,6 +32,21 @@ from ..utils import log
 
 AXIS = "mp"
 
+# jax moved shard_map out of experimental (and renamed check_rep to
+# check_vma) across the versions this repo meets; resolve once here so
+# every learner build site works on either spelling
+try:
+    from jax import shard_map as _shard_map
+    _SHARD_CHECK_KW = "check_vma"
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _SHARD_CHECK_KW = "check_rep"
+
+
+def _shard_mapped(fn, mesh, in_specs, out_specs):
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_SHARD_CHECK_KW: False})
+
 
 def resolve_num_machines(config, available: Optional[int] = None) -> int:
     """Device count for the parallel learners: min(num_machines, devices),
@@ -109,9 +124,7 @@ class ParallelGrower:
         else:  # feature: everything replicated, search sharded internally
             in_specs = tuple(P() for _ in range(15))
             out_specs = (P(), P())
-        fn = jax.jit(jax.shard_map(inner, mesh=self.mesh,
-                                   in_specs=in_specs, out_specs=out_specs,
-                                   check_vma=False))
+        fn = jax.jit(_shard_mapped(inner, self.mesh, in_specs, out_specs))
         self._cache[statics] = fn
         return fn
 
@@ -214,9 +227,8 @@ class ParallelGrower:
                     rp, rp, rp,
                     P(), P(), P(), P(), P(), P(), P(), P(), P())
         out_specs = (P(), rp, P(AXIS, None, None), P())
-        fn = jax.jit(jax.shard_map(shard_fn, mesh=self.mesh,
-                                   in_specs=in_specs, out_specs=out_specs,
-                                   check_vma=False),
+        fn = jax.jit(_shard_mapped(shard_fn, self.mesh, in_specs,
+                                   out_specs),
                      donate_argnums=(0,))
         self._pcache[statics] = fn
         return fn

@@ -47,6 +47,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..obs import tracing
 from ..utils import log
 
 SCHEMA_VERSION = 1
@@ -74,6 +75,7 @@ CONFIG_HASH_EXCLUDE = frozenset({
     "local_listen_port", "time_out",
     "tpu_profile", "tpu_profile_trace_dir", "tpu_log_json",
     "tpu_telemetry_path", "tpu_telemetry_device_stats",
+    "tpu_trace_path", "tpu_trace_max_events", "tpu_trace_xla_analysis",
     "tpu_checkpoint_path", "tpu_checkpoint_interval", "tpu_checkpoint_keep",
     "tpu_comm_retries", "tpu_comm_backoff_ms", "tpu_comm_backoff_max_ms",
     "tpu_comm_op_timeout_s", "tpu_comm_heartbeat_s",
@@ -194,6 +196,10 @@ class CheckpointManager:
     def save(self, booster) -> str:
         """Write one atomic checkpoint of the booster's CURRENT state
         (model + trainer aux + scores), then apply retention."""
+        with tracing.span("ckpt/save", "ckpt"):
+            return self._save_impl(booster)
+
+    def _save_impl(self, booster) -> str:
         t0 = time.monotonic()
         gbdt = getattr(booster, "_gbdt", booster)
         # _sync_model first (inside capture_aux_state): deferred pipeline
@@ -301,21 +307,22 @@ class CheckpointManager:
     def load(path: str) -> CheckpointData:
         """Load a checkpoint: ``path`` is either one checkpoint directory
         or a manager root (then the newest valid checkpoint is used)."""
-        if os.path.isfile(os.path.join(path, MANIFEST)):
-            ckpt = path
-        else:
-            ckpt = CheckpointManager.latest(path)
-            if ckpt is None:
-                raise CheckpointError(
-                    "no valid checkpoint found under %s" % path)
-        manifest = verify(ckpt)
-        with open(os.path.join(ckpt, MODEL_FILE)) as f:
-            model_str = f.read()
-        with open(os.path.join(ckpt, STATE_FILE)) as f:
-            state = json.load(f)
-        with np.load(os.path.join(ckpt, SCORES_FILE)) as z:
-            scores = {k: z[k] for k in z.files}
-        return CheckpointData(ckpt, manifest, model_str, state, scores)
+        with tracing.span("ckpt/load", "ckpt", path=str(path)):
+            if os.path.isfile(os.path.join(path, MANIFEST)):
+                ckpt = path
+            else:
+                ckpt = CheckpointManager.latest(path)
+                if ckpt is None:
+                    raise CheckpointError(
+                        "no valid checkpoint found under %s" % path)
+            manifest = verify(ckpt)
+            with open(os.path.join(ckpt, MODEL_FILE)) as f:
+                model_str = f.read()
+            with open(os.path.join(ckpt, STATE_FILE)) as f:
+                state = json.load(f)
+            with np.load(os.path.join(ckpt, SCORES_FILE)) as z:
+                scores = {k: z[k] for k in z.files}
+            return CheckpointData(ckpt, manifest, model_str, state, scores)
 
     @staticmethod
     def restore(booster, ckpt: CheckpointData) -> int:
@@ -323,6 +330,11 @@ class CheckpointManager:
         dataset) to the checkpointed round.  Returns the round index to
         resume the boosting loop from.  Refuses on config-hash or
         dataset-fingerprint mismatch."""
+        with tracing.span("ckpt/restore", "ckpt", round=ckpt.round):
+            return CheckpointManager._restore_impl(booster, ckpt)
+
+    @staticmethod
+    def _restore_impl(booster, ckpt: CheckpointData) -> int:
         gbdt = getattr(booster, "_gbdt", booster)
         want, have = ckpt.manifest["config_hash"], config_hash(gbdt.config)
         if want != have:

@@ -30,6 +30,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from ..obs import tracing
 from ..utils import log
 from .metrics import ModelStats
 
@@ -127,18 +128,21 @@ class MicroBatcher:
         timeout_s = (self.timeout_s if timeout_ms is None
                      else float(timeout_ms) / 1e3)
         req = _Request(rows, timeout_s)
-        with self._lock:
-            if self._stopped:
-                raise BatcherStoppedError("batcher %s stopped" % self.name)
-            if self._queued_rows + req.n > self.max_queue_rows:
-                self.stats.record_reject()
-                raise QueueFullError(
-                    "queue full: %d rows waiting, +%d over the %d cap"
-                    % (self._queued_rows, req.n, self.max_queue_rows))
-            self._queue.append(req)
-            self._queued_rows += req.n
-            self.stats.set_queue_depth(self._queued_rows)
-            self._not_empty.notify()
+        with tracing.span("serve/enqueue", "serve", rows=req.n,
+                          model=self.name):
+            with self._lock:
+                if self._stopped:
+                    raise BatcherStoppedError(
+                        "batcher %s stopped" % self.name)
+                if self._queued_rows + req.n > self.max_queue_rows:
+                    self.stats.record_reject()
+                    raise QueueFullError(
+                        "queue full: %d rows waiting, +%d over the %d cap"
+                        % (self._queued_rows, req.n, self.max_queue_rows))
+                self._queue.append(req)
+                self._queued_rows += req.n
+                self.stats.set_queue_depth(self._queued_rows)
+                self._not_empty.notify()
         if not req.event.wait(timeout_s):
             # mark cancelled so the worker skips it if still queued; a
             # dispatch already in flight just discards the result
@@ -202,7 +206,10 @@ class MicroBatcher:
             try:
                 X = (live[0].rows if len(live) == 1
                      else np.concatenate([r.rows for r in live], axis=0))
-                out = np.asarray(self.predict_fn(X))
+                with tracing.span("serve/micro_batch", "serve",
+                                  rows=X.shape[0], riders=len(live),
+                                  model=self.name):
+                    out = np.asarray(self.predict_fn(X))
                 a = 0
                 for req in live:
                     req.result = out[a:a + req.n]

@@ -31,6 +31,7 @@ import numpy as np
 from ..config import Config
 from ..obs import adapters as obs_adapters
 from ..obs import default_registry
+from ..obs import tracing as obs_tracing
 from ..utils import log
 from ..utils.profiling import Profiler
 from .batcher import (BatcherStoppedError, MicroBatcher, QueueFullError,
@@ -67,6 +68,10 @@ class Server:
         self.metrics = default_registry()
         obs_adapters.ensure_device_metrics(self.metrics)
         obs_adapters.ensure_comm_metrics(self.metrics)
+        # span timeline for the request lifecycle (enqueue -> micro-batch
+        # -> device -> respond) when tpu_trace_path is set; flushed on
+        # shutdown and harmless to leave armed
+        self._tracing = obs_tracing.configure_from_config(cfg) is not None
         self._lock = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._http_thread: Optional[threading.Thread] = None
@@ -141,21 +146,23 @@ class Server:
             raise ModelNotFoundError(name)
         stats.record_request(X.shape[0])
         t0 = time.perf_counter()
-        try:
-            out = batcher.submit(X, timeout_ms=timeout_ms)
-        except QueueFullError:
-            # graceful degradation: saturated queue + small request ->
-            # serve it on the host walk RIGHT NOW on this thread; the
-            # host path never waits on compilation, so overflow traffic
-            # degrades to reference-speed instead of erroring
-            if not (self.config.serve_host_fallback
-                    and X.shape[0] <= self.config.serve_fallback_max_rows):
-                raise
-            entry = self.registry.get(name)
-            with self.profiler.phase("serve/host_fallback"):
-                out = entry.booster._gbdt.predict(X, device=False)
-            stats.record_fallback()
-            stats.record_batch(X.shape[0], device=False)
+        with obs_tracing.span("serve/request", "serve", rows=X.shape[0],
+                              model=name):
+            try:
+                out = batcher.submit(X, timeout_ms=timeout_ms)
+            except QueueFullError:
+                # graceful degradation: saturated queue + small request ->
+                # serve it on the host walk RIGHT NOW on this thread; the
+                # host path never waits on compilation, so overflow traffic
+                # degrades to reference-speed instead of erroring
+                if not (self.config.serve_host_fallback
+                        and X.shape[0] <= self.config.serve_fallback_max_rows):
+                    raise
+                entry = self.registry.get(name)
+                with self.profiler.phase("serve/host_fallback"):
+                    out = entry.booster._gbdt.predict(X, device=False)
+                stats.record_fallback()
+                stats.record_batch(X.shape[0], device=False)
         stats.record_latency((time.perf_counter() - t0) * 1e3)
         return np.asarray(out)
 
@@ -219,6 +226,14 @@ class Server:
             self._batchers.clear()
         for b in batchers:
             b.stop()
+        if self._tracing:
+            self._tracing = False
+            try:
+                path = obs_tracing.get_tracer().flush()
+                if path:
+                    log.info("trace: span timeline written to %s", path)
+            except Exception as exc:  # noqa: BLE001 — teardown never raises
+                log.warning("trace flush failed: %s", exc)
 
 
 def _make_handler(server: Server):

@@ -27,6 +27,7 @@ from contextlib import contextmanager
 from typing import Dict, Optional
 
 from . import log
+from ..obs import tracing
 
 
 class Profiler:
@@ -53,9 +54,17 @@ class Profiler:
 
     @contextmanager
     def phase(self, name: str):
-        if not self.enabled:
+        # every phase site doubles as a span site: the tracer records a
+        # nested span for this phase even when the accumulators are off,
+        # so tpu_trace_path alone yields a full timeline.  The span
+        # closes AFTER sync_fn, so it covers device time like the clock.
+        tracer = tracing.get_tracer()
+        span = tracer.span(name, "phase") if tracer.enabled else None
+        if not self.enabled and span is None:
             yield
             return
+        if span is not None:
+            span.__enter__()
         start = time.perf_counter()
         try:
             yield
@@ -65,7 +74,14 @@ class Profiler:
                     self.sync_fn()
                 except Exception:  # noqa: BLE001 — timing must not kill train
                     pass
+            if span is not None:
+                try:
+                    span.__exit__(None, None, None)
+                except Exception:  # noqa: BLE001
+                    pass
             dt = time.perf_counter() - start
+            if not self.enabled:
+                return
             with self._lock:
                 self.totals[name] = self.totals.get(name, 0.0) + dt
                 self.counts[name] = self.counts.get(name, 0) + 1
@@ -125,14 +141,30 @@ class TraceSession:
         self._live = False
 
     def start(self):
-        if self.trace_dir and not self._live:
-            import jax
+        if not self.trace_dir or self._live:
+            return
+        import jax
+        try:
             jax.profiler.start_trace(self.trace_dir)
-            self._live = True
+        except RuntimeError as exc:
+            # another profiler session is already live (e.g. two boosters
+            # sharing one process) — don't claim ownership of it, and
+            # don't let a double start_trace kill training
+            log.warning("[profile] start_trace skipped: %s", exc)
+            return
+        self._live = True
 
     def stop(self):
-        if self._live:
-            import jax
+        """Idempotent; callers run this in a `finally` (engine.train /
+        GBDT.finish_telemetry) so a raising training loop cannot leak a
+        live profiler session."""
+        if not self._live:
+            return
+        self._live = False
+        import jax
+        try:
             jax.profiler.stop_trace()
-            self._live = False
-            log.info("[profile] jax trace written to %s", self.trace_dir)
+        except Exception as exc:  # noqa: BLE001 — teardown must not raise
+            log.warning("[profile] stop_trace failed: %s", exc)
+            return
+        log.info("[profile] jax trace written to %s", self.trace_dir)

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Fuse per-rank span-trace files into ONE Chrome trace-event timeline.
+
+A distributed run with ``tpu_trace_path=/tmp/run.trace`` writes one file
+per rank (``/tmp/run.trace.rank0``, ``.rank1``, ...), each timestamped
+on its OWN monotonic clock.  This tool aligns them into a single file
+Perfetto / chrome://tracing can open, with one process lane per rank:
+
+1. every event's ts is rebased to wall time via the file's
+   ``wall_epoch_us`` metadata (the wall clock at that rank's ts=0);
+2. each rank's wall time is shifted by its ``clock_offset_us`` — the
+   NTP-style offset against the comm hub estimated in the SocketComm
+   handshake — so all ranks share the HUB's clock;
+3. the earliest event across ranks becomes ts=0 of the merged file.
+
+Collective correlation: allgather spans carry a cluster-unique
+``trace_id`` arg derived from (comm session, sequence number), so after
+the merge an allgather's send / wait / recv legs line up across ranks
+under matching ids.  The tool reports how many collective ids matched
+across every rank (``--strict`` exits nonzero when any id is missing
+from some rank).
+
+Usage:
+    python tools/trace_merge.py RANK_FILE [RANK_FILE ...] -o merged.json
+    python tools/trace_merge.py /tmp/run.trace.rank*  -o merged.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def load_rank_trace(path: str) -> Dict:
+    """One per-rank trace file -> {"events": [...], "metadata": {...}}.
+    Raises ValueError on files that are not span traces."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "traceEvents" not in data:
+        raise ValueError("%s is not a Chrome trace-event JSON object "
+                         "(no traceEvents key)" % path)
+    meta = data.get("metadata") or {}
+    if "wall_epoch_us" not in meta:
+        raise ValueError("%s has no wall_epoch_us metadata — not a "
+                         "lightgbm_tpu span trace?" % path)
+    return {"events": data["traceEvents"], "metadata": meta, "path": path}
+
+
+def merge(traces: List[Dict]) -> Dict:
+    """Fuse loaded per-rank traces into one trace-event object."""
+    # hub-time epoch of each rank's ts=0: local wall epoch + offset-to-hub
+    epochs = {}
+    for t in traces:
+        m = t["metadata"]
+        epochs[id(t)] = (float(m["wall_epoch_us"])
+                         + float(m.get("clock_offset_us", 0.0)))
+    base = min(epochs.values())
+
+    merged: List[Dict] = []
+    collectives: Dict[str, set] = {}
+    ranks = []
+    for t in traces:
+        m = t["metadata"]
+        rank = int(m.get("rank", 0))
+        ranks.append(rank)
+        shift = epochs[id(t)] - base
+        for e in t["events"]:
+            e = dict(e)
+            e["pid"] = rank
+            if e.get("ph") != "M":
+                e["ts"] = round(float(e.get("ts", 0)) + shift, 3)
+            merged.append(e)
+            tid = (e.get("args") or {}).get("trace_id")
+            if tid and e.get("cat") == "comm" and e.get("ph") == "X" \
+                    and e.get("name") == "comm/allgather":
+                collectives.setdefault(tid, set()).add(rank)
+    merged.sort(key=lambda e: (e.get("ts", 0), e.get("pid", 0)))
+
+    world = max((int(t["metadata"].get("world", 1)) for t in traces),
+                default=1)
+    matched = sum(1 for rs in collectives.values() if len(rs) == len(traces))
+    meta = {
+        "merged_from": [t["path"] for t in traces],
+        "ranks": sorted(ranks),
+        "world": world,
+        "collectives_total": len(collectives),
+        "collectives_matched_all_ranks": matched,
+        "clock_offsets_us": {
+            str(int(t["metadata"].get("rank", 0))):
+                float(t["metadata"].get("clock_offset_us", 0.0))
+            for t in traces},
+    }
+    return {"traceEvents": merged, "displayTimeUnit": "ms",
+            "metadata": meta}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Fuse per-rank lightgbm_tpu trace files into one "
+                    "Chrome trace-event timeline")
+    ap.add_argument("files", nargs="+", help="per-rank trace files")
+    ap.add_argument("-o", "--output", required=True,
+                    help="merged trace output path")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 unless every collective id appears in "
+                         "every rank's file")
+    args = ap.parse_args(argv)
+
+    try:
+        traces = [load_rank_trace(p) for p in args.files]
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print("trace_merge: %s" % exc, file=sys.stderr)
+        return 2
+    seen = [int(t["metadata"].get("rank", 0)) for t in traces]
+    if len(set(seen)) != len(seen):
+        print("trace_merge: duplicate ranks in inputs: %s" % seen,
+              file=sys.stderr)
+        return 2
+
+    out = merge(traces)
+    with open(args.output, "w") as f:
+        json.dump(out, f, separators=(",", ":"))
+    m = out["metadata"]
+    print("merged %d ranks -> %s: %d events, %d/%d collectives matched "
+          "across all ranks"
+          % (len(traces), args.output, len(out["traceEvents"]),
+             m["collectives_matched_all_ranks"], m["collectives_total"]))
+    if args.strict and m["collectives_total"] \
+            and m["collectives_matched_all_ranks"] != m["collectives_total"]:
+        print("trace_merge: --strict: %d collectives missing from some "
+              "rank" % (m["collectives_total"]
+                        - m["collectives_matched_all_ranks"]),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -303,6 +303,42 @@ def trace_smoke(lgb):
         return "FAILED: %s" % e
 
 
+def chaos_smoke():
+    """Real-process elastic recovery drill (one line in `detail`).
+
+    Spawns a 3-rank localhost world via tools/chaos_run.py, SIGKILLs one
+    rank mid-iteration and requires the survivors to fence it, re-form
+    at world 2 and finish from the newest checkpoint.  Children are
+    pinned to the CPU backend so the drill never competes with the timed
+    TPU runs.  Never fails the bench: any problem becomes the summary.
+    """
+    import os
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        import chaos_run
+    finally:
+        sys.path.pop(0)
+    prev = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"   # spawned ranks only
+    try:
+        s = chaos_run.run_scenario("kill_rank", world=3, rounds=5,
+                                   n_rows=180, chaos_round=2,
+                                   join_timeout_s=180.0)
+        return ("kill_rank: world %d->%d, %d survivors complete, "
+                "recovery %.2fs, ok=%s"
+                % (s["world"], s["final_world"],
+                   len(s["completed_ranks"]),
+                   s.get("recovery_s") or float("nan"), s["ok"]))
+    except Exception as e:  # noqa: BLE001 — smoke only, never fatal
+        return "FAILED: %s" % e
+    finally:
+        if prev is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = prev
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -331,6 +367,7 @@ def main():
             "lambdarank": rank,
             "quality_ok": ok,
             "trace_smoke": trace_smoke(lgb),
+            "chaos_smoke": chaos_smoke(),
         },
     }
     print(json.dumps(result))

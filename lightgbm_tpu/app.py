@@ -120,6 +120,10 @@ class Application:
 
     def train(self) -> None:
         cfg = self.config
+        if cfg.tpu_elastic and not cfg.is_single_machine() and (
+                cfg.machines or cfg.machine_list_filename):
+            self._train_elastic()
+            return
         if not cfg.is_single_machine() and (cfg.machines
                                             or cfg.machine_list_filename):
             # multi-host: attach to the JAX coordination service so
@@ -142,6 +146,7 @@ class Application:
             name = vf.split("/")[-1]
             valid_names.append(name)
         callbacks = []
+        restore_sig = self._install_preemption(callbacks)
         if cfg.snapshot_freq > 0:
             # model snapshots every snapshot_freq iterations
             # (GBDT::Train, gbdt.cpp:255-259)
@@ -166,14 +171,17 @@ class Application:
                                 "and ignoring input_model",
                                 cfg.tpu_checkpoint_path)
                 log.info("Resuming from checkpoint %s", resume_from)
-        booster = engine.train(
-            dict(self.raw_params), train_set,
-            num_boost_round=cfg.num_iterations,
-            valid_sets=valid_sets, valid_names=valid_names,
-            init_model=(cfg.input_model or None) if resume_from is None
-            else None,
-            callbacks=callbacks or None,
-            resume_from=resume_from)
+        try:
+            booster = engine.train(
+                dict(self.raw_params), train_set,
+                num_boost_round=cfg.num_iterations,
+                valid_sets=valid_sets, valid_names=valid_names,
+                init_model=(cfg.input_model or None) if resume_from is None
+                else None,
+                callbacks=callbacks or None,
+                resume_from=resume_from)
+        finally:
+            restore_sig()
         booster.save_model(cfg.output_model)
         if cfg.tpu_telemetry_path:
             # the CLI's one-shot analogue of GET /metrics: dump the final
@@ -196,6 +204,86 @@ class Application:
                      "tools/trace_check.py, fuse ranks with "
                      "tools/trace_merge.py", cfg.tpu_trace_path)
         log.info("Finished training; model saved to %s", cfg.output_model)
+
+    def _install_preemption(self, callbacks: list):
+        """SIGTERM/SIGINT -> finish the current round, write one final
+        checkpoint (atomic, via CheckpointManager), exit cleanly with
+        the model holding only fully trained rounds.  Returns a restorer
+        for the previous handlers; no-op (and no handler swap) off the
+        main thread or when signals are unavailable."""
+        import signal as signal_mod
+        import threading
+        cfg = self.config
+        stop = threading.Event()
+        manager = None
+        if cfg.tpu_checkpoint_path and cfg.machine_rank <= 0:
+            from .resilience import CheckpointManager
+            manager = CheckpointManager(
+                cfg.tpu_checkpoint_path,
+                interval=cfg.tpu_checkpoint_interval,
+                keep_last_n=cfg.tpu_checkpoint_keep,
+                rank=max(cfg.machine_rank, 0))
+        from . import callback as callback_mod
+        callbacks.append(callback_mod.preemption(stop, manager))
+        prev = {}
+
+        def on_signal(signum, _frame):
+            log.warning("signal %d received: will stop after the current "
+                        "round%s", signum,
+                        " and checkpoint" if manager is not None else "")
+            stop.set()
+
+        try:
+            for sig in (signal_mod.SIGTERM, signal_mod.SIGINT):
+                prev[sig] = signal_mod.signal(sig, on_signal)
+        except ValueError:          # not the main thread
+            return lambda: None
+
+        def restore():
+            for sig, handler in prev.items():
+                try:
+                    signal_mod.signal(sig, handler)
+                except ValueError:
+                    pass
+        return restore
+
+    def _train_elastic(self) -> None:
+        """tpu_elastic=true multi-machine training: run under the
+        degraded-world supervisor (resilience/elastic.py) instead of the
+        plain engine path.  The full dataset is loaded on every rank
+        (the supervisor re-shards it per world incarnation) and the
+        FINAL incarnation's rank 0 writes output_model."""
+        cfg = self.config
+        from .parallel.distributed import parse_machines, resolve_rank
+        from .resilience import ElasticFenced, ElasticSupervisor
+        machines = parse_machines(cfg)
+        orig_rank = (cfg.machine_rank if cfg.machine_rank >= 0
+                     else resolve_rank(machines))
+        d = loader_mod.load_data_file(
+            cfg, cfg.data, initscore_filename=cfg.initscore_filename)
+        callbacks = []
+        restore_sig = self._install_preemption(callbacks)
+        sup = ElasticSupervisor(
+            dict(self.raw_params), d.X, d.label, orig_rank=orig_rank,
+            machines=machines, weight=d.weight, group=d.group,
+            init_score=d.init_score,
+            categorical_features=d.categorical or (),
+            num_boost_round=cfg.num_iterations, callbacks=callbacks)
+        try:
+            result = sup.run()
+        except ElasticFenced as e:
+            log.warning("elastic: %s — exiting without a model (the "
+                        "surviving world owns the run)", e)
+            return
+        finally:
+            restore_sig()
+        log.info("elastic training done: world %d, generation %d, "
+                 "%d reform(s), %.2fs recovering", result.world,
+                 result.generation, result.reforms, result.recovery_s)
+        if result.rank == 0:
+            result.booster.save_model(cfg.output_model)
+            log.info("Finished training; model saved to %s",
+                     cfg.output_model)
 
     def predict(self) -> None:
         cfg = self.config
@@ -257,6 +345,9 @@ class Application:
         log.info("Loaded %s v%d (%d trees); serving on %s:%d",
                  entry.name, entry.version, entry.num_trees,
                  cfg.serve_host, cfg.serve_port)
+        # SIGTERM -> graceful drain: finish queued + in-flight requests
+        # (bounded by tpu_serve_drain_timeout_s), then exit
+        server.install_signal_handlers()
         server.serve_http(block=True)
 
     def convert_model(self) -> None:

@@ -112,6 +112,41 @@ def checkpoint(manager) -> Callable:
     return _callback
 
 
+def preemption(stop_event, manager=None) -> Callable:
+    """Graceful-preemption stop: when ``stop_event`` (a threading.Event,
+    typically set from a SIGTERM/SIGINT handler — app.py wires this for
+    the CLI train path) is set, write one final checkpoint through
+    ``manager`` (if given) and stop training BEFORE the next round
+    starts, so the model saved on the way out holds only fully trained
+    rounds.
+
+        stop = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        engine.train(params, ds,
+                     callbacks=[callback.preemption(stop, mgr)])
+    """
+
+    def _callback(env: CallbackEnv) -> None:
+        if not stop_event.is_set():
+            return
+        log.warning("preemption requested: stopping before round %d",
+                    env.iteration)
+        if manager is not None:
+            try:
+                manager.save(env.model)
+            except Exception as exc:  # noqa: BLE001 — still stop cleanly
+                log.warning("final preemption checkpoint failed: %s", exc)
+        # best_iteration = rounds already completed (round env.iteration
+        # has NOT trained); engine catches this around cb_before
+        raise EarlyStopException(env.iteration - 1, None)
+
+    _callback.before_iteration = True
+    # first among before-iteration callbacks: a preempted run must not
+    # burn time in schedule updates for a round it will never train
+    _callback.order = 0
+    return _callback
+
+
 def _resolve_schedule(key: str, spec, round_idx: int, num_rounds: int):
     """A per-round parameter value from a list (one entry per round) or a
     callable round_idx -> value."""

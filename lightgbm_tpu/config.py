@@ -201,6 +201,40 @@ _SCHEMA = [
     ("tpu_comm_backoff_max_ms", float, 2000.0),  # backoff cap
     ("tpu_comm_op_timeout_s", float, 0.0),   # per send/recv cap; 0 = inherit setup timeout
     ("tpu_comm_heartbeat_s", float, 0.0),    # >0 -> rank-liveness probe every N seconds
+    # --- elasticity parameters (no reference analogue)
+    # Elastic distributed training (lightgbm_tpu/resilience/elastic):
+    # active liveness protocol, generation-fenced collectives, and
+    # degraded-world recovery — a dead rank is detected, fenced, and the
+    # survivors re-form and resume from the newest checkpoint; see
+    # docs/Elasticity.md.
+    ("tpu_elastic", bool, False),            # run training under the elastic
+    #   supervisor (requires a machine list and tpu_checkpoint_path for
+    #   cross-failure resume)
+    ("tpu_elastic_heartbeat_ms", float, 200.0),  # control-channel ping interval
+    ("tpu_elastic_suspect_ms", float, 1000.0),   # silence before a rank is
+    #   declared dead (detection latency upper bound, rounded up to whole
+    #   heartbeat intervals)
+    ("tpu_elastic_rejoin_s", float, 3.0),    # re-formation window for restarted
+    #   ranks to rejoin before the world proceeds at reduced size
+    ("tpu_elastic_min_world", int, 1),       # abort instead of re-forming below
+    #   this many surviving ranks
+    ("tpu_elastic_max_reforms", int, 3),     # abort after this many world
+    #   re-formations in one run
+    ("tpu_elastic_sync_every", int, 1),      # rounds between liveness-bearing
+    #   allgathers (the failure-propagation seam; higher = less comm, slower
+    #   failure detection at the training loop level)
+    # --- serving admission-control parameters (no reference analogue)
+    # Load shedding + circuit breaking for task=serve (serving/admission);
+    # see docs/Elasticity.md for the semantics.
+    ("tpu_serve_shed_queue_rows", int, 0),   # queue-depth watermark: reject new
+    #   requests with 429 + Retry-After once this many rows are queued
+    #   (0 = shed only at the hard serve_queue_rows bound)
+    ("tpu_serve_shed_retry_after_s", float, 1.0),  # Retry-After hint on 429/503
+    ("tpu_serve_breaker_failures", int, 5),  # consecutive device-path failures
+    #   that open the circuit breaker (then requests ride the host walk)
+    ("tpu_serve_breaker_reset_s", float, 30.0),  # open -> half-open probe delay
+    ("tpu_serve_drain_timeout_s", float, 10.0),  # SIGTERM: max wait for in-flight
+    #   requests before the server exits
 ]
 
 # alias -> canonical name (src/io/config_auto.cpp:4-157)
@@ -307,6 +341,10 @@ ALIAS_TABLE: Dict[str, str] = {
     "checkpoint_dir": "tpu_checkpoint_path",
     "checkpoint_interval": "tpu_checkpoint_interval",
     "checkpoint_freq": "tpu_checkpoint_interval",
+    "elastic": "tpu_elastic", "elastic_training": "tpu_elastic",
+    "elastic_rejoin_window_s": "tpu_elastic_rejoin_s",
+    "serve_shed_queue_rows": "tpu_serve_shed_queue_rows",
+    "serve_drain_timeout_s": "tpu_serve_drain_timeout_s",
     "checkpoint_keep": "tpu_checkpoint_keep",
     "keep_last_n": "tpu_checkpoint_keep",
     "comm_retries": "tpu_comm_retries",
@@ -531,6 +569,36 @@ class Config:
         if self.tpu_trace_max_events < 1024:
             log.fatal("tpu_trace_max_events must be >= 1024, got %d"
                       % self.tpu_trace_max_events)
+        if self.tpu_elastic:
+            if self.tpu_elastic_heartbeat_ms <= 0:
+                log.fatal("tpu_elastic_heartbeat_ms must be > 0, got %g"
+                          % self.tpu_elastic_heartbeat_ms)
+            if self.tpu_elastic_suspect_ms < self.tpu_elastic_heartbeat_ms:
+                log.fatal("tpu_elastic_suspect_ms (%g) must be >= "
+                          "tpu_elastic_heartbeat_ms (%g)"
+                          % (self.tpu_elastic_suspect_ms,
+                             self.tpu_elastic_heartbeat_ms))
+            if self.tpu_elastic_min_world < 1:
+                log.fatal("tpu_elastic_min_world must be >= 1, got %d"
+                          % self.tpu_elastic_min_world)
+            if self.tpu_elastic_sync_every < 1:
+                log.fatal("tpu_elastic_sync_every must be >= 1, got %d"
+                          % self.tpu_elastic_sync_every)
+            if self.tpu_elastic_rejoin_s < 0:
+                log.fatal("tpu_elastic_rejoin_s must be >= 0, got %g"
+                          % self.tpu_elastic_rejoin_s)
+        if self.tpu_serve_shed_queue_rows < 0:
+            log.fatal("tpu_serve_shed_queue_rows must be >= 0, got %d"
+                      % self.tpu_serve_shed_queue_rows)
+        if self.tpu_serve_breaker_failures < 1:
+            log.fatal("tpu_serve_breaker_failures must be >= 1, got %d"
+                      % self.tpu_serve_breaker_failures)
+        if (self.tpu_serve_shed_retry_after_s < 0
+                or self.tpu_serve_breaker_reset_s < 0
+                or self.tpu_serve_drain_timeout_s < 0):
+            log.fatal("tpu_serve_shed_retry_after_s / "
+                      "tpu_serve_breaker_reset_s / tpu_serve_drain_timeout_s "
+                      "must be >= 0")
 
     def is_single_machine(self) -> bool:
         return self.num_machines <= 1

@@ -34,7 +34,8 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
           early_stopping_rounds: Optional[int] = None, evals_result=None,
           verbose_eval=True, learning_rates=None,
           keep_training_booster: bool = False, callbacks=None,
-          resume_from: Optional[str] = None):
+          resume_from: Optional[str] = None,
+          resume_mode: str = "strict"):
     """Mirror of engine.py:19-243.
 
     resume_from: a checkpoint directory (or a CheckpointManager root,
@@ -47,6 +48,14 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     on NEW data is init_model's job; resume is a restart of the SAME
     run.  Note early-stopping metric history restarts at the resume
     point, so the byte-identity guarantee applies to fixed-round runs.
+
+    resume_mode: "strict" (default) restores bitwise — same config,
+    same dataset fingerprint.  "reshard" is the elastic supervisor's
+    degraded-world path: the row shard changed with the world size, so
+    the dataset check is waived and the train score plane is rebuilt
+    from this rank's raw shard (CheckpointManager.restore_elastic);
+    topology params may differ from the checkpoint, training params may
+    not.
     """
     params = dict(params) if params else {}
     num_boost_round = int(_pop_param(params, "num_iterations", num_boost_round))
@@ -120,7 +129,14 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         # restore AFTER valid sets attach so their score planes exist to
         # be overwritten with the checkpointed arrays
         from .resilience import CheckpointManager
-        restored_round = CheckpointManager.restore(booster, ckpt)
+        if resume_mode == "reshard":
+            restored_round = CheckpointManager.restore_elastic(
+                booster, ckpt, train_set.data)
+        elif resume_mode == "strict":
+            restored_round = CheckpointManager.restore(booster, ckpt)
+        else:
+            raise LightGBMError("unknown resume_mode %r (strict|reshard)"
+                                % (resume_mode,))
         # loop bounds below: train rounds [restored_round, num_boost_round)
         # — num_boost_round is the TOTAL round count of the run being
         # resumed, exactly as the uninterrupted run would iterate — and
@@ -152,15 +168,18 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
         # tpu_telemetry_path is set: merge each round's metric values
         # into the per-iteration JSONL event (obs/recorder.py)
         callbacks.add(callback_mod.telemetry())
-    if cfg.tpu_checkpoint_path:
+    if cfg.tpu_checkpoint_path and cfg.machine_rank <= 0:
         # periodic atomic checkpoints (resilience/checkpoint.py); resume
         # with resume_from=cfg.tpu_checkpoint_path (the CLI does this
-        # automatically)
+        # automatically).  Rank-gated: when several ranks share the
+        # checkpoint directory only rank 0 writes — every rank holds the
+        # same model, and concurrent retention sweeps would race
         from .resilience import CheckpointManager
         callbacks.add(callback_mod.checkpoint(CheckpointManager(
             cfg.tpu_checkpoint_path,
             interval=cfg.tpu_checkpoint_interval,
-            keep_last_n=cfg.tpu_checkpoint_keep)))
+            keep_last_n=cfg.tpu_checkpoint_keep,
+            rank=max(cfg.machine_rank, 0))))
 
     cb_before = {cb for cb in callbacks
                  if getattr(cb, "before_iteration", False)}
@@ -174,11 +193,20 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
     # start_trace would poison every later training run in the process
     try:
         for i in range(begin_round, end_round):
-            for cb in cb_before:
-                cb(callback_mod.CallbackEnv(model=booster, params=params,
-                                            iteration=i, begin_iteration=begin_cb,
-                                            end_iteration=end_round,
-                                            evaluation_result_list=None))
+            try:
+                for cb in cb_before:
+                    cb(callback_mod.CallbackEnv(model=booster, params=params,
+                                                iteration=i,
+                                                begin_iteration=begin_cb,
+                                                end_iteration=end_round,
+                                                evaluation_result_list=None))
+            except callback_mod.EarlyStopException as es:
+                # preemption-style stops fire BEFORE the round trains
+                # (callback.preemption): best_iteration counts the rounds
+                # already completed, nothing from round i exists yet
+                booster.best_iteration = es.best_iteration + 1
+                _record_best(booster, es.best_score)
+                break
             finished = booster.update(fobj=fobj)
 
             evaluation_result_list = []

@@ -1813,6 +1813,41 @@ class GBDT:
             if key in scores:
                 vs.score = jnp.asarray(scores[key])
 
+    def rebuild_score_from_raw(self, raw_X: np.ndarray) -> None:
+        """Reshard-tolerant train-plane rebuild for elastic resume.
+
+        The exact plane saved by capture_score_arrays is keyed to the
+        row shard the checkpoint was cut on; after an elastic
+        re-formation this rank holds a DIFFERENT shard, so the plane is
+        recomputed instead: the construction-time baseline (zeros plus
+        per-row init_score — boost_from_average is baked into tree 0 via
+        add_bias, so it rides in with the trees) plus a host raw-score
+        walk over the loaded ensemble (text-loaded trees carry no
+        bin-space thresholds, so the bin-replay path is unavailable;
+        predict_raw's raw-threshold walk is shard-size work once per
+        re-formation).  Matches the uninterrupted plane up to float
+        summation order, which is what a degraded-world resume can
+        promise — the topology itself changed.
+        """
+        if self.train_state is None:
+            return
+        n = self.train_state.ds.num_data
+        if raw_X is None or len(raw_X) != n:
+            raise ValueError(
+                "rebuild_score_from_raw needs the raw feature matrix of "
+                "this rank's CURRENT shard (%d rows), got %s"
+                % (n, "None" if raw_X is None else len(raw_X)))
+        k = self.num_tree_per_iteration
+        base = np.zeros((k, n), np.float64)
+        if self.train_set.metadata.init_score is not None:
+            base += np.asarray(_expand_init_score(
+                self.train_set.metadata.init_score, k, n), np.float64)
+        if self.models:
+            pred = np.asarray(self.predict_raw(raw_X, device=False),
+                              np.float64)
+            base += pred[None, :] if k == 1 else pred.T
+        self.train_state.score = jnp.asarray(base, self.dtype)
+
     # ------------------------------------------------------------------ #
     def refit(self, X: np.ndarray, label: np.ndarray,
               weight=None, group=None) -> None:

@@ -59,6 +59,28 @@ def ensure_comm_metrics(reg: MetricsRegistry, rank: int = 0,
             for name, help_text in COMM_COUNTERS}
 
 
+def ensure_elastic_metrics(reg: MetricsRegistry,
+                           rank: int = 0) -> Dict[str, object]:
+    """Gauges for the elastic supervisor (resilience/elastic.py), labeled
+    by the process's ORIGINAL machine-list rank — the stable identity
+    across world re-formations."""
+    labels = dict(orig_rank=str(rank))
+    return {
+        "generation": reg.gauge(
+            "lgbm_elastic_generation",
+            help="Current elastic world generation", **labels),
+        "world": reg.gauge(
+            "lgbm_elastic_world_size",
+            help="Ranks in the current world incarnation", **labels),
+        "reforms": reg.gauge(
+            "lgbm_elastic_reforms",
+            help="World re-formations survived by this run", **labels),
+        "recovery_s": reg.gauge(
+            "lgbm_elastic_recovery_seconds",
+            help="Cumulative failure-to-re-formed seconds", **labels),
+    }
+
+
 def comm_totals(reg: MetricsRegistry) -> Optional[Dict[str, float]]:
     """Cumulative comm traffic across every rank this process has seen,
     or None when no comm layer ever registered."""
@@ -95,6 +117,12 @@ def publish_model_stats(reg: MetricsRegistry, name: str, stats,
     reg.counter("lgbm_serve_rejected_total",
                 help="Queue-full rejections",
                 model=name).set_fn(pull("rejected_queue_full"))
+    reg.counter("lgbm_serve_shed_total",
+                help="Requests shed by admission control (429+Retry-After)",
+                model=name).set_fn(pull("shed"))
+    reg.counter("lgbm_serve_breaker_batches_total",
+                help="Batches forced host-side by an open circuit breaker",
+                model=name).set_fn(pull("breaker_batches"))
     reg.counter("lgbm_serve_timeouts_total",
                 help="Requests that missed their deadline",
                 model=name).set_fn(pull("timeouts"))

@@ -279,3 +279,25 @@ def _json_default(o):
     if hasattr(o, "tolist"):
         return o.tolist()
     return str(o)
+
+
+def elastic_event(config, what: str, **fields) -> None:
+    """Append one elastic-lifecycle event ({"event": "elastic",
+    "what": "reform"|"complete", ...}) to Config.tpu_telemetry_path.
+
+    The supervisor lives OUTSIDE any single booster's TrainingRecorder
+    (a world re-formation spans two boosters), so this appends directly
+    — same file, same one-line-per-event JSONL contract, best-effort
+    like every other telemetry write."""
+    path = getattr(config, "tpu_telemetry_path", "")
+    if not path:
+        return
+    event = {"event": "elastic", "what": str(what)}
+    event.update(fields)
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(event, default=_json_default,
+                               separators=(",", ":")) + "\n")
+    except Exception as exc:  # noqa: BLE001 — telemetry never raises
+        log.warning("telemetry: elastic event write to %s failed: %s",
+                    path, exc)

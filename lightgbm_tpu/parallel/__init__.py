@@ -1,0 +1,17 @@
+"""lightgbm_tpu.parallel — distributed data loading, tree learners and
+the cross-host comm layer.
+
+- ``distributed``: SocketComm (hub-and-spoke JSON allgather),
+  ElasticComm (generation-fenced membership + liveness control plane),
+  machine-list parsing and jax.distributed bring-up.
+- ``dist_data``: rank-sharded ingest with distributed find-bin.
+- ``learners``: shard_map'd parallel tree growers over a device mesh.
+"""
+from .distributed import (ElasticComm, SocketComm,  # noqa: F401
+                          WorldChangedError, initialize_from_config,
+                          parse_machines, resolve_rank)
+
+__all__ = [
+    "ElasticComm", "SocketComm", "WorldChangedError",
+    "initialize_from_config", "parse_machines", "resolve_rank",
+]

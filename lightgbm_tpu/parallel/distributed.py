@@ -43,17 +43,20 @@ from __future__ import annotations
 import errno
 import hashlib
 import json
+import math
 import os
 import select
 import socket
 import struct
+import threading
 import time
 import uuid
 from contextlib import nullcontext
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from ..obs import tracing as obs_tracing
-from ..resilience.comm import CommFailure, FaultInjector, Heartbeat, RetryPolicy
+from ..resilience.comm import (CommFailure, FaultInjector, Heartbeat,
+                               RetryPolicy, WorldChangedError)
 from ..utils import log
 
 # sentinel returned by _with_retry when the fault injector swallowed the
@@ -210,17 +213,25 @@ class SocketComm:
     the full rank-ordered list.  Setup-phase traffic only (a few KB of
     serialized BinMapper state) — hot-path collectives are XLA's job.
 
-    Wire format (v2, span-trace aware): the spoke handshake is
-    ``!id`` (rank, local wall clock) and the hub replies ``!16sdd``
-    (comm session id, recv time, send time) — an NTP-style exchange
-    whose midpoint estimates each spoke's clock offset against the hub
-    for tools/trace_merge.py.  Every frame is then an 8-byte ``!q``
-    length + 16-byte trace-id + 8-byte ``!q`` span-id header + JSON
-    blob; the trace fields carry the sender's collective trace-id and
-    live span so per-rank trace files correlate (all zeros when tracing
-    is off — the header is always present, keeping the protocol
+    Wire format (v3, span-trace + generation aware): the spoke
+    handshake is ``!iqd`` (rank, generation, local wall clock) and the
+    hub replies ``!16sqdd`` (comm session id, generation, recv time,
+    send time) — an NTP-style exchange whose midpoint estimates each
+    spoke's clock offset against the hub for tools/trace_merge.py.
+    Every frame is then an 8-byte ``!q`` length + 16-byte trace-id +
+    8-byte ``!q`` span-id + 8-byte ``!q`` generation + 1-byte frame
+    kind header + JSON blob.  The trace fields carry the sender's
+    collective trace-id and live span so per-rank trace files correlate
+    (all zeros when tracing is off).  The generation is the elasticity
+    fence: a plain SocketComm lives its whole life at generation 0, an
+    ElasticComm bumps it on every world re-formation, and a receiver
+    REJECTS any data frame stamped with a different generation
+    (``WorldChangedError``) so a fenced rank's stale traffic can never
+    corrupt a re-formed world.  Kind ``FRAME_POISON`` aborts the
+    receiver's collective immediately (bounded-time failure
+    propagation).  The header is always present, keeping the protocol
     uniform; every rank runs the same code, so there is no version
-    skew).
+    skew.
     """
 
     def __init__(self, rank: int, world: int, machines: List[str],
@@ -228,7 +239,8 @@ class SocketComm:
                  retry: Optional[RetryPolicy] = None,
                  op_timeout_s: float = 0.0,
                  heartbeat_s: float = 0.0,
-                 injector: Optional[FaultInjector] = None):
+                 injector: Optional[FaultInjector] = None,
+                 generation: int = 0):
         """port_offset: the machine-list port belongs to the JAX
         coordination service (initialize_from_config) — binding the hub
         there would EADDRINUSE against it, so the find-bin comm uses
@@ -241,38 +253,10 @@ class SocketComm:
         the rank-liveness probe thread; injector is the test-only
         FaultInjector hook consulted before each wire op.
         """
-        self.rank, self.world = rank, world
-        self.timeout = timeout_s
-        self.retry = retry if retry is not None else RetryPolicy()
-        self.op_timeout = op_timeout_s if op_timeout_s > 0 else timeout_s
-        self._injector = injector
-        self._heartbeat: Optional[Heartbeat] = None
+        self._init_state(rank, world, timeout_s, retry, op_timeout_s,
+                         injector, generation)
         host, port = machines[0].rsplit(":", 1)
         port = int(port) + port_offset
-        self._peers: List[socket.socket] = []
-        # hub peers arrive rank-ordered 1..world-1; a spoke's single
-        # peer is the hub (rank 0) — CommFailure names ranks from this
-        self._peer_ranks: List[int] = []
-        # comm counters (bytes in/out, allgather rounds, sync-wait
-        # seconds, retries/aborts) tagged rank/world in the process-wide
-        # registry — the comm quarter of the unified telemetry layer
-        from ..obs import adapters as obs_adapters
-        from ..obs import default_registry
-        m = obs_adapters.ensure_comm_metrics(default_registry(), rank, world)
-        self._m_sent = m["lgbm_comm_bytes_sent_total"]
-        self._m_recv = m["lgbm_comm_bytes_received_total"]
-        self._m_allgather = m["lgbm_comm_allgather_total"]
-        self._m_wait = m["lgbm_comm_sync_wait_seconds_total"]
-        self._m_retries = m["lgbm_comm_retries_total"]
-        self._m_failures = m["lgbm_comm_failures_total"]
-        # span-trace correlation state: the comm session id (minted by
-        # the hub, learned by spokes in the handshake) + a per-instance
-        # collective sequence number derive cluster-unique collective
-        # trace ids; clock offset is this rank's wall clock vs the hub's
-        self._session = uuid.uuid4().bytes
-        self._seq = 0
-        self._clock_offset_s = 0.0
-        self._clock_rtt_s = 0.0
         if world == 1:
             self._publish_trace_identity()
             return
@@ -307,23 +291,26 @@ class SocketComm:
             for _ in range(world - 1):
                 conn, _addr = srv.accept()
                 conn.settimeout(timeout_s)
-                # 12-byte spoke handshake: rank + the spoke's wall clock
-                # at send time (t0 of the NTP-style offset exchange)
-                r, _peer_t0 = struct.unpack("!id", _recv_exact(conn, 12))
+                # 20-byte spoke handshake: rank + generation + the
+                # spoke's wall clock at send time (t0 of the NTP-style
+                # offset exchange)
+                r, _peer_gen, _peer_t0 = struct.unpack(
+                    "!iqd", _recv_exact(conn, 20))
                 by_rank[r] = (conn, time.time())
             # waiting for world-1 spokes to dial in is the hub's share
-            # of cluster-formation skew; the 12-byte rank handshakes are
+            # of cluster-formation skew; the 20-byte rank handshakes are
             # the first wire traffic
             self._m_wait.inc(time.monotonic() - t0)
-            self._m_recv.inc(12 * (world - 1))
+            self._m_recv.inc(20 * (world - 1))
             srv.close()
-            # reply to every spoke: session id + (t1 recv time, t2 send
-            # time) so each spoke closes its own offset estimate
+            # reply to every spoke: session id + the hub's generation +
+            # (t1 recv time, t2 send time) so each spoke closes its own
+            # offset estimate
             for r in range(1, world):
                 conn, t1 = by_rank[r]
-                conn.sendall(struct.pack("!16sdd", self._session, t1,
-                                         time.time()))
-            self._m_sent.inc(32 * (world - 1))
+                conn.sendall(struct.pack("!16sqdd", self._session,
+                                         self.generation, t1, time.time()))
+            self._m_sent.inc(40 * (world - 1))
             self._peers = [by_rank[r][0] for r in range(1, world)]
             self._peer_ranks = list(range(1, world))
         else:
@@ -346,12 +333,15 @@ class SocketComm:
             self._m_wait.inc(time.monotonic() - t0)
             s.settimeout(timeout_s)
             wall_t0 = time.time()
-            s.sendall(struct.pack("!id", rank, wall_t0))
-            self._m_sent.inc(12)
-            self._session, t1, t2 = struct.unpack(
-                "!16sdd", _recv_exact(s, 32))
+            s.sendall(struct.pack("!iqd", rank, self.generation, wall_t0))
+            self._m_sent.inc(20)
+            self._session, hub_gen, t1, t2 = struct.unpack(
+                "!16sqdd", _recv_exact(s, 40))
+            # the hub's generation is authoritative (a restarted spoke
+            # rejoining an elastic world adopts the current one)
+            self.generation = hub_gen
             wall_t3 = time.time()
-            self._m_recv.inc(32)
+            self._m_recv.inc(40)
             # NTP midpoint: hub clock minus this rank's clock; add it to
             # local wall timestamps to express them in hub time
             self._clock_offset_s = ((t1 - wall_t0) + (t2 - wall_t3)) / 2.0
@@ -366,6 +356,50 @@ class SocketComm:
             s.settimeout(self.op_timeout)
         if heartbeat_s > 0:
             self.start_heartbeat(heartbeat_s)
+
+    def _init_state(self, rank: int, world: int, timeout_s: float,
+                    retry: Optional[RetryPolicy], op_timeout_s: float,
+                    injector: Optional[FaultInjector],
+                    generation: int = 0) -> None:
+        """Per-instance comm state shared by SocketComm and ElasticComm
+        (which forms its topology first and only then knows its rank and
+        world, so this cannot live inline in __init__)."""
+        self.rank, self.world = rank, world
+        self.timeout = timeout_s
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.op_timeout = op_timeout_s if op_timeout_s > 0 else timeout_s
+        self._injector = injector
+        self._heartbeat: Optional[Heartbeat] = None
+        self.generation = int(generation)
+        # set by the control plane (poison / liveness conviction / hub
+        # loss): _with_retry raises it instead of retrying, so a blocked
+        # or failing collective surfaces the topology change in bounded
+        # time rather than burning the whole retry budget
+        self._world_changed: Optional[WorldChangedError] = None
+        self._peers: List[socket.socket] = []
+        # hub peers arrive rank-ordered 1..world-1; a spoke's single
+        # peer is the hub (rank 0) — CommFailure names ranks from this
+        self._peer_ranks: List[int] = []
+        # comm counters (bytes in/out, allgather rounds, sync-wait
+        # seconds, retries/aborts) tagged rank/world in the process-wide
+        # registry — the comm quarter of the unified telemetry layer
+        from ..obs import adapters as obs_adapters
+        from ..obs import default_registry
+        m = obs_adapters.ensure_comm_metrics(default_registry(), rank, world)
+        self._m_sent = m["lgbm_comm_bytes_sent_total"]
+        self._m_recv = m["lgbm_comm_bytes_received_total"]
+        self._m_allgather = m["lgbm_comm_allgather_total"]
+        self._m_wait = m["lgbm_comm_sync_wait_seconds_total"]
+        self._m_retries = m["lgbm_comm_retries_total"]
+        self._m_failures = m["lgbm_comm_failures_total"]
+        # span-trace correlation state: the comm session id (minted by
+        # the hub, learned by spokes in the handshake) + a per-instance
+        # collective sequence number derive cluster-unique collective
+        # trace ids; clock offset is this rank's wall clock vs the hub's
+        self._session = uuid.uuid4().bytes
+        self._seq = 0
+        self._clock_offset_s = 0.0
+        self._clock_rtt_s = 0.0
 
     @classmethod
     def from_config(cls, rank: int, world: int, machines: List[str],
@@ -393,14 +427,23 @@ class SocketComm:
         attempts = self.retry.retries + 1
         last: Optional[BaseException] = None
         for attempt in range(1, attempts + 1):
+            wc = self._world_changed
+            if wc is not None:
+                # the control plane already knows the membership is
+                # wrong — retrying the wire op would just burn the
+                # budget against sockets the fence deliberately killed
+                raise wc
             try:
                 if self._injector is not None:
                     if self._injector.check(op) == FaultInjector.DROP:
                         return _DROPPED
                 return fn()
-            except CommFailure:
+            except (CommFailure, WorldChangedError):
                 raise
             except (OSError, ConnectionError) as exc:
+                wc = self._world_changed
+                if wc is not None:
+                    raise wc
                 last = exc
                 if attempt >= attempts:
                     break
@@ -503,7 +546,8 @@ class SocketComm:
                                  nbytes=len(blob)):
                     sent = self._with_retry(
                         "send", i,
-                        lambda c=conn: _send_blob(c, blob, trace_id, span_id))
+                        lambda c=conn: _send_blob(c, blob, trace_id, span_id,
+                                                  self.generation))
                 if sent is not _DROPPED:
                     self._m_sent.inc(len(blob) + _FRAME_OVERHEAD)
             return out  # type: ignore[return-value]
@@ -517,20 +561,38 @@ class SocketComm:
         return None if got is _DROPPED else got
 
     # counted wire helpers: every frame is 8-byte length prefix +
-    # 24-byte trace header + blob, and blocking-recv time IS the
-    # rank-skew sync wait at this seam
+    # 33-byte trace/generation header + blob, and blocking-recv time IS
+    # the rank-skew sync wait at this seam
     def _send_counted(self, sock: socket.socket, obj,
                       trace_id: bytes = None, span_id: int = 0) -> None:
         blob = _encode(obj)
         _send_blob(sock, blob, trace_id if trace_id is not None
-                   else _ZERO_TRACE, span_id)
+                   else _ZERO_TRACE, span_id, self.generation)
         self._m_sent.inc(len(blob) + _FRAME_OVERHEAD)
 
     def _recv_counted(self, sock: socket.socket):
         t0 = time.monotonic()
-        blob, peer_trace, peer_span = _recv_frame(sock)
+        blob, peer_trace, peer_span, peer_gen, kind = _recv_frame(sock)
         self._m_wait.inc(time.monotonic() - t0)
         self._m_recv.inc(len(blob) + _FRAME_OVERHEAD)
+        if kind == FRAME_POISON:
+            # bounded-time failure propagation: a peer's control plane
+            # says the membership changed — abort this collective NOW
+            # instead of waiting out op timeouts against dead sockets
+            info = json.loads(blob.decode("utf-8"))
+            dead = info.get("dead", [])
+            me = getattr(self, "orig_rank", self.rank)
+            raise WorldChangedError(
+                "poison frame received", dead_ranks=dead,
+                generation=info.get("generation", peer_gen),
+                fenced=me in dead)
+        if peer_gen != self.generation:
+            # generation fencing: traffic from a rank still living in a
+            # previous (or future) incarnation of the world must never
+            # be mistaken for this one's payloads
+            raise WorldChangedError(
+                "frame from generation %d rejected" % peer_gen,
+                generation=self.generation)
         if peer_span:
             # mark the arrival with the SENDER's ids so the merged
             # timeline can connect this rank's wait to the peer's send
@@ -550,6 +612,512 @@ class SocketComm:
                 pass
         self._peers = []
         self._peer_ranks = []
+
+
+class ElasticComm(SocketComm):
+    """A SocketComm that survives rank death: generation-fenced world
+    formation, an active ping/pong control channel, and poison-frame
+    failure propagation.  resilience.elastic.ElasticSupervisor re-forms
+    one of these per world incarnation.
+
+    Formation runs on ONE port per original rank (its machine-list
+    entry + port_offset).  The hub is the lowest rank this process
+    believes alive; spokes dial every lower-ranked candidate in a
+    round-robin sweep until one accepts (a dead candidate refuses or
+    times out, so the sweep converges on the real hub).  Each spoke
+    sends a JSON JOIN on the connection that then becomes its data
+    plane, the hub answers with ASSIGN carrying the membership (original
+    ranks, hub first — the hub anchors rank 0 of every incarnation),
+    the generation, the comm session and the NTP-style clock pair; a
+    second connection per spoke becomes the control channel.  Initial
+    formation (generation 0) demands the full expected world; a
+    re-formation waits ``rejoin_window_s`` for restarted ranks to come
+    back (they adopt the hub's generation), then proceeds with whoever
+    joined — so a killed rank costs one rejoin window, never a hang.
+
+    After formation the hub's liveness monitor (resilience.comm
+    Heartbeat with consecutive-miss suspicion) PINGs every control
+    channel each ``heartbeat_s``; a control-channel EOF (process death)
+    or ``suspect_s`` of silence (hang, partition) convicts the rank.
+    Conviction FENCES it: ``_world_changed`` is set so in-flight
+    collectives abort with WorldChangedError instead of retrying, a
+    POISON frame goes to every surviving spoke, and the fenced rank's
+    sockets are shut down so no thread blocked in recv waits past the
+    suspicion timeout.  Spokes mirror the hub: their control thread
+    answers PINGs, treats POISON as world change and control-channel
+    EOF as hub death.  Fencing is one-way — a convicted rank that
+    wakes up finds its generation rejected and must rejoin at the next
+    re-formation window.
+
+    Split-brain caveat (documented, not solved — CAP is undefeated): a
+    spoke whose alive-view is stale keeps sweeping candidates until
+    ``timeout_s`` and then fails formation rather than electing a
+    second hub; a restarted rank that believes it is the hub will wait
+    out its own formation window and abort rather than hijack a world
+    it cannot see.
+    """
+
+    def __init__(self, orig_rank: int, machines: List[str],
+                 generation: int = 0, alive=None,
+                 timeout_s: float = 30.0, port_offset: int = 1,
+                 rejoin_window_s: float = 3.0, min_world: int = 1,
+                 heartbeat_s: float = 0.2, suspect_s: float = 1.0,
+                 retry: Optional[RetryPolicy] = None,
+                 op_timeout_s: float = 0.0,
+                 injector: Optional[FaultInjector] = None):
+        self.orig_rank = int(orig_rank)
+        self.machines = list(machines)
+        self.rejoin_window_s = max(float(rejoin_window_s), 0.05)
+        self.min_world = max(int(min_world), 1)
+        self._hb_interval = max(float(heartbeat_s), 1e-3)
+        self._suspect_s = max(float(suspect_s), self._hb_interval)
+        self._ctrl: Dict[int, dict] = {}      # hub: orig -> conn state
+        self._ctrl_sock: Optional[socket.socket] = None   # spoke: to hub
+        self._ctrl_thread: Optional[threading.Thread] = None
+        self._ctrl_stop = threading.Event()
+        self._fence_lock = threading.Lock()
+        self._fenced_origs: set = set()
+        alive_set = {int(a) for a in (alive if alive is not None
+                                      else range(len(self.machines)))}
+        alive_set.add(self.orig_rank)
+        self._alive = sorted(alive_set)
+        if self.orig_rank == self._alive[0]:
+            formed = self._form_hub(int(generation), timeout_s, port_offset)
+        else:
+            formed = self._form_spoke(int(generation), timeout_s, port_offset)
+        membership: List[int] = formed["membership"]
+        new_rank = membership.index(self.orig_rank)
+        self._init_state(new_rank, len(membership), timeout_s, retry,
+                         op_timeout_s, injector, formed["generation"])
+        self._session = formed["session"]
+        self._clock_offset_s, self._clock_rtt_s = formed.get("clock",
+                                                             (0.0, 0.0))
+        self.membership = list(membership)
+        self._publish_trace_identity()
+        if self.world > 1:
+            if new_rank == 0:
+                self._peers = [formed["data"][membership[i]]
+                               for i in range(1, self.world)]
+                self._peer_ranks = list(range(1, self.world))
+                now = time.monotonic()
+                self._ctrl = {o: {"sock": formed["ctrl"][o], "last": now,
+                                  "eof": False}
+                              for o in membership[1:]}
+            else:
+                self._peers = [formed["data"]]
+                self._peer_ranks = [0]
+                self._ctrl_sock = formed["ctrl"]
+            for s in self._peers:
+                s.settimeout(self.op_timeout)
+            self._start_control_plane()
+        log.info("elastic world formed: generation=%d membership=%s "
+                 "(orig rank %d -> %d/%d)", self.generation,
+                 self.membership, self.orig_rank, self.rank, self.world)
+
+    @classmethod
+    def from_config(cls, orig_rank: int, machines: List[str], config,
+                    generation: int = 0, alive=None,
+                    **kwargs) -> "ElasticComm":
+        """Construct with the elasticity knobs resolved from a Config
+        (tpu_elastic_heartbeat_ms / tpu_elastic_suspect_ms /
+        tpu_elastic_rejoin_s / tpu_elastic_min_world on top of the
+        tpu_comm_* resilience set)."""
+        kwargs.setdefault("retry", RetryPolicy.from_config(config))
+        kwargs.setdefault("op_timeout_s",
+                          float(getattr(config, "tpu_comm_op_timeout_s", 0.0)))
+        kwargs.setdefault("heartbeat_s", float(
+            getattr(config, "tpu_elastic_heartbeat_ms", 200.0)) / 1e3)
+        kwargs.setdefault("suspect_s", float(
+            getattr(config, "tpu_elastic_suspect_ms", 1000.0)) / 1e3)
+        kwargs.setdefault("rejoin_window_s",
+                          float(getattr(config, "tpu_elastic_rejoin_s", 3.0)))
+        kwargs.setdefault("min_world",
+                          int(getattr(config, "tpu_elastic_min_world", 1)))
+        return cls(orig_rank, machines, generation=generation, alive=alive,
+                   **kwargs)
+
+    # -- formation ------------------------------------------------------
+    def _addr(self, orig: int, port_offset: int) -> Tuple[str, int]:
+        host, port = self.machines[orig].rsplit(":", 1)
+        return host, int(port) + port_offset
+
+    def _form_hub(self, gen: int, timeout_s: float,
+                  port_offset: int) -> dict:
+        host, port = self._addr(self.orig_rank, port_offset)
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            srv.bind((host, port))
+        except OSError as e:
+            if not (e.errno == errno.EADDRNOTAVAIL
+                    or isinstance(e, socket.gaierror)):
+                srv.close()
+                raise
+            log.warning("elastic hub cannot bind %s:%d (%s) — binding "
+                        "all interfaces", host, port, e)
+            srv.bind(("", port))
+        srv.listen(max(len(self.machines) * 2, 2))
+        expected = set(self._alive) - {self.orig_rank}
+        everyone = set(range(len(self.machines))) - {self.orig_rank}
+        # initial formation demands the full expected world and may wait
+        # the whole timeout; a re-formation waits only the rejoin window,
+        # leaving early when every original rank is back
+        window = timeout_s if gen == 0 else self.rejoin_window_s
+        deadline = time.monotonic() + window
+        joins: Dict[int, tuple] = {}
+        try:
+            while True:
+                have = set(joins)
+                if have >= (expected if gen == 0 else everyone):
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                srv.settimeout(min(remaining, 0.25))
+                try:
+                    conn, _addr_ = srv.accept()
+                except socket.timeout:
+                    continue
+                conn.settimeout(timeout_s)
+                try:
+                    hello = _recv_msg(conn)
+                except (OSError, ConnectionError, ValueError):
+                    conn.close()
+                    continue
+                r = int(hello.get("orig_rank", -1))
+                if (hello.get("type") != "join"
+                        or not 0 <= r < len(self.machines)):
+                    conn.close()
+                    continue
+                if r in joins:
+                    # a restarted process supersedes its stale connection
+                    joins[r][0].close()
+                joins[r] = (conn, time.time())
+            if gen == 0 and not set(joins) >= expected:
+                missing = sorted(expected - set(joins))
+                for conn, _t1 in joins.values():
+                    conn.close()
+                srv.close()
+                raise ConnectionError(
+                    "elastic formation timed out after %.1fs: rank(s) %s "
+                    "never joined" % (timeout_s, missing))
+            # hub first: the hub anchors rank 0 of every incarnation, so
+            # the hub-and-spoke data plane never needs re-wiring
+            membership = [self.orig_rank] + sorted(joins)
+            if len(membership) < self.min_world:
+                # under-join is a TRANSIENT verdict — the absentees may
+                # just be late (still draining their own failed
+                # collectives).  ConnectionError, not WorldChangedError:
+                # nobody gets convicted, the supervisor burns one reform
+                # and retries, and the late ranks join the next attempt
+                for conn, _t1 in joins.values():
+                    conn.close()
+                srv.close()
+                raise ConnectionError(
+                    "cannot re-form generation %d: %d rank(s) joined "
+                    "within the %.1fs rejoin window but min_world=%d"
+                    % (gen, len(membership), window, self.min_world))
+            session = uuid.uuid4().bytes
+            for r, (conn, t1) in joins.items():
+                _send_msg(conn, {"type": "assign", "membership": membership,
+                                 "generation": gen,
+                                 "session": session.hex(),
+                                 "t1": t1, "t2": time.time()}, gen)
+            # second connection per member: the control channel
+            ctrl: Dict[int, socket.socket] = {}
+            cdl = time.monotonic() + timeout_s
+            while set(ctrl) < set(joins):
+                remaining = cdl - time.monotonic()
+                if remaining <= 0:
+                    for c in ctrl.values():
+                        c.close()
+                    for conn, _t1 in joins.values():
+                        conn.close()
+                    srv.close()
+                    raise ConnectionError(
+                        "control channel(s) missing from rank(s) %s"
+                        % sorted(set(joins) - set(ctrl)))
+                srv.settimeout(min(remaining, 0.25))
+                try:
+                    conn, _addr_ = srv.accept()
+                except socket.timeout:
+                    continue
+                conn.settimeout(timeout_s)
+                try:
+                    hello = _recv_msg(conn)
+                except (OSError, ConnectionError, ValueError):
+                    conn.close()
+                    continue
+                if hello.get("type") == "join":
+                    # a rank that missed the rejoin window: reject it
+                    # explicitly so it fails fast instead of timing out
+                    try:
+                        _send_msg(conn, {"type": "reject",
+                                         "generation": gen}, gen)
+                    except OSError:
+                        pass
+                    conn.close()
+                    continue
+                r = int(hello.get("orig_rank", -1))
+                if hello.get("type") != "ctrl" or r not in joins:
+                    conn.close()
+                    continue
+                ctrl[r] = conn
+        finally:
+            srv.close()
+        return {"membership": membership, "generation": gen,
+                "session": session,
+                "data": {r: conn for r, (conn, _t1) in joins.items()},
+                "ctrl": ctrl}
+
+    def _form_spoke(self, gen: int, timeout_s: float,
+                    port_offset: int) -> dict:
+        candidates = [c for c in self._alive if c < self.orig_rank]
+        deadline = time.monotonic() + timeout_s
+        conn = hub = None
+        # round-robin sweep: a dead candidate refuses instantly (or
+        # times out in 1 s); the real hub is the first that accepts
+        while conn is None:
+            for c in candidates:
+                if time.monotonic() >= deadline:
+                    break
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s.settimeout(1.0)
+                try:
+                    s.connect(self._addr(c, port_offset))
+                    conn, hub = s, c
+                    break
+                except OSError:
+                    s.close()
+            if conn is None:
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        "no elastic hub among candidate rank(s) %s "
+                        "within %.1fs" % (candidates, timeout_s))
+                time.sleep(0.1)
+        conn.settimeout(timeout_s + self.rejoin_window_s)
+        wall_t0 = time.time()
+        try:
+            _send_msg(conn, {"type": "join", "orig_rank": self.orig_rank,
+                             "generation": gen, "wall": wall_t0}, gen)
+            assign = _recv_msg(conn)
+        except (OSError, ConnectionError, ValueError) as e:
+            conn.close()
+            raise ConnectionError(
+                "hub candidate %d dropped the formation exchange: %s"
+                % (hub, e))
+        wall_t3 = time.time()
+        if assign.get("type") == "reject":
+            conn.close()
+            raise WorldChangedError(
+                "rejoin window missed: the world re-formed without "
+                "this rank", dead_ranks=[self.orig_rank],
+                generation=int(assign.get("generation", gen)), fenced=True)
+        if assign.get("type") != "assign":
+            conn.close()
+            raise ConnectionError("unexpected formation reply %r"
+                                  % assign.get("type"))
+        membership = [int(r) for r in assign["membership"]]
+        gen = int(assign["generation"])
+        t1, t2 = float(assign["t1"]), float(assign["t2"])
+        clock = (((t1 - wall_t0) + (t2 - wall_t3)) / 2.0,
+                 (wall_t3 - wall_t0) - (t2 - t1))
+        ctrl = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ctrl.settimeout(timeout_s)
+        try:
+            ctrl.connect(self._addr(hub, port_offset))
+            _send_msg(ctrl, {"type": "ctrl",
+                             "orig_rank": self.orig_rank}, gen)
+        except OSError:
+            ctrl.close()
+            conn.close()
+            raise
+        return {"membership": membership, "generation": gen,
+                "session": bytes.fromhex(assign["session"]),
+                "data": conn, "ctrl": ctrl, "clock": clock}
+
+    # -- control plane --------------------------------------------------
+    def _start_control_plane(self) -> None:
+        if self.rank == 0:
+            from ..obs import default_registry
+            suspect_after = max(
+                1, int(math.ceil(self._suspect_s / self._hb_interval)))
+            self._heartbeat = Heartbeat(
+                self._ctrl_probe, self._hb_interval, rank=self.rank,
+                world=self.world, registry=default_registry(),
+                suspect_after=suspect_after,
+                on_change=self._fence).start()
+        else:
+            self._ctrl_thread = threading.Thread(
+                target=self._ctrl_loop, name="lgbm-elastic-ctrl",
+                daemon=True)
+            self._ctrl_thread.start()
+
+    def _ctrl_probe(self) -> List[int]:
+        """Hub liveness probe (one Heartbeat round): PING every control
+        channel, drain PONGs, report ranks (ORIGINAL numbering) that are
+        closed or silent past the staleness bound."""
+        now = time.monotonic()
+        for orig, st in self._ctrl.items():
+            if st["eof"]:
+                continue
+            try:
+                _send_msg(st["sock"], {}, self.generation, FRAME_PING)
+            except OSError:
+                st["eof"] = True
+        socks = {st["sock"]: st for st in self._ctrl.values()
+                 if not st["eof"]}
+        while socks:
+            try:
+                readable, _, _ = select.select(list(socks), [], [], 0)
+            except (OSError, ValueError):
+                break
+            if not readable:
+                break
+            for s in readable:
+                st = socks.pop(s)
+                try:
+                    s.settimeout(1.0)
+                    _blob, _tr, _sp, g, kind = _recv_frame(s)
+                except (OSError, ConnectionError, ValueError):
+                    st["eof"] = True
+                    continue
+                if kind == FRAME_PONG and g == self.generation:
+                    st["last"] = now
+        stale_after = max(1.5 * self._hb_interval, 0.05)
+        unresponsive = []
+        for orig, st in self._ctrl.items():
+            if orig in self._fenced_origs:
+                continue
+            if st["eof"] or (now - st["last"]) > stale_after:
+                unresponsive.append(orig)
+        return unresponsive
+
+    def _fence(self, dead_origs: set) -> None:
+        """Heartbeat conviction-set transition: fence newly dead ranks.
+        One-way — a convicted rank that wakes up later finds its
+        generation rejected and must rejoin at the next re-formation."""
+        with self._fence_lock:
+            fresh = {int(r) for r in dead_origs} - self._fenced_origs
+            if not fresh:
+                return
+            self._fenced_origs |= fresh
+            all_dead = sorted(self._fenced_origs)
+        log.warning("elastic: fencing rank(s) %s at generation %d",
+                    sorted(fresh), self.generation)
+        # 1. our own collectives must stop retrying against the fence
+        self._world_changed = WorldChangedError(
+            "peer rank(s) fenced by liveness monitor",
+            dead_ranks=all_dead, generation=self.generation)
+        # 2. poison every surviving spoke so nobody blocks past this
+        poison = _encode({"dead": all_dead, "generation": self.generation})
+        for orig, st in self._ctrl.items():
+            if orig in all_dead or st["eof"]:
+                continue
+            try:
+                _send_blob(st["sock"], poison,
+                           generation=self.generation, kind=FRAME_POISON)
+            except OSError:
+                st["eof"] = True
+        # 3. shut the fenced ranks' sockets so any thread blocked in
+        # recv on them wakes immediately
+        for orig in fresh:
+            st = self._ctrl.get(orig)
+            if st is not None:
+                _shutdown(st["sock"])
+            if orig in self.membership:
+                idx = self.membership.index(orig)
+                if 1 <= idx <= len(self._peers):
+                    _shutdown(self._peers[idx - 1])
+
+    def _ctrl_loop(self) -> None:
+        """Spoke control thread: answer hub PINGs, treat POISON as a
+        world change and control-channel EOF as hub death; either way
+        shut our own data socket so the main thread never blocks past
+        the event."""
+        sock = self._ctrl_sock
+        hub_orig = self.membership[0]
+        while not self._ctrl_stop.is_set():
+            try:
+                readable, _, _ = select.select([sock], [], [], 0.25)
+            except (OSError, ValueError):
+                break
+            if not readable:
+                continue
+            try:
+                sock.settimeout(5.0)
+                blob, _tr, _sp, g, kind = _recv_frame(sock)
+            except (OSError, ConnectionError, ValueError):
+                if self._ctrl_stop.is_set():
+                    break
+                self._world_changed = WorldChangedError(
+                    "control channel to hub lost",
+                    dead_ranks=[hub_orig], generation=self.generation)
+                for s in self._peers:
+                    _shutdown(s)
+                break
+            if kind == FRAME_PING:
+                try:
+                    _send_msg(sock, {}, self.generation, FRAME_PONG)
+                except OSError:
+                    pass
+            elif kind == FRAME_POISON:
+                try:
+                    info = json.loads(blob.decode("utf-8"))
+                except ValueError:
+                    info = {}
+                dead = [int(r) for r in info.get("dead", [])]
+                self._world_changed = WorldChangedError(
+                    "world membership changed", dead_ranks=dead,
+                    generation=int(info.get("generation", g)),
+                    fenced=self.orig_rank in dead)
+                for s in self._peers:
+                    _shutdown(s)
+                break
+
+    # -- supervisor surface ---------------------------------------------
+    def world_changed(self) -> Optional[WorldChangedError]:
+        return self._world_changed
+
+    def fenced_ranks(self) -> List[int]:
+        """Original ranks this incarnation has fenced (hub) or been told
+        are dead (spoke)."""
+        wc = self._world_changed
+        dead = set(self._fenced_origs)
+        if wc is not None:
+            dead |= set(wc.dead_ranks)
+        return sorted(dead)
+
+    def close(self) -> None:
+        self._ctrl_stop.set()
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
+        if self._ctrl_sock is not None:
+            _shutdown(self._ctrl_sock)
+        if self._ctrl_thread is not None:
+            self._ctrl_thread.join(timeout=2.0)
+            self._ctrl_thread = None
+        for st in self._ctrl.values():
+            try:
+                st["sock"].close()
+            except OSError:
+                pass
+        self._ctrl = {}
+        if self._ctrl_sock is not None:
+            try:
+                self._ctrl_sock.close()
+            except OSError:
+                pass
+            self._ctrl_sock = None
+        super().close()
+
+
+def _shutdown(sock: socket.socket) -> None:
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
 
 
 def _json_default(o):
@@ -576,14 +1144,16 @@ def _maybe_span(tr, name: str, **args):
 
 
 def _send_blob(sock: socket.socket, blob: bytes,
-               trace_id: bytes = None, span_id: int = 0) -> None:
+               trace_id: bytes = None, span_id: int = 0,
+               generation: int = 0, kind: int = 0) -> None:
     sock.sendall(struct.pack("!q", len(blob))
                  + (trace_id if trace_id is not None else _ZERO_TRACE)
-                 + struct.pack("!q", span_id) + blob)
+                 + struct.pack("!qqB", span_id, generation, kind) + blob)
 
 
-def _send_msg(sock: socket.socket, obj) -> None:
-    _send_blob(sock, _encode(obj))
+def _send_msg(sock: socket.socket, obj, generation: int = 0,
+              kind: int = 0) -> None:
+    _send_blob(sock, _encode(obj), generation=generation, kind=kind)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -600,7 +1170,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _recv_frame(sock: socket.socket):
-    """-> (blob, sender trace-id bytes, sender span id)."""
+    """-> (blob, sender trace-id bytes, sender span id, generation,
+    frame kind)."""
     (n,) = struct.unpack("!q", _recv_exact(sock, 8))
     if n < 0 or n > _MAX_MSG:
         raise ConnectionError(
@@ -608,9 +1179,9 @@ def _recv_frame(sock: socket.socket):
             "length prefix, or a dataset so wide its mapper exchange "
             "exceeds the cap — raise distributed._MAX_MSG if the latter"
             % (n, _MAX_MSG))
-    hdr = _recv_exact(sock, 24)
-    (span_id,) = struct.unpack("!q", hdr[16:24])
-    return _recv_exact(sock, n), hdr[:16], span_id
+    hdr = _recv_exact(sock, _FRAME_OVERHEAD - 8)
+    span_id, generation, kind = struct.unpack("!qqB", hdr[16:33])
+    return _recv_exact(sock, n), hdr[:16], span_id, generation, kind
 
 
 def _recv_msg(sock: socket.socket):
@@ -622,6 +1193,16 @@ def _recv_msg(sock: socket.socket):
 # features) while still bounding what a garbage length prefix can make
 # us allocate
 _MAX_MSG = 8 << 30
-# per-frame wire overhead: 8-byte length + 16-byte trace-id + 8-byte span-id
-_FRAME_OVERHEAD = 32
+# per-frame wire overhead (v3): 8-byte length + 16-byte trace-id +
+# 8-byte span-id + 8-byte generation + 1-byte frame kind
+_FRAME_OVERHEAD = 41
 _ZERO_TRACE = b"\x00" * 16
+
+# frame kinds: DATA carries an allgather payload; POISON tells the
+# receiver the world membership changed (blob = {"dead": [...],
+# "generation": g}); PING/PONG are the ElasticComm control-channel
+# liveness probes (empty blobs)
+FRAME_DATA = 0
+FRAME_POISON = 1
+FRAME_PING = 2
+FRAME_PONG = 3

@@ -9,6 +9,10 @@ Two halves:
 - ``comm``: retry policy / fault injector / typed ``CommFailure`` /
   rank-liveness heartbeat that ``parallel.distributed.SocketComm``
   wraps around its wire operations.
+- ``elastic``: the degraded-world training supervisor — re-forms the
+  comm world at a smaller size when a rank dies, re-shards the row
+  partition and resumes from the newest checkpoint
+  (docs/Elasticity.md).
 
 See docs/Resilience.md for the checkpoint format and failure modes.
 """
@@ -16,10 +20,13 @@ from .checkpoint import (CheckpointData, CheckpointError, CheckpointManager,
                          CheckpointMismatchError, config_hash,
                          dataset_fingerprint, list_checkpoints, verify)
 from .comm import CommFailure, FaultInjector, Heartbeat, RetryPolicy
+from .elastic import (ElasticAborted, ElasticFenced, ElasticResult,
+                      ElasticSupervisor)
 
 __all__ = [
     "CheckpointData", "CheckpointError", "CheckpointManager",
-    "CheckpointMismatchError", "CommFailure", "FaultInjector", "Heartbeat",
-    "RetryPolicy", "config_hash", "dataset_fingerprint", "list_checkpoints",
-    "verify",
+    "CheckpointMismatchError", "CommFailure", "ElasticAborted",
+    "ElasticFenced", "ElasticResult", "ElasticSupervisor", "FaultInjector",
+    "Heartbeat", "RetryPolicy", "config_hash", "dataset_fingerprint",
+    "list_checkpoints", "verify",
 ]

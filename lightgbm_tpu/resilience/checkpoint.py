@@ -79,6 +79,19 @@ CONFIG_HASH_EXCLUDE = frozenset({
     "tpu_checkpoint_path", "tpu_checkpoint_interval", "tpu_checkpoint_keep",
     "tpu_comm_retries", "tpu_comm_backoff_ms", "tpu_comm_backoff_max_ms",
     "tpu_comm_op_timeout_s", "tpu_comm_heartbeat_s",
+    "tpu_elastic", "tpu_elastic_heartbeat_ms", "tpu_elastic_suspect_ms",
+    "tpu_elastic_rejoin_s", "tpu_elastic_min_world",
+    "tpu_elastic_max_reforms", "tpu_elastic_sync_every",
+    "tpu_serve_shed_queue_rows", "tpu_serve_shed_retry_after_s",
+    "tpu_serve_breaker_failures", "tpu_serve_breaker_reset_s",
+    "tpu_serve_drain_timeout_s",
+})
+
+# Additionally excluded for DEGRADED-WORLD (elastic) resume: topology
+# params legitimately change when the world re-forms at a different
+# size, and the per-rank row partition they drive is rebuilt anyway.
+ELASTIC_HASH_EXCLUDE = CONFIG_HASH_EXCLUDE | frozenset({
+    "num_machines", "pre_partition",
 })
 
 
@@ -91,11 +104,11 @@ class CheckpointMismatchError(CheckpointError):
     or against a differently-binned dataset."""
 
 
-def config_hash(config) -> str:
+def config_hash(config, exclude: frozenset = CONFIG_HASH_EXCLUDE) -> str:
     """Stable hash over the training-relevant half of the config."""
     from ..config import PARAMETER_SET
     payload = {name: getattr(config, name) for name in sorted(PARAMETER_SET)
-               if name not in CONFIG_HASH_EXCLUDE}
+               if name not in exclude}
     blob = json.dumps(payload, sort_keys=True, default=str)
     return hashlib.sha256(blob.encode("utf-8")).hexdigest()
 
@@ -167,12 +180,16 @@ class CheckpointManager:
     """
 
     def __init__(self, path: str, interval: int = 10, keep_last_n: int = 3,
-                 registry=None):
+                 registry=None, rank: int = 0):
         if not path:
             raise CheckpointError("CheckpointManager needs a directory path")
         self.path = str(path)
         self.interval = int(interval)
         self.keep_last_n = max(int(keep_last_n), 1)
+        # when several ranks share one tpu_checkpoint_path, only rank 0
+        # writes and sweeps — concurrent retention from multiple ranks
+        # would race rmtree against a sibling's in-flight rename
+        self.rank = max(int(rank), 0)
         if registry is None:
             from ..obs import default_registry
             registry = default_registry()
@@ -193,9 +210,13 @@ class CheckpointManager:
             return None
         return self.save(booster)
 
-    def save(self, booster) -> str:
+    def save(self, booster) -> Optional[str]:
         """Write one atomic checkpoint of the booster's CURRENT state
-        (model + trainer aux + scores), then apply retention."""
+        (model + trainer aux + scores), then apply retention.  A no-op
+        (None) on ranks > 0: every rank holds the same model, so one
+        writer suffices and shared-directory sweeps cannot race."""
+        if self.rank > 0:
+            return None
         with tracing.span("ckpt/save", "ckpt"):
             return self._save_impl(booster)
 
@@ -231,6 +252,8 @@ class CheckpointManager:
                 "boosting": state.get("boosting", ""),
                 "num_trees": model_str.count("\nTree="),
                 "config_hash": config_hash(gbdt.config),
+                "config_hash_elastic": config_hash(gbdt.config,
+                                                   ELASTIC_HASH_EXCLUDE),
                 "dataset_fingerprint": dataset_fingerprint(gbdt.train_set),
                 "created_at": time.time(),
                 "files": {
@@ -367,6 +390,54 @@ class CheckpointManager:
         log.info("Restored checkpoint %s: round %d, %d trees",
                  ckpt.path, ckpt.round, len(gbdt.models))
         return ckpt.round
+
+    @staticmethod
+    def restore_elastic(booster, ckpt: CheckpointData,
+                        raw_X: np.ndarray) -> int:
+        """Degraded-world restore: same training params, DIFFERENT row
+        shard (the elastic supervisor re-partitions after a world
+        re-formation, so strict ``restore`` would refuse on the dataset
+        fingerprint).  The config hash is checked with topology params
+        additionally excluded; the saved train score plane — which
+        indexes the OLD shard's rows — is discarded and rebuilt from
+        ``raw_X`` (this rank's current raw shard) via
+        ``rebuild_score_from_raw``.  Shard-independent score entries
+        (valid-set planes, DART's exact per-tree arrays) restore
+        verbatim.
+        """
+        with tracing.span("ckpt/restore_elastic", "ckpt", round=ckpt.round):
+            gbdt = getattr(booster, "_gbdt", booster)
+            want = ckpt.manifest.get("config_hash_elastic")
+            have = config_hash(gbdt.config, ELASTIC_HASH_EXCLUDE)
+            if want is None:
+                log.warning("checkpoint %s predates elastic config "
+                            "hashing; resuming without the config check",
+                            ckpt.path)
+            elif want != have:
+                raise CheckpointMismatchError(
+                    "config mismatch: checkpoint %s was taken with "
+                    "elastic config hash %s but this run resolves to %s "
+                    "— degraded-world resume allows topology changes, "
+                    "not training-parameter changes"
+                    % (ckpt.path, want[:12], have[:12]))
+            boosting = ckpt.state.get("boosting", "")
+            if boosting and boosting != type(gbdt).__name__.lower():
+                raise CheckpointMismatchError(
+                    "boosting mismatch: checkpoint is %r, booster is %r"
+                    % (boosting, type(gbdt).__name__.lower()))
+            gbdt.load_model_from_string(ckpt.model_str)
+            if gbdt.iter != ckpt.round:
+                raise CheckpointError(
+                    "checkpoint %s claims round %d but its model holds "
+                    "%d iterations" % (ckpt.path, ckpt.round, gbdt.iter))
+            gbdt.restore_aux_state(ckpt.state)
+            gbdt.restore_score_arrays(
+                {k: v for k, v in ckpt.scores.items() if k != "train"})
+            gbdt.rebuild_score_from_raw(raw_X)
+            log.info("Elastic-restored checkpoint %s: round %d, %d "
+                     "trees, train plane rebuilt for a %d-row shard",
+                     ckpt.path, ckpt.round, len(gbdt.models), len(raw_X))
+            return ckpt.round
 
 
 def list_checkpoints(path: str) -> List:

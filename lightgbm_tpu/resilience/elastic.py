@@ -1,0 +1,399 @@
+"""Elastic distributed training: degraded-world recovery supervisor.
+
+The reference's network stack treats any rank death as fatal — every
+surviving machine blocks in Allreduce until its socket times out and
+the job is lost (network.cpp:64-243 has no membership protocol at
+all).  Here the world is allowed to SHRINK: the supervisor wraps
+``engine.train`` in a re-formation loop so a killed, hung or
+partitioned rank costs one rejoin window and the rounds since the
+last checkpoint, never the job.
+
+One incarnation of the world = one ``parallel.distributed.ElasticComm``
+generation:
+
+1. form the world among the ranks still believed alive (the hub —
+   lowest surviving original rank — anchors rank 0 of every
+   incarnation);
+2. re-shard the data-parallel row partition for the NEW (rank, world)
+   with the same ``pre_partition_rows`` draw a fresh launch would use
+   — deterministic given the topology — and run distributed find-bin
+   so bin mappers stay identical across ranks;
+3. resume from the newest checkpoint under ``tpu_checkpoint_path``
+   via ``engine.train(resume_mode="reshard")``, which waives the
+   dataset fingerprint (the shard changed with the world) and rebuilds
+   the score plane from this rank's raw rows;
+4. train; a per-round sync collective is the failure-propagation seam:
+   when the liveness monitor fences a rank, every survivor's next
+   collective raises WorldChangedError, the supervisor tears the comm
+   down, marks the fenced ranks dead, and re-forms at generation+1.
+
+The recovered run is deterministic given the new topology: same
+checkpoint, same re-shard draw, same mappers.  It is NOT byte-identical
+to an undisturbed run — the row partition changed — which is the
+documented degraded-world promise (docs/Elasticity.md).
+
+Chaos hooks: ``LGBM_TPU_CHAOS=kill:<orig_rank>:<round>`` (also
+``exit:``/``slow:<orig>:<round>:<secs>``/``partition:<orig>:<round>``)
+makes that rank injure itself at the start of that round of generation
+0 — tools/chaos_run.py drives real multi-process scenarios with it.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import log
+from .checkpoint import CheckpointManager
+from .comm import CommFailure, FaultInjector
+
+CHAOS_ENV = "LGBM_TPU_CHAOS"
+
+
+class ElasticAborted(RuntimeError):
+    """Degraded-world recovery gave up: the world shrank below
+    ``tpu_elastic_min_world``, re-formed more than
+    ``tpu_elastic_max_reforms`` times, or failed to form at all."""
+
+
+class ElasticFenced(ElasticAborted):
+    """THIS rank was fenced by the survivors (missed the rejoin window
+    or was convicted by the liveness monitor).  The process should exit
+    quietly — the world has already moved on without it."""
+
+
+@dataclass
+class ElasticResult:
+    """What one rank's supervisor run produced."""
+    booster: Any                       # trained Booster (this rank's copy)
+    orig_rank: int                     # machine-list rank of this process
+    rank: int                          # rank in the FINAL incarnation
+    world: int                         # final world size
+    generation: int                    # final comm generation
+    reforms: int                       # world re-formations survived
+    dead_ranks: List[int] = field(default_factory=list)
+    recovery_s: float = 0.0            # total failure->re-formed seconds
+
+
+class ElasticSupervisor:
+    """Degraded-world training supervisor for one rank.
+
+    ``params`` is the ordinary train-parameter dict (must carry the
+    topology: ``machines``/``machine_list_filename`` + ``num_machines``;
+    ``tpu_checkpoint_path`` enables resume-on-re-form).  ``X``/``label``
+    are the FULL dataset — every rank loads the same arrays and keeps
+    only its partition, exactly like the fresh-launch pre-partition
+    path, so a re-shard needs no data movement.
+
+        sup = ElasticSupervisor(params, X, y, orig_rank=rank)
+        result = sup.run()            # -> ElasticResult
+    """
+
+    def __init__(self, params: Dict[str, Any], X, label, *,
+                 orig_rank: Optional[int] = None,
+                 machines: Optional[List[str]] = None,
+                 weight=None, group=None, init_score=None,
+                 categorical_features: Sequence[int] = (),
+                 num_boost_round: Optional[int] = None,
+                 callbacks: Optional[list] = None,
+                 port_offset: int = 1,
+                 timeout_s: Optional[float] = None,
+                 injector: Optional[FaultInjector] = None):
+        from ..config import Config
+        from ..parallel.distributed import parse_machines, resolve_rank
+        self.params = dict(params)
+        self.X = np.asarray(X)
+        self.label = None if label is None else np.asarray(label)
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.categorical_features = tuple(categorical_features)
+        self.callbacks = list(callbacks or [])
+        self.port_offset = int(port_offset)
+        self.injector = injector
+        cfg = Config(self.params)
+        self.cfg = cfg
+        self.machines = (list(machines) if machines is not None
+                         else parse_machines(cfg))
+        if orig_rank is not None:
+            self.orig_rank = int(orig_rank)
+        elif cfg.machine_rank >= 0:
+            self.orig_rank = int(cfg.machine_rank)
+        else:
+            self.orig_rank = resolve_rank(self.machines)
+        self.num_boost_round = int(
+            num_boost_round if num_boost_round is not None
+            else cfg.num_iterations)
+        self.timeout_s = float(
+            timeout_s if timeout_s is not None
+            else max(cfg.time_out, 1) * 1.0)
+        self._chaos_fired = False
+        self._metrics = None
+
+    # -- public ---------------------------------------------------------
+    def run(self) -> ElasticResult:
+        """Train to ``num_boost_round`` rounds, surviving rank deaths.
+
+        Raises ElasticFenced when THIS rank is voted out, ElasticAborted
+        when the world cannot recover (too small / too many reforms /
+        formation failure past the budget)."""
+        from ..parallel.distributed import ElasticComm, WorldChangedError
+        cfg = self.cfg
+        max_reforms = max(0, int(getattr(cfg, "tpu_elastic_max_reforms", 3)))
+        min_world = max(1, int(getattr(cfg, "tpu_elastic_min_world", 1)))
+        known_dead: set = set()
+        generation = 0
+        reforms = 0
+        recovery_s = 0.0
+        t_failure: Optional[float] = None
+        while True:
+            if self.orig_rank in known_dead:
+                raise ElasticFenced(
+                    "rank %d was fenced by the surviving world"
+                    % self.orig_rank)
+            alive = [r for r in range(len(self.machines))
+                     if r not in known_dead]
+            if len(alive) < min_world:
+                raise ElasticAborted(
+                    "world shrank to %d rank(s) < tpu_elastic_min_world=%d"
+                    % (len(alive), min_world))
+            comm = None
+            try:
+                comm = ElasticComm.from_config(
+                    self.orig_rank, self.machines, cfg,
+                    generation=generation, alive=alive,
+                    timeout_s=self.timeout_s,
+                    port_offset=self.port_offset,
+                    injector=self.injector)
+                generation = comm.generation
+                if t_failure is not None:
+                    dt = time.monotonic() - t_failure
+                    recovery_s += dt
+                    t_failure = None
+                    log.warning("elastic: world re-formed at generation %d "
+                                "(world %d) %.2fs after failure",
+                                generation, comm.world, dt)
+                self._publish(generation, comm.world, reforms, recovery_s)
+                booster = self._train_once(comm)
+                # final barrier: nobody tears the world down while a
+                # peer is still inside its last sync collective
+                comm.allgather({"type": "done", "orig": comm.orig_rank})
+                result = ElasticResult(
+                    booster=booster, orig_rank=self.orig_rank,
+                    rank=comm.rank, world=comm.world,
+                    generation=generation, reforms=reforms,
+                    dead_ranks=sorted(known_dead), recovery_s=recovery_s)
+                comm.close()
+                self._record(cfg, "complete", generation, comm.world,
+                             reforms, recovery_s)
+                return result
+            except WorldChangedError as exc:
+                dead = set(int(r) for r in exc.dead_ranks)
+                if exc.fenced or self.orig_rank in dead:
+                    if comm is not None:
+                        comm.close()
+                    raise ElasticFenced(
+                        "rank %d fenced at generation %d: %s"
+                        % (self.orig_rank, generation, exc)) from exc
+            except (CommFailure, ConnectionError, OSError) as exc:
+                # wire failure without a membership verdict.  For a spoke
+                # that exhausted its hub sweep, the candidates it could
+                # not reach are the dead set — marking them dead makes
+                # this rank the hub of the next incarnation, so the
+                # sweep converges instead of spinning.
+                dead = set()
+                if comm is None:
+                    dead = {r for r in alive if r < self.orig_rank}
+                log.warning("elastic: comm failure at generation %d (%s: "
+                            "%s)", generation, type(exc).__name__,
+                            str(exc).split("\n")[0][:200])
+                if not dead and comm is not None:
+                    # the wire failure raced the liveness verdict: give
+                    # the heartbeat/poison one suspicion window to
+                    # convict BEFORE tearing the world down, so every
+                    # survivor re-forms with the same dead set instead
+                    # of splitting on divergent alive views
+                    dead = self._await_verdict(comm)
+            if t_failure is None:
+                t_failure = time.monotonic()
+            if comm is not None:
+                dead |= set(comm.fenced_ranks())
+                try:
+                    comm.close()
+                except OSError:
+                    pass
+            if self.orig_rank in dead:
+                raise ElasticFenced(
+                    "rank %d fenced at generation %d (verdict arrived "
+                    "after a wire failure)" % (self.orig_rank, generation))
+            dead -= {self.orig_rank}
+            if not dead:
+                # a failure nobody was convicted for (e.g. hub formation
+                # raced a dying spoke): burn one reform and retry with
+                # the same alive view
+                log.warning("elastic: no conviction for the failure; "
+                            "retrying formation")
+            known_dead |= dead
+            reforms += 1
+            self._record(cfg, "reform", generation, len(alive) - len(dead),
+                         reforms, recovery_s, dead=sorted(known_dead))
+            if reforms > max_reforms:
+                raise ElasticAborted(
+                    "gave up after %d re-formation(s) "
+                    "(tpu_elastic_max_reforms=%d); dead ranks: %s"
+                    % (reforms, max_reforms, sorted(known_dead)))
+            generation += 1
+
+    def _await_verdict(self, comm) -> set:
+        """Poll the comm's membership verdict (heartbeat convictions on
+        the hub, the hub's poison broadcast on spokes) for up to one
+        suspicion window plus a few probes.  Returns the convicted set
+        (possibly containing THIS rank — the caller turns that into
+        ElasticFenced); empty when no verdict arrived in time."""
+        wait = comm._suspect_s + 3.0 * comm._hb_interval
+        deadline = time.monotonic() + wait
+        while time.monotonic() < deadline:
+            dead = set(comm.fenced_ranks())
+            wc = comm.world_changed()
+            if wc is not None:
+                dead |= {int(r) for r in wc.dead_ranks}
+                if wc.fenced:
+                    dead.add(self.orig_rank)
+            if dead:
+                return dead
+            time.sleep(min(comm._hb_interval, 0.05))
+        return set()
+
+    # -- one incarnation ------------------------------------------------
+    def _train_once(self, comm):
+        """Re-shard for the incarnation's (rank, world) and train, with
+        the per-round sync collective wired in as a callback."""
+        from ..basic import Dataset
+        from ..config import Config
+        from ..engine import train as engine_train
+        from ..parallel.dist_data import construct_rank_shard, \
+            pre_partition_rows
+        params = dict(self.params)
+        params["machine_rank"] = comm.rank
+        params["num_machines"] = comm.world
+        params.pop("machines", None)
+        params.pop("machine_list_filename", None)
+        cfg = Config(params)
+        shard = construct_rank_shard(
+            self.X, cfg, comm.rank, comm.world, comm,
+            label=self.label, group=self.group, weight=self.weight,
+            init_score=self.init_score,
+            categorical_features=self.categorical_features,
+            pre_partition=True)
+        # the raw rows of the SAME draw ride on the Dataset: the elastic
+        # restore rebuilds the score plane from them (restore_elastic)
+        qb = None
+        if self.group is not None:
+            qb = np.concatenate([[0], np.cumsum(np.asarray(self.group))])
+        keep, _ = pre_partition_rows(len(self.X), comm.rank, comm.world,
+                                     qb, seed=cfg.data_random_seed)
+        ds = Dataset(self.X[keep], params=params)
+        ds._binned = shard
+        resume = None
+        if cfg.tpu_checkpoint_path:
+            resume = CheckpointManager.latest(cfg.tpu_checkpoint_path)
+            if resume is not None:
+                log.info("elastic: rank %d/%d resuming from %s",
+                         comm.rank, comm.world, resume)
+        cbs = [self._sync_callback(comm, cfg)] + list(self.callbacks)
+        return engine_train(params, ds,
+                            num_boost_round=self.num_boost_round,
+                            resume_from=resume,
+                            resume_mode="reshard" if resume else "strict",
+                            callbacks=cbs)
+
+    def _sync_callback(self, comm, cfg):
+        """The failure-propagation seam: a tiny allgather every
+        ``tpu_elastic_sync_every`` rounds.  A fenced world turns the
+        next sync into WorldChangedError on every survivor, bounding
+        how far ranks can drift past a failure."""
+        every = max(1, int(getattr(cfg, "tpu_elastic_sync_every", 1)))
+
+        def _callback(env) -> None:
+            self._maybe_chaos(comm, env.iteration)
+            wc = comm.world_changed()
+            if wc is not None:
+                raise wc
+            if env.iteration % every:
+                return
+            comm.allgather({"type": "sync", "round": env.iteration,
+                            "orig": comm.orig_rank,
+                            "generation": comm.generation})
+
+        _callback.before_iteration = True
+        _callback.order = 1     # right after preemption (0)
+        return _callback
+
+    # -- chaos ----------------------------------------------------------
+    def _maybe_chaos(self, comm, round_idx: int) -> None:
+        """Self-inflicted failures for chaos testing, armed by the
+        LGBM_TPU_CHAOS env var (generation 0 only, once per process)."""
+        spec = os.environ.get(CHAOS_ENV)
+        if not spec or self._chaos_fired or comm.generation != 0:
+            return
+        try:
+            parts = spec.split(":")
+            kind, target, at = parts[0], int(parts[1]), int(parts[2])
+        except (ValueError, IndexError):
+            log.warning("unparseable %s=%r (want kind:rank:round[:secs])",
+                        CHAOS_ENV, spec)
+            return
+        if comm.orig_rank != target or round_idx < at:
+            return
+        self._chaos_fired = True
+        log.warning("chaos: %s on rank %d at round %d", kind,
+                    comm.orig_rank, round_idx)
+        if kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+            time.sleep(60)      # pragma: no cover — SIGKILL landed
+        elif kind == "exit":
+            os._exit(17)
+        elif kind in ("slow", "partition"):
+            # a hang/partition from the world's point of view: stop
+            # answering pings long enough for conviction (slow ranks
+            # resume and find themselves fenced)
+            secs = float(parts[3]) if len(parts) > 3 else 30.0
+            if comm._ctrl_sock is not None and kind == "partition":
+                from ..parallel.distributed import _shutdown
+                _shutdown(comm._ctrl_sock)
+            comm._ctrl_stop.set()       # stop answering hub pings
+            time.sleep(secs)
+        else:
+            log.warning("unknown chaos kind %r", kind)
+
+    # -- observability ---------------------------------------------------
+    def _publish(self, generation: int, world: int, reforms: int,
+                 recovery_s: float) -> None:
+        try:
+            from ..obs.adapters import ensure_elastic_metrics
+            from ..obs import default_registry
+            m = ensure_elastic_metrics(default_registry(),
+                                       rank=self.orig_rank)
+            m["generation"].set(generation)
+            m["world"].set(world)
+            m["reforms"].set(reforms)
+            m["recovery_s"].set(recovery_s)
+        except Exception:   # noqa: BLE001 — metrics never break training
+            pass
+
+    def _record(self, cfg, what: str, generation: int, world: int,
+                reforms: int, recovery_s: float, dead=None) -> None:
+        """One elastic lifecycle event into the telemetry JSONL (when
+        tpu_telemetry_path is configured); best-effort."""
+        try:
+            from ..obs.recorder import elastic_event
+            elastic_event(cfg, what, orig_rank=self.orig_rank,
+                          generation=generation, world=world,
+                          reforms=reforms, recovery_s=round(recovery_s, 4),
+                          dead_ranks=dead or [])
+        except Exception:   # noqa: BLE001
+            pass

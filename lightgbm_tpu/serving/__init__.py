@@ -6,6 +6,8 @@ concurrent requests (batcher.py), an in-process + stdlib-HTTP frontend
 (server.py, CLI task=serve), request-path observability (metrics.py)
 and a small client (client.py).  See docs/Serving.md.
 """
+from .admission import (CircuitBreaker, DrainingError,  # noqa: F401
+                        ShedError)
 from .batcher import (BatcherStoppedError, MicroBatcher,  # noqa: F401
                       QueueFullError, RequestTimeoutError)
 from .client import ServingClient, ServingError  # noqa: F401
@@ -19,4 +21,5 @@ __all__ = [
     "ModelRegistry", "ModelEntry", "ModelNotFoundError",
     "MicroBatcher", "QueueFullError", "RequestTimeoutError",
     "BatcherStoppedError", "ModelStats", "Histogram",
+    "CircuitBreaker", "DrainingError", "ShedError",
 ]

@@ -32,6 +32,7 @@ import numpy as np
 
 from ..obs import tracing
 from ..utils import log
+from .admission import DrainingError
 from .metrics import ModelStats
 
 
@@ -86,6 +87,8 @@ class MicroBatcher:
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
         self._stopped = False
+        self._draining = False
+        self._inflight = 0           # requests inside a dispatch right now
         self._worker = threading.Thread(
             target=self._run, name="lgbm-serve-batcher-%s" % (name or "?"),
             daemon=True)
@@ -116,6 +119,30 @@ class MicroBatcher:
         with self._lock:
             return self._queued_rows
 
+    # -- graceful drain ------------------------------------------------- #
+    def begin_drain(self) -> None:
+        """Stop admitting new work; queued and in-flight requests still
+        complete.  Irreversible for this batcher instance."""
+        with self._lock:
+            self._draining = True
+            self._not_empty.notify_all()
+
+    def drained(self) -> bool:
+        with self._lock:
+            return (self._draining and not self._queue
+                    and self._inflight == 0)
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """begin_drain() and wait until every admitted request finished
+        (or the timeout passes).  Returns True when fully drained."""
+        self.begin_drain()
+        deadline = time.perf_counter() + max(float(timeout_s), 0.0)
+        while not self.drained():
+            if time.perf_counter() >= deadline:
+                return False
+            time.sleep(0.005)
+        return True
+
     def submit(self, rows: np.ndarray,
                timeout_ms: Optional[float] = None) -> np.ndarray:
         """Blocking predict through the coalescing queue.
@@ -134,6 +161,9 @@ class MicroBatcher:
                 if self._stopped:
                     raise BatcherStoppedError(
                         "batcher %s stopped" % self.name)
+                if self._draining:
+                    raise DrainingError(
+                        "batcher %s is draining for shutdown" % self.name)
                 if self._queued_rows + req.n > self.max_queue_rows:
                     self.stats.record_reject()
                     raise QueueFullError(
@@ -183,6 +213,7 @@ class MicroBatcher:
                 batch.append(self._queue.pop(0))
                 taken += nxt.n
             self._queued_rows -= taken
+            self._inflight += len(batch)
             self.stats.set_queue_depth(self._queued_rows)
             return batch
 
@@ -193,31 +224,38 @@ class MicroBatcher:
                 if self._stopped:
                     return
                 continue
-            now = time.perf_counter()
-            live = []
-            for req in batch:
-                if req.cancelled or now >= req.deadline_t:
-                    req.cancelled = True    # expired in queue: don't pay
-                    continue                # the dispatch for a dead rider
-                live.append(req)
-                self.stats.record_wait((now - req.enqueue_t) * 1e3)
-            if not live:
-                continue
             try:
-                X = (live[0].rows if len(live) == 1
-                     else np.concatenate([r.rows for r in live], axis=0))
-                with tracing.span("serve/micro_batch", "serve",
-                                  rows=X.shape[0], riders=len(live),
-                                  model=self.name):
-                    out = np.asarray(self.predict_fn(X))
-                a = 0
-                for req in live:
-                    req.result = out[a:a + req.n]
-                    a += req.n
-                    req.event.set()
-            except BaseException as e:  # noqa: BLE001 — riders must wake
-                log.warning("serving batch dispatch failed: %s", e)
-                self.stats.record_error()
-                for req in live:
-                    req.error = e
-                    req.event.set()
+                self._dispatch(batch)
+            finally:
+                with self._lock:
+                    self._inflight -= len(batch)
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        now = time.perf_counter()
+        live = []
+        for req in batch:
+            if req.cancelled or now >= req.deadline_t:
+                req.cancelled = True    # expired in queue: don't pay
+                continue                # the dispatch for a dead rider
+            live.append(req)
+            self.stats.record_wait((now - req.enqueue_t) * 1e3)
+        if not live:
+            return
+        try:
+            X = (live[0].rows if len(live) == 1
+                 else np.concatenate([r.rows for r in live], axis=0))
+            with tracing.span("serve/micro_batch", "serve",
+                              rows=X.shape[0], riders=len(live),
+                              model=self.name):
+                out = np.asarray(self.predict_fn(X))
+            a = 0
+            for req in live:
+                req.result = out[a:a + req.n]
+                a += req.n
+                req.event.set()
+        except BaseException as e:  # noqa: BLE001 — riders must wake
+            log.warning("serving batch dispatch failed: %s", e)
+            self.stats.record_error()
+            for req in live:
+                req.error = e
+                req.event.set()

@@ -44,6 +44,8 @@ class ModelStats:
         self.host_batches = 0        # dispatches on the host walk
         self.host_fallback = 0       # overload requests served host-side
         self.rejected_queue_full = 0  # 429-style rejections
+        self.shed = 0                # admission-control sheds (429+Retry-After)
+        self.breaker_batches = 0     # batches forced host-side (breaker open)
         self.timeouts = 0            # requests that missed their deadline
         self.errors = 0              # predict-path exceptions
         self.queue_depth = 0         # live gauge (rows waiting)
@@ -77,6 +79,14 @@ class ModelStats:
         with self._lock:
             self.rejected_queue_full += 1
 
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed += 1
+
+    def record_breaker_batch(self) -> None:
+        with self._lock:
+            self.breaker_batches += 1
+
     def record_timeout(self) -> None:
         with self._lock:
             self.timeouts += 1
@@ -103,6 +113,8 @@ class ModelStats:
                 "host_batches": self.host_batches,
                 "host_fallback": self.host_fallback,
                 "rejected_queue_full": self.rejected_queue_full,
+                "shed": self.shed,
+                "breaker_batches": self.breaker_batches,
                 "timeouts": self.timeouts,
                 "errors": self.errors,
                 "queue_depth": self.queue_depth,

@@ -34,6 +34,7 @@ from ..obs import default_registry
 from ..obs import tracing as obs_tracing
 from ..utils import log
 from ..utils.profiling import Profiler
+from .admission import CircuitBreaker, DrainingError, ShedError
 from .batcher import (BatcherStoppedError, MicroBatcher, QueueFullError,
                       RequestTimeoutError)
 from .metrics import ModelStats
@@ -61,6 +62,8 @@ class Server:
             profiler=self.profiler)
         self._batchers: Dict[str, MicroBatcher] = {}
         self._stats: Dict[str, ModelStats] = {}
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._draining = False
         # GET /metrics renders the process-wide registry: per-model
         # request counters published below, plus the device gauges and
         # comm counter families (rank-0 defaults so the exposition
@@ -100,6 +103,9 @@ class Server:
                     max_queue_rows=cfg.serve_queue_rows,
                     timeout_ms=cfg.serve_request_timeout_ms,
                     stats=stats, name=name).start()
+                self._breakers[name] = CircuitBreaker(
+                    failure_threshold=cfg.tpu_serve_breaker_failures,
+                    reset_s=cfg.tpu_serve_breaker_reset_s)
                 obs_adapters.publish_model_stats(
                     self.metrics, name, stats,
                     queue_depth_fn=self._batchers[name].queue_depth_rows)
@@ -110,6 +116,7 @@ class Server:
         with self._lock:
             batcher = self._batchers.pop(name, None)
             self._stats.pop(name, None)
+            self._breakers.pop(name, None)
         if batcher is not None:
             batcher.stop()
         obs_adapters.unpublish_model_stats(self.metrics, name)
@@ -119,11 +126,34 @@ class Server:
     def _batch_predict(self, name: str, X: np.ndarray) -> np.ndarray:
         """The batcher's dispatch fn: resolve the CURRENT version at
         batch time (hot-swaps apply to the very next batch) and record
-        which path the batch rode."""
+        which path the batch rode.  The circuit breaker guards the
+        dispatch: while OPEN, batches ride the host walk — plain NumPy,
+        no compilation, always available — so a sick device path turns
+        into slower answers instead of an error storm."""
         entry = self.registry.get(name)
-        with self.profiler.phase("serve/batch_predict"):
-            out, device = entry.predict(X)
         stats = self._stats.get(name)
+        breaker = self._breakers.get(name)
+        if breaker is not None and not breaker.allow():
+            with self.profiler.phase("serve/breaker_host"):
+                out = entry.booster._gbdt.predict(X, device=False)
+            if stats is not None:
+                stats.record_breaker_batch()
+                stats.record_batch(X.shape[0], device=False)
+            return np.asarray(out)
+        try:
+            with self.profiler.phase("serve/batch_predict"):
+                out, device = entry.predict(X)
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure()
+                if breaker.state == CircuitBreaker.OPEN:
+                    log.warning("serving: circuit breaker for %s OPENED "
+                                "(%d consecutive failures); batches ride "
+                                "the host path for %.1fs", name,
+                                breaker.failure_threshold, breaker.reset_s)
+            raise
+        if breaker is not None:
+            breaker.record_success()
         if stats is not None:
             stats.record_batch(X.shape[0], device)
         return np.asarray(out)
@@ -139,11 +169,25 @@ class Server:
             X = X[None, :]
         if X.ndim != 2 or X.shape[0] == 0:
             raise ValueError("rows must be [n, features] with n >= 1")
+        if self._draining:
+            # whole-server state, checked before the model lookup: a
+            # drained server answers 503 even for evicted models
+            raise DrainingError("server is draining for shutdown")
         with self._lock:
             batcher = self._batchers.get(name)
             stats = self._stats.get(name)
         if batcher is None:
             raise ModelNotFoundError(name)
+        shed_rows = self.config.tpu_serve_shed_queue_rows
+        if shed_rows > 0 and (batcher.queue_depth_rows() + X.shape[0]
+                              > shed_rows):
+            # shed at the door: the queue never grows past the watermark
+            # and the client gets an explicit come-back-later hint
+            stats.record_shed()
+            raise ShedError(
+                "shedding load: %d rows queued (+%d over the %d watermark)"
+                % (batcher.queue_depth_rows(), X.shape[0], shed_rows),
+                retry_after_s=self.config.tpu_serve_shed_retry_after_s)
         stats.record_request(X.shape[0])
         t0 = time.perf_counter()
         with obs_tracing.span("serve/request", "serve", rows=X.shape[0],
@@ -171,12 +215,15 @@ class Server:
         with self._lock:
             stats = dict(self._stats)
             batchers = dict(self._batchers)
+            breakers = {n: b.snapshot() for n, b in self._breakers.items()}
         return {
             "uptime_s": round(time.time() - self._start_t, 3),
+            "draining": self._draining,
             "models": {name: dict(s.snapshot(),
                                   queue_depth=batchers[name]
                                   .queue_depth_rows()
-                                  if name in batchers else 0)
+                                  if name in batchers else 0,
+                                  breaker=breakers.get(name))
                        for name, s in stats.items()},
             "registry": self.registry.info(),
             "phases": self.profiler.snapshot(),
@@ -216,6 +263,67 @@ class Server:
     def http_port(self) -> Optional[int]:
         return self._httpd.server_address[1] if self._httpd else None
 
+    # -- readiness + graceful drain ------------------------------------ #
+    def is_ready(self) -> bool:
+        """Readiness (GET /readyz): serving traffic is welcome — not
+        draining and at least one model loaded.  Liveness (/livez) is
+        unconditional: a draining server is alive, just not ready."""
+        return not self._draining and bool(self.registry.names())
+
+    def begin_drain(self) -> None:
+        """Flip to draining: /readyz goes 503 (so load balancers stop
+        sending), new predicts get DrainingError, queued + in-flight
+        requests keep going."""
+        if self._draining:
+            return
+        self._draining = True
+        with self._lock:
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            b.begin_drain()
+        log.info("serving: draining — no new work admitted, %d batcher(s) "
+                 "finishing in-flight requests", len(batchers))
+
+    def drain_and_shutdown(self, timeout_s: Optional[float] = None) -> bool:
+        """Graceful termination: drain every batcher within `timeout_s`
+        (Config.tpu_serve_drain_timeout_s by default), then shut the
+        HTTP frontend and workers down.  Returns True when every
+        admitted request completed before the deadline."""
+        if timeout_s is None:
+            timeout_s = self.config.tpu_serve_drain_timeout_s
+        self.begin_drain()
+        deadline = time.perf_counter() + max(float(timeout_s), 0.0)
+        with self._lock:
+            batchers = list(self._batchers.values())
+        clean = True
+        for b in batchers:
+            clean &= b.drain(max(deadline - time.perf_counter(), 0.0))
+        if not clean:
+            log.warning("serving: drain timed out after %.1fs; remaining "
+                        "requests get BatcherStoppedError", timeout_s)
+        self.shutdown()
+        return clean
+
+    def install_signal_handlers(self) -> bool:
+        """SIGTERM -> drain_and_shutdown in a background thread (the
+        handler itself must return immediately so serve_forever's accept
+        loop keeps answering in-flight connections).  Returns False when
+        not on the main thread (signals unavailable)."""
+        import signal as signal_mod
+
+        def on_term(signum, _frame):
+            log.warning("serving: signal %d — starting graceful drain "
+                        "(timeout %.1fs)", signum,
+                        self.config.tpu_serve_drain_timeout_s)
+            threading.Thread(target=self.drain_and_shutdown,
+                             name="lgbm-serve-drain", daemon=True).start()
+
+        try:
+            signal_mod.signal(signal_mod.SIGTERM, on_term)
+        except ValueError:
+            return False
+        return True
+
     def shutdown(self) -> None:
         httpd, self._httpd = self._httpd, None
         if httpd is not None:
@@ -243,11 +351,14 @@ def _make_handler(server: Server):
         def log_message(self, fmt, *args):  # route through our logger
             log.debug("http: " + fmt, *args)
 
-        def _reply(self, code: int, payload: Dict) -> None:
+        def _reply(self, code: int, payload: Dict,
+                   headers: Optional[Dict[str, str]] = None) -> None:
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -274,9 +385,22 @@ def _make_handler(server: Server):
                 self._reply(200, server.stats_snapshot())
             elif path == "/models":
                 self._reply(200, {"models": server.registry.info()})
-            elif path in ("/healthz", "/health"):
+            elif path in ("/healthz", "/health", "/livez"):
+                # liveness: the process is up and answering — even while
+                # draining (kill a live-but-draining pod and you abandon
+                # its in-flight requests)
                 self._reply(200, {"status": "ok",
                                   "models": server.registry.names()})
+            elif path == "/readyz":
+                # readiness: route traffic here?  503 while draining or
+                # model-less so load balancers rotate this replica out
+                if server.is_ready():
+                    self._reply(200, {"status": "ready",
+                                      "models": server.registry.names()})
+                else:
+                    self._reply(503, {
+                        "status": ("draining" if server._draining
+                                   else "no models loaded")})
             else:
                 self._reply(404, {"error": "unknown path %s" % path})
 
@@ -300,11 +424,17 @@ def _make_handler(server: Server):
                     self._reply(404, {"error": "unknown path %s" % path})
             except ModelNotFoundError as e:
                 self._reply(404, {"error": "unknown model %s" % e})
+            except ShedError as e:
+                self._reply(429, {"error": str(e)},
+                            headers={"Retry-After": "%d" % max(
+                                1, int(round(e.retry_after_s)))})
             except QueueFullError as e:
-                self._reply(429, {"error": str(e)})
+                self._reply(429, {"error": str(e)},
+                            headers={"Retry-After": "%d" % max(1, int(round(
+                                server.config.tpu_serve_shed_retry_after_s)))})
             except RequestTimeoutError as e:
                 self._reply(504, {"error": str(e)})
-            except BatcherStoppedError as e:
+            except (BatcherStoppedError, DrainingError) as e:
                 self._reply(503, {"error": str(e)})
             except (ValueError, TypeError, log.LightGBMError) as e:
                 self._reply(400, {"error": str(e)})

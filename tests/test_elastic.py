@@ -1,0 +1,291 @@
+"""Elastic training + serving admission control (ISSUE 5 acceptance).
+
+Three families:
+
+- elastic recovery: a REAL 3-process world (tools/chaos_run.py) with one
+  rank SIGKILLed mid-iteration must fence the victim, re-form at world 2
+  and finish from the newest checkpoint WITHOUT hanging — the whole
+  drill runs under a hard subprocess timeout.
+- serving admission: overload answers 429 + Retry-After at the door (the
+  queue never grows past the shed watermark), SIGTERM drains gracefully
+  (in-flight requests finish; /readyz flips 503 while /livez stays 200).
+- circuit breaker: closed -> open after N consecutive failures, exactly
+  one half-open probe after reset_s, and an OPEN breaker reroutes
+  batches onto the always-available host walk.
+"""
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import (CircuitBreaker, DrainingError, Server,
+                                  ShedError)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHAOS = os.path.join(ROOT, "tools", "chaos_run.py")
+
+
+def _run_chaos(scenario, timeout_s=300):
+    """Drive tools/chaos_run.py exactly as CI does; returns (rc, summary).
+    The subprocess timeout is the no-hang guarantee: a survivor stuck in
+    a fenced collective would blow it."""
+    proc = subprocess.run(
+        [sys.executable, CHAOS, "--scenario", scenario, "--fast",
+         "--timeout", "150"],
+        capture_output=True, text=True, timeout=timeout_s,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    txt = proc.stdout
+    start = txt.rfind("\n{")
+    summary = json.loads(txt[start:] if start >= 0 else txt)
+    return proc.returncode, summary
+
+
+class TestElasticRecovery:
+    def test_kill_rank_mid_iteration_recovers(self):
+        """One rank SIGKILLed mid-iteration: both survivors detect the
+        death, re-form at world 2 (generation 1), resume from the newest
+        checkpoint and deliver full-length models."""
+        rc, s = _run_chaos("kill_rank")
+        assert rc == 0 and s["ok"] is True, s
+        assert s["completed_ranks"] == [0, 1]
+        for o in s["results"].values():
+            assert o["outcome"] == "complete"
+            assert o["world"] == 2 and o["generation"] >= 1
+            assert o["reforms"] >= 1 and s["victim"] in o["dead_ranks"]
+            assert o["num_trees"] >= s["rounds"]
+        assert 0.0 < s["recovery_s"] < 30.0
+
+    @pytest.mark.slow
+    def test_control_run_unharmed(self):
+        """No injury: all three ranks complete at world 3, zero reforms."""
+        rc, s = _run_chaos("none")
+        assert rc == 0 and s["ok"] is True, s
+        assert s["completed_ranks"] == [0, 1, 2]
+        assert all(o["world"] == 3 and o["reforms"] == 0
+                   for o in s["results"].values())
+
+    @pytest.mark.slow
+    def test_kill_hub_survivors_reanchor(self):
+        """Killing rank 0 forces the survivors to elect a new hub."""
+        rc, s = _run_chaos("kill_hub")
+        assert rc == 0 and s["ok"] is True, s
+        assert s["completed_ranks"] == [1, 2]
+
+
+# --------------------------------------------------------------------- #
+# serving admission control
+# --------------------------------------------------------------------- #
+def _train(params=None, n=300, nf=8, iters=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, nf)
+    y = 2.0 * X[:, 0] - X[:, 1] + 0.05 * rng.randn(n)
+    base = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+            "min_data_in_leaf": 5}
+    base.update(params or {})
+    bst = lgb.Booster(params=base, train_set=lgb.Dataset(X, label=y))
+    for _ in range(iters):
+        bst.update()
+    return bst
+
+
+@pytest.fixture(scope="module")
+def booster():
+    return _train()
+
+
+def _server(booster, **over):
+    params = {"serve_batch_wait_ms": 5.0, "serve_warmup_buckets": [1, 8],
+              "serve_request_timeout_ms": 30_000.0}
+    params.update(over)
+    srv = Server(params)
+    srv.load_model("default", model_str=booster.model_to_string())
+    return srv
+
+
+class TestLoadShedding:
+    def test_shed_at_watermark_before_enqueue(self, booster):
+        """A request that would push the queue past the watermark is
+        refused AT THE DOOR with the configured Retry-After hint — it
+        never enqueues, so the queue is bounded by construction."""
+        srv = _server(booster, tpu_serve_shed_queue_rows=1,
+                      tpu_serve_shed_retry_after_s=2.5)
+        try:
+            X = np.random.RandomState(1).rand(3, 8)
+            with pytest.raises(ShedError) as ei:
+                srv.predict(X)                       # 0 queued + 3 > 1
+            assert ei.value.retry_after_s == 2.5
+            out = srv.predict(X[:1])                 # 0 + 1 <= 1 admitted
+            np.testing.assert_array_equal(out, booster.predict(X[:1]))
+            snap = srv.stats_snapshot()["models"]["default"]
+            assert snap["shed"] == 1 and snap["requests"] == 1
+        finally:
+            srv.shutdown()
+
+    def test_shed_answers_429_with_retry_after_header(self, booster):
+        srv = _server(booster, tpu_serve_shed_queue_rows=1,
+                      tpu_serve_shed_retry_after_s=2.0)
+        httpd = srv.serve_http(port=0, block=False)
+        try:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", httpd.server_address[1], timeout=10)
+            body = json.dumps({"rows": [[0.1] * 8] * 4})
+            conn.request("POST", "/predict", body,
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 429
+            assert resp.getheader("Retry-After") == "2"
+            assert "shedding load" in json.loads(resp.read())["error"]
+            conn.close()
+        finally:
+            srv.shutdown()
+
+
+class TestDrain:
+    def test_readyz_flips_while_livez_stays_up(self, booster):
+        srv = _server(booster)
+        httpd = srv.serve_http(port=0, block=False)
+        port = httpd.server_address[1]
+
+        def get(path):
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            resp.read()
+            conn.close()
+            return resp.status
+
+        try:
+            assert get("/livez") == 200 and get("/readyz") == 200
+            srv.begin_drain()
+            assert get("/livez") == 200      # process is alive…
+            assert get("/readyz") == 503     # …but takes no new traffic
+            with pytest.raises(DrainingError):
+                srv.predict(np.zeros((1, 8)))
+        finally:
+            srv.shutdown()
+
+    def test_drain_finishes_inflight_requests(self, booster):
+        """Requests sitting in the queue when the drain starts still get
+        answers; only NEW admissions are refused."""
+        srv = _server(booster, serve_batch_wait_ms=300.0,
+                      serve_max_batch_rows=1024)
+        X = np.random.RandomState(2).rand(2, 8)
+        out, err = [], []
+
+        def rider():
+            try:
+                out.append(srv.predict(X))
+            except Exception as e:  # noqa: BLE001 — assert below
+                err.append(e)
+
+        t = threading.Thread(target=rider)
+        t.start()
+        time.sleep(0.05)                     # rider is queued, waiting
+        try:
+            assert srv.drain_and_shutdown(timeout_s=10.0) is True
+            t.join(timeout=10.0)
+            assert not t.is_alive() and not err
+            np.testing.assert_array_equal(out[0], booster.predict(X))
+            with pytest.raises(DrainingError):
+                srv.predict(X)
+        finally:
+            srv.shutdown()
+
+    def test_sigterm_triggers_graceful_drain(self, booster):
+        """Satellite: SIGTERM -> background drain -> shutdown, without
+        killing the process (pytest keeps running)."""
+        srv = _server(booster)
+        prev = signal.getsignal(signal.SIGTERM)
+        try:
+            assert srv.install_signal_handlers() is True
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.monotonic() + 10.0
+            while not srv._draining and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert srv._draining, "SIGTERM did not start the drain"
+            deadline = time.monotonic() + 10.0
+            while srv._httpd is not None and time.monotonic() < deadline:
+                time.sleep(0.01)
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+            srv.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# circuit breaker
+# --------------------------------------------------------------------- #
+class TestCircuitBreaker:
+    def test_opens_after_consecutive_failures_only(self):
+        t = [0.0]
+        br = CircuitBreaker(failure_threshold=3, reset_s=10.0,
+                            clock=lambda: t[0])
+        br.record_failure()
+        br.record_failure()
+        br.record_success()                  # streak broken
+        br.record_failure()
+        br.record_failure()
+        assert br.state == CircuitBreaker.CLOSED and br.allow()
+        br.record_failure()                  # third CONSECUTIVE
+        assert br.state == CircuitBreaker.OPEN and not br.allow()
+        assert br.open_count == 1
+
+    def test_half_open_single_probe_then_close(self):
+        t = [0.0]
+        br = CircuitBreaker(failure_threshold=1, reset_s=5.0,
+                            clock=lambda: t[0])
+        br.record_failure()
+        assert not br.allow()
+        t[0] = 5.1
+        assert br.allow()                    # the one half-open probe
+        assert br.state == CircuitBreaker.HALF_OPEN
+        assert not br.allow()                # concurrent probe denied
+        br.record_success()
+        assert br.state == CircuitBreaker.CLOSED and br.allow()
+
+    def test_half_open_failure_reopens_for_full_reset(self):
+        t = [0.0]
+        br = CircuitBreaker(failure_threshold=1, reset_s=5.0,
+                            clock=lambda: t[0])
+        br.record_failure()
+        t[0] = 5.1
+        assert br.allow()
+        br.record_failure()                  # probe failed
+        assert br.state == CircuitBreaker.OPEN and br.open_count == 2
+        t[0] = 10.0                          # only 4.9s into the window
+        assert not br.allow()
+        t[0] = 10.3
+        assert br.allow()
+
+    def test_open_breaker_forces_host_walk(self, booster):
+        """Server integration: a failing device dispatch trips the
+        breaker, after which predictions still answer — rerouted to the
+        host walk — and the breaker_batches counter proves the path."""
+        srv = _server(booster, tpu_serve_breaker_failures=2,
+                      tpu_serve_breaker_reset_s=60.0)
+        X = np.random.RandomState(3).rand(2, 8)
+        try:
+            entry = srv.registry.get("default")
+
+            def boom(_X):
+                raise RuntimeError("device exploded")
+
+            entry.predict = boom
+            for _ in range(2):
+                with pytest.raises(RuntimeError, match="device exploded"):
+                    srv.predict(X)
+            assert srv._breakers["default"].state == CircuitBreaker.OPEN
+            out = srv.predict(X)             # host walk, no entry.predict
+            np.testing.assert_array_equal(out, booster.predict(X))
+            snap = srv.stats_snapshot()["models"]["default"]
+            assert snap["breaker_batches"] >= 1
+            assert snap["breaker"]["state"] == CircuitBreaker.OPEN
+        finally:
+            srv.shutdown()

@@ -340,3 +340,59 @@ class TestHeartbeat:
         dead.remove(2)  # a rank coming back is observed too
         assert hb.poll_once() == [3]
         assert gauge.value == 3
+
+    def test_detection_latency_bounded(self):
+        """A silent rank is convicted within interval_s * suspect_after
+        plus one probe (the documented bound), not eventually."""
+        import time
+        dead = set()
+        interval, after = 0.02, 3
+        hb = Heartbeat(lambda: sorted(dead), interval_s=interval,
+                       rank=0, world=3, suspect_after=after).start()
+        try:
+            time.sleep(4 * interval)          # healthy warm-up window
+            assert hb.alive() and hb.dead_ranks() == []
+            t0 = time.monotonic()
+            dead.add(2)
+            while hb.alive() and time.monotonic() - t0 < 5.0:
+                time.sleep(interval / 4)
+            latency = time.monotonic() - t0
+            assert hb.dead_ranks() == [2]
+            # bound plus generous CI scheduling slack
+            assert latency < interval * (after + 1) + 1.0
+        finally:
+            hb.stop()
+
+    def test_single_miss_never_flaps(self):
+        """With suspect_after=2 an alternating miss/answer pattern —
+        GC pause, one dropped packet — never convicts; two CONSECUTIVE
+        misses do."""
+        hb = Heartbeat(lambda: [], interval_s=60.0, world=2,
+                       suspect_after=2)
+        for missing in ([1], [], [1], [], [1]):
+            hb.probe = lambda m=missing: m
+            hb.poll_once()
+            assert hb.alive(), "a lone miss must not convict"
+        assert hb.suspect_ranks() == [1]   # last round left one miss
+        hb.probe = lambda: [1]
+        hb.poll_once()                     # second consecutive miss
+        assert hb.dead_ranks() == [1] and not hb.alive()
+
+    def test_gauge_recovers_after_transient_stall(self):
+        """A CONVICTED rank that answers again is un-declared and the
+        alive-ranks gauge climbs back to the full world."""
+        reg = default_registry()
+        missing = [3]
+        hb = Heartbeat(lambda: list(missing), interval_s=60.0, rank=1,
+                       world=4, registry=reg, suspect_after=2)
+        gauge = reg.gauge("lgbm_comm_alive_ranks", rank="1", world="4")
+        transitions = []
+        hb.on_change = lambda d: transitions.append(sorted(d))
+        hb.poll_once()
+        assert gauge.value == 4            # suspected, not yet convicted
+        hb.poll_once()
+        assert gauge.value == 3 and hb.dead_ranks() == [3]
+        missing.clear()                    # stall heals
+        hb.poll_once()
+        assert gauge.value == 4 and hb.alive()
+        assert transitions == [[3], []]

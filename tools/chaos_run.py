@@ -1,0 +1,190 @@
+"""Chaos driver for elastic distributed training (resilience/elastic.py).
+
+Launches a REAL multi-process world on localhost, injures one rank
+mid-training, and verifies the survivors detect the failure, re-form at
+the reduced world size, resume from the newest checkpoint and finish —
+printing one JSON summary with the measured recovery time.
+
+    python tools/chaos_run.py --scenario kill_rank          # SIGKILL
+    python tools/chaos_run.py --scenario slow_rank          # hang > suspect
+    python tools/chaos_run.py --scenario partition          # ctrl cut
+    python tools/chaos_run.py --scenario kill_hub           # kill rank 0
+    python tools/chaos_run.py --scenario none               # control run
+    python tools/chaos_run.py --scenario kill_rank --fast   # CI smoke
+
+Exit code 0 iff the scenario's expectations held (survivors completed
+at the expected world size with a usable model).  The injury rides the
+LGBM_TPU_CHAOS env hook (kind:orig_rank:round[:secs]) the supervisor's
+sync callback honours at generation 0.
+"""
+import argparse
+import json
+import multiprocessing as mp
+import os
+import socket
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _data(n: int, f: int = 8, seed: int = 7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def _worker(orig_rank, machines, params, n_rows, rounds, q):
+    """One rank's process: build the shared synthetic dataset and run
+    the supervisor; report the outcome on the queue."""
+    from lightgbm_tpu.resilience.elastic import (ElasticAborted,
+                                                 ElasticFenced,
+                                                 ElasticSupervisor)
+    X, y = _data(n_rows)
+    sup = ElasticSupervisor(dict(params), X, y, orig_rank=orig_rank,
+                            machines=machines, num_boost_round=rounds,
+                            port_offset=0, timeout_s=30.0)
+    try:
+        r = sup.run()
+        q.put((orig_rank, {
+            "outcome": "complete", "rank": r.rank, "world": r.world,
+            "generation": r.generation, "reforms": r.reforms,
+            "dead_ranks": r.dead_ranks,
+            "recovery_s": round(r.recovery_s, 3),
+            "num_trees": r.booster.num_trees(),
+        }))
+    except ElasticFenced as e:
+        q.put((orig_rank, {"outcome": "fenced", "error": str(e)}))
+    except ElasticAborted as e:
+        q.put((orig_rank, {"outcome": "aborted", "error": str(e)}))
+
+
+SCENARIOS = ("kill_rank", "kill_hub", "slow_rank", "partition", "none")
+
+
+def run_scenario(scenario: str, world: int = 3, rounds: int = 8,
+                 n_rows: int = 240, chaos_round: int = 3,
+                 join_timeout_s: float = 120.0) -> dict:
+    """Run one chaos scenario; returns the summary dict (see main)."""
+    assert scenario in SCENARIOS, scenario
+    victim = {"kill_rank": world - 1, "kill_hub": 0,
+              "slow_rank": world - 1, "partition": world - 1}.get(scenario)
+    tmp = tempfile.mkdtemp(prefix="lgbm_chaos_")
+    machines = ",".join("127.0.0.1:%d" % _free_port() for _ in range(world))
+    params = {
+        "objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+        "verbosity": -1,
+        "num_machines": world, "machines": machines,
+        "tree_learner": "data", "pre_partition": True,
+        "tpu_elastic": True,
+        "tpu_elastic_heartbeat_ms": 100.0, "tpu_elastic_suspect_ms": 500.0,
+        # min_world=2 is the quorum knob: a stalled/partitioned victim
+        # that never heard the poison aborts instead of re-forming a
+        # zombie world of one (the split-brain caveat in Elasticity.md)
+        "tpu_elastic_rejoin_s": 1.0,
+        "tpu_elastic_min_world": max(1, min(2, world - 1)),
+        "tpu_checkpoint_path": os.path.join(tmp, "ckpts"),
+        "tpu_checkpoint_interval": 1,
+    }
+    env_chaos = None
+    if scenario in ("kill_rank", "kill_hub"):
+        env_chaos = "kill:%d:%d" % (victim, chaos_round)
+    elif scenario == "slow_rank":
+        env_chaos = "slow:%d:%d:%.1f" % (victim, chaos_round, 20.0)
+    elif scenario == "partition":
+        env_chaos = "partition:%d:%d:%.1f" % (victim, chaos_round, 20.0)
+    if env_chaos is not None:
+        os.environ["LGBM_TPU_CHAOS"] = env_chaos
+    else:
+        os.environ.pop("LGBM_TPU_CHAOS", None)
+    try:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        mlist = machines.split(",")
+        procs = [ctx.Process(target=_worker,
+                             args=(r, mlist, params, n_rows, rounds, q))
+                 for r in range(world)]
+        t0 = time.monotonic()
+        for p in procs:
+            p.start()
+        results = {}
+        deadline = time.monotonic() + join_timeout_s
+        # wait for the survivors only; a stalled victim's abort report
+        # can arrive minutes later and is informational
+        want = world if scenario == "none" else world - 1
+        while len(results) < want and time.monotonic() < deadline:
+            try:
+                rank, out = q.get(timeout=1.0)
+                results[rank] = out
+            except Exception:   # noqa: BLE001 — queue.Empty
+                if not any(p.is_alive() for p in procs):
+                    break
+        total_s = time.monotonic() - t0
+        for p in procs:
+            p.join(timeout=5.0)
+            if p.is_alive():
+                p.terminate()
+    finally:
+        os.environ.pop("LGBM_TPU_CHAOS", None)
+    completed = {r: o for r, o in results.items()
+                 if o.get("outcome") == "complete"}
+    fenced = sorted(r for r, o in results.items()
+                    if o.get("outcome") == "fenced")
+    expect_world = world if scenario == "none" else world - 1
+    ok = bool(completed) and all(
+        o["world"] == expect_world and o["num_trees"] >= rounds
+        for o in completed.values())
+    if scenario != "none":
+        ok = ok and all(o["reforms"] >= 1 and victim in o["dead_ranks"]
+                        for o in completed.values())
+    recovery = max((o.get("recovery_s", 0.0)
+                    for o in completed.values()), default=None)
+    return {
+        "scenario": scenario, "world": world, "victim": victim,
+        "rounds": rounds, "ok": ok, "final_world": expect_world,
+        "completed_ranks": sorted(completed),
+        "fenced_ranks": fenced,
+        "recovery_s": recovery,
+        "total_s": round(total_s, 3),
+        "results": results,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--scenario", choices=SCENARIOS, default="kill_rank")
+    ap.add_argument("--world", type=int, default=3)
+    ap.add_argument("--rounds", type=int, default=8)
+    ap.add_argument("--rows", type=int, default=240)
+    ap.add_argument("--chaos-round", type=int, default=3)
+    ap.add_argument("--fast", action="store_true",
+                    help="CI smoke: fewer rounds/rows, shorter timeouts")
+    ap.add_argument("--timeout", type=float, default=120.0)
+    args = ap.parse_args(argv)
+    if args.fast:
+        args.rounds = min(args.rounds, 5)
+        args.rows = min(args.rows, 180)
+        args.chaos_round = min(args.chaos_round, 2)
+    summary = run_scenario(args.scenario, world=args.world,
+                           rounds=args.rounds, n_rows=args.rows,
+                           chaos_round=args.chaos_round,
+                           join_timeout_s=args.timeout)
+    print(json.dumps(summary, indent=2, sort_keys=True))
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -339,6 +339,29 @@ def chaos_smoke():
             os.environ["JAX_PLATFORMS"] = prev
 
 
+def lint_smoke():
+    """tpulint over the shipped tree (one line in `detail`).
+
+    Proves the static-analysis gate still loads and the tree is clean
+    against tools/lint_baseline.json — the same signal CI enforces, so
+    a bench run on a dirty checkout shows "new N" right in the output.
+    Pure-stdlib path (no jax involved).  Never fails the bench: any
+    problem becomes the summary.
+    """
+    import importlib.util
+    import os
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_bench_lint", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tools", "lint.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.smoke()
+    except Exception as e:  # noqa: BLE001 — smoke only, never fatal
+        return "FAILED: %s" % e
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -368,6 +391,7 @@ def main():
             "quality_ok": ok,
             "trace_smoke": trace_smoke(lgb),
             "chaos_smoke": chaos_smoke(),
+            "lint_smoke": lint_smoke(),
         },
     }
     print(json.dumps(result))

@@ -1,0 +1,19 @@
+"""tpulint: AST-based static analysis for this package's hot-path,
+locking, config and hygiene invariants.
+
+Stdlib-only by design — importing this package must never import jax
+(or anything else from lightgbm_tpu), so ``tools/lint.py`` can gate CI
+in environments without an accelerator stack.  See
+docs/StaticAnalysis.md for the checker catalog, suppression syntax and
+baselining workflow.
+"""
+from __future__ import annotations
+
+from .core import (DEFAULT_ROOTS, Finding, HIGH, LOW, MEDIUM, Project,
+                   SEVERITIES, SourceFile, collect_files, run_suite,
+                   severity_counts)
+from . import baseline, report
+
+__all__ = ["DEFAULT_ROOTS", "Finding", "HIGH", "LOW", "MEDIUM",
+           "Project", "SEVERITIES", "SourceFile", "baseline",
+           "collect_files", "report", "run_suite", "severity_counts"]

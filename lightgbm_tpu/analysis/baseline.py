@@ -1,0 +1,81 @@
+"""Baseline file: the accepted-debt ledger for tpulint.
+
+The gate is **zero NEW findings**, not zero findings: pre-existing,
+triaged debt lives in a committed JSON baseline (tools/lint_baseline.json)
+keyed by the move-stable fingerprints from ``core.assign_fingerprints``.
+A finding whose fingerprint is in the baseline is reported as "known";
+anything else fails the run.  Baseline entries that no longer match any
+finding are reported as stale (fixed debt — delete them by regenerating
+with ``tools/lint.py --write-baseline``) but never fail the gate.
+
+The file format keeps path/line/message next to each fingerprint purely
+for human review of the debt; only the fingerprint participates in
+matching, so line shifts and file moves don't churn the ledger.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Sequence, Tuple
+
+from .core import Finding
+
+BASELINE_VERSION = 1
+
+
+def load(path: str) -> Dict[str, Dict]:
+    """fingerprint -> entry dict.  Raises ValueError on a malformed or
+    future-versioned file — a silently ignored baseline would turn the
+    gate off."""
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or data.get("tool") != "tpulint":
+        raise ValueError("%s is not a tpulint baseline" % path)
+    if int(data.get("version", 0)) > BASELINE_VERSION:
+        raise ValueError("baseline version %s is newer than this tool"
+                         % data.get("version"))
+    out: Dict[str, Dict] = {}
+    for entry in data.get("findings", []):
+        fp = entry.get("fingerprint")
+        if not fp:
+            raise ValueError("baseline entry without fingerprint: %r" % entry)
+        out[fp] = entry
+    return out
+
+
+def render(findings: Sequence[Finding]) -> str:
+    """Serialize findings as a baseline document (deterministic order,
+    one finding per line block — reviewable diffs)."""
+    doc = {
+        "tool": "tpulint",
+        "version": BASELINE_VERSION,
+        "findings": [
+            {"fingerprint": f.fingerprint, "check": f.check,
+             "severity": f.severity, "path": f.path, "line": f.line,
+             "message": f.message}
+            for f in sorted(findings, key=Finding.sort_key)
+        ],
+    }
+    return json.dumps(doc, indent=1, sort_keys=False) + "\n"
+
+
+def save(path: str, findings: Sequence[Finding]) -> None:
+    # regenerable artifact — durability doesn't matter, so no fsync
+    with open(path, "w", encoding="utf-8") as fh:  # tpulint: ok=write-no-fsync
+        fh.write(render(findings))
+
+
+def diff(findings: Sequence[Finding], baseline: Dict[str, Dict]
+         ) -> Tuple[List[Finding], List[Finding], List[Dict]]:
+    """(new, known, stale): findings not in the baseline, findings
+    matched by it, and baseline entries no finding matched."""
+    new: List[Finding] = []
+    known: List[Finding] = []
+    seen = set()
+    for f in findings:
+        if f.fingerprint in baseline:
+            known.append(f)
+            seen.add(f.fingerprint)
+        else:
+            new.append(f)
+    stale = [entry for fp, entry in baseline.items() if fp not in seen]
+    return new, known, stale

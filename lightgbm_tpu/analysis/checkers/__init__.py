@@ -1,0 +1,22 @@
+"""tpulint checker registry.
+
+Import order is the display/severity-triage order; ``all_checkers``
+returns fresh instances so one CLI process can run several roots
+without cross-run state.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Checker
+from .jit_hazards import JitHazardChecker
+from .lock_discipline import LockDisciplineChecker
+from .config_drift import ConfigDriftChecker
+from .hygiene import HygieneChecker
+
+CHECKER_CLASSES = (JitHazardChecker, LockDisciplineChecker,
+                   ConfigDriftChecker, HygieneChecker)
+
+
+def all_checkers() -> List[Checker]:
+    return [cls() for cls in CHECKER_CLASSES]

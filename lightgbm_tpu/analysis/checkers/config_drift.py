@@ -1,0 +1,244 @@
+"""Checker family 3: config drift between schema, code, and docs.
+
+``lightgbm_tpu/config.py`` is the single source of truth (``_SCHEMA`` +
+``ALIAS_TABLE``), ``docs/Parameters.md`` is generated from it, and the
+``tpu_*`` / ``serve_*`` knobs are read as plain attributes all over the
+tree.  Three things silently rot in that arrangement:
+
+- a param stays in the schema after the code that read it is deleted
+  (**dead param** — users set it, nothing happens),
+- a param is added to the schema without regenerating the docs, or a
+  doc row survives a schema removal (**undocumented / stale doc** —
+  the gen+diff pipeline catches the literal file drift, this checker
+  catches it even when someone edits the .md by hand),
+- code reads a knob the schema never defines (**phantom param** —
+  ``getattr(cfg, "tpu_histgoram_impl", ...)`` typos that silently take
+  the default forever), or an alias maps to a canonical name that
+  does not exist (**broken alias**).
+
+Emitted:
+
+- ``config-dead-param``        MEDIUM  tpu_*/serve_* schema entry never
+                                       read outside config.py
+- ``config-undocumented-param`` HIGH   schema entry with no
+                                       docs/Parameters.md row
+- ``config-stale-doc``          HIGH   doc row with no schema entry
+- ``config-broken-alias``       HIGH   alias canon missing from schema
+- ``config-phantom-param``      MEDIUM tpu_*/serve_* attribute or
+                                       string key read that the schema
+                                       does not define
+
+The schema is recovered from the AST of any scanned ``config.py`` that
+defines ``_SCHEMA`` (so the fixture mini-projects under tests/ exercise
+the checker without touching the real schema), and the doc table is the
+``| `name` | ...`` rows of ``<root>/docs/Parameters.md``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import Checker, Finding, HIGH, MEDIUM, Project, SourceFile
+
+CHECK_DEAD = "config-dead-param"
+CHECK_UNDOC = "config-undocumented-param"
+CHECK_STALE = "config-stale-doc"
+CHECK_ALIAS = "config-broken-alias"
+CHECK_PHANTOM = "config-phantom-param"
+
+_PREFIXES = ("tpu_", "serve_")
+#: receivers an attribute read counts as a *config* read on, for the
+#: phantom check — ``self._httpd.serve_forever`` must not look like a
+#: config param just because of its prefix.
+_CONFIG_BASES = ("config", "cfg", "conf", "params", "opts")
+_DOC_ROW_RE = re.compile(r"^\|\s*`(\w+)`\s*\|")
+_DOC_REL = "docs/Parameters.md"
+
+
+def _is_prefixed(name: str) -> bool:
+    return name.startswith(_PREFIXES)
+
+
+class _Schema:
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        self.params: Dict[str, int] = {}     # name -> lineno
+        self.aliases: Dict[str, Tuple[str, int]] = {}  # alias -> (canon, ln)
+
+
+def _parse_schema(sf: SourceFile) -> Optional[_Schema]:
+    schema: Optional[_Schema] = None
+    for node in sf.tree.body:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        names = {t.id for t in targets if isinstance(t, ast.Name)}
+        value = node.value
+        if "_SCHEMA" in names and isinstance(value, (ast.List, ast.Tuple)):
+            schema = schema or _Schema(sf)
+            for elt in value.elts:
+                if (isinstance(elt, (ast.Tuple, ast.List)) and elt.elts
+                        and isinstance(elt.elts[0], ast.Constant)
+                        and isinstance(elt.elts[0].value, str)):
+                    schema.params[elt.elts[0].value] = elt.lineno
+        elif "ALIAS_TABLE" in names and isinstance(value, ast.Dict):
+            schema = schema or _Schema(sf)
+            for k, v in zip(value.keys, value.values):
+                if (isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        and isinstance(v, ast.Constant)
+                        and isinstance(v.value, str)):
+                    schema.aliases[k.value] = (v.value, k.lineno)
+    if schema is not None and not schema.params:
+        return None
+    return schema
+
+
+def _config_receiver(value: ast.AST) -> bool:
+    """True when the attribute receiver plausibly IS the config object
+    (cfg.tpu_x, self.config.tpu_x) — any prefixed attribute counts as a
+    *read* for dead-param purposes, but only these count as *phantom*
+    candidates."""
+    name = value.id if isinstance(value, ast.Name) else \
+        value.attr if isinstance(value, ast.Attribute) else ""
+    name = name.strip("_").lower()
+    return name.endswith(_CONFIG_BASES)
+
+
+def _string_key_reads(node: ast.AST) -> Iterable[Tuple[str, ast.AST]]:
+    """tpu_*/serve_* names referenced as string keys: getattr(x, "k"),
+    x["k"], x.get("k", ...), hasattr/setattr(x, "k")."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        if (isinstance(f, ast.Name) and f.id in ("getattr", "hasattr",
+                                                 "setattr")
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and isinstance(node.args[1].value, str)):
+            yield node.args[1].value, node.args[1]
+        elif (isinstance(f, ast.Attribute) and f.attr == "get"
+                and node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            yield node.args[0].value, node.args[0]
+    elif isinstance(node, ast.Subscript):
+        sl = node.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            yield sl.value, sl
+
+
+class ConfigDriftChecker(Checker):
+    id = "config"
+    description = ("schema params unread in code, schema<->Parameters.md "
+                   "drift, broken aliases, phantom param reads")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        schemas = []
+        for sf in project.files:
+            if os.path.basename(sf.rel) == "config.py":
+                s = _parse_schema(sf)
+                if s is not None:
+                    schemas.append(s)
+        if not schemas:
+            return []
+        all_params: Set[str] = set()
+        for s in schemas:
+            all_params |= set(s.params)
+        reads, phantoms = self._scan_reads(project, schemas, all_params)
+        findings: List[Finding] = []
+        # docs/Parameters.md documents exactly one schema; with several
+        # config.py files in one scan, diff it against the package one
+        # (or the only one) rather than cross-matching fixtures.
+        doc_schema = schemas[0] if len(schemas) == 1 else next(
+            (s for s in schemas if s.sf.rel == "lightgbm_tpu/config.py"),
+            None)
+        for s in schemas:
+            findings.extend(self._schema_findings(s, reads))
+            if s is doc_schema:
+                findings.extend(self._doc_findings(project, s))
+        findings.extend(
+            self.finding(sf, node, MEDIUM,
+                         "reads config param %r which is not in the "
+                         "schema — a typo here silently yields the "
+                         "fallback/AttributeError forever" % name,
+                         check=CHECK_PHANTOM)
+            for sf, node, name in phantoms)
+        return findings
+
+    # -- usage scan -----------------------------------------------------
+    def _scan_reads(self, project: Project, schemas: List[_Schema],
+                    all_params: Set[str]):
+        """(set of schema params read anywhere outside their config
+        file, [(sf, node, name)] phantom prefixed reads)."""
+        schema_files = {s.sf.rel for s in schemas}
+        reads: Set[str] = set()
+        phantoms: List[Tuple[SourceFile, ast.AST, str]] = []
+        for sf in project.files:
+            in_schema_file = sf.rel in schema_files
+            for node in ast.walk(sf.tree):
+                hits: List[Tuple[str, ast.AST, bool]] = []
+                if isinstance(node, ast.Attribute) and \
+                        _is_prefixed(node.attr):
+                    hits.append((node.attr, node,
+                                 _config_receiver(node.value)))
+                hits.extend((n, where, True)
+                            for n, where in _string_key_reads(node)
+                            if _is_prefixed(n))
+                for name, where, certain in hits:
+                    if name in all_params:
+                        if not in_schema_file:
+                            reads.add(name)
+                    elif certain and not in_schema_file:
+                        phantoms.append((sf, where, name))
+        return reads, phantoms
+
+    # -- schema-side findings -------------------------------------------
+    def _schema_findings(self, s: _Schema, reads: Set[str]
+                         ) -> List[Finding]:
+        out: List[Finding] = []
+        for name, lineno in sorted(s.params.items()):
+            if _is_prefixed(name) and name not in reads:
+                if s.sf.is_suppressed(lineno, CHECK_DEAD):
+                    continue
+                out.append(Finding(
+                    CHECK_DEAD, MEDIUM, s.sf.rel, lineno, 1,
+                    "schema param %r is never read outside the schema "
+                    "— dead knob; wire it up or remove it" % name,
+                    scope=name))
+        for alias, (canon, lineno) in sorted(s.aliases.items()):
+            if canon not in s.params:
+                out.append(Finding(
+                    CHECK_ALIAS, HIGH, s.sf.rel, lineno, 1,
+                    "alias %r maps to %r which is not in the schema"
+                    % (alias, canon), scope=alias))
+        return out
+
+    # -- docs <-> schema ------------------------------------------------
+    def _doc_findings(self, project: Project, s: _Schema) -> List[Finding]:
+        doc_path = os.path.join(project.root, *_DOC_REL.split("/"))
+        if not os.path.isfile(doc_path):
+            return []        # fixture trees without docs opt out
+        with open(doc_path, encoding="utf-8") as fh:
+            doc_lines = fh.read().splitlines()
+        documented: Dict[str, int] = {}
+        for i, line in enumerate(doc_lines, start=1):
+            m = _DOC_ROW_RE.match(line)
+            if m and m.group(1) != "parameter":
+                documented.setdefault(m.group(1), i)
+        out: List[Finding] = []
+        for name, lineno in sorted(s.params.items()):
+            if name not in documented:
+                out.append(Finding(
+                    CHECK_UNDOC, HIGH, s.sf.rel, lineno, 1,
+                    "schema param %r has no row in %s — regenerate with "
+                    "tools/gen_param_docs.py --write"
+                    % (name, _DOC_REL), scope=name))
+        for name, lineno in sorted(documented.items()):
+            if name not in s.params and name not in s.aliases:
+                out.append(Finding(
+                    CHECK_STALE, HIGH, _DOC_REL, lineno, 1,
+                    "documented param %r is not in the schema — stale "
+                    "doc row; regenerate with tools/gen_param_docs.py "
+                    "--write" % name, scope=name))
+        return out

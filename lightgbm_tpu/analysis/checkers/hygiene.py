@@ -1,0 +1,240 @@
+"""Checker family 4: resource and exception hygiene.
+
+The resilience layer (retrying comm, checkpoint/resume) only works if
+failures actually propagate and file handles actually close.  Three
+patterns defeat it quietly:
+
+- ``open()`` / ``socket.socket()`` whose handle is not managed by a
+  ``with`` block leaks the fd on any exception between open and close
+  (on a long-lived serving process that is an eventual crash);
+- a bare ``except:`` — or ``except Exception: pass`` — swallows
+  ``CommFailure`` (and ``KeyboardInterrupt``, for the bare form), so
+  the retry/fence machinery never sees the fault it exists to handle;
+- a plain ``f.write(...)`` path for durable state without an fsync
+  loses the file on power cut — ``atomic_write_text`` in file_io.py is
+  the sanctioned pattern (tmp + fsync + rename).
+
+Emitted:
+
+- ``except-bare``      MEDIUM  ``except:`` with no exception class
+- ``except-swallow``   MEDIUM  ``except (Base)Exception:`` whose body
+                               is only ``pass``/``...`` (no re-raise,
+                               no logging) — CommFailure dies here
+- ``resource-no-with`` MEDIUM  ``open()`` result not used as a context
+                               manager (direct ``.close()`` chains and
+                               assignments both count)
+- ``socket-no-with``   LOW     ``socket.socket()`` kept outside
+                               ``with`` — long-lived comm sockets are
+                               legitimate, hence LOW + suppression
+- ``write-no-fsync``   LOW     write-mode ``open()`` inside
+                               lightgbm_tpu/ whose enclosing function
+                               neither fsyncs nor delegates to
+                               ``atomic_write_text``
+
+Append-mode streams (telemetry JSONL) and ``tools/`` scripts are not
+flag-worthy durability surfaces; ``file_io.py`` itself implements the
+sanctioned pattern and is exempt from ``write-no-fsync``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from ..core import Checker, Finding, LOW, MEDIUM, Project, SourceFile
+
+CHECK_BARE = "except-bare"
+CHECK_SWALLOW = "except-swallow"
+CHECK_OPEN = "resource-no-with"
+CHECK_SOCKET = "socket-no-with"
+CHECK_FSYNC = "write-no-fsync"
+
+_BROAD = {"Exception", "BaseException"}
+_FSYNC_EXEMPT = ("lightgbm_tpu/file_io.py",)
+
+
+def _is_open_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Name) and f.id == "open":
+        return True
+    # os.open returns a raw fd (try/finally os.close is the right
+    # pattern there) — only the context-manageable opens count
+    return (isinstance(f, ast.Attribute) and f.attr == "open"
+            and isinstance(f.value, ast.Name) and f.value.id == "io")
+
+
+def _is_socket_ctor(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr == "socket"
+            and isinstance(f.value, ast.Name) and f.value.id == "socket") \
+        or (isinstance(f, ast.Attribute) and f.attr == "create_connection"
+            and isinstance(f.value, ast.Name) and f.value.id == "socket")
+
+
+def _open_mode(node: ast.Call) -> str:
+    if len(node.args) >= 2 and isinstance(node.args[1], ast.Constant) \
+            and isinstance(node.args[1].value, str):
+        return node.args[1].value
+    for kw in node.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return "r"
+
+
+def _only_passes(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue        # docstring / ellipsis
+        return False
+    return True
+
+
+def _names_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return False
+    for n in ast.walk(t):
+        if isinstance(n, ast.Name) and n.id in _BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _BROAD:
+            return True
+    return False
+
+
+class HygieneChecker(Checker):
+    id = "hygiene"
+    description = ("unmanaged open()/sockets, exception swallowing, "
+                   "fsync-less durable writes")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for sf in project.files:
+            findings.extend(self._check_file(sf))
+        return findings
+
+    def _check_file(self, sf: SourceFile) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.ExceptHandler):
+                self._check_handler(sf, node, out)
+            elif isinstance(node, ast.Call):
+                if _is_open_call(node):
+                    self._check_open(sf, node, out)
+                elif _is_socket_ctor(node):
+                    self._check_socket(sf, node, out)
+        return out
+
+    # -- exceptions -----------------------------------------------------
+    def _check_handler(self, sf: SourceFile, node: ast.ExceptHandler,
+                       out: List[Finding]) -> None:
+        if node.type is None:
+            out.append(self.finding(
+                sf, node, MEDIUM,
+                "bare 'except:' also catches KeyboardInterrupt/"
+                "SystemExit and swallows CommFailure — name the "
+                "exceptions (or 'except Exception' with a log+re-raise)",
+                check=CHECK_BARE))
+            return
+        if _names_broad(node) and _only_passes(node.body):
+            out.append(self.finding(
+                sf, node, MEDIUM,
+                "'except %s: pass' silently swallows every fault "
+                "including CommFailure — log it, narrow it, or re-raise"
+                % ast.unparse(node.type), check=CHECK_SWALLOW))
+
+    # -- resources ------------------------------------------------------
+    def _in_with(self, sf: SourceFile, node: ast.Call) -> bool:
+        """True when the call is a with-item context expression, is
+        returned/yielded for the caller to manage, feeds a contextlib
+        stack, or initializes an attribute whose lifetime a close()/
+        ``__exit__`` method plausibly manages.  ``direct`` tracks
+        whether we still hold the HANDLE itself — ``return open(p)``
+        hands it to the caller, but ``return open(p).read()`` only
+        returns the bytes and leaks the fd."""
+        cur: Optional[ast.AST] = node
+        direct = True
+        while cur is not None:
+            parent = sf.parent(cur)
+            if isinstance(parent, ast.withitem) \
+                    and parent.context_expr is cur:
+                return True
+            if isinstance(parent, (ast.Return, ast.Yield, ast.YieldFrom)):
+                return direct
+            if isinstance(parent, ast.Call) and cur is not parent.func:
+                f = parent.func
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in ("enter_context", "closing"):
+                    return True
+                if isinstance(f, ast.Name) and f.id == "closing":
+                    return True
+                direct = False
+            elif isinstance(parent, ast.Attribute):
+                direct = False      # open(p).read(): handle identity lost
+            if isinstance(parent, ast.Assign):
+                # self._sock = socket.socket(...)  — owned by the object,
+                # closed in its shutdown path; flagging every one of
+                # these buries the real leaks.
+                return direct and any(isinstance(t, ast.Attribute)
+                                      for t in parent.targets)
+            if isinstance(parent, (ast.stmt, ast.FunctionDef,
+                                   ast.AsyncFunctionDef, ast.Module)):
+                # crossed out of the expression without hitting a
+                # withitem: inspect no further up
+                return False
+            cur = parent
+        return False
+
+    def _check_open(self, sf: SourceFile, node: ast.Call,
+                    out: List[Finding]) -> None:
+        if self._in_with(sf, node):
+            mode = _open_mode(node)
+            if any(c in mode for c in "wx+") and "a" not in mode:
+                self._check_fsync(sf, node, out)
+            return
+        out.append(self.finding(
+            sf, node, MEDIUM,
+            "open() without a 'with' block leaks the fd if anything "
+            "between open and close raises", check=CHECK_OPEN))
+
+    def _check_socket(self, sf: SourceFile, node: ast.Call,
+                      out: List[Finding]) -> None:
+        if self._in_with(sf, node):
+            return
+        out.append(self.finding(
+            sf, node, LOW,
+            "socket kept outside 'with' — fine for a long-lived comm "
+            "link, but then close() must be exception-safe "
+            "(tpulint: ok=%s to acknowledge)" % CHECK_SOCKET,
+            check=CHECK_SOCKET))
+
+    def _check_fsync(self, sf: SourceFile, node: ast.Call,
+                     out: List[Finding]) -> None:
+        if not sf.rel.startswith("lightgbm_tpu/") \
+                or sf.rel in _FSYNC_EXEMPT:
+            return
+        func = self._enclosing_function(sf, node)
+        if func is None:
+            return
+        for n in ast.walk(func):
+            if isinstance(n, ast.Call):
+                f = n.func
+                name = f.attr if isinstance(f, ast.Attribute) else \
+                    f.id if isinstance(f, ast.Name) else ""
+                if name in ("fsync", "atomic_write_text", "atomic_write"):
+                    return
+        out.append(self.finding(
+            sf, node, LOW,
+            "write-mode open() with no fsync in the enclosing function "
+            "— durable state should go through atomic_write_text "
+            "(tmp + fsync + rename) or fsync before close",
+            check=CHECK_FSYNC))
+
+    def _enclosing_function(self, sf: SourceFile, node: ast.AST):
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = sf.parent(cur)
+        return None

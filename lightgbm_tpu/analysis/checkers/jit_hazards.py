@@ -1,0 +1,308 @@
+"""Checker family 1: jit/retrace hazards.
+
+NOTES.md and the BENCH trajectory document the failure class: a silent
+host-device sync or an accidental retrace inside the hot path erases a
+perf win without failing a single test (ROADMAP item 2's plateau is
+exactly this bug surface).  The reference's equivalent discipline is
+"no omp call may throw across the parallel region"; ours is "nothing
+inside a ``@jax.jit`` body may materialize a traced value on the host".
+
+Flagged inside jit-compiled function bodies (``@jax.jit``, ``@jit``,
+``@partial(jit, ...)`` decorators, and ``f2 = jax.jit(f)`` /
+``f2 = partial(jax.jit, ...)(f)`` wrap-assignments):
+
+- ``.item()`` / ``.block_until_ready()`` calls          -> HIGH
+- ``np.asarray`` / ``np.array`` on traced values        -> HIGH
+  (numpy aliases resolved from the module's imports)
+- ``float()`` / ``int()`` / ``bool()`` casts of traced values -> MEDIUM
+- Python ``if`` / ``while`` / ternary branching on a non-static
+  parameter                                             -> MEDIUM
+
+Casts/branches that only involve ``static_argnames`` /
+``static_argnums`` parameters or shape metadata (``.shape``, ``.ndim``,
+``.dtype``, ``.size``, ``len()``) are concrete at trace time and are
+not flagged.  Deliberate sync points carry a ``# tpulint: ok=<check>``
+allowlist comment (see docs/StaticAnalysis.md).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+from ..core import Checker, Finding, HIGH, MEDIUM, Project, SourceFile
+
+CHECK_SYNC = "jit-host-sync"
+CHECK_CAST = "jit-host-cast"
+CHECK_BRANCH = "jit-traced-branch"
+
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+_SHAPE_ATTRS = {"shape", "ndim", "dtype", "size"}
+_NUMPY_MODULES = {"numpy", "numpy.ma"}
+_HOST_NP_FUNCS = {"asarray", "array", "copy", "frombuffer"}
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    return ((isinstance(node, ast.Name) and node.id == "jit")
+            or (isinstance(node, ast.Attribute) and node.attr == "jit"))
+
+
+def _is_partial_ref(node: ast.AST) -> bool:
+    return ((isinstance(node, ast.Name) and node.id == "partial")
+            or (isinstance(node, ast.Attribute) and node.attr == "partial"))
+
+
+def _static_names_from_keywords(keywords: Sequence[ast.keyword],
+                                func: Optional[ast.FunctionDef]
+                                ) -> Optional[Set[str]]:
+    """Resolve static_argnames/static_argnums keywords to parameter
+    names.  None means "could not resolve" (dynamic value) — treat every
+    parameter as potentially static to avoid false positives."""
+    names: Set[str] = set()
+    for kw in keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+                elif isinstance(n, (ast.Name, ast.Call)):
+                    return None
+        elif kw.arg == "static_argnums":
+            if func is None:
+                return None
+            params = [a.arg for a in (func.args.posonlyargs
+                                      + func.args.args)]
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    if 0 <= n.value < len(params):
+                        names.add(params[n.value])
+                elif isinstance(n, (ast.Name, ast.Call)):
+                    return None
+    return names
+
+
+def _param_names(func: ast.FunctionDef) -> List[str]:
+    a = func.args
+    names = [x.arg for x in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return names
+
+
+def _numpy_aliases(tree: ast.Module) -> Set[str]:
+    """Local names bound to the host numpy module (``import numpy as
+    np`` and friends) — jax.numpy aliases are deliberately NOT
+    included; jnp inside jit is the whole point."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name in _NUMPY_MODULES:
+                    out.add(alias.asname or alias.name.split(".")[0])
+    return out
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _is_shape_only(node: ast.AST,
+                   relevant: Optional[Set[str]] = None) -> bool:
+    """True when every Name in the expression (restricted to the
+    ``relevant`` names, e.g. the traced parameters) is reached through
+    a trace-time-concrete view: shape metadata (x.shape[0], x.ndim,
+    len(x)) or identity tests (``x is None`` compares the Python
+    object, never the traced value)."""
+    shielded: Set[int] = set()
+    for n in ast.walk(node):
+        if (isinstance(n, ast.Attribute) and n.attr in _SHAPE_ATTRS):
+            for sub in ast.walk(n.value):
+                if isinstance(sub, ast.Name):
+                    shielded.add(id(sub))
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == "len"):
+            for arg in n.args:
+                for sub in ast.walk(arg):
+                    if isinstance(sub, ast.Name):
+                        shielded.add(id(sub))
+        if (isinstance(n, ast.Compare)
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in n.ops)):
+            for sub in ast.walk(n):
+                if isinstance(sub, ast.Name):
+                    shielded.add(id(sub))
+    names = [n for n in ast.walk(node) if isinstance(n, ast.Name)
+             and (relevant is None or n.id in relevant)]
+    return bool(names) and all(id(n) in shielded for n in names)
+
+
+class JitHazardChecker(Checker):
+    id = "jit"
+    description = ("host syncs, host casts and Python branching on traced "
+                   "values inside @jax.jit bodies")
+
+    #: inside the package only the device-code layers are in scope; the
+    #: fixture trees used by tests sit outside lightgbm_tpu/ and are
+    #: always scanned.
+    PACKAGE_SCOPES = ("lightgbm_tpu/ops/", "lightgbm_tpu/models/",
+                      "lightgbm_tpu/engine.py", "lightgbm_tpu/parallel/")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for sf in project.files:
+            if (sf.rel.startswith("lightgbm_tpu/")
+                    and not any(sf.rel.startswith(p)
+                                for p in self.PACKAGE_SCOPES)):
+                continue
+            findings.extend(self._check_file(sf))
+        return findings
+
+    # -- per-file ------------------------------------------------------
+    def _check_file(self, sf: SourceFile) -> List[Finding]:
+        np_aliases = _numpy_aliases(sf.tree)
+        jit_funcs = self._jit_functions(sf)
+        out: List[Finding] = []
+        for func, statics in jit_funcs:
+            out.extend(self._check_jit_body(sf, func, statics, np_aliases))
+        return out
+
+    def _jit_functions(self, sf: SourceFile):
+        """[(FunctionDef, static param-name set or None=unknown)]."""
+        by_name: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.FunctionDef):
+                by_name.setdefault(node.name, node)
+        found = []
+        seen: Set[int] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.FunctionDef):
+                statics = self._decorator_statics(node)
+                if statics is not False and id(node) not in seen:
+                    seen.add(id(node))
+                    found.append((node, statics))
+            elif isinstance(node, ast.Assign):
+                target = self._wrapped_function(node.value)
+                if target is None:
+                    continue
+                fname, statics = target
+                func = by_name.get(fname)
+                if func is not None and id(func) not in seen:
+                    seen.add(id(func))
+                    found.append((func, statics))
+        return found
+
+    def _decorator_statics(self, func: ast.FunctionDef):
+        """False = not jit-decorated; otherwise the static-name set
+        (None = unresolvable)."""
+        for dec in func.decorator_list:
+            if _is_jit_ref(dec):
+                return set()
+            if isinstance(dec, ast.Call):
+                if _is_jit_ref(dec.func):
+                    return _static_names_from_keywords(dec.keywords, func)
+                if (_is_partial_ref(dec.func) and dec.args
+                        and _is_jit_ref(dec.args[0])):
+                    return _static_names_from_keywords(dec.keywords, func)
+        return False
+
+    def _wrapped_function(self, value: ast.AST):
+        """Recognize ``jax.jit(f, ...)`` and ``partial(jax.jit, ...)(f)``
+        assignment forms; returns (func name, statics) or None."""
+        if not isinstance(value, ast.Call):
+            return None
+        if (_is_jit_ref(value.func) and value.args
+                and isinstance(value.args[0], ast.Name)):
+            return value.args[0].id, _static_names_from_keywords(
+                value.keywords, None)
+        if (isinstance(value.func, ast.Call)
+                and _is_partial_ref(value.func.func)
+                and value.func.args and _is_jit_ref(value.func.args[0])
+                and value.args and isinstance(value.args[0], ast.Name)):
+            return value.args[0].id, _static_names_from_keywords(
+                value.func.keywords, None)
+        return None
+
+    # -- body scan -----------------------------------------------------
+    def _check_jit_body(self, sf: SourceFile, func: ast.FunctionDef,
+                        statics: Optional[Set[str]],
+                        np_aliases: Set[str]) -> List[Finding]:
+        if statics is None:
+            # unresolvable static set: every param may be static; only
+            # the unconditional host syncs below remain reportable
+            statics = set(_param_names(func))
+        params = set(_param_names(func))
+        traced = params - statics
+        out: List[Finding] = []
+
+        def visit(node: ast.AST, traced_now: Set[str]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not func:
+                # nested def: its params shadow outer traced names
+                inner = traced_now - set(_param_names(node))
+                for child in ast.iter_child_nodes(node):
+                    visit(child, inner)
+                return
+            if isinstance(node, ast.Call):
+                self._check_call(sf, node, traced_now, np_aliases, out)
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                test = node.test
+                used = _names_in(test) & traced_now
+                if used and not _is_shape_only(test, traced_now):
+                    out.append(self.finding(
+                        sf, test, MEDIUM,
+                        "Python branch on possibly-traced value(s) %s "
+                        "inside a @jax.jit body — concretizes under "
+                        "trace (or retraces per value); use lax.cond/"
+                        "jnp.where or mark the argument static"
+                        % sorted(used), check=CHECK_BRANCH))
+            for child in ast.iter_child_nodes(node):
+                visit(child, traced_now)
+
+        for stmt in func.body:
+            visit(stmt, traced)
+        return out
+
+    def _check_call(self, sf: SourceFile, node: ast.Call,
+                    traced: Set[str], np_aliases: Set[str],
+                    out: List[Finding]) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "item" and not node.args and not node.keywords:
+                out.append(self.finding(
+                    sf, node, HIGH,
+                    ".item() inside a @jax.jit body forces a device->"
+                    "host sync (and fails on tracers); keep the value "
+                    "on device or return it", check=CHECK_SYNC))
+                return
+            if f.attr == "block_until_ready":
+                out.append(self.finding(
+                    sf, node, HIGH,
+                    ".block_until_ready() inside a @jax.jit body is a "
+                    "host sync; the trace already sequences the "
+                    "computation", check=CHECK_SYNC))
+                return
+            if (isinstance(f.value, ast.Name) and f.value.id in np_aliases
+                    and f.attr in _HOST_NP_FUNCS):
+                out.append(self.finding(
+                    sf, node, HIGH,
+                    "host numpy %s.%s() inside a @jax.jit body "
+                    "materializes the traced value on the host; use "
+                    "jax.numpy" % (f.value.id, f.attr), check=CHECK_SYNC))
+                return
+        if isinstance(f, ast.Name) and f.id in _CAST_BUILTINS and node.args:
+            names = set()
+            for arg in node.args:
+                names |= _names_in(arg)
+            if not names:
+                return              # float('inf'), int(1) — constants
+            if all(n not in traced for n in names):
+                return              # statics / enclosing python scalars…
+            if all(_is_shape_only(arg, traced) or not _names_in(arg)
+                   for arg in node.args):
+                return              # shape metadata is concrete
+            out.append(self.finding(
+                sf, node, MEDIUM,
+                "%s() cast of possibly-traced value(s) %s inside a "
+                "@jax.jit body concretizes under trace; compute with "
+                "jnp or mark the argument static"
+                % (f.id, sorted(names & traced)), check=CHECK_CAST))

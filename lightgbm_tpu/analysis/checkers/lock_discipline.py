@@ -1,0 +1,525 @@
+"""Checker family 2: lock discipline across the threaded layers.
+
+The serving path, the elastic comm layer and the telemetry registry are
+lock-heavy (14 ``threading.Lock``/``Condition`` sites today) and their
+failure modes — a mutation slipping out from under its lock, a blocking
+socket call made while holding a lock, two classes acquiring each
+other's locks in opposite orders — are exactly what tests rarely catch
+(they need the losing interleaving).  This checker infers the locking
+contract from the code itself and flags departures:
+
+- **Guarded-attribute inference**: an attribute of a lock-owning class
+  that is read or written inside any ``with self._lock:`` block is
+  *guarded*; a write to it outside every lock region (outside
+  ``__init__`` and private helpers only reachable from it) is flagged
+  HIGH (``lock-unguarded-write``).
+- **Shared-write heuristic** (MEDIUM, ``lock-shared-write``): in a
+  lock-owning class, an unlocked write to an attribute that another
+  method also touches — racy publication even when no locked site
+  exists yet.
+- **Blocking calls under a lock** (``lock-blocking-call``): socket
+  recv/accept/connect/sendall, untimed ``.join()`` / ``.wait()`` /
+  ``.get()``, ``time.sleep``, and device dispatch
+  (``block_until_ready``, ``predict*`` / ``warmup*`` calls) while a
+  lock is held.  ``Condition.wait`` on a condition built from the held
+  lock is the sanctioned idiom and is not flagged.
+- **Lock-order cycles** (HIGH, ``lock-order-cycle``): the acquisition
+  graph — nested ``with`` blocks plus calls into methods that acquire
+  their own class lock — must stay acyclic, or two threads can
+  deadlock by arriving in opposite orders.  Re-acquiring a
+  non-reentrant lock (nested ``with`` or a same-class method call) is
+  flagged ``lock-reentrant``.
+
+Inference is name-based (``ClassName.attr`` / ``module:name``
+identifies a lock), so it runs without executing any code and without
+jax present.  Module-level locks participate in the blocking-call and
+order analyses; guarded-attribute inference is class-only.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..core import Checker, Finding, HIGH, MEDIUM, Project, SourceFile
+
+CHECK_UNGUARDED = "lock-unguarded-write"
+CHECK_SHARED = "lock-shared-write"
+CHECK_BLOCKING = "lock-blocking-call"
+CHECK_ORDER = "lock-order-cycle"
+CHECK_REENTRANT = "lock-reentrant"
+
+_BLOCK_HIGH_ATTRS = {"recv", "recv_into", "recvfrom", "accept", "connect",
+                     "sendall"}
+_DISPATCH_ATTRS = {"block_until_ready", "device_put", "predict_fn",
+                   "predict", "predict_device", "predict_bucketed",
+                   "warmup", "warmup_buckets"}
+_MUTATOR_METHODS = {"append", "extend", "insert", "remove", "pop", "clear",
+                    "update", "add", "discard", "setdefault", "popitem",
+                    "sort", "reverse", "appendleft", "popleft"}
+_LOCK_CTORS = {"Lock", "RLock"}
+_AMBIGUITY_CAP = 3       # cross-class call edges only when <= this many
+#                          lock-owning classes define the method name
+#: method names shared with dict/list/set/queue — a ``.get()`` under a
+#: lock is overwhelmingly a dict read, not a call into another
+#: lock-owning class; never build cross-class order edges from these.
+_COMMON_METHOD_NAMES = _MUTATOR_METHODS | {
+    "get", "keys", "values", "items", "copy", "put", "close", "join",
+    "start", "stop", "wait", "notify", "notify_all", "acquire",
+    "release", "send", "recv", "read", "write", "flush"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _ctor_name(value: ast.AST) -> Optional[str]:
+    """'Lock' / 'RLock' / 'Condition' when value is threading.X(...)."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    if isinstance(f, ast.Attribute) and f.attr in _LOCK_CTORS | {"Condition"}:
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in _LOCK_CTORS | {"Condition"}:
+        return f.id
+    return None
+
+
+def _shallow_nodes(stmt: ast.stmt) -> Iterable[ast.AST]:
+    """Expression-level nodes belonging to this statement, without
+    descending into nested statements, nested defs, or lambda bodies
+    (those do not execute under the current lock context)."""
+    stack: List[ast.AST] = []
+
+    def push_children(n: ast.AST) -> None:
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.stmt, ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda,
+                                  ast.excepthandler)):
+                continue
+            stack.append(child)
+
+    push_children(stmt)
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, ast.Lambda):
+            push_children(n)
+
+
+class _Access:
+    __slots__ = ("attr", "lock", "method", "node", "is_write")
+
+    def __init__(self, attr, lock, method, node, is_write):
+        self.attr = attr
+        self.lock = lock            # lock id held at the access, or None
+        self.method = method
+        self.node = node
+        self.is_write = is_write
+
+
+class _ScopeInfo:
+    """One lock-owning class — or a module pseudo-scope for
+    module-level locks (blocking/order analysis only)."""
+
+    def __init__(self, sf: SourceFile, name: str, is_module: bool = False):
+        self.sf = sf
+        self.name = name
+        self.is_module = is_module
+        self.lock_attrs: Dict[str, str] = {}     # attr -> Lock|RLock
+        self.cond_attrs: Dict[str, Optional[str]] = {}  # attr -> lock attr
+        self.module_locks: Dict[str, str] = {}   # module-level name -> kind
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        self.accesses: List[_Access] = []
+        self.calls_under_lock: List[Tuple[str, ast.Call, str]] = []
+        self.acquires: Dict[str, Set[str]] = {}  # method -> lock ids
+        self.callers: Dict[str, Set[str]] = {}   # method -> calling methods
+        self.order_edges: List[Tuple[str, str, ast.AST]] = []
+        self.reentrant_nodes: List[ast.AST] = []
+
+    def lock_id(self, attr: str) -> str:
+        return "%s.%s" % (self.name, attr)
+
+    def is_nonreentrant(self, lock_id: str) -> bool:
+        tail = lock_id.rsplit(".", 1)[-1].rsplit(":", 1)[-1]
+        kind = self.lock_attrs.get(tail) or self.module_locks.get(tail)
+        return kind == "Lock"
+
+
+class LockDisciplineChecker(Checker):
+    id = "locks"
+    description = ("guarded-attribute mutations outside locks, blocking "
+                   "calls under locks, lock-order cycles")
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        scopes: List[_ScopeInfo] = []
+        for sf in project.files:
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ClassDef):
+                    info = self._scan_class(sf, node)
+                    if info is not None:
+                        scopes.append(info)
+            mod = self._scan_module(sf)
+            if mod is not None:
+                scopes.append(mod)
+        findings: List[Finding] = []
+        for info in scopes:
+            if not info.is_module:
+                findings.extend(self._write_findings(info))
+            findings.extend(self._blocking_findings(info))
+        findings.extend(self._order_findings(scopes))
+        return findings
+
+    # -- scope scans ----------------------------------------------------
+    def _scan_class(self, sf: SourceFile,
+                    node: ast.ClassDef) -> Optional[_ScopeInfo]:
+        info = _ScopeInfo(sf, node.name)
+        info.methods = {n.name: n for n in node.body
+                        if isinstance(n, ast.FunctionDef)}
+        for meth in info.methods.values():
+            for stmt in ast.walk(meth):
+                if isinstance(stmt, ast.Assign):
+                    kind = _ctor_name(stmt.value)
+                    if kind is None:
+                        continue
+                    for tgt in stmt.targets:
+                        attr = _self_attr(tgt)
+                        if attr is None:
+                            continue
+                        if kind == "Condition":
+                            arg = (stmt.value.args[0]
+                                   if stmt.value.args else None)
+                            info.cond_attrs[attr] = _self_attr(arg)
+                        else:
+                            info.lock_attrs[attr] = kind
+        if not info.lock_attrs and not info.cond_attrs:
+            return None
+        for mname, meth in info.methods.items():
+            info.acquires.setdefault(mname, set())
+            self._walk(info, mname, meth.body, held=[])
+        for mname, meth in info.methods.items():
+            for n in ast.walk(meth):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and isinstance(n.func.value, ast.Name)
+                        and n.func.value.id == "self"
+                        and n.func.attr in info.methods):
+                    info.callers.setdefault(n.func.attr, set()).add(mname)
+        return info
+
+    def _scan_module(self, sf: SourceFile) -> Optional[_ScopeInfo]:
+        base = os.path.basename(sf.rel).rsplit(".", 1)[0]
+        info = _ScopeInfo(sf, base, is_module=True)
+        for stmt in sf.tree.body:
+            if isinstance(stmt, ast.Assign):
+                kind = _ctor_name(stmt.value)
+                if kind in _LOCK_CTORS:
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name):
+                            info.module_locks[tgt.id] = kind
+        if not info.module_locks:
+            return None
+        info.methods = {n.name: n for n in sf.tree.body
+                        if isinstance(n, ast.FunctionDef)}
+        for mname, meth in info.methods.items():
+            info.acquires.setdefault(mname, set())
+            self._walk(info, mname, meth.body, held=[])
+        return info
+
+    def _as_lock(self, info: _ScopeInfo, expr: ast.AST) -> Optional[str]:
+        """Lock id acquired by using `expr` as a with-context, if any.
+        A Condition context acquires its underlying lock."""
+        attr = _self_attr(expr)
+        if attr is not None:
+            if attr in info.lock_attrs:
+                return info.lock_id(attr)
+            if attr in info.cond_attrs:
+                under = info.cond_attrs[attr]
+                return info.lock_id(under if under else attr)
+            return None
+        if isinstance(expr, ast.Name) and expr.id in info.module_locks:
+            return "%s:%s" % (info.name, expr.id)
+        return None
+
+    def _walk(self, info: _ScopeInfo, mname: str,
+              body: Sequence[ast.stmt], held: List[str]) -> None:
+        for stmt in body:
+            if isinstance(stmt, ast.With):
+                acquired: List[str] = []
+                for item in stmt.items:
+                    lock = self._as_lock(info, item.context_expr)
+                    if lock is None:
+                        continue
+                    if lock in held:
+                        if info.is_nonreentrant(lock):
+                            info.reentrant_nodes.append(stmt)
+                    elif held:
+                        info.order_edges.append((held[-1], lock, stmt))
+                    info.acquires[mname].add(lock)
+                    acquired.append(lock)
+                self._walk(info, mname, stmt.body, held + acquired)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # nested def (callback/closure): it does NOT run under
+                # the enclosing lock — scan it with an empty stack
+                self._walk(info, mname, stmt.body, [])
+                continue
+            self._scan_stmt(info, mname, stmt, held)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub:
+                    self._walk(info, mname, sub, held)
+            for handler in getattr(stmt, "handlers", []) or []:
+                self._walk(info, mname, handler.body, held)
+
+    def _scan_stmt(self, info: _ScopeInfo, mname: str, stmt: ast.stmt,
+                   held: List[str]) -> None:
+        lock = held[-1] if held else None
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target] if stmt.target is not None else [])
+            for tgt in targets:
+                for leaf in self._target_leaves(tgt):
+                    attr = _self_attr(leaf)
+                    if attr is None and isinstance(leaf, ast.Subscript):
+                        attr = _self_attr(leaf.value)
+                    if attr is not None:
+                        info.accesses.append(
+                            _Access(attr, lock, mname, stmt, True))
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                attr = _self_attr(tgt)
+                if attr is None and isinstance(tgt, ast.Subscript):
+                    attr = _self_attr(tgt.value)
+                if attr is not None:
+                    info.accesses.append(
+                        _Access(attr, lock, mname, stmt, True))
+        for node in _shallow_nodes(stmt):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in _MUTATOR_METHODS):
+                    attr = _self_attr(f.value)
+                    if attr is not None:
+                        info.accesses.append(
+                            _Access(attr, lock, mname, node, True))
+                if lock is not None:
+                    info.calls_under_lock.append((lock, node, mname))
+            attr = _self_attr(node)
+            if attr is not None and isinstance(getattr(node, "ctx", None),
+                                               ast.Load):
+                info.accesses.append(
+                    _Access(attr, lock, mname, node, False))
+
+    def _target_leaves(self, tgt: ast.AST) -> List[ast.AST]:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            out = []
+            for elt in tgt.elts:
+                out.extend(self._target_leaves(elt))
+            return out
+        if isinstance(tgt, ast.Starred):
+            return self._target_leaves(tgt.value)
+        return [tgt]
+
+    # -- findings: unguarded / shared writes ----------------------------
+    def _init_only(self, info: _ScopeInfo) -> Set[str]:
+        """__init__ plus private helpers reachable ONLY from it — their
+        writes happen before the object is shared across threads."""
+        init_only = {"__init__"}
+        changed = True
+        while changed:
+            changed = False
+            for mname in info.methods:
+                if mname in init_only:
+                    continue
+                callers = info.callers.get(mname)
+                if callers and callers <= init_only \
+                        and mname.startswith("_"):
+                    init_only.add(mname)
+                    changed = True
+        return init_only
+
+    def _write_findings(self, info: _ScopeInfo) -> List[Finding]:
+        special = set(info.lock_attrs) | set(info.cond_attrs)
+        guarded: Set[str] = set()
+        methods_touching: Dict[str, Set[str]] = {}
+        for a in info.accesses:
+            if a.attr in special:
+                continue
+            methods_touching.setdefault(a.attr, set()).add(a.method)
+            if a.lock is not None:
+                guarded.add(a.attr)
+        init_only = self._init_only(info)
+        out: List[Finding] = []
+        for a in info.accesses:
+            if (not a.is_write or a.lock is not None
+                    or a.attr in special or a.method in init_only):
+                continue
+            if a.attr in guarded:
+                out.append(self.finding(
+                    info.sf, a.node, HIGH,
+                    "write to %s.%s outside the lock that guards it "
+                    "elsewhere in this class — racy against every "
+                    "locked reader/writer" % (info.name, a.attr),
+                    check=CHECK_UNGUARDED))
+            elif len(methods_touching.get(a.attr, ())) > 1:
+                out.append(self.finding(
+                    info.sf, a.node, MEDIUM,
+                    "unlocked write to %s.%s in a lock-owning class; "
+                    "the attribute is also used by %s — guard the "
+                    "write or document why the race is benign"
+                    % (info.name, a.attr,
+                       ", ".join(sorted(methods_touching[a.attr]
+                                        - {a.method})) or "other threads"),
+                    check=CHECK_SHARED))
+        return out
+
+    # -- findings: blocking calls under a lock --------------------------
+    def _blocking_findings(self, info: _ScopeInfo) -> List[Finding]:
+        out: List[Finding] = []
+        for lock, node, mname in info.calls_under_lock:
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            has_timeout = any(kw.arg == "timeout" for kw in node.keywords) \
+                or bool(node.args)
+            attr = f.attr
+            recv_attr = _self_attr(f.value)
+            if attr in _BLOCK_HIGH_ATTRS:
+                out.append(self.finding(
+                    info.sf, node, HIGH,
+                    "blocking socket call .%s() while holding %s — a "
+                    "slow/dead peer stalls every thread waiting on the "
+                    "lock; move I/O outside the critical section"
+                    % (attr, lock), check=CHECK_BLOCKING))
+            elif attr in _DISPATCH_ATTRS:
+                out.append(self.finding(
+                    info.sf, node, HIGH,
+                    "device dispatch .%s() while holding %s — a compile "
+                    "or ~100 ms device roundtrip serializes every "
+                    "thread on this lock" % (attr, lock),
+                    check=CHECK_BLOCKING))
+            elif attr == "join" and not has_timeout:
+                out.append(self.finding(
+                    info.sf, node, HIGH,
+                    "untimed .join() while holding %s can deadlock if "
+                    "the joined thread needs the lock" % lock,
+                    check=CHECK_BLOCKING))
+            elif attr == "wait" and not has_timeout:
+                if recv_attr is not None and recv_attr in info.cond_attrs:
+                    continue    # Condition.wait releases the held lock
+                out.append(self.finding(
+                    info.sf, node, MEDIUM,
+                    "untimed .wait() while holding %s blocks every "
+                    "other thread on the lock (Condition.wait on the "
+                    "lock's own condition is exempt)" % lock,
+                    check=CHECK_BLOCKING))
+            elif attr == "get" and not node.args and not node.keywords:
+                out.append(self.finding(
+                    info.sf, node, MEDIUM,
+                    "argument-less .get() while holding %s blocks "
+                    "forever on an empty queue; pass a timeout or get "
+                    "outside the lock" % lock, check=CHECK_BLOCKING))
+            elif attr == "sleep":
+                out.append(self.finding(
+                    info.sf, node, MEDIUM,
+                    "sleep while holding %s stalls every waiter for "
+                    "the full duration" % lock, check=CHECK_BLOCKING))
+        return out
+
+    # -- findings: lock-order cycles ------------------------------------
+    def _order_findings(self, scopes: List[_ScopeInfo]) -> List[Finding]:
+        method_locks: Dict[str, List[Tuple[_ScopeInfo, Set[str]]]] = {}
+        for info in scopes:
+            for mname, locks in info.acquires.items():
+                if locks:
+                    method_locks.setdefault(mname, []).append((info, locks))
+        edges: Dict[Tuple[str, str], Tuple[SourceFile, ast.AST]] = {}
+        findings: List[Finding] = []
+        for info in scopes:
+            for outer, inner, node in info.order_edges:
+                edges.setdefault((outer, inner), (info.sf, node))
+            for node in info.reentrant_nodes:
+                findings.append(self.finding(
+                    info.sf, node, HIGH,
+                    "re-acquiring a non-reentrant lock of %s while "
+                    "already held deadlocks immediately" % info.name,
+                    check=CHECK_REENTRANT))
+            for lock, call, mname in info.calls_under_lock:
+                f = call.func
+                if not isinstance(f, ast.Attribute):
+                    continue
+                callee = f.attr
+                is_self_call = (isinstance(f.value, ast.Name)
+                                and f.value.id == "self")
+                if is_self_call and callee in info.methods:
+                    for inner in info.acquires.get(callee, ()):
+                        if inner == lock and info.is_nonreentrant(lock):
+                            findings.append(self.finding(
+                                info.sf, call, HIGH,
+                                "self.%s() acquires non-reentrant %s "
+                                "already held here — deadlock"
+                                % (callee, lock), check=CHECK_REENTRANT))
+                        elif inner != lock:
+                            edges.setdefault((lock, inner),
+                                             (info.sf, call))
+                    continue
+                owners = method_locks.get(callee, [])
+                if not is_self_call and callee not in _COMMON_METHOD_NAMES \
+                        and 0 < len(owners) <= _AMBIGUITY_CAP:
+                    for other, locks in owners:
+                        if other is info:
+                            continue
+                        for inner in locks:
+                            if inner != lock:
+                                edges.setdefault((lock, inner),
+                                                 (info.sf, call))
+        findings.extend(self._cycles(edges))
+        return findings
+
+    def _cycles(self, edges: Dict[Tuple[str, str],
+                                  Tuple[SourceFile, ast.AST]]
+                ) -> List[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        out: List[Finding] = []
+        reported: Set[frozenset] = set()
+        for start in sorted(graph):
+            path: List[str] = []
+            on_path: Set[str] = set()
+
+            def dfs(u: str) -> Optional[List[str]]:
+                path.append(u)
+                on_path.add(u)
+                for v in sorted(graph.get(u, ())):
+                    if v == start and len(path) > 1:
+                        return list(path)
+                    if v not in on_path and v > start:
+                        cyc = dfs(v)
+                        if cyc:
+                            return cyc
+                path.pop()
+                on_path.discard(u)
+                return None
+
+            cycle = dfs(start)
+            if cycle:
+                key = frozenset(cycle)
+                if key in reported:
+                    continue
+                reported.add(key)
+                first_edge = (cycle[0], cycle[1 % len(cycle)])
+                sf, node = edges.get(first_edge,
+                                     next(iter(edges.values())))
+                out.append(self.finding(
+                    sf, node, HIGH,
+                    "lock acquisition-order cycle %s — threads taking "
+                    "these locks in opposite orders deadlock; pick one "
+                    "global order" % " -> ".join(cycle + [cycle[0]]),
+                    check=CHECK_ORDER))
+        return out

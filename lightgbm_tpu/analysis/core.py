@@ -1,0 +1,269 @@
+"""tpulint core: jax-import-free AST analysis framework.
+
+The reference enforces its threading invariants by convention — the
+exception-safe ``OMP_INIT_EX()`` / ``OMP_LOOP_EX_BEGIN()`` macro
+discipline (include/LightGBM/utils/openmp_wrapper.h) that every hot
+loop must follow by hand.  This package is the JAX/threading analogue
+enforced by a checker: a small visitor framework over ``ast`` plus four
+checker families (jit/retrace hazards, lock discipline, config drift,
+resource/exception hygiene) that gate CI via ``tools/lint.py``.
+
+Design constraints:
+
+- **No jax import, no lightgbm_tpu import.**  The linter must run in
+  environments where ``JAX_PLATFORMS`` is unavailable (pre-merge CI,
+  doc builders), so everything here is stdlib-only and the package is
+  loadable standalone (tools/lint.py loads it by file path without
+  executing ``lightgbm_tpu/__init__``).
+- **Stable fingerprints.**  A finding's identity must survive line
+  shifts AND file moves, or the baseline churns on every refactor.
+  Fingerprints hash (check id, file basename, enclosing qualname,
+  normalized source line, occurrence index) — never the directory or
+  the line number.
+- **Suppression is visible.**  ``# tpulint: ok=<check>`` on the
+  offending line (or ``# tpulint: disable-next-line=<check>`` above it)
+  is the allowlist for deliberate sync points / long-lived sockets; a
+  bare ``# tpulint: ok`` suppresses every check on that line.  Grep for
+  ``tpulint:`` to audit every exemption.
+"""
+from __future__ import annotations
+
+import ast
+import hashlib
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+HIGH = "HIGH"
+MEDIUM = "MEDIUM"
+LOW = "LOW"
+SEVERITIES = (HIGH, MEDIUM, LOW)
+_SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+_SUPPRESS_RE = re.compile(   # longest alternative first: 'disable' must
+    r"#\s*tpulint:\s*"       # not shadow 'disable-next-line'
+    r"(disable-next-line|ok|disable)\s*(?:=\s*([\w,\- ]+))?")
+
+
+class Finding:
+    """One diagnostic: where, what, how bad, and a move-stable identity."""
+
+    __slots__ = ("check", "severity", "path", "line", "col", "message",
+                 "scope", "fingerprint")
+
+    def __init__(self, check: str, severity: str, path: str, line: int,
+                 col: int, message: str, scope: str = "",
+                 fingerprint: str = ""):
+        assert severity in SEVERITIES, severity
+        self.check = check
+        self.severity = severity
+        self.path = path
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+        self.scope = scope
+        self.fingerprint = fingerprint
+
+    def sort_key(self):
+        return (_SEV_RANK[self.severity], self.path, self.line, self.check)
+
+    def to_dict(self) -> Dict:
+        return {"check": self.check, "severity": self.severity,
+                "path": self.path, "line": self.line, "col": self.col,
+                "message": self.message, "scope": self.scope,
+                "fingerprint": self.fingerprint}
+
+    def format(self) -> str:
+        where = "%s:%d:%d" % (self.path, self.line, self.col)
+        scope = (" [%s]" % self.scope) if self.scope else ""
+        return "%s: %s %s: %s%s" % (where, self.severity, self.check,
+                                    self.message, scope)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "Finding(%s)" % self.format()
+
+
+def _parse_suppressions(lines: Sequence[str]) -> Dict[int, Set[str]]:
+    """line number (1-based) -> set of suppressed check ids ('*' = all)."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        kind, arg = m.group(1), m.group(2)
+        checks = ({c.strip() for c in arg.split(",") if c.strip()}
+                  if arg else {"*"})
+        target = i + 1 if kind == "disable-next-line" else i
+        out.setdefault(target, set()).update(checks)
+    return out
+
+
+class SourceFile:
+    """One parsed module: source text, AST with parent links, and the
+    per-line suppression table."""
+
+    def __init__(self, abspath: str, rel: str, text: str):
+        self.abspath = abspath
+        self.rel = rel.replace(os.sep, "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+        self.suppress = _parse_suppressions(self.lines)
+        self._parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def qualname(self, node: ast.AST) -> str:
+        """Enclosing 'Class.method' (or 'func', or '<module>') of a node
+        — the scope component of the fingerprint."""
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self._parents.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+    def is_suppressed(self, line: int, check: str) -> bool:
+        checks = self.suppress.get(line)
+        return bool(checks) and ("*" in checks or check in checks)
+
+
+class Project:
+    """The file set one lint run sees, plus the repo root for checkers
+    that need non-Python inputs (docs/Parameters.md)."""
+
+    def __init__(self, root: str, files: Sequence[SourceFile]):
+        self.root = root
+        self.files = list(files)
+        self.by_rel = {f.rel: f for f in self.files}
+
+    def iter_files(self, prefixes: Optional[Sequence[str]] = None
+                   ) -> Iterable[SourceFile]:
+        if prefixes is None:
+            yield from self.files
+            return
+        for f in self.files:
+            if any(f.rel.startswith(p) or f.rel == p.rstrip("/")
+                   for p in prefixes):
+                yield f
+
+
+class Checker:
+    """One checker family.  Subclasses set ``id``/``description`` and
+    implement ``run`` over the whole project (cross-file checks like
+    config drift and lock-order cycles need the global view)."""
+
+    id = "base"
+    description = ""
+
+    def run(self, project: Project) -> Iterable[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, sf: SourceFile, node: ast.AST, severity: str,
+                message: str, check: Optional[str] = None) -> Finding:
+        return Finding(check or self.id, severity, sf.rel,
+                       getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0) + 1,
+                       message, scope=sf.qualname(node))
+
+
+# -- fingerprints ----------------------------------------------------------
+
+def _norm_line(text: str) -> str:
+    return " ".join(text.split())
+
+
+def assign_fingerprints(findings: List[Finding],
+                        by_rel: Dict[str, SourceFile]) -> None:
+    """Stable identity: sha1(check | basename | scope | normalized line
+    | k) where k disambiguates identical lines within one scope by
+    order of appearance.  Deliberately excludes directory and line
+    number so renames/moves and unrelated edits don't churn the
+    baseline."""
+    seen: Dict[Tuple, int] = {}
+    for f in sorted(findings, key=lambda x: (x.path, x.line, x.col, x.check)):
+        sf = by_rel.get(f.path)
+        line_text = _norm_line(sf.line_text(f.line)) if sf else ""
+        key = (f.check, os.path.basename(f.path), f.scope, line_text)
+        k = seen.get(key, 0)
+        seen[key] = k + 1
+        blob = "|".join((f.check, os.path.basename(f.path), f.scope,
+                         line_text, str(k)))
+        f.fingerprint = hashlib.sha1(blob.encode("utf-8")).hexdigest()[:16]
+
+
+# -- file collection and the suite entry point -----------------------------
+
+DEFAULT_ROOTS = ("lightgbm_tpu", "tools", "bench.py")
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules"}
+
+
+def collect_files(root: str, paths: Optional[Sequence[str]] = None
+                  ) -> Tuple[List[SourceFile], List[Finding]]:
+    """Load every .py under the default roots (or the explicit paths).
+    Unparseable files become parse-error findings instead of crashing
+    the run — a linter that dies on bad input can't gate anything."""
+    targets: List[str] = []
+    for p in (paths or DEFAULT_ROOTS):
+        absp = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(absp):
+            targets.append(absp)
+        elif os.path.isdir(absp):
+            for dirpath, dirnames, filenames in os.walk(absp):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in _SKIP_DIRS)
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        targets.append(os.path.join(dirpath, fn))
+    files: List[SourceFile] = []
+    errors: List[Finding] = []
+    for absp in targets:
+        rel = os.path.relpath(absp, root).replace(os.sep, "/")
+        try:
+            with open(absp, encoding="utf-8") as fh:
+                text = fh.read()
+            files.append(SourceFile(absp, rel, text))
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            line = getattr(e, "lineno", 1) or 1
+            errors.append(Finding("parse-error", HIGH, rel, line, 1,
+                                  "cannot analyze: %s" % e))
+    return files, errors
+
+
+def run_suite(root: str, paths: Optional[Sequence[str]] = None,
+              only: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run every registered checker (or the ``only`` subset) and return
+    fingerprinted, suppression-filtered, severity-sorted findings."""
+    from .checkers import all_checkers
+
+    files, findings = collect_files(root, paths)
+    project = Project(root, files)
+    for checker in all_checkers():
+        if only and checker.id not in only:
+            continue
+        findings.extend(checker.run(project))
+    findings = [f for f in findings
+                if not (f.path in project.by_rel
+                        and project.by_rel[f.path].is_suppressed(f.line,
+                                                                 f.check))]
+    assign_fingerprints(findings, project.by_rel)
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+def severity_counts(findings: Iterable[Finding]) -> Dict[str, int]:
+    out = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        out[f.severity] += 1
+    return out

@@ -142,7 +142,8 @@ class Counter:
 
     def set_fn(self, fn: Optional[Callable[[], float]]) -> "Counter":
         """Pull the value from `fn` at collect time instead of inc()."""
-        self._fn = fn
+        with self._lock:
+            self._fn = fn
         return self
 
     @property
@@ -178,7 +179,8 @@ class Gauge:
         self.inc(-amount)
 
     def set_fn(self, fn: Optional[Callable[[], float]]) -> "Gauge":
-        self._fn = fn
+        with self._lock:
+            self._fn = fn
         return self
 
     @property

@@ -160,7 +160,8 @@ class SpanTracer:
         return self
 
     def disable(self) -> None:
-        self.enabled = False
+        with self._lock:
+            self.enabled = False
 
     def set_metadata(self, **kv) -> None:
         """Attach run facts to the file metadata (rank coordinates, comm
@@ -173,8 +174,10 @@ class SpanTracer:
         relative to the comm hub (hub clock minus local clock, seconds);
         trace_merge ADDS it to local wall timestamps to express every
         rank's spans in hub time."""
-        self._clock_offset_us = float(offset_s) * 1e6
-        self.set_metadata(clock_offset_us=round(self._clock_offset_us, 1),
+        offset_us = float(offset_s) * 1e6
+        with self._lock:
+            self._clock_offset_us = offset_us
+        self.set_metadata(clock_offset_us=round(offset_us, 1),
                           clock_rtt_us=round(float(rtt_s) * 1e6, 1))
 
     # -- recording ------------------------------------------------------ #
@@ -282,7 +285,8 @@ class SpanTracer:
     def close(self) -> Optional[str]:
         """Flush and disarm; subsequent spans are free no-ops again."""
         path = self.flush()
-        self.enabled = False
+        with self._lock:
+            self.enabled = False
         return path
 
     # -- internals ------------------------------------------------------ #
@@ -327,7 +331,9 @@ class SpanTracer:
                     kind=kind)
             except Exception:  # noqa: BLE001 — metrics must not kill a span
                 return
-            self._hist_cache[kind] = hist
+            # benign last-wins race: the registry dedupes children by
+            # label key, so concurrent builders store the same object
+            self._hist_cache[kind] = hist  # tpulint: ok=lock-shared-write
         try:
             hist.observe(ms)
         except Exception:  # noqa: BLE001

@@ -261,7 +261,7 @@ class SocketComm:
             self._publish_trace_identity()
             return
         if rank == 0:
-            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)  # tpulint: ok=socket-no-with
             srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             # bind the interface the machine list names for rank 0.  If
             # that address is not locally bindable (NAT / port-forward
@@ -320,7 +320,7 @@ class SocketComm:
             deadline = time.monotonic() + timeout_s
             t0 = time.monotonic()
             while True:
-                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)  # tpulint: ok=socket-no-with
                 s.settimeout(min(5.0, timeout_s))
                 try:
                     s.connect((host, int(port)))
@@ -744,7 +744,7 @@ class ElasticComm(SocketComm):
     def _form_hub(self, gen: int, timeout_s: float,
                   port_offset: int) -> dict:
         host, port = self._addr(self.orig_rank, port_offset)
-        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)  # tpulint: ok=socket-no-with
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         try:
             srv.bind((host, port))
@@ -881,7 +881,7 @@ class ElasticComm(SocketComm):
             for c in candidates:
                 if time.monotonic() >= deadline:
                     break
-                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)  # tpulint: ok=socket-no-with
                 s.settimeout(1.0)
                 try:
                     s.connect(self._addr(c, port_offset))
@@ -922,7 +922,7 @@ class ElasticComm(SocketComm):
         t1, t2 = float(assign["t1"]), float(assign["t2"])
         clock = (((t1 - wall_t0) + (t2 - wall_t3)) / 2.0,
                  (wall_t3 - wall_t0) - (t2 - t1))
-        ctrl = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ctrl = socket.socket(socket.AF_INET, socket.SOCK_STREAM)  # tpulint: ok=socket-no-with
         ctrl.settimeout(timeout_s)
         try:
             ctrl.connect(self._addr(hub, port_offset))
@@ -1006,9 +1006,10 @@ class ElasticComm(SocketComm):
         log.warning("elastic: fencing rank(s) %s at generation %d",
                     sorted(fresh), self.generation)
         # 1. our own collectives must stop retrying against the fence
-        self._world_changed = WorldChangedError(
-            "peer rank(s) fenced by liveness monitor",
-            dead_ranks=all_dead, generation=self.generation)
+        with self._fence_lock:
+            self._world_changed = WorldChangedError(
+                "peer rank(s) fenced by liveness monitor",
+                dead_ranks=all_dead, generation=self.generation)
         # 2. poison every surviving spoke so nobody blocks past this
         poison = _encode({"dead": all_dead, "generation": self.generation})
         for orig, st in self._ctrl.items():
@@ -1050,9 +1051,10 @@ class ElasticComm(SocketComm):
             except (OSError, ConnectionError, ValueError):
                 if self._ctrl_stop.is_set():
                     break
-                self._world_changed = WorldChangedError(
-                    "control channel to hub lost",
-                    dead_ranks=[hub_orig], generation=self.generation)
+                with self._fence_lock:
+                    self._world_changed = WorldChangedError(
+                        "control channel to hub lost",
+                        dead_ranks=[hub_orig], generation=self.generation)
                 for s in self._peers:
                     _shutdown(s)
                 break
@@ -1067,10 +1069,11 @@ class ElasticComm(SocketComm):
                 except ValueError:
                     info = {}
                 dead = [int(r) for r in info.get("dead", [])]
-                self._world_changed = WorldChangedError(
-                    "world membership changed", dead_ranks=dead,
-                    generation=int(info.get("generation", g)),
-                    fenced=self.orig_rank in dead)
+                with self._fence_lock:
+                    self._world_changed = WorldChangedError(
+                        "world membership changed", dead_ranks=dead,
+                        generation=int(info.get("generation", g)),
+                        fenced=self.orig_rank in dead)
                 for s in self._peers:
                     _shutdown(s)
                 break

@@ -96,9 +96,11 @@ class MicroBatcher:
 
     # -- public API ---------------------------------------------------- #
     def start(self) -> "MicroBatcher":
-        if not self._started:
+        with self._lock:
+            if self._started:
+                return self
             self._started = True
-            self._worker.start()
+        self._worker.start()
         return self
 
     def stop(self, join: bool = True) -> None:

@@ -240,24 +240,28 @@ class Server:
                    block: bool = True) -> ThreadingHTTPServer:
         host = host if host is not None else self.config.serve_host
         port = port if port is not None else self.config.serve_port
-        self._httpd = ThreadingHTTPServer((host, port), _make_handler(self))
-        self._httpd.daemon_threads = True
-        bound = self._httpd.server_address
+        httpd = ThreadingHTTPServer((host, port), _make_handler(self))
+        httpd.daemon_threads = True
+        with self._lock:
+            self._httpd = httpd
+        bound = httpd.server_address
         log.info("serving on http://%s:%d (POST /predict, GET /stats, "
                  "GET /metrics)", bound[0], bound[1])
         if block:
             try:
-                self._httpd.serve_forever()
+                httpd.serve_forever()
             except KeyboardInterrupt:
                 log.info("interrupt: shutting down server")
             finally:
                 self.shutdown()
         else:
-            self._http_thread = threading.Thread(
-                target=self._httpd.serve_forever, daemon=True,
+            thread = threading.Thread(
+                target=httpd.serve_forever, daemon=True,
                 name="lgbm-serve-http")
-            self._http_thread.start()
-        return self._httpd
+            with self._lock:
+                self._http_thread = thread
+            thread.start()
+        return httpd
 
     @property
     def http_port(self) -> Optional[int]:
@@ -274,10 +278,10 @@ class Server:
         """Flip to draining: /readyz goes 503 (so load balancers stop
         sending), new predicts get DrainingError, queued + in-flight
         requests keep going."""
-        if self._draining:
-            return
-        self._draining = True
         with self._lock:
+            if self._draining:
+                return
+            self._draining = True
             batchers = list(self._batchers.values())
         for b in batchers:
             b.begin_drain()
@@ -325,7 +329,8 @@ class Server:
         return True
 
     def shutdown(self) -> None:
-        httpd, self._httpd = self._httpd, None
+        with self._lock:
+            httpd, self._httpd = self._httpd, None
         if httpd is not None:
             httpd.shutdown()
             httpd.server_close()
@@ -334,8 +339,9 @@ class Server:
             self._batchers.clear()
         for b in batchers:
             b.stop()
-        if self._tracing:
-            self._tracing = False
+        with self._lock:
+            tracing, self._tracing = self._tracing, False
+        if tracing:
             try:
                 path = obs_tracing.get_tracer().flush()
                 if path:

@@ -1,0 +1,8 @@
+"""tpulint fixture consumer for the driftproj schema."""
+
+
+def run(cfg):
+    x = cfg.tpu_used_knob                        # schema read: fine
+    y = cfg.serve_undocumented                   # read, but not in docs
+    z = getattr(cfg, "tpu_typo_knob", None)      # -> config-phantom-param
+    return x, y, z

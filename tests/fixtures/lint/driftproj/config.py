@@ -1,0 +1,13 @@
+"""tpulint fixture schema: exercises every config-drift check."""
+
+_SCHEMA = [
+    ("num_iterations", int, 100),
+    ("tpu_used_knob", str, "auto"),
+    ("tpu_dead_knob", bool, False),     # -> config-dead-param (unread)
+    ("serve_undocumented", int, 1),     # -> config-undocumented-param
+]
+
+ALIAS_TABLE = {
+    "n_iter": "num_iterations",
+    "bad_alias": "nonexistent_param",   # -> config-broken-alias
+}

@@ -1,0 +1,24 @@
+"""tpulint fixture: every hygiene checker must FIRE on this file."""
+import socket
+
+
+def bare_except(path):
+    try:
+        return int(open(path).read())      # resource-no-with (MEDIUM)
+    except:                                # except-bare (MEDIUM)
+        return 0
+
+
+def swallow(fn):
+    try:
+        fn()
+    except Exception:                      # except-swallow (MEDIUM)
+        pass
+
+
+def leaky_socket(host, port):
+    s = socket.socket()                    # socket-no-with (LOW)
+    s.connect((host, port))
+    s.sendall(b"ping")
+    s.close()
+    return True

@@ -1,0 +1,58 @@
+"""tpulint fixture: every jit checker must FIRE on this file.
+
+Not imported by anything — scanned as AST only (tests point the lint
+suite at this directory explicitly; the repo gate never scans tests/).
+"""
+import numpy as np
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def sync_item(x):
+    total = jnp.sum(x)
+    return total.item()            # jit-host-sync (HIGH)
+
+
+@jax.jit
+def sync_block(x):
+    y = jnp.cumsum(x)
+    y.block_until_ready()          # jit-host-sync (HIGH)
+    return y
+
+
+@jax.jit
+def sync_numpy(x):
+    host = np.asarray(x)           # jit-host-sync (HIGH): host numpy
+    return jnp.asarray(host)
+
+
+@jax.jit
+def cast_traced(x):
+    return float(x) * 2.0          # jit-host-cast (MEDIUM)
+
+
+@jax.jit
+def branch_traced(x):
+    if x > 0:                      # jit-traced-branch (MEDIUM)
+        return x
+    return -x
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def branch_partial(x, mode):
+    val = x if x > 0 else -x       # jit-traced-branch (MEDIUM): IfExp on x
+    if mode == "fast":             # NOT flagged: mode is static
+        return val
+    return val * 2
+
+
+def wrapped_impl(x, n):
+    while x < n:                   # jit-traced-branch: x traced (n static)
+        x = x + 1
+    return x
+
+
+wrapped = partial(jax.jit, static_argnames=("n",))(wrapped_impl)

@@ -1,0 +1,50 @@
+"""tpulint fixture: NO jit checker may fire on this file."""
+import numpy as np
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def shape_branch(x):
+    if x.shape[0] > 128:           # shape metadata is trace-concrete
+        return jnp.sum(x)
+    return jnp.mean(x)
+
+
+@jax.jit
+def len_and_none(x, aux=None):
+    if aux is not None:            # identity test never concretizes
+        x = x + aux
+    n = float(len(x))              # len() is concrete; cast of it too
+    return x / n
+
+
+@partial(jax.jit, static_argnames=("k",))
+def static_branch(x, k):
+    if k > 3:                      # static param: fine
+        return jnp.topk(x, k)[0] if hasattr(jnp, "topk") else x
+    return x
+
+
+@jax.jit
+def local_python(x):
+    scale = 2.0
+    if scale > 1.0:                # plain python local, not a param
+        x = x * scale
+    return jnp.where(x > 0, x, 0.0)   # jnp.where instead of branching
+
+
+@jax.jit
+def allowed_sync(x):
+    s = jnp.sum(x)
+    return s.item()                # tpulint: ok=jit-host-sync
+
+
+def host_helper(x):
+    return np.asarray(x).sum()     # not jitted: host numpy is fine
+
+
+def host_cast(x):
+    return float(x)                # not jitted either

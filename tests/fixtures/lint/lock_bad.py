@@ -1,0 +1,88 @@
+"""tpulint fixture: every lock checker must FIRE on this file."""
+import queue
+import socket
+import threading
+import time
+
+
+class UnguardedWrite:
+    """_count is guarded in add() but mutated raw in reset()."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def add(self, n):
+        with self._lock:
+            self._count += n
+
+    def reset(self):
+        self._count = 0            # lock-unguarded-write (HIGH)
+
+
+class SharedWrite:
+    """No locked site for _mode, but two methods race on it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._mode = "idle"
+
+    def run(self):
+        self._mode = "busy"        # lock-shared-write (MEDIUM)
+        with self._lock:
+            self._items.append(1)
+
+    def describe(self):
+        return self._mode
+
+
+class BlockingUnderLock:
+    def __init__(self, sock, q):
+        self._lock = threading.Lock()
+        self._sock = sock
+        self._q = q
+        self._last = b""
+
+    def pump(self):
+        with self._lock:
+            data = self._sock.recv(4096)     # lock-blocking-call (HIGH)
+            item = self._q.get()             # lock-blocking-call (MEDIUM)
+            time.sleep(0.5)                  # lock-blocking-call (MEDIUM)
+            self._last = data
+            return item
+
+
+class Reentrant:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def outer(self):
+        with self._lock:
+            with self._lock:       # lock-reentrant (HIGH)
+                self._n += 1
+
+
+class OrderAB:
+    def __init__(self, other):
+        self._lock = threading.Lock()
+        self.other = other
+
+    def cross(self):
+        with self._lock:
+            self.other.locked_entry()        # A -> B edge
+
+
+class OrderBA:
+    def __init__(self, other):
+        self._lock = threading.Lock()
+        self.other = other
+
+    def locked_entry(self):
+        with self._lock:
+            return True
+
+    def cross_back(self):
+        with self._lock:
+            self.other.cross()               # B -> A edge: cycle (HIGH)

@@ -1,0 +1,65 @@
+"""tpulint fixture: NO lock checker may fire on this file."""
+import threading
+
+
+class Disciplined:
+    """Guarded attrs always mutated under the lock; Condition.wait on
+    the lock's own condition; private helper only called from
+    __init__."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue = []
+        self._stopped = False
+        self._init_state()
+
+    def _init_state(self):
+        self._queue = []           # init-only helper: no lock needed
+        self._stopped = False
+
+    def put(self, item):
+        with self._lock:
+            self._queue.append(item)
+            self._cv.notify()
+
+    def take(self):
+        with self._cv:
+            while not self._queue:
+                self._cv.wait()    # Condition.wait releases the lock
+            return self._queue.pop(0)
+
+    def stop(self):
+        with self._lock:
+            self._stopped = True
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._queue)
+
+    def peek_len(self):
+        return len(self._queue)    # read outside lock: not flagged
+
+
+class ReentrantByDesign:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._n = 0
+
+    def outer(self):
+        with self._lock:
+            with self._lock:       # RLock: re-acquire is fine
+                self._n += 1
+
+
+class TimeoutsEverywhere:
+    def __init__(self, q, worker):
+        self._lock = threading.Lock()
+        self._q = q
+        self._worker = worker
+        self._got = None
+
+    def drain(self):
+        with self._lock:
+            self._got = self._q.get(timeout=1.0)   # timed: fine
+            self._worker.join(2.0)                 # timed: fine

@@ -1,0 +1,285 @@
+"""tpulint gate + checker semantics.
+
+Loads the analysis package exactly the way tools/lint.py does (by file
+path, never through lightgbm_tpu/__init__) so these tests also prove
+the linter works without importing jax.  Fixture files with deliberate
+violations live in tests/fixtures/lint/ — the repo gate never scans
+tests/, so they cannot dirty the shipped baseline.
+"""
+import importlib.util
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIX = os.path.join(REPO, "tests", "fixtures", "lint")
+BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
+
+
+def _load_cli():
+    name = "_tpulint_cli_under_test"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+CLI = _load_cli()
+ana = CLI.load_analysis()
+
+
+def _run(*names, only=None, root=FIX):
+    paths = [os.path.join(root, n) for n in names] or None
+    return ana.run_suite(root, paths, only=only)
+
+
+def _checks(findings):
+    return {f.check for f in findings}
+
+
+# -- the repo gate itself -------------------------------------------------
+
+_repo_findings = None
+
+
+def repo_findings():
+    global _repo_findings
+    if _repo_findings is None:
+        _repo_findings = ana.run_suite(REPO)
+    return _repo_findings
+
+
+def test_repo_has_zero_high_findings():
+    highs = [f for f in repo_findings() if f.severity == "HIGH"]
+    assert highs == [], "HIGH findings must be FIXED, never baselined:\n%s" \
+        % "\n".join(f.format() for f in highs)
+
+
+def test_repo_matches_committed_baseline():
+    base = ana.baseline.load(BASELINE)
+    new, _known, stale = ana.baseline.diff(repo_findings(), base)
+    assert new == [], "new lint findings (fix or re-baseline):\n%s" \
+        % "\n".join(f.format() for f in new)
+    assert stale == [], "stale baseline entries (regenerate with " \
+        "tools/lint.py --write-baseline):\n%s" \
+        % "\n".join(str(e) for e in stale)
+
+
+# -- jit/retrace hazards --------------------------------------------------
+
+def test_jit_bad_fixture_fires():
+    fs = _run("jit_bad.py")
+    assert {"jit-host-sync", "jit-host-cast",
+            "jit-traced-branch"} <= _checks(fs)
+    syncs = [f for f in fs if f.check == "jit-host-sync"]
+    assert len(syncs) == 3 and all(f.severity == "HIGH" for f in syncs)
+    # the partial(jax.jit, ...)(impl) wrap form is recognised too
+    assert any(f.scope == "wrapped_impl" for f in fs
+               if f.check == "jit-traced-branch")
+    # static params never count as traced
+    branch_names = [f.message for f in fs if f.check == "jit-traced-branch"]
+    assert not any("'mode'" in m or "'n'" in m for m in branch_names)
+
+
+def test_jit_ok_fixture_is_clean():
+    assert not [f for f in _run("jit_ok.py")
+                if f.check.startswith("jit-")]
+
+
+# -- lock discipline ------------------------------------------------------
+
+def test_lock_bad_fixture_fires():
+    fs = _run("lock_bad.py")
+    assert {"lock-unguarded-write", "lock-shared-write",
+            "lock-blocking-call", "lock-reentrant",
+            "lock-order-cycle"} <= _checks(fs)
+    blocking = [f for f in fs if f.check == "lock-blocking-call"]
+    assert {f.severity for f in blocking} == {"HIGH", "MEDIUM"}
+    unguarded = [f for f in fs if f.check == "lock-unguarded-write"]
+    assert any(f.scope == "UnguardedWrite.reset" for f in unguarded)
+
+
+def test_lock_ok_fixture_is_clean():
+    assert not [f for f in _run("lock_ok.py")
+                if f.check.startswith("lock-")]
+
+
+# -- hygiene --------------------------------------------------------------
+
+def test_hygiene_bad_fixture_fires():
+    fs = _run("hygiene_bad.py")
+    assert {"except-bare", "except-swallow", "resource-no-with",
+            "socket-no-with"} <= _checks(fs)
+
+
+def test_hygiene_ok_fixture_is_clean():
+    assert _run("hygiene_ok.py") == []
+
+
+def test_write_no_fsync_only_inside_package(tmp_path):
+    pkg = tmp_path / "lightgbm_tpu"
+    pkg.mkdir()
+    body = ("def save(path, data):\n"
+            "    with open(path, 'w') as fh:\n"
+            "        fh.write(data)\n")
+    (pkg / "writer.py").write_text(body)
+    (pkg / "file_io.py").write_text(body)       # sanctioned home: exempt
+    fs = ana.run_suite(str(tmp_path), ["lightgbm_tpu"])
+    hits = [f for f in fs if f.check == "write-no-fsync"]
+    assert [f.path for f in hits] == ["lightgbm_tpu/writer.py"]
+
+
+# -- config drift ---------------------------------------------------------
+
+def test_config_drift_fixture_project():
+    fs = ana.run_suite(os.path.join(FIX, "driftproj"), ["."])
+    by = {f.check: f for f in fs}
+    assert set(by) == {"config-dead-param", "config-undocumented-param",
+                       "config-stale-doc", "config-broken-alias",
+                       "config-phantom-param"}
+    assert by["config-dead-param"].scope == "tpu_dead_knob"
+    assert by["config-undocumented-param"].scope == "serve_undocumented"
+    assert by["config-undocumented-param"].severity == "HIGH"
+    assert by["config-stale-doc"].scope == "tpu_removed_knob"
+    assert by["config-stale-doc"].path == "docs/Parameters.md"
+    assert by["config-broken-alias"].scope == "bad_alias"
+    assert "tpu_typo_knob" in by["config-phantom-param"].message
+
+
+def test_repo_schema_has_no_dead_or_undocumented_params():
+    assert not [f for f in repo_findings()
+                if f.check.startswith("config-")]
+
+
+# -- fingerprints and baseline --------------------------------------------
+
+def test_fingerprints_stable_across_runs():
+    a = {f.fingerprint: f.check for f in _run("lock_bad.py")}
+    b = {f.fingerprint: f.check for f in _run("lock_bad.py")}
+    assert a == b and a
+
+
+def test_fingerprints_survive_file_moves(tmp_path):
+    src = os.path.join(FIX, "lock_bad.py")
+    flat = tmp_path / "proj1"
+    nested = tmp_path / "proj2"
+    flat.mkdir()
+    (nested / "deep" / "inner").mkdir(parents=True)
+    shutil.copy(src, flat / "lock_bad.py")
+    shutil.copy(src, nested / "deep" / "inner" / "lock_bad.py")
+    fp1 = {f.fingerprint for f in ana.run_suite(str(flat), ["."])}
+    fp2 = {f.fingerprint for f in ana.run_suite(str(nested), ["."])}
+    assert fp1 == fp2 and fp1
+
+
+def test_baseline_roundtrip(tmp_path):
+    fs = _run("lock_bad.py")
+    path = str(tmp_path / "base.json")
+    ana.baseline.save(path, fs)
+    loaded = ana.baseline.load(path)
+    new, known, stale = ana.baseline.diff(fs, loaded)
+    assert new == [] and stale == [] and len(known) == len(fs)
+    # dropping a finding surfaces exactly one stale ledger entry
+    new, known, stale = ana.baseline.diff(fs[1:], loaded)
+    assert new == [] and len(stale) == 1
+    # an empty baseline fails everything
+    new, _known, _stale = ana.baseline.diff(fs, {})
+    assert len(new) == len(fs)
+
+
+def test_baseline_rejects_malformed(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text('{"tool": "other"}')
+    with pytest.raises(ValueError):
+        ana.baseline.load(str(p))
+    p.write_text('{"tool": "tpulint", "version": 99, "findings": []}')
+    with pytest.raises(ValueError):
+        ana.baseline.load(str(p))
+
+
+# -- suppressions and selection -------------------------------------------
+
+_RACY = ("import threading\n"
+         "class C:\n"
+         "    def __init__(self):\n"
+         "        self._lock = threading.Lock()\n"
+         "        self._x = 0\n"
+         "    def locked(self):\n"
+         "        with self._lock:\n"
+         "            self._x += 1\n"
+         "    def racy(self):\n"
+         "%s"
+         "        self._x = 5\n")
+
+
+def test_disable_next_line_suppression(tmp_path):
+    flagged = tmp_path / "a.py"
+    flagged.write_text(_RACY % "")
+    fs = ana.run_suite(str(tmp_path), ["a.py"])
+    assert "lock-unguarded-write" in _checks(fs)
+    ok = tmp_path / "b.py"
+    ok.write_text(_RACY %
+                  "        # tpulint: disable-next-line="
+                  "lock-unguarded-write\n")
+    fs = ana.run_suite(str(tmp_path), ["b.py"])
+    assert "lock-unguarded-write" not in _checks(fs)
+
+
+def test_only_filter_limits_checker_families():
+    fs = _run("lock_bad.py", "hygiene_bad.py", only=["hygiene"])
+    assert fs and not [f for f in fs if f.check.startswith("lock-")]
+
+
+def test_parse_error_becomes_finding(tmp_path):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    fs = ana.run_suite(str(tmp_path), ["broken.py"])
+    assert [f.check for f in fs] == ["parse-error"]
+    assert fs[0].severity == "HIGH"
+
+
+# -- the CLI, without jax -------------------------------------------------
+
+def _cli(args, env_extra=None, poison_jax=True, tmp_path=None):
+    """Run tools/lint.py in a subprocess with -S (no sitecustomize) and
+    a poisoned `jax` module on PYTHONPATH: any jax import anywhere in
+    the lint path explodes loudly."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    if poison_jax:
+        poison = tmp_path / "poison"
+        poison.mkdir(exist_ok=True)
+        (poison / "jax.py").write_text(
+            "raise RuntimeError('tpulint must not import jax')\n")
+        env["PYTHONPATH"] = str(poison)
+    return subprocess.run(
+        [sys.executable, "-S", os.path.join(REPO, "tools", "lint.py")]
+        + args, capture_output=True, text=True, env=env, cwd=REPO)
+
+
+@pytest.mark.slow
+def test_cli_gate_passes_on_shipped_tree(tmp_path):
+    res = _cli(["--baseline", BASELINE], tmp_path=tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "0 new" in res.stdout
+
+
+def test_cli_gate_fails_on_violation_file(tmp_path):
+    res = _cli(["--root", FIX, "--baseline", BASELINE, "lock_bad.py"],
+               tmp_path=tmp_path)
+    assert res.returncode == 1, res.stdout + res.stderr
+
+
+def test_cli_json_report(tmp_path):
+    res = _cli(["--root", FIX, "--json", "jit_bad.py"], tmp_path=tmp_path)
+    doc = json.loads(res.stdout)
+    assert doc["tool"] == "tpulint"
+    assert doc["total"] == len(doc["findings"]) > 0
+    assert {f["check"] for f in doc["findings"]} >= {"jit-host-sync"}

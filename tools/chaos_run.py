@@ -33,7 +33,7 @@ import numpy as np  # noqa: E402
 
 
 def _free_port() -> int:
-    s = socket.socket()
+    s = socket.socket()  # tpulint: ok=socket-no-with
     s.bind(("127.0.0.1", 0))
     p = s.getsockname()[1]
     s.close()

@@ -25,7 +25,8 @@ def parse_sections():
     """(section title, [param names]) in schema order, recovered from the
     `# --- section` comments inside the _SCHEMA literal — the analogue of
     the reference parsing config.h's `#pragma region` / doc comments."""
-    src = open(os.path.join(REPO, "lightgbm_tpu", "config.py")).read()
+    with open(os.path.join(REPO, "lightgbm_tpu", "config.py")) as fh:
+        src = fh.read()
     body = src.split("_SCHEMA = [", 1)[1].split("\n]", 1)[0]
     sections, current = [], ("Parameters", [])
     for line in body.splitlines():

@@ -362,6 +362,48 @@ def lint_smoke():
         return "FAILED: %s" % e
 
 
+def perf_smoke(result):
+    """tools/perf_gate.py over this run's numbers (one line in `detail`).
+
+    Feeds the bench result just produced through the committed perf
+    ledger (tools/perf_baseline.json) in a subprocess — the same gate CI
+    runs against the BENCH_r*.json wrapper — so a throughput regression
+    shows up as "BREACH" right in the bench output instead of next
+    round's diff.  Never fails the bench: the gate's verdict (pass /
+    breach / skip) IS the summary line.
+    """
+    import os
+    import subprocess
+    import tempfile
+    from lightgbm_tpu.config import Config
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        cfg = Config()
+        fd, path = tempfile.mkstemp(prefix="lgbm_bench_perf",
+                                    suffix=".json")
+        with os.fdopen(fd, "w") as f:
+            json.dump(result, f)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(here, "tools", "perf_gate.py"),
+                 "--bench", path,
+                 "--baseline", os.path.join(here, "tools",
+                                            "perf_baseline.json"),
+                 "--tolerance", str(cfg.tpu_perf_gate_tolerance)],
+                capture_output=True, text=True, timeout=60)
+        finally:
+            os.unlink(path)
+        verdict = (proc.stdout.strip().splitlines() or [""])[-1]
+        if proc.returncode == 0:
+            return verdict
+        breaches = [ln for ln in proc.stderr.strip().splitlines()
+                    if ln.startswith("BREACH")]
+        return "rc=%d %s" % (proc.returncode,
+                             "; ".join(breaches) or verdict)
+    except Exception as e:  # noqa: BLE001 — smoke only, never fatal
+        return "FAILED: %s" % e
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -394,6 +436,8 @@ def main():
             "lint_smoke": lint_smoke(),
         },
     }
+    # the gate reads the finished result, so it attaches after the fact
+    result["detail"]["perf_smoke"] = perf_smoke(result)
     print(json.dumps(result))
     return 0 if ok else 1
 

@@ -235,6 +235,20 @@ _SCHEMA = [
     ("tpu_serve_breaker_reset_s", float, 30.0),  # open -> half-open probe delay
     ("tpu_serve_drain_timeout_s", float, 10.0),  # SIGTERM: max wait for in-flight
     #   requests before the server exits
+    # --- perf / roofline parameters (no reference analogue)
+    # Roofline performance observatory (obs/perf, tools/roofline_report,
+    # tools/perf_gate): analytic HBM-byte/FLOP floors per hot kernel vs
+    # the measured chip ceilings; see docs/Observability.md.
+    ("tpu_perf_roofline", bool, True),       # attach a roofline section (analytic
+    #   byte budget vs achieved GB/s) to each recorder round event and the
+    #   lgbm_roofline_* gauges; training output is bitwise-identical on/off
+    ("tpu_perf_hbm_gbps", float, 161.0),     # measured HBM stream roof (NOTES.md)
+    ("tpu_perf_peak_tflops", float, 24.0),   # measured compute roof, any dtype
+    ("tpu_perf_chain", int, 8),              # dispatches chained per timing sync
+    #   in the measurement harness (amortizes ~100 ms tunnel fetch latency)
+    ("tpu_perf_gate_tolerance", float, 0.15),  # perf-ledger regression tolerance:
+    #   tools/perf_gate.py fails when a tracked metric drops more than this
+    #   fraction below its committed baseline
 ]
 
 # alias -> canonical name (src/io/config_auto.cpp:4-157)
@@ -599,6 +613,16 @@ class Config:
             log.fatal("tpu_serve_shed_retry_after_s / "
                       "tpu_serve_breaker_reset_s / tpu_serve_drain_timeout_s "
                       "must be >= 0")
+        if self.tpu_perf_hbm_gbps <= 0 or self.tpu_perf_peak_tflops <= 0:
+            log.fatal("tpu_perf_hbm_gbps and tpu_perf_peak_tflops must be "
+                      "> 0, got %g / %g" % (self.tpu_perf_hbm_gbps,
+                                            self.tpu_perf_peak_tflops))
+        if self.tpu_perf_chain < 1:
+            log.fatal("tpu_perf_chain must be >= 1, got %d"
+                      % self.tpu_perf_chain)
+        if not 0 <= self.tpu_perf_gate_tolerance < 1:
+            log.fatal("tpu_perf_gate_tolerance must be in [0, 1), got %g"
+                      % self.tpu_perf_gate_tolerance)
 
     def is_single_machine(self) -> bool:
         return self.num_machines <= 1

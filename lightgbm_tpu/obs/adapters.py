@@ -47,6 +47,13 @@ def ensure_device_metrics(reg: MetricsRegistry) -> None:
     reg.counter("lgbm_xla_cache_hits_total",
                 help="Compilation-cache hits").set_fn(
         lambda: device_mod.compile_counts()["cache_hits"])
+    reg.gauge("lgbm_xla_peak_hbm_bytes",
+              help="High-water mark of XLA's peak-HBM estimate "
+                   "(max over analyze_compiled results)").set_fn(
+        lambda: device_mod.hbm_stats()["peak_hbm_bytes"])
+    reg.counter("lgbm_xla_cost_analyses_total",
+                help="analyze_compiled calls that produced stats").set_fn(
+        lambda: device_mod.hbm_stats()["analyses"])
 
 
 def ensure_comm_metrics(reg: MetricsRegistry, rank: int = 0,

@@ -27,6 +27,10 @@ _counts = {
     "traces": 0,             # jaxpr traces (retraces included)
     "cache_hits": 0,         # compilation-cache hits
 }
+# high-water mark over every analyze_compiled result this process — the
+# live-gauge view of XLA's own peak-HBM estimate (recorder dicts only
+# see the per-retrace values)
+_hbm = {"peak_hbm_bytes": 0, "analyses": 0}
 
 # event name fragments -> counter key; matched by substring so minor
 # renames across jax versions keep counting instead of silently zeroing
@@ -132,9 +136,20 @@ def analyze_compiled(fn, args, signature: str = "") -> Optional[Dict]:
     if not stats:
         return None
     stats["signature"] = signature
+    with _lock:
+        _hbm["analyses"] += 1
+        if stats.get("peak_hbm_bytes", 0) > _hbm["peak_hbm_bytes"]:
+            _hbm["peak_hbm_bytes"] = int(stats["peak_hbm_bytes"])
     tracing.complete("compile", _time.perf_counter() - t0, cat="xla",
                      **stats)
     return stats
+
+
+def hbm_stats() -> Dict[str, int]:
+    """Process-wide peak-HBM high-water mark (max peak_hbm_bytes across
+    every analyze_compiled call) + how many analyses fed it."""
+    with _lock:
+        return dict(_hbm)
 
 
 def compile_counts() -> Dict[str, int]:

@@ -1,0 +1,305 @@
+"""Roofline performance observatory: analytic cost models + measurement.
+
+Throughput has been flat across five rounds while the obs stack could
+only say *when* an iteration was slow, never *why*: nothing attributed
+the ~450 ms/50-iter block to individual dispatches in HBM bytes and
+FLOPs against the measured chip ceilings (~161 GB/s stream, ~24 TFLOP/s
+in every dtype — NOTES.md).  This module is the measurement layer the
+fused-kernel and quantized-histogram work is steered by, following the
+roofline methodology (Williams et al., "Roofline: An Insightful Visual
+Performance Model"): every hot op registers an ANALYTIC cost model —
+the minimum HBM bytes it must move and the FLOPs it executes, derived
+from shapes/dtypes alone — next to its kernel, and a measurement
+harness using the tunnel-safe timing discipline (chain K dispatches,
+reduce to a device scalar, ``float()`` to sync — ``block_until_ready``
+is unreliable through the tunnel) turns (cost, measured ms) into
+achieved GB/s / GFLOP/s and "% of roof" numbers per kernel.
+
+Three consumers:
+
+- ``tools/roofline_report.py`` drives the hot kernels standalone and
+  prints the per-kernel roofline table + the per-iteration byte budget;
+- ``TrainingRecorder`` emits a ``roofline`` section per round event and
+  ``lgbm_roofline_*`` gauges (achieved GB/s of the boosting iteration
+  against the analytic byte floor), plus a bytes/FLOPs-tagged span in
+  the Chrome trace;
+- ``tools/perf_gate.py`` ingests roofline summaries + BENCH history
+  into the committed perf ledger and fails CI on regressions.
+
+Cost models are LOWER BOUNDS by construction (compulsory traffic only:
+each operand read once, each result written once — no re-streaming, no
+padding waste).  Achieved/analytic utilization above ~1.0 of a roof
+therefore indicates a modeling bug, and utilization far below it says
+the kernel is latency- or overhead-bound, not bandwidth-bound — exactly
+the distinction the byte budget exists to draw.
+
+Everything here is read-only on training state: models train
+bitwise-identically with the observatory on or off (the existing obs
+guarantee; tests/test_perf.py asserts it again for the roofline path).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, NamedTuple, Optional
+
+# Measured chip ceilings (NOTES.md "This chip / environment"): defaults
+# for the tpu_perf_hbm_gbps / tpu_perf_peak_tflops config knobs.
+DEFAULT_HBM_GBPS = 161.0
+DEFAULT_PEAK_TFLOPS = 24.0
+# chained dispatches per timing sync (tpu_perf_chain default): one
+# blocking fetch through the tunnel costs ~100 ms, so K calls share it
+DEFAULT_CHAIN = 8
+# perf-ledger regression tolerance (tpu_perf_gate_tolerance default);
+# tools/perf_gate.py keeps its own copy so it can run without jax
+DEFAULT_GATE_TOLERANCE = 0.15
+
+
+class KernelCost(NamedTuple):
+    """Analytic minimum cost of one kernel dispatch."""
+    kernel: str          # registry name, e.g. "partition/segment"
+    hbm_bytes: int       # compulsory HBM traffic (reads + writes)
+    flops: int           # FLOPs executed (one MAC = 2 FLOPs)
+    note: str = ""       # modeling assumptions worth showing in a table
+
+
+class Roofline(NamedTuple):
+    """The chip ceilings achieved numbers are compared against."""
+    hbm_gbps: float = DEFAULT_HBM_GBPS
+    peak_tflops: float = DEFAULT_PEAK_TFLOPS
+
+    @classmethod
+    def from_config(cls, config) -> "Roofline":
+        return cls(
+            hbm_gbps=float(getattr(config, "tpu_perf_hbm_gbps",
+                                   DEFAULT_HBM_GBPS)),
+            peak_tflops=float(getattr(config, "tpu_perf_peak_tflops",
+                                      DEFAULT_PEAK_TFLOPS)))
+
+
+# -- cost-model registry ------------------------------------------------- #
+# kernel name -> fn(**shape kwargs) -> KernelCost.  Ops modules register
+# their models at import next to the kernel they describe, so the model
+# and the kernel can be reviewed (and drift) together.
+_COST_MODELS: Dict[str, Callable[..., KernelCost]] = {}
+
+
+def cost_model(name: str):
+    """Decorator: register fn as the analytic cost model for `name`."""
+    def deco(fn: Callable[..., KernelCost]):
+        _COST_MODELS[name] = fn
+        return fn
+    return deco
+
+
+def cost(name: str, **shape_kwargs) -> KernelCost:
+    """Evaluate the registered model for `name` at concrete shapes."""
+    return _COST_MODELS[name](**shape_kwargs)
+
+
+def cost_models() -> List[str]:
+    """Registered kernel names (sorted; import side effect of ops.*)."""
+    # importing the ops modules is what populates the registry — pull
+    # them in lazily so `import lightgbm_tpu.obs` alone stays light
+    from ..ops import (histogram, histogram_pallas, split,  # noqa: F401
+                       split_pallas, partition_pallas, grow_partition,
+                       predict)
+    return sorted(_COST_MODELS)
+
+
+def achieved(kc: KernelCost, ms: float,
+             roof: Optional[Roofline] = None) -> Dict[str, float]:
+    """(cost, measured ms) -> achieved GB/s, GFLOP/s and roof shares."""
+    roof = roof or Roofline()
+    s = max(ms, 1e-9) / 1e3
+    gbps = kc.hbm_bytes / 1e9 / s
+    gflops = kc.flops / 1e9 / s
+    return {
+        "ms": round(ms, 4),
+        "hbm_bytes": int(kc.hbm_bytes),
+        "flops": int(kc.flops),
+        "gbps": round(gbps, 3),
+        "gflops": round(gflops, 3),
+        "hbm_util": round(gbps / roof.hbm_gbps, 4),
+        "flop_util": round(gflops / (roof.peak_tflops * 1e3), 6),
+        "arith_intensity": round(kc.flops / max(kc.hbm_bytes, 1), 3),
+    }
+
+
+# -- measurement harness ------------------------------------------------- #
+def _probe_scalar(out):
+    """Device scalar depending on `out`: the SMALLEST leaf of the pytree
+    summed in f32.  Forcing the smallest leaf (a partition kernel's
+    counts[2], not its multi-GB arena) keeps the probe's own bandwidth
+    out of the measurement while the single device stream still orders
+    it after the kernel."""
+    import jax
+    import jax.numpy as jnp
+    leaves = [x for x in jax.tree_util.tree_leaves(out)
+              if hasattr(x, "dtype")]
+    if not leaves:
+        return jnp.float32(0)
+    smallest = min(leaves, key=lambda x: getattr(x, "size", 1))
+    return jnp.sum(smallest.astype(jnp.float32))
+
+
+def measure(fn: Callable, args=(), chain: int = DEFAULT_CHAIN,
+            warmup: int = 1) -> float:
+    """Wall-clock one dispatch of `fn(*args)` in ms, tunnel-safe.
+
+    Discipline (NOTES.md): dispatch is async and ``block_until_ready``
+    does not reliably block on this backend, while one blocking fetch
+    costs ~100 ms of tunnel latency.  So: warm up (compile) and sync
+    once; then dispatch `chain` calls back-to-back and sync ONCE by
+    reducing the last result to a device scalar and ``float()``-ing it
+    — the single device stream guarantees every chained call finished
+    first.  Returns amortized ms per call.
+    """
+    import time
+    chain = max(int(chain), 1)
+    out = None
+    for _ in range(max(int(warmup), 1)):
+        out = fn(*args)
+    float(_probe_scalar(out))                  # compile + drain warmup
+    t0 = time.perf_counter()
+    for _ in range(chain):
+        out = fn(*args)
+    float(_probe_scalar(out))                  # ONE sync for the chain
+    return (time.perf_counter() - t0) / chain * 1e3
+
+
+def measure_kernel(name: str, fn: Callable, args=(),
+                   roof: Optional[Roofline] = None,
+                   chain: int = DEFAULT_CHAIN,
+                   **shape_kwargs) -> Dict[str, float]:
+    """measure + cost + achieved in one summary row (the roofline
+    report's unit of output)."""
+    kc = cost(name, **shape_kwargs)
+    ms = measure(fn, args, chain=chain)
+    row = {"kernel": name, "note": kc.note}
+    row.update(achieved(kc, ms, roof))
+    return row
+
+
+# -- per-iteration byte budget ------------------------------------------- #
+def iteration_budget(rows: int, features: int, max_bin: int,
+                     num_leaves: int, engine: str = "partition",
+                     dtype_bytes: int = 4) -> Dict:
+    """Analytic HBM-byte/FLOP floor for ONE boosting iteration.
+
+    A balanced-tree lower bound: the sum of parent-segment sizes over
+    the L-1 splits is modeled as n*log2(L) rows (leaf-wise growth on
+    skewed data streams fewer — this is the floor the 161 GB/s roof is
+    multiplied against, not a prediction).  Phases follow the measured
+    shape of the loop (NOTES.md per-iteration budget): root histogram,
+    per-split partition + smaller-child histogram + split scan, then
+    the fixed per-tree work (g/h refresh, carry compaction, score).
+
+    Returns {"phases": [{phase, bytes, flops, note}...],
+             "total_bytes", "total_flops"} — the byte-budget table.
+    """
+    import math
+    n = max(int(rows), 1)
+    F = max(int(features), 1)
+    B = max(int(max_bin), 2)
+    L = max(int(num_leaves), 2)
+    depth = max(math.log2(L), 1.0)
+    hist_out = F * B * 3 * 4                     # f32 [F, B, 3]
+    phases: List[Dict] = []
+
+    def add(phase, nbytes, flops, note=""):
+        phases.append({"phase": phase, "bytes": int(nbytes),
+                       "flops": int(flops), "note": note})
+
+    if engine == "partition":
+        from ..ops import partition_pallas as pp
+        row_b = 2 * pp.arena_channels(F)        # bf16 arena row footprint
+        # root histogram: one streamed pass over the full arena
+        add("root_hist", n * row_b + hist_out, 2 * n * (3 + F),
+            "one arena pass")
+        # per-split partition: read parent once, write both children
+        split_rows = n * depth                  # balanced-tree bound
+        add("partition", 2 * split_rows * row_b,
+            2 * split_rows * 2 * pp.SUB,
+            "sum(parent) ~ n*log2(L); compaction MACs DMA-overlapped")
+        # smaller-child histograms: half the parent rows per split
+        add("child_hist", (split_rows / 2) * row_b + (L - 1) * hist_out,
+            2 * (split_rows / 2) * (3 + F), "smaller child only")
+        # split scans: histogram in, packed split row out
+        add("split_scan", L * (hist_out + F * 64),
+            L * F * B * 32, "L histogram scans")
+        # fixed per-tree: g/h plane refresh + carry compaction + score
+        add("gh_refresh", n * (2 * dtype_bytes + 6 * 2), 8 * n,
+            "grad/hess -> residue planes")
+        add("carry_compact", 2 * n * row_b, 0, "ping-pong root slot")
+    else:
+        bins_b = n * F                          # uint8 bin matrix
+        gh_b = n * (2 * dtype_bytes + 4)        # g, h, leaf ids
+        add("root_hist", bins_b + gh_b + hist_out, 2 * n * F * 3,
+            "one masked pass")
+        split_rows = n * depth
+        add("child_hist", (split_rows / 2) * (F + 2 * dtype_bytes + 4)
+            + (L - 1) * hist_out, 2 * (split_rows / 2) * F * 3,
+            "compact impl: smaller child rows only")
+        add("split_scan", L * (hist_out + F * 64), L * F * B * 32,
+            "L histogram scans")
+        add("leaf_update", depth * n * 4, depth * n,
+            "row->leaf label rewrites")
+        add("score_update", n * 2 * dtype_bytes, 2 * n, "score += leaf out")
+
+    total_b = sum(p["bytes"] for p in phases)
+    total_f = sum(p["flops"] for p in phases)
+    for p in phases:
+        p["share"] = round(p["bytes"] / max(total_b, 1), 4)
+    return {"engine": engine, "rows": n, "features": F, "max_bin": B,
+            "num_leaves": L, "phases": phases,
+            "total_bytes": int(total_b), "total_flops": int(total_f)}
+
+
+def budget_summary(budget: Dict, wall_s: float,
+                   roof: Optional[Roofline] = None) -> Dict[str, float]:
+    """One iteration's budget + measured wall seconds -> the recorder's
+    per-round roofline dict (achieved GB/s against the analytic floor)."""
+    roof = roof or Roofline()
+    s = max(float(wall_s), 1e-9)
+    gbps = budget["total_bytes"] / 1e9 / s
+    gflops = budget["total_flops"] / 1e9 / s
+    # 6 decimals: a compile-dominated first round on a CPU backend is
+    # micro-GB/s and must not round to an (apparently broken) zero
+    return {
+        "analytic_mb": round(budget["total_bytes"] / 1e6, 3),
+        "analytic_gflop": round(budget["total_flops"] / 1e9, 3),
+        "achieved_gbps": round(gbps, 6),
+        "achieved_gflops": round(gflops, 6),
+        "hbm_util": round(gbps / roof.hbm_gbps, 6),
+        "flop_util": round(gflops / (roof.peak_tflops * 1e3), 9),
+    }
+
+
+# -- registry publication ------------------------------------------------ #
+def publish_iteration_gauges(reg, summary: Dict[str, float]) -> None:
+    """Per-round roofline gauges (set, not set_fn: the recorder owns the
+    cadence — one update per boosting round)."""
+    reg.gauge("lgbm_roofline_achieved_gbps",
+              help="Analytic iteration bytes / measured iteration wall "
+                   "(GB/s)").set(summary["achieved_gbps"])
+    reg.gauge("lgbm_roofline_hbm_util",
+              help="Achieved GB/s over the measured HBM roof").set(
+        summary["hbm_util"])
+    reg.gauge("lgbm_roofline_iteration_mb",
+              help="Analytic HBM-byte floor per boosting iteration "
+                   "(MB)").set(summary["analytic_mb"])
+
+
+def publish_kernel_summaries(reg, rows: List[Dict]) -> None:
+    """Per-kernel roofline gauges (tools/roofline_report.py publishes
+    these when asked to leave a scrapeable trail)."""
+    for r in rows:
+        labels = dict(kernel=r["kernel"])
+        reg.gauge("lgbm_roofline_kernel_gbps",
+                  help="Achieved HBM GB/s per kernel", **labels).set(
+            r["gbps"])
+        reg.gauge("lgbm_roofline_kernel_gflops",
+                  help="Achieved GFLOP/s per kernel", **labels).set(
+            r["gflops"])
+        reg.gauge("lgbm_roofline_kernel_hbm_util",
+                  help="Per-kernel share of the HBM roof", **labels).set(
+            r["hbm_util"])

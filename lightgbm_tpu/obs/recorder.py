@@ -82,6 +82,14 @@ class TrainingRecorder:
         self._deferred_iters: List[int] = []
         self._closed = False
         self._write_failed = False
+        # roofline: analytic per-iteration byte/FLOP floor (obs/perf),
+        # computed once from the first round's shapes, then turned into
+        # achieved GB/s per round from wall_s alone — read-only on
+        # training state, so bitwise identity is untouched
+        self.roofline_enabled = bool(
+            getattr(config, "tpu_perf_roofline", True))
+        self._budget: Optional[Dict] = None
+        self._roof = None
         adapters.ensure_device_metrics(self.registry)
         self._m_iters = self.registry.counter(
             "lgbm_train_iterations_total", help="Boosting rounds completed")
@@ -132,6 +140,9 @@ class TrainingRecorder:
         comm = adapters.comm_totals(self.registry)
         if comm is not None:
             event["comm"] = comm
+        roofline = self._roofline(gbdt, wall_s)
+        if roofline is not None:
+            event["roofline"] = roofline
         self._m_iters.inc()
         self._m_seconds.inc(wall_s)
         if not finished:
@@ -225,6 +236,47 @@ class TrainingRecorder:
         if goss is not None:
             out["goss_top"], out["goss_other"] = int(goss[0]), int(goss[1])
         return out
+
+    def _roofline(self, gbdt, wall_s: float) -> Optional[Dict[str, float]]:
+        """Per-round roofline summary: the cached analytic byte/FLOP
+        floor for one iteration over the measured wall time, as achieved
+        GB/s / GFLOP/s and shares of the configured roofs.  Also feeds
+        the lgbm_roofline_* gauges and (when the tracer is armed) a
+        bytes/FLOPs-tagged span.  Best-effort: any failure disables the
+        section for the run rather than touching training."""
+        if not self.roofline_enabled:
+            return None
+        try:
+            from . import perf
+            if self._budget is None:
+                engine = ("partition"
+                          if getattr(gbdt, "_use_partition_engine", False)
+                          else "label")
+                ds = getattr(gbdt, "train_set", None)
+                features = int(getattr(ds, "num_features", 0) or 1)
+                self._budget = perf.iteration_budget(
+                    rows=int(getattr(gbdt, "num_data", 0) or 1),
+                    features=features,
+                    max_bin=int(getattr(gbdt, "max_bin", 0)
+                                or getattr(self.config, "max_bin", 255)),
+                    num_leaves=int(getattr(self.config, "num_leaves", 31)),
+                    engine=engine)
+                self._roof = perf.Roofline.from_config(self.config)
+            summary = perf.budget_summary(self._budget, wall_s, self._roof)
+            perf.publish_iteration_gauges(self.registry, summary)
+            tracer = tracing.get_tracer()
+            if tracer.enabled:
+                tracing.complete(
+                    "roofline/iteration", wall_s, cat="roofline",
+                    analytic_bytes=self._budget["total_bytes"],
+                    analytic_flops=self._budget["total_flops"],
+                    gbps=summary["achieved_gbps"],
+                    hbm_util=summary["hbm_util"])
+            return summary
+        except Exception as exc:  # noqa: BLE001 — telemetry never raises
+            self.roofline_enabled = False
+            log.warning("telemetry: roofline section disabled: %s", exc)
+            return None
 
     def _span_deltas(self) -> Optional[Dict[str, Dict[str, float]]]:
         """Per-round span summary: the tracer's cumulative per-kind

@@ -942,3 +942,24 @@ grow_tree_partition = partial(jax.jit, static_argnames=(
     "hist_slots", "forced_splits", "pristine", "carried_bump0",
     "interpret"),
     donate_argnums=(0,))(grow_tree_partition_impl)
+
+
+# -- roofline cost model (obs/perf) -------------------------------------- #
+from ..obs.perf import KernelCost, cost_model  # noqa: E402
+
+
+@cost_model("tree/iteration")
+def _cost_tree_iteration(rows: int, features: int, max_bin: int,
+                         num_leaves: int,
+                         engine: str = "partition") -> KernelCost:
+    """One full boosting iteration (grow one tree): the aggregate of
+    the phase floors in obs/perf.iteration_budget — root histogram,
+    per-split partition + smaller-child histogram + split scans, g/h
+    refresh and carry compaction.  Balanced-tree lower bound: the sum
+    of parent segments across the L-1 splits is modeled as n*log2(L)
+    rows."""
+    from ..obs import perf
+    b = perf.iteration_budget(rows, features, max_bin, num_leaves,
+                              engine=engine)
+    return KernelCost("tree/iteration", b["total_bytes"], b["total_flops"],
+                      "sum of phase floors, n*log2(L) partition bound")

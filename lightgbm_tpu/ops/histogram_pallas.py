@@ -160,3 +160,23 @@ def radix_epilogue(out, G: int, m: int, hi_n: int, lo_n: int):
     out = out.reshape(G, m, 3, hi_n, m, lo_n)
     diag = jnp.moveaxis(jnp.diagonal(out, axis1=1, axis2=4), -1, 1)
     return diag.reshape(G * m, 3, hi_n * lo_n).transpose(0, 2, 1)
+
+
+# -- roofline cost model (obs/perf) -------------------------------------- #
+from ..obs.perf import KernelCost, cost_model  # noqa: E402
+
+
+@cost_model("hist/pallas")
+def _cost_hist_pallas(rows: int, features: int, max_bin: int,
+                      dtype_bytes: int = 4) -> KernelCost:
+    """Radix-pair MXU histogram: HBM floor is one pass over bins (u8)
+    and g/h/leaf_ids plus the pre-epilogue [G, M, N] f32 accumulator;
+    FLOPs are what the MXU actually executes — 2*M*N MACs per row tile
+    per feature group, off-diagonal (f, f') blocks included."""
+    n, F, B = int(rows), int(features), int(max_bin)
+    lo_n, hi_n, m = _radix_plan(B)
+    G = -(-F // m)
+    M, N = 3 * hi_n * m, m * lo_n
+    nbytes = n * F + n * (2 * dtype_bytes + 4) + G * M * N * 4
+    return KernelCost("hist/pallas", nbytes, 2 * n * G * M * N,
+                      "MXU %dx%d tile per %d-feature group" % (M, N, m))

@@ -976,3 +976,44 @@ def segment_histogram(arena, start, cnt, num_features: int, max_bin: int,
     )(sc, arena)
     hist = split_radix_epilogue(out, n_blocks * k, m, hi_n=hi_n, lo_n=lo_n)
     return hist[:F, :max_bin, :]
+
+
+# -- roofline cost models (obs/perf) ------------------------------------- #
+from ..obs.perf import KernelCost, cost_model  # noqa: E402
+
+_ARENA_B = 2  # bf16 arena element
+
+
+@cost_model("partition/segment")
+def _cost_partition(rows: int, features: int) -> KernelCost:
+    """Stream a parent segment once and write both children (same total
+    rows): 2x the segment's arena footprint plus the pred plane slice.
+    The per-sub-block permutation matmuls are DMA-overlapped, so FLOPs
+    count only the 2*SUB MACs per row that fill otherwise-idle lanes —
+    this kernel lives on the bandwidth roof by design."""
+    n = int(rows)
+    row_b = _ARENA_B * arena_channels(int(features))
+    return KernelCost("partition/segment", 2 * n * row_b + n * 4,
+                      2 * n * SUB,
+                      "parent read + children write, %dB/row" % row_b)
+
+
+@cost_model("partition/hist")
+def _cost_seg_hist(rows: int, features: int, max_bin: int) -> KernelCost:
+    """Segment histogram: one pass over the segment's arena rows (bin
+    planes AND residue planes ride the same row stripe) plus the
+    [F, B, 3] f32 output; 3 accumulates per (row, feature) floor."""
+    n, F, B = int(rows), int(features), int(max_bin)
+    row_b = _ARENA_B * arena_channels(F)
+    return KernelCost("partition/hist", n * row_b + F * B * 3 * 4,
+                      3 * n * F, "one arena pass, %dB/row" % row_b)
+
+
+@cost_model("partition/compact")
+def _cost_compact(rows: int, features: int) -> KernelCost:
+    """Carry compaction: read every live row once, write it once at its
+    packed destination — pure data movement, zero useful FLOPs."""
+    n = int(rows)
+    row_b = _ARENA_B * arena_channels(int(features))
+    return KernelCost("partition/compact", 2 * n * row_b, 0,
+                      "pure copy, %dB/row" % row_b)

@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""Perf ledger: gate bench throughput + roofline utilization regressions.
+
+The loose BENCH_r0*.json files were a history nobody enforced — a 20%
+throughput regression would land as an anecdote in the next round's
+diff.  This tool turns them into a gated ledger, the throughput twin of
+tools/trace_check.py: it ingests the newest bench result (the driver's
+wrapper ``{"n": N, "parsed": {...}}`` or bench.py's raw JSON line) plus
+an optional tools/roofline_report.py summary, compares every tracked
+metric against the committed ``tools/perf_baseline.json``, and exits
+nonzero on any drop beyond the tolerance.  bench.py runs it after every
+bench as the ``perf_smoke`` detail line.
+
+Stdlib only, on purpose: the gate must be runnable in CI (and in
+subprocess tests on the CPU image) without importing jax or the
+package.
+
+Baseline schema (tools/perf_baseline.json):
+
+    {
+      "schema": 1,
+      "metrics": {
+        "higgs_mrows_iter_s": {"baseline": 24.559, "tolerance": 0.15},
+        "mslr_mrows_iter_s":  {"baseline": 6.878}
+      },
+      "roofline": {
+        "partition/segment": {"hbm_util_min": 0.25}
+      },
+      "history": [{"round": 1, "higgs": 5.652}, ...]
+    }
+
+``tolerance`` is the allowed fractional drop below ``baseline`` (the
+default mirrors Config.tpu_perf_gate_tolerance); metrics are one-sided
+— going faster never breaches.  Roofline floors are absolute
+bandwidth-utilization minimums per kernel.  CPU-backend bench results
+skip the throughput gate (the ledger tracks the TPU numbers; a CPU
+smoke run proving 1000x slower is noise, not a regression).
+
+Usage:
+    python tools/perf_gate.py                      # newest BENCH_r*.json
+    python tools/perf_gate.py --bench FILE [--roofline FILE]
+    python tools/perf_gate.py --bench FILE --write-baseline [--margin 0.15]
+
+Exit codes: 0 pass/skip, 1 breach, 2 unreadable input (trace_check's
+contract).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "perf_baseline.json")
+# mirrors Config.tpu_perf_gate_tolerance's default; kept literal so the
+# gate stays importable without jax/the package
+DEFAULT_TOLERANCE = 0.15
+
+
+def _load_json(path: str, what: str) -> Optional[Dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as exc:
+        print("perf_gate: cannot read %s %s: %s" % (what, path, exc),
+              file=sys.stderr)
+        return None
+
+
+def newest_bench(root: str = REPO) -> Optional[str]:
+    """Newest BENCH_r*.json by its round number ``n`` (falling back to
+    the filename when the wrapper key is absent)."""
+    best: Tuple[int, str] = (-1, "")
+    for path in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        try:
+            with open(path) as f:
+                n = int(json.load(f).get("n", -1))
+        except (OSError, ValueError):
+            continue
+        if (n, path) > best:
+            best = (n, path)
+    return best[1] or None
+
+
+def extract_metrics(bench: Dict) -> Dict:
+    """Bench JSON (driver wrapper or raw bench.py result) -> the flat
+    metric dict the ledger tracks."""
+    parsed = bench.get("parsed") if isinstance(bench.get("parsed"), dict) \
+        else bench
+    detail = parsed.get("detail") or {}
+    out: Dict = {"backend": detail.get("backend", "unknown"),
+                 "round": bench.get("n")}
+    higgs = (detail.get("higgs") or {}).get("throughput_mrows_iter_s")
+    if higgs is None:
+        higgs = parsed.get("value")   # pre-detail bench format (r01/r02)
+    if higgs is not None:
+        out["higgs_mrows_iter_s"] = float(higgs)
+    mslr = (detail.get("lambdarank") or {}).get("throughput_mrows_iter_s")
+    if mslr is not None:
+        out["mslr_mrows_iter_s"] = float(mslr)
+    return out
+
+
+def extract_roofline(summary: Dict) -> Dict[str, float]:
+    """roofline_report --json output -> {kernel: hbm_util}."""
+    return {k.get("kernel", "?"): float(k.get("hbm_util", 0.0))
+            for k in summary.get("kernels", [])
+            if isinstance(k, dict)}
+
+
+def check(metrics: Dict, roofline: Optional[Dict[str, float]],
+          baseline: Dict, tolerance: Optional[float] = None) -> List[str]:
+    """-> breach descriptions (empty = pass).  CPU-backend metrics skip
+    the throughput floors; roofline floors are enforced whenever a
+    summary was provided."""
+    breaches: List[str] = []
+    enforce_throughput = metrics.get("backend") == "tpu"
+    for name, spec in (baseline.get("metrics") or {}).items():
+        if not enforce_throughput:
+            continue
+        got = metrics.get(name)
+        base = float(spec.get("baseline", 0.0))
+        if got is None or base <= 0:
+            continue
+        tol = (float(tolerance) if tolerance is not None
+               else float(spec.get("tolerance", DEFAULT_TOLERANCE)))
+        floor = base * (1.0 - tol)
+        if float(got) < floor:
+            breaches.append(
+                "%s %.3f < floor %.3f (baseline %.3f - %d%% tolerance)"
+                % (name, float(got), floor, base, round(tol * 100)))
+    if roofline is not None:
+        for kernel, spec in (baseline.get("roofline") or {}).items():
+            floor = spec.get("hbm_util_min")
+            got = roofline.get(kernel)
+            if floor is None or got is None:
+                continue
+            if got < float(floor):
+                breaches.append(
+                    "roofline %s hbm_util %.4f < floor %.4f"
+                    % (kernel, got, float(floor)))
+    return breaches
+
+
+def make_baseline(metrics: Dict, roofline: Optional[Dict[str, float]],
+                  prev: Optional[Dict], margin: float) -> Dict:
+    """Derive/refresh a baseline from a known-good bench run, keeping
+    the history trail from the previous ledger."""
+    out: Dict = {"schema": 1, "metrics": {}, "history": []}
+    if prev:
+        out["history"] = list(prev.get("history") or [])
+    entry = {"round": metrics.get("round")}
+    for name in ("higgs_mrows_iter_s", "mslr_mrows_iter_s"):
+        if name in metrics:
+            out["metrics"][name] = {"baseline": round(metrics[name], 3),
+                                    "tolerance": margin}
+            entry[name.split("_")[0]] = round(metrics[name], 3)
+    out["history"].append(entry)
+    if roofline:
+        out["roofline"] = {
+            k: {"hbm_util_min": round(u * (1.0 - margin), 4)}
+            for k, u in sorted(roofline.items()) if u > 0}
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Gate bench throughput and roofline utilization "
+                    "against the committed perf ledger")
+    ap.add_argument("--bench", help="bench JSON (driver wrapper or raw "
+                                    "bench.py result); default: newest "
+                                    "BENCH_r*.json in the repo root")
+    ap.add_argument("--roofline", help="tools/roofline_report.py --json "
+                                       "summary to enforce kernel floors")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="ledger file (default tools/perf_baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="refresh the ledger from this run instead of "
+                         "checking (appends to its history)")
+    ap.add_argument("--tolerance", type=float,
+                    help="override every metric's allowed fractional drop")
+    ap.add_argument("--margin", type=float, default=DEFAULT_TOLERANCE,
+                    help="tolerance recorded by --write-baseline "
+                         "(default %g)" % DEFAULT_TOLERANCE)
+    ap.add_argument("--json", action="store_true",
+                    help="print the extracted metrics as JSON")
+    args = ap.parse_args(argv)
+
+    bench_path = args.bench or newest_bench()
+    if not bench_path:
+        print("perf_gate: no BENCH_r*.json found and no --bench given",
+              file=sys.stderr)
+        return 2
+    bench = _load_json(bench_path, "bench")
+    if bench is None:
+        return 2
+    metrics = extract_metrics(bench)
+
+    roofline = None
+    if args.roofline:
+        summary = _load_json(args.roofline, "roofline summary")
+        if summary is None:
+            return 2
+        roofline = extract_roofline(summary)
+
+    if args.json:
+        print(json.dumps({"metrics": metrics, "roofline": roofline},
+                         indent=1, sort_keys=True))
+    else:
+        parts = ["%s=%.3f" % (k, v) for k, v in sorted(metrics.items())
+                 if isinstance(v, float)]
+        print("perf_gate: %s [backend=%s round=%s]"
+              % (" ".join(parts) or "no tracked metrics",
+                 metrics.get("backend"), metrics.get("round")))
+
+    if args.write_baseline:
+        prev = None
+        if os.path.exists(args.baseline):
+            prev = _load_json(args.baseline, "baseline")
+        ledger = make_baseline(metrics, roofline, prev, args.margin)
+        with open(args.baseline, "w") as f:
+            json.dump(ledger, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print("ledger written to %s (%d metrics, margin %g)"
+              % (args.baseline, len(ledger["metrics"]), args.margin))
+        return 0
+
+    baseline = _load_json(args.baseline, "baseline")
+    if baseline is None:
+        return 2
+    breaches = check(metrics, roofline, baseline, args.tolerance)
+    if breaches:
+        for b in breaches:
+            print("BREACH: %s" % b, file=sys.stderr)
+        return 1
+    if metrics.get("backend") != "tpu":
+        print("ledger %s: skipped (backend=%s; throughput floors track "
+              "the TPU numbers)" % (args.baseline, metrics.get("backend")))
+    else:
+        print("ledger %s: OK (%d metric floors enforced)"
+              % (args.baseline, len(baseline.get("metrics") or {})))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

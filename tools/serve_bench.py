@@ -8,13 +8,31 @@ sequential single-row predicts.  Works on any backend (JAX_PLATFORMS=cpu
 is fine for CI); on TPU the coalescing win is larger because the ~100 ms
 dispatch floor dominates single-row latency.
 
+Two modes:
+
+- closed-loop (default): N client threads, each fires the next request
+  only when its previous one returns.  Measures coalescing throughput,
+  but the arrival rate adapts to the server — queueing never builds up,
+  so tail latency under real load is invisible (coordinated omission).
+- open-loop (--open-loop): requests arrive on a Poisson process at an
+  OFFERED rate the server does not control; latency is measured from
+  the scheduled arrival time, so queue buildup at an overloaded QPS
+  level shows up in p99 instead of being absorbed by the client.  Emits
+  a p50/p99-latency-at-offered-QPS BENCH line.
+
 Usage: python tools/serve_bench.py [requests_per_level] [model_trees]
+       python tools/serve_bench.py --open-loop [--qps 50,200,800]
+           [--duration-s 5] [--trees 64]
 Emits one BENCH-style JSON line:
   {"metric": "serve_concurrency_speedup_x32", "value": ..., "unit": "x",
    "vs_baseline": ..., "detail": {...}}
+or, open-loop:
+  {"metric": "serve_open_loop_p99_ms", "value": ..., "unit": "ms", ...}
 """
+import argparse
 import json
 import sys
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -60,9 +78,125 @@ def _run_level(server, rows, concurrency, requests):
     return requests / wall, p50, p99
 
 
-def main():
-    requests = int(sys.argv[1]) if len(sys.argv) > 1 else 256
-    trees = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+def _run_open_loop(server, rows, offered_qps, duration_s, rng):
+    """One offered-QPS level: Poisson arrivals (exponential gaps) for
+    `duration_s`, dispatched from a wide pool so a slow server cannot
+    slow the ARRIVALS down.  Latency is measured from each request's
+    scheduled arrival time — queue wait (including dispatcher backlog)
+    counts, which is the whole point of the open loop."""
+    lat, errors = [], [0]
+    lock = threading.Lock()
+    # enough workers that the pool itself is never the bottleneck at
+    # the offered rates this bench runs
+    pool = ThreadPoolExecutor(max_workers=256)
+    t0 = time.perf_counter()
+    # pre-draw the whole arrival schedule so the dispatcher loop does
+    # no RNG work between sends
+    gaps = rng.exponential(1.0 / offered_qps,
+                           int(offered_qps * duration_s) + 1)
+    sched = t0 + np.cumsum(gaps)
+    sched = sched[sched < t0 + duration_s]
+
+    def one(scheduled_t, i):
+        try:
+            server.predict(rows[i % len(rows)])
+            dt = (time.perf_counter() - scheduled_t) * 1e3
+            with lock:
+                lat.append(dt)
+        except Exception:  # noqa: BLE001 — shed/timeout counts as error
+            with lock:
+                errors[0] += 1
+
+    for i, ts in enumerate(sched):
+        now = time.perf_counter()
+        if ts > now:
+            time.sleep(ts - now)
+        pool.submit(one, ts, i)
+    pool.shutdown(wait=True)
+    wall = time.perf_counter() - t0
+    done = len(lat)
+    p50, p99 = _percentiles(lat) if lat else (float("nan"), float("nan"))
+    return {"offered_qps": round(offered_qps, 1),
+            "achieved_qps": round(done / wall, 1),
+            "sent": len(sched), "completed": done, "errors": errors[0],
+            "p50_ms": round(p50, 3), "p99_ms": round(p99, 3)}
+
+
+def _open_loop_main(args):
+    bst = _train(args.trees)
+    rng = np.random.RandomState(1)
+    rows = [rng.rand(1, 28) for _ in range(64)]
+    server = Server({"serve_model_name": "bench",
+                     "serve_min_device_work": 0,
+                     "serve_batch_wait_ms": 2.0,
+                     "serve_max_batch_rows": 256,
+                     "serve_request_timeout_ms": 60_000.0,
+                     "serve_warmup_buckets": [1, 2, 4, 8, 16, 32, 64, 128,
+                                              256]})
+    server.load_model("bench", model_str=bst.model_to_string())
+    _run_level(server, rows, 4, 32)   # settle the dispatch path
+
+    qps_levels = [float(q) for q in args.qps.split(",")]
+    arrivals = np.random.RandomState(7)
+    levels = {}
+    for q in qps_levels:
+        r = _run_open_loop(server, rows, q, args.duration_s, arrivals)
+        levels["%g" % q] = r
+        print("offered %8.1f qps: achieved %8.1f qps  p50=%.2f ms  "
+              "p99=%.2f ms  errors=%d"
+              % (q, r["achieved_qps"], r["p50_ms"], r["p99_ms"],
+                 r["errors"]))
+    server.shutdown()
+
+    # headline: tail latency at the highest offered level the server
+    # actually sustained (achieved within 10% of offered)
+    sustained = [r for r in levels.values()
+                 if r["achieved_qps"] >= 0.9 * r["offered_qps"]]
+    head = sustained[-1] if sustained else list(levels.values())[0]
+    result = {
+        "metric": "serve_open_loop_p99_ms",
+        "value": head["p99_ms"],
+        "unit": "ms",
+        "vs_baseline": head["offered_qps"],
+        "detail": {
+            "mode": "open_loop_poisson",
+            "duration_s": args.duration_s,
+            "model_trees": args.trees,
+            "levels": levels,
+            "sustained_qps": head["offered_qps"],
+            "quality_ok": bool(sustained),
+        },
+    }
+    print(json.dumps(result))
+    return 0 if sustained else 1
+
+
+def _parse_args(argv):
+    ap = argparse.ArgumentParser(
+        description="Serving bench: closed-loop concurrency sweep or "
+                    "open-loop Poisson offered load")
+    ap.add_argument("requests", nargs="?", type=int, default=256,
+                    help="closed-loop requests per level (default 256)")
+    ap.add_argument("trees_pos", nargs="?", type=int, default=None,
+                    help="model size in trees (positional compat)")
+    ap.add_argument("--trees", type=int, default=64)
+    ap.add_argument("--open-loop", action="store_true",
+                    help="Poisson offered-load mode")
+    ap.add_argument("--qps", default="50,200,800",
+                    help="comma-separated offered QPS levels")
+    ap.add_argument("--duration-s", type=float, default=5.0,
+                    help="seconds per offered-QPS level")
+    args = ap.parse_args(argv)
+    if args.trees_pos is not None:
+        args.trees = args.trees_pos
+    return args
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    if args.open_loop:
+        return _open_loop_main(args)
+    requests, trees = args.requests, args.trees
     bst = _train(trees)
     rng = np.random.RandomState(1)
     rows = [rng.rand(1, 28) for _ in range(64)]

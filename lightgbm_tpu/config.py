@@ -249,6 +249,22 @@ _SCHEMA = [
     ("tpu_perf_gate_tolerance", float, 0.15),  # perf-ledger regression tolerance:
     #   tools/perf_gate.py fails when a tracked metric drops more than this
     #   fraction below its committed baseline
+    # --- quantized histogram training parameters (no reference analogue)
+    # Quantized gradient/hessian histogram accumulation (docs/Quantized.md):
+    # g/h become int8 codes carried as TWO arena payload planes instead of
+    # six f32-residue planes, histogram radix payload shrinks 7 -> 3
+    # components, and leaf outputs are recovered exactly from the integer
+    # bin sums via per-tree scales.  HBM bytes drop, FLOPs are unchanged
+    # (this chip runs every dtype at the same ~24 TFLOP/s — bytes are the
+    # binding resource, NOTES.md).
+    ("tpu_quantized_grad", bool, False),  # enable quantized histogram
+    #   training (partition engine only; falls back off with a warning
+    #   when the engine is unavailable)
+    ("tpu_quantized_bits", int, 8),       # gradient code width; only 8 is
+    #   implemented (int8 codes in [-127, 127])
+    ("tpu_quantized_seed", int, 0),       # stochastic-rounding seed for the
+    #   gradient codes (0 = derive from the main `seed`); folded with the
+    #   iteration index so checkpoint resume is bitwise-identical
 ]
 
 # alias -> canonical name (src/io/config_auto.cpp:4-157)
@@ -623,6 +639,12 @@ class Config:
         if not 0 <= self.tpu_perf_gate_tolerance < 1:
             log.fatal("tpu_perf_gate_tolerance must be in [0, 1), got %g"
                       % self.tpu_perf_gate_tolerance)
+        if self.tpu_quantized_bits != 8:
+            log.fatal("tpu_quantized_bits: only 8-bit codes are "
+                      "implemented, got %d" % self.tpu_quantized_bits)
+        if self.tpu_quantized_seed < 0:
+            log.fatal("tpu_quantized_seed must be >= 0, got %d"
+                      % self.tpu_quantized_seed)
 
     def is_single_machine(self) -> bool:
         return self.num_machines <= 1

@@ -515,6 +515,7 @@ class GBDT:
                 self._arena = None
                 self._bins_t = None
                 self._last_truncated = None
+                self._quantized = False
                 self._fused_fn = None
                 self._sync_model()
                 self._rebuild_train_score()
@@ -647,15 +648,17 @@ class GBDT:
 
     def _build_fused_iter(self):
         from ..ops import grow_partition as gp
+        from ..ops import quantize as qz
         objective = self.objective
         interpret = jax.default_backend() != "tpu"
         k = max(self.num_tree_per_iteration, 1)
+        quantized = getattr(self, "_quantized", False)
         self._fused_fields = self._objective_device_fields()
         fields = self._fused_fields
 
         def fused(arena, bins_t, score, field_vals, row0, fmasks,
                   num_bins, default_bins, missing_types, sparams, monotone,
-                  penalty, shrink):
+                  penalty, shrink, qkey):
             # score is [k, n]; gradients come back class-major and every
             # class's tree grows in the SAME program, reusing the one
             # donated arena; each class gets its own feature mask (the
@@ -674,8 +677,16 @@ class GBDT:
             hess = jnp.asarray(hess, jnp.float32).reshape(k, n)
             ivecs, fvecs, deltas = [], [], []
             for kk in range(k):
+                g_in, h_in, qsc = grad[kk], hess[kk], None
+                if quantized:
+                    # in-program quantization: codes + scales never leave
+                    # the device; the key is folded per class so every
+                    # tree draws independent rounding noise
+                    g_in, h_in, _gs, _hs = qz.quantize_gradients(
+                        grad[kk], hess[kk], jax.random.fold_in(qkey, kk))
+                    qsc = (_gs, _hs)
                 arrays, delta, arena, trunc = gp.grow_tree_partition_impl(
-                    arena, bins_t, grad[kk], hess[kk], row0, fmasks[kk],
+                    arena, bins_t, g_in, h_in, row0, fmasks[kk],
                     num_bins, default_bins, missing_types, sparams,
                     monotone, penalty,
                     None, None, self.is_categorical,
@@ -686,7 +697,8 @@ class GBDT:
                     max_cat_threshold=self.config.max_cat_threshold,
                     hist_slots=self._hist_slots,
                     forced_splits=self._forced_splits,
-                    pristine=True, interpret=interpret)
+                    pristine=True, quantized=quantized,
+                    quant_scales=qsc, interpret=interpret)
                 ivec, fvec = grow_ops.pack_tree_arrays(arrays)
                 ivecs.append(jnp.concatenate(
                     [ivec, trunc.astype(jnp.int32)[None]]))
@@ -714,11 +726,15 @@ class GBDT:
         k = max(self.num_tree_per_iteration, 1)
         fmasks = jnp.stack([self._feature_sample() for _ in range(k)])
         field_vals = [getattr(h, a) for h, a in self._fused_fields]
+        from ..ops import quantize as _qz
+        # pure function of (config seed, restored iteration counter):
+        # kill-and-resume replays the identical rounding noise
+        qkey = _qz.quantize_key(getattr(self, "_quant_seed", 0), self.iter)
         args = (self._arena, self._bins_t, self.train_state.score,
                 field_vals, self._row_all_in, fmasks,
                 self.train_state.num_bins, self.train_state.default_bins,
                 self.train_state.missing_types, self.split_params,
-                self.monotone, self.penalty, sh)
+                self.monotone, self.penalty, sh, qkey)
         if rebuilt and getattr(self, "_tracing", False) \
                 and getattr(self.config, "tpu_trace_xla_analysis", True):
             # kernel attribution: one "compile" span per retrace carrying
@@ -812,7 +828,9 @@ class GBDT:
     def _build_fused_iter_carried(self):
         from ..ops import grow_partition as gp
         from ..ops import partition_pallas as _pp
+        from ..ops import quantize as qz
         objective = self.objective
+        quantized = getattr(self, "_quantized", False)
         interpret = jax.default_backend() != "tpu"
         n = self._bins_t.shape[1]
         base = self._carry_base
@@ -829,7 +847,7 @@ class GBDT:
 
         def fused(arena, bins_t, root0, dst, field_vals, row0, fmask,
                   num_bins, default_bins, missing_types, sparams,
-                  monotone, penalty, shrink):
+                  monotone, penalty, shrink, qkey):
             olds = [getattr(h, a) for h, a in fields_io]
             for (h, a), v in zip(fields_io, field_vals):
                 setattr(h, a, v)
@@ -846,9 +864,18 @@ class GBDT:
             finally:
                 for (h, a), v in zip(fields_io, olds):
                     setattr(h, a, v)
+            g_in = jnp.asarray(grad, jnp.float32)
+            h_in = jnp.asarray(hess, jnp.float32)
+            qsc = None
+            if quantized:
+                # grad/hess are in CARRIED (arena) row order here, and so
+                # are the codes — the fused root kernel writes them next
+                # to the rows they belong to
+                g_in, h_in, _gs, _hs = qz.quantize_gradients(
+                    g_in, h_in, qkey)
+                qsc = (_gs, _hs)
             arrays, _used, arena, trunc = gp.grow_tree_partition_impl(
-                arena, bins_t, jnp.asarray(grad, jnp.float32),
-                jnp.asarray(hess, jnp.float32), row0, fmask,
+                arena, bins_t, g_in, h_in, row0, fmask,
                 num_bins, default_bins, missing_types, sparams,
                 monotone, penalty, None, None, self.is_categorical,
                 self.train_state.bundle,
@@ -858,7 +885,8 @@ class GBDT:
                 hist_slots=self._hist_slots,
                 forced_splits=self._forced_splits,
                 pristine=False, carried_root=root0, carry_dst=dst,
-                carried_bump0=bump0, interpret=interpret)
+                carried_bump0=bump0, quantized=quantized,
+                quant_scales=qsc, interpret=interpret)
             # per-row leaf value over the compacted order (leaf-index
             # segments): boundary scatter + cumsum, no gather
             lv = arrays.leaf_value.astype(jnp.float32)
@@ -892,12 +920,14 @@ class GBDT:
         p = self._carry_parity
         root0 = jnp.int32(self._carry_slots[p])
         dst = jnp.int32(self._carry_slots[1 - p])
+        from ..ops import quantize as _qz
+        qkey = _qz.quantize_key(getattr(self, "_quant_seed", 0), self.iter)
         ivec, fvec, arena = self._carried_fn(
             self._arena, self._bins_t, root0, dst, field_vals,
             self._row_all_in, fmask,
             self.train_state.num_bins, self.train_state.default_bins,
             self.train_state.missing_types, self.split_params,
-            self.monotone, self.penalty, sh)
+            self.monotone, self.penalty, sh, qkey)
         if not getattr(self, "_fused_validated", False):
             int(ivec[-1])
             self._fused_validated = True
@@ -1123,6 +1153,11 @@ class GBDT:
             self._last_truncated = None
             self._truncation_warned = False
             self._hist_slots = 0
+            self._quantized = False
+            if cfg.tpu_quantized_grad:
+                log.warning("tpu_quantized_grad is serial-only (per-shard "
+                            "code scales would desynchronize the psum'd "
+                            "integer histograms); ignoring")
             grower_ok = (base_ok and not self._forced_splits
                          and self._cegb_coupled is None)
             if eng == "partition" and not grower_ok:
@@ -1187,13 +1222,16 @@ class GBDT:
             lo_n, hi_n, m_r = _radix_plan(max(self.max_bin, 2))
             f_blk = max(m_r, 8)
             nb_r = pp.feature_channels(n_groups) // f_blk
+            # quantized mode accumulates the 3-component code radix
+            # instead of the 7-component residue radix
+            payload = 3 if cfg.tpu_quantized_grad else 7
             fused_vmem = (
                 2 * C * pp.TILE * 2                       # in_buf bf16
                 + (pp.TILE // pp.SUB) * pp.SUB * 2 * pp.SUB * 2   # P_all
                 + 2 * C * pp.CARRY_W * 4                  # carries f32
                 + 4 * C * pp.FLUSH_W * 2                  # flush bufs
                 + 2 * pp.TILE * 4                         # pred bufs
-                + nb_r * (f_blk // m_r) * 7 * hi_n * m_r * 128 * 4)
+                + nb_r * (f_blk // m_r) * payload * hi_n * m_r * 128 * 4)
             fits = (arena_bytes < budget and C <= 512
                     and fused_vmem < 13 * (1 << 20))
             eng = ("partition" if eligible and fits
@@ -1205,6 +1243,24 @@ class GBDT:
         self._bins_t = None
         self._last_truncated = None     # device bool from the last grown tree
         self._truncation_warned = False
+        self._quantized = bool(cfg.tpu_quantized_grad
+                               and self._use_partition_engine)
+        self._quant_seed = int(cfg.tpu_quantized_seed or cfg.seed)
+        if cfg.tpu_quantized_grad and not self._use_partition_engine:
+            log.warning("tpu_quantized_grad requires the partition engine; "
+                        "training unquantized on the label engine")
+        if self._quantized:
+            from ..ops import quantize as _qz
+            bits = int(cfg.tpu_quantized_bits)
+            if not _qz.overflow_safe(self.num_data, bits=bits):
+                # bin-count-aware guard: only the FULLEST bin's occupancy
+                # bounds integer exactness, and n rows is its worst case
+                log.warning(
+                    "tpu_quantized_grad: %d rows exceed the single-bin "
+                    "integer-exactness envelope (%d rows/bin); histogram "
+                    "code sums may round in f32 if one bin captures more "
+                    "than that (docs/Quantized.md)",
+                    self.num_data, _qz.exact_rows(bits))
         if self._use_partition_engine:
             from ..ops import grow_partition as gp
             from ..ops import partition_pallas as _pp
@@ -1229,10 +1285,17 @@ class GBDT:
                                                    False)
                                            and self._bag_mask is None)
                                else "leaf_ids")
+            g_in, h_in, qsc = grad, hess, None
+            if self._quantized:
+                from ..ops import quantize as _qz
+                g_in, h_in, _gs, _hs = _qz.quantize_gradients(
+                    grad, hess,
+                    _qz.quantize_key(self._quant_seed, self.iter))
+                qsc = (_gs, _hs)
             try:
                 arrays, out, self._arena, self._last_truncated = \
                     self._grow_partition(
-                    self._arena, self._bins_t, grad, hess, row_init,
+                    self._arena, self._bins_t, g_in, h_in, row_init,
                     self._feature_sample(),
                     self.train_state.num_bins, self.train_state.default_bins,
                     self.train_state.missing_types,
@@ -1247,6 +1310,7 @@ class GBDT:
                     max_cat_threshold=self.config.max_cat_threshold,
                     hist_slots=self._hist_slots,
                     forced_splits=self._forced_splits,
+                    quantized=self._quantized, quant_scales=qsc,
                     interpret=jax.default_backend() != "tpu")
                 if not getattr(self, "_partition_validated", False):
                     # force materialization once: async dispatch would
@@ -1268,6 +1332,7 @@ class GBDT:
                 self._arena = None
                 self._bins_t = None
                 self._last_truncated = None
+                self._quantized = False
         self._last_emit = "leaf_ids"
         grow_fn = (self._grower if self._grower is not None
                    else grow_ops.grow_tree)

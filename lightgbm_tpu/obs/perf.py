@@ -182,7 +182,8 @@ def measure_kernel(name: str, fn: Callable, args=(),
 # -- per-iteration byte budget ------------------------------------------- #
 def iteration_budget(rows: int, features: int, max_bin: int,
                      num_leaves: int, engine: str = "partition",
-                     dtype_bytes: int = 4) -> Dict:
+                     dtype_bytes: int = 4,
+                     quantized: bool = False) -> Dict:
     """Analytic HBM-byte/FLOP floor for ONE boosting iteration.
 
     A balanced-tree lower bound: the sum of parent-segment sizes over
@@ -192,6 +193,15 @@ def iteration_budget(rows: int, features: int, max_bin: int,
     shape of the loop (NOTES.md per-iteration budget): root histogram,
     per-split partition + smaller-child histogram + split scan, then
     the fixed per-tree work (g/h refresh, carry compaction, score).
+
+    With quantized=True (tpu_quantized_grad, partition engine only) the
+    budget models the int8-code mode of docs/Quantized.md: histogram
+    kernels read only the feature rows plus TWO code planes (not six
+    residue planes), the root histogram is FUSED with the code-plane
+    refresh (ops/partition_pallas.fused_refresh_histogram — one arena
+    pass pays for both), and gh_refresh writes codes instead of residue
+    planes.  Partition and carry-compact phases still move the full
+    arena row (rows are relocated whole).
 
     Returns {"phases": [{phase, bytes, flops, note}...],
              "total_bytes", "total_flops"} — the byte-budget table.
@@ -212,23 +222,46 @@ def iteration_budget(rows: int, features: int, max_bin: int,
     if engine == "partition":
         from ..ops import partition_pallas as pp
         row_b = 2 * pp.arena_channels(F)        # bf16 arena row footprint
-        # root histogram: one streamed pass over the full arena
-        add("root_hist", n * row_b + hist_out, 2 * n * (3 + F),
-            "one arena pass")
-        # per-split partition: read parent once, write both children
+        Fp = pp.feature_channels(F)
+        # quantized histogram kernels DMA only the feature-row stripe
+        # plus the two code planes (8-row DMA granularity), never the
+        # stale residue planes — the partial-row read of
+        # segment_histogram(quantized=True)
+        hist_row_b = (2 * min(pp.arena_channels(F), -(-(Fp + 2) // 8) * 8)
+                      if quantized else row_b)
         split_rows = n * depth                  # balanced-tree bound
+        if quantized:
+            # fused root: ONE pass reads the Fp feature rows + the fresh
+            # code array and writes the two code planes while the
+            # histogram accumulates — the separate gh_refresh plane
+            # write and the full-arena root read both disappear
+            add("root_hist", n * (2 * Fp + 8) + hist_out, 2 * n * (3 + F),
+                "fused code refresh + root histogram, one pass")
+        else:
+            # root histogram: one streamed pass over the full arena
+            add("root_hist", n * row_b + hist_out, 2 * n * (3 + F),
+                "one arena pass")
+        # per-split partition: read parent once, write both children
+        # (rows relocate WHOLE, so quantization does not shrink this)
         add("partition", 2 * split_rows * row_b,
             2 * split_rows * 2 * pp.SUB,
             "sum(parent) ~ n*log2(L); compaction MACs DMA-overlapped")
         # smaller-child histograms: half the parent rows per split
-        add("child_hist", (split_rows / 2) * row_b + (L - 1) * hist_out,
-            2 * (split_rows / 2) * (3 + F), "smaller child only")
+        add("child_hist", (split_rows / 2) * hist_row_b
+            + (L - 1) * hist_out,
+            2 * (split_rows / 2) * (3 + F),
+            "smaller child only" + (", code-plane stripe" if quantized
+                                    else ""))
         # split scans: histogram in, packed split row out
         add("split_scan", L * (hist_out + F * 64),
             L * F * B * 32, "L histogram scans")
-        # fixed per-tree: g/h plane refresh + carry compaction + score
-        add("gh_refresh", n * (2 * dtype_bytes + 6 * 2), 8 * n,
-            "grad/hess -> residue planes")
+        # fixed per-tree: g/h refresh + carry compaction + score
+        if quantized:
+            add("gh_refresh", n * (2 * dtype_bytes + 2 * 2), 8 * n,
+                "grad/hess -> int8 codes (planes ride the fused root)")
+        else:
+            add("gh_refresh", n * (2 * dtype_bytes + 6 * 2), 8 * n,
+                "grad/hess -> residue planes")
         add("carry_compact", 2 * n * row_b, 0, "ping-pong root slot")
     else:
         bins_b = n * F                          # uint8 bin matrix
@@ -250,7 +283,8 @@ def iteration_budget(rows: int, features: int, max_bin: int,
     for p in phases:
         p["share"] = round(p["bytes"] / max(total_b, 1), 4)
     return {"engine": engine, "rows": n, "features": F, "max_bin": B,
-            "num_leaves": L, "phases": phases,
+            "num_leaves": L, "quantized": bool(quantized),
+            "phases": phases,
             "total_bytes": int(total_b), "total_flops": int(total_f)}
 
 

@@ -260,7 +260,8 @@ class TrainingRecorder:
                     max_bin=int(getattr(gbdt, "max_bin", 0)
                                 or getattr(self.config, "max_bin", 255)),
                     num_leaves=int(getattr(self.config, "num_leaves", 31)),
-                    engine=engine)
+                    engine=engine,
+                    quantized=bool(getattr(gbdt, "_quantized", False)))
                 self._roof = perf.Roofline.from_config(self.config)
             summary = perf.budget_summary(self._budget, wall_s, self._roof)
             perf.publish_iteration_gauges(self.registry, summary)

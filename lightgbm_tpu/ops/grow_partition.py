@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 
 from . import partition_pallas as pp
+from . import quantize as qz
 from . import split_pallas as sp_pl
 from .grow import MISSING_NAN, MISSING_ZERO, BundleMaps, TreeArrays
 from .split import (K_MIN_SCORE, SplitParams,
@@ -126,6 +127,11 @@ def grow_tree_partition_impl(
         carry_dst=None,               # traced col offset for emit="carry"
         carried_bump0: int = 0,       # static first bump column (past
         #                               both root slots) in carried mode
+        quantized: bool = False,      # static: grad/hess arrive as int8
+        #   CODES (ops/quantize) riding TWO payload planes instead of six
+        #   residue planes; histogram kernels run the 3-component radix
+        #   and results are dequantized per-kernel via quant_scales
+        quant_scales=None,            # traced (g_scale, h_scale) f32
         interpret: bool = False):
     """Grow one leaf-wise tree.
 
@@ -166,12 +172,29 @@ def grow_tree_partition_impl(
             "feature-parallel requires num_features (%d) divisible by "
             "num_machines (%d); pad features first (ParallelGrower does)"
             % (F, num_machines))
+    if quantized and dist:
+        raise ValueError(
+            "quantized histogram mode is serial-only: code scales are "
+            "per-call maxima, so shard-local scales would desynchronize "
+            "the psum'd integer histograms")
+    if quantized and quant_scales is None:
+        raise ValueError("quantized=True requires quant_scales")
     dtype = jnp.float32
     Fp = pp.feature_channels(G)
     L = max_leaves
     seg = partial(pp.segment_histogram, num_features=G, max_bin=max_bin,
-                  interpret=interpret)
+                  quantized=quantized, interpret=interpret)
     part = partial(pp.partition_segment, interpret=interpret)
+    if quantized:
+        _gs, _hs = quant_scales
+
+        def deq(h):
+            # integer code sums -> f32 (g, h, count); exact within the
+            # qz.exact_rows() envelope (docs/Quantized.md)
+            return qz.dequantize_hist(h, _gs, _hs)
+    else:
+        def deq(h):
+            return h.astype(dtype)
 
     # ---- arena assembly --------------------------------------------------
     # Pristine layout (the driver's path): feature bins + rowid planes
@@ -187,23 +210,39 @@ def grow_tree_partition_impl(
     if carried and (not full_bag or dist):
         raise ValueError("carried-arena mode requires full_bag serial")
     work0 = pp.pristine_work0(n) if pristine else 0
-    gh = jnp.concatenate(
-        [c[None] for c in pp.split_f32(grad)]
-        + [c[None] for c in pp.split_f32(hess)], axis=0)
+    if quantized:
+        # TWO code planes at [Fp, Fp+2) (g_code, h_code as exact small
+        # integers in bf16); planes Fp+2..Fp+5 go stale and are never
+        # read — the 3-component radix stops at the count plane
+        gh = pp.pack_code_planes(grad, hess)
+    else:
+        gh = jnp.concatenate(
+            [c[None] for c in pp.split_f32(grad)]
+            + [c[None] for c in pp.split_f32(hess)], axis=0)
+    # full_bag quantized roots skip the XLA plane write entirely: the
+    # fused root kernel below DMAs the fresh codes into the arena while
+    # it streams the feature rows for the root histogram — one pass pays
+    # for both (the per-iteration byte saving iteration_budget reports)
+    fuse_root = quantized and full_bag
     if carried:
         # bins/rowids AND the score/label planes already sit at the
         # carried root (compacted there by the previous tree's
         # emit="carry"); only the g/h planes need this tree's gradients
-        arena = jax.lax.dynamic_update_slice(
-            arena_buf, gh, (jnp.int32(Fp),
-                            jnp.asarray(carried_root, jnp.int32)))
+        arena = (arena_buf if fuse_root else
+                 jax.lax.dynamic_update_slice(
+                     arena_buf, gh, (jnp.int32(Fp),
+                                     jnp.asarray(carried_root, jnp.int32))))
     elif pristine:
-        arena = jax.lax.dynamic_update_slice(arena_buf, gh, (Fp, 0))
+        arena = (arena_buf if fuse_root else
+                 jax.lax.dynamic_update_slice(arena_buf, gh, (Fp, 0)))
     else:
         chans = [bins_t.astype(adt)]
         if Fp > G:
             chans.append(jnp.zeros((Fp - G, n), adt))
         chans += [gh]
+        if quantized:
+            # keep the rowid planes at their fixed rows Fp+6..Fp+8
+            chans.append(jnp.zeros((pp.N_AUX - 3 - gh.shape[0], n), adt))
         chans += [c[None] for c in
                   pp.split_rowid(jnp.arange(n, dtype=jnp.int32))]
         if C > Fp + pp.N_AUX:
@@ -240,15 +279,29 @@ def grow_tree_partition_impl(
         arena, counts0, root_hist_b = part(
             arena, pred0, jnp.int32(0), jnp.int32(n),
             jnp.int32(bag_dst), jnp.int32(oob_dst), hist_stream=0,
-            num_features=G, max_bin=max_bin)
+            num_features=G, max_bin=max_bin, quantized=quantized)
         root_c = counts0[0]
         root_s0 = jnp.int32(bag_dst)
         cursor0 = jnp.int32(oob_dst + n_al)  # past the oob dump space
 
     if full_bag:
-        root_hist = seg(arena, root_s0, root_c)
+        if quantized:
+            # fused mega-kernel (ISSUE 8 tentpole): ONE double-buffered
+            # pass over the root segment writes the fresh code planes
+            # AND accumulates the root histogram — replacing the XLA
+            # plane update plus a separate full-read seg() launch.
+            # Unlike the per-child fusion dead end below (the fh gate),
+            # the root histogram covers every row the refresh touches
+            # anyway, so this fusion is pure byte saving (the same
+            # argument as the bagging hist_stream above).
+            arena, root_hist_q = pp.fused_refresh_histogram(
+                arena, gh, root_s0, root_c, num_features=G,
+                max_bin=max_bin, interpret=interpret)
+            root_hist = deq(root_hist_q)
+        else:
+            root_hist = seg(arena, root_s0, root_c)
     else:
-        root_hist = root_hist_b.astype(dtype)
+        root_hist = deq(root_hist_b.astype(dtype))
     root_c_local = root_c
     if dp:
         # DP: one histogram allreduce; global sums/counts fall out of it
@@ -635,8 +688,9 @@ def grow_tree_partition_impl(
             in_slot = state.slot_leaf == best_leaf
             found = jnp.any(in_slot)
             pslot = jnp.argmax(in_slot).astype(jnp.int32)
-            recomputed = seg(state.arena, s0,
-                             jnp.where(found | no_split, 0, cntP_local))
+            recomputed = deq(seg(state.arena, s0,
+                                 jnp.where(found | no_split, 0,
+                                           cntP_local)))
             # under DP the recompute's allreduce is BATCHED with the
             # smaller-child histogram's below (one collective per split
             # even in pooled mode); only the kernel must run pre-split
@@ -688,8 +742,8 @@ def grow_tree_partition_impl(
         # fixed cost ever did.  Two launches stay the right shape here.
         arena, counts = part(state.arena, pred_dummy, s0, cntP, dstA, dstB,
                              decision=decision)
-        small_hist = seg(arena, dstB,
-                         jnp.where(no_split, 0, counts[1]))
+        small_hist = deq(seg(arena, dstB,
+                             jnp.where(no_split, 0, counts[1])))
         if dp:
             # DP: ONE collective per split — the smaller child's histogram
             # allreduce (the sibling still comes from subtraction, §3.4.2);
@@ -940,7 +994,7 @@ grow_tree_partition = partial(jax.jit, static_argnames=(
     "max_leaves", "max_depth", "max_bin", "emit", "full_bag",
     "max_cat_threshold", "axis_name", "learner", "num_machines", "top_k",
     "hist_slots", "forced_splits", "pristine", "carried_bump0",
-    "interpret"),
+    "quantized", "interpret"),
     donate_argnums=(0,))(grow_tree_partition_impl)
 
 
@@ -951,7 +1005,8 @@ from ..obs.perf import KernelCost, cost_model  # noqa: E402
 @cost_model("tree/iteration")
 def _cost_tree_iteration(rows: int, features: int, max_bin: int,
                          num_leaves: int,
-                         engine: str = "partition") -> KernelCost:
+                         engine: str = "partition",
+                         quantized: bool = False) -> KernelCost:
     """One full boosting iteration (grow one tree): the aggregate of
     the phase floors in obs/perf.iteration_budget — root histogram,
     per-split partition + smaller-child histogram + split scans, g/h
@@ -960,6 +1015,6 @@ def _cost_tree_iteration(rows: int, features: int, max_bin: int,
     rows."""
     from ..obs import perf
     b = perf.iteration_budget(rows, features, max_bin, num_leaves,
-                              engine=engine)
+                              engine=engine, quantized=quantized)
     return KernelCost("tree/iteration", b["total_bytes"], b["total_flops"],
                       "sum of phase floors, n*log2(L) partition bound")

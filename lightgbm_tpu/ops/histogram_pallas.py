@@ -60,24 +60,10 @@ def _radix_plan(max_bin: int):
     return lo_n, hi_n, m
 
 
-def _hist_kernel(leaf_ref, bins_ref, lid_ref, grad_ref, hess_ref, out_ref,
-                 *, lo_n: int, hi_n: int, m: int, k: int, tile: int):
-    """One (feature_block, row_tile) step; a feature block is k groups of m
-    features, one MXU-tile matmul each (batched).
-
-    bins_ref: [k * m, tile] uint8 (feature-major block slice)
-    lid_ref:  [1, tile] int32 row→leaf labels
-    grad/hess_ref: [1, tile] f32
-    out_ref:  [k, 3 * hi_n * m, lo_n * m] f32 — rows (f, c, hi), cols (f', lo)
-    """
-    i = pl.program_id(1)
-
-    bins = bins_ref[:].astype(jnp.int32)                      # [k*m, T]
-    msk = (lid_ref[:] == leaf_ref[0]).astype(jnp.float32)     # [1, T]
-    g = grad_ref[:] * msk
-    h = hess_ref[:] * msk
-    gh = jnp.concatenate([g, h, msk], axis=0)                 # [3, T]
-
+def _radix_matmul(gh, bins, out_ref, i, *, lo_n: int, hi_n: int, m: int,
+                  k: int, tile: int):
+    """Shared radix-pair MXU contraction + in-place grid accumulation:
+    gh [3, tile] payload planes, bins [k*m, tile] int32 bin codes."""
     hi = bins // lo_n
     lo = bins - hi * lo_n
     hi_iota = jax.lax.broadcasted_iota(jnp.int32, (1, hi_n, 1), 1)
@@ -101,6 +87,41 @@ def _hist_kernel(leaf_ref, bins_ref, lid_ref, grad_ref, hess_ref, out_ref,
     @pl.when(i != 0)
     def _():
         out_ref[:] = out_ref[:] + part
+
+
+def _hist_kernel(leaf_ref, bins_ref, lid_ref, grad_ref, hess_ref, out_ref,
+                 *, lo_n: int, hi_n: int, m: int, k: int, tile: int):
+    """One (feature_block, row_tile) step; a feature block is k groups of m
+    features, one MXU-tile matmul each (batched).
+
+    bins_ref: [k * m, tile] uint8 (feature-major block slice)
+    lid_ref:  [1, tile] int32 row→leaf labels
+    grad/hess_ref: [1, tile] f32
+    out_ref:  [k, 3 * hi_n * m, lo_n * m] f32 — rows (f, c, hi), cols (f', lo)
+    """
+    i = pl.program_id(1)
+    bins = bins_ref[:].astype(jnp.int32)                      # [k*m, T]
+    msk = (lid_ref[:] == leaf_ref[0]).astype(jnp.float32)     # [1, T]
+    g = grad_ref[:] * msk
+    h = hess_ref[:] * msk
+    gh = jnp.concatenate([g, h, msk], axis=0)                 # [3, T]
+    _radix_matmul(gh, bins, out_ref, i, lo_n=lo_n, hi_n=hi_n, m=m, k=k,
+                  tile=tile)
+
+
+def _hist_kernel_q(leaf_ref, bins_ref, lid_ref, code_ref, out_ref,
+                   *, lo_n: int, hi_n: int, m: int, k: int, tile: int):
+    """Quantized variant: g/h arrive as ONE [2, tile] int8 code block and
+    leaf labels as uint8, so the per-row HBM read is F+3 bytes instead of
+    F+12.  The MXU contraction is identical — the accumulator holds exact
+    integer code sums (f32-exact below 2^24, ops/quantize.exact_rows)."""
+    i = pl.program_id(1)
+    bins = bins_ref[:].astype(jnp.int32)                      # [k*m, T]
+    msk = (lid_ref[:].astype(jnp.int32) == leaf_ref[0]).astype(jnp.float32)
+    gh = jnp.concatenate([code_ref[:].astype(jnp.float32) * msk, msk],
+                         axis=0)                              # [3, T]
+    _radix_matmul(gh, bins, out_ref, i, lo_n=lo_n, hi_n=hi_n, m=m, k=k,
+                  tile=tile)
 
 
 @functools.partial(jax.jit, static_argnames=("max_bin", "tile", "interpret"))
@@ -151,6 +172,60 @@ def leaf_histogram(bins, grad, hess, leaf_ids, leaf, max_bin: int,
     return hist[:F, :max_bin, :].astype(grad.dtype)
 
 
+@functools.partial(jax.jit, static_argnames=("max_bin", "tile", "interpret"))
+def leaf_histogram_quantized(bins, g_code, h_code, leaf_ids, leaf,
+                             max_bin: int, tile: int = 2048,
+                             interpret: bool = False) -> jnp.ndarray:
+    """[F, max_bin, 3] f32 INTEGER-CODE histogram of rows with
+    leaf_ids == leaf: (sum g_code, sum h_code, count).
+
+    bins [n, F] uint8; g_code/h_code [n] int8-valued (any real dtype —
+    packed to int8 on the wire); leaf_ids [n] with values < 255 (uint8 on
+    the wire; pass zeros with leaf=0 for a whole-dataset/root histogram,
+    where order-invariance lets this kernel read the row-order packed
+    bins instead of streaming the bf16 arena).  Recover real g/h sums
+    with ops.quantize.dequantize_hist.
+    """
+    n, F = bins.shape
+    lo_n, hi_n, m = _radix_plan(max_bin)
+    M, N = 3 * hi_n * m, lo_n * m
+    f_blk = max(m, 8)
+    k = f_blk // m
+
+    f_pad = -F % f_blk
+    n_pad = -n % tile
+    bins_t = jnp.pad(bins.astype(jnp.uint8), ((0, n_pad), (0, f_pad))).T
+    # pad value 255 is never a leaf id (leaf < 255 enforced by callers)
+    lid = jnp.pad(leaf_ids.astype(jnp.uint8), (0, n_pad),
+                  constant_values=255)[None, :]
+    codes = jnp.stack([
+        jnp.pad(g_code.astype(jnp.int8), (0, n_pad)),
+        jnp.pad(h_code.astype(jnp.int8), (0, n_pad))])        # [2, n+pad]
+    Fp = F + f_pad
+    n_blocks = Fp // f_blk
+    n_tiles = (n + n_pad) // tile
+    leaf_arr = jnp.asarray(leaf, jnp.int32).reshape(1)
+
+    kernel = functools.partial(_hist_kernel_q, lo_n=lo_n, hi_n=hi_n, m=m,
+                               k=k, tile=tile)
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_blocks, n_tiles),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),             # leaf scalar
+            pl.BlockSpec((f_blk, tile), lambda f, i: (f, i)),  # bins
+            pl.BlockSpec((1, tile), lambda f, i: (0, i)),      # leaf_ids u8
+            pl.BlockSpec((2, tile), lambda f, i: (0, i)),      # g/h codes i8
+        ],
+        out_specs=pl.BlockSpec((k, M, N), lambda f, i: (f, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_blocks * k, M, N), jnp.float32),
+        interpret=interpret,
+    )(leaf_arr, bins_t, lid, codes)
+
+    hist = radix_epilogue(out, n_blocks * k, m, hi_n, lo_n)
+    return hist[:F, :max_bin, :]
+
+
 def radix_epilogue(out, G: int, m: int, hi_n: int, lo_n: int):
     """Unscramble the [G*M, N] radix-matmul accumulator into [G*m, B, 3]
     histograms: [G, f, 3, hi_n, f', lo_n] -> diagonal f == f' -> transpose.
@@ -180,3 +255,22 @@ def _cost_hist_pallas(rows: int, features: int, max_bin: int,
     nbytes = n * F + n * (2 * dtype_bytes + 4) + G * M * N * 4
     return KernelCost("hist/pallas", nbytes, 2 * n * G * M * N,
                       "MXU %dx%d tile per %d-feature group" % (M, N, m))
+
+
+@cost_model("hist/quantized")
+def _cost_hist_quantized(rows: int, features: int, max_bin: int,
+                         dtype_bytes: int = 4) -> KernelCost:
+    """Quantized radix histogram: per-row HBM floor is F bin bytes plus
+    THREE payload bytes (int8 g code, int8 h code, uint8 leaf id) where
+    the f32 kernel reads 2*dtype_bytes+4 — and where the f32 PARTITION
+    engine streams the full bf16 arena row (partition/hist).  FLOPs are
+    identical: this chip's MXU runs every dtype at the same rate, so the
+    quantized win is purely bytes."""
+    n, F, B = int(rows), int(features), int(max_bin)
+    lo_n, hi_n, m = _radix_plan(B)
+    G = -(-F // m)
+    M, N = 3 * hi_n * m, m * lo_n
+    nbytes = n * (F + 3) + G * M * N * 4
+    return KernelCost("hist/quantized", nbytes, 2 * n * G * M * N,
+                      "int8 codes: %d B/row vs %d B/row f32"
+                      % (F + 3, F + 2 * dtype_bytes + 4))

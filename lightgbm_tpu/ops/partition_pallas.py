@@ -136,6 +136,28 @@ def split_f32(x):
             lo.astype(jnp.bfloat16))
 
 
+def pack_code_planes(g_code, h_code):
+    """int8-valued g/h codes (f32 arrays from ops.quantize) -> [2, n]
+    bf16 payload planes for arena rows Fp+0/Fp+1.  bf16 represents every
+    integer in [-256, 256] exactly, so the cast is lossless — quantized
+    mode replaces the SIX f32-residue planes with these TWO."""
+    return jnp.stack([g_code, h_code]).astype(ARENA_DT)
+
+
+def _align8(rows: int) -> int:
+    """Round an arena row count up to the 8-sublane DMA granule."""
+    return -(-rows // 8) * 8
+
+
+def _side_effect_params():
+    """pltpu.CompilerParams(has_side_effects=True) where available.
+    CPU-only jax builds lack the attribute; interpret-mode tests of the
+    side-effecting kernels then run without compiler params (interpret
+    mode ignores them anyway)."""
+    cp = getattr(pltpu, "CompilerParams", None)
+    return cp(has_side_effects=True) if cp is not None else None
+
+
 def split_rowid(r):
     """int32 [n] (< 2^24) -> three byte planes as bf16 (values <= 255)."""
     r = r.astype(jnp.int32)
@@ -358,9 +380,10 @@ def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
         if hist_plan is not None:
             hs_f = hs.astype(jnp.float32)
             hmask = (hs_f * predB + (1.0 - hs_f) * predA).astype(jnp.bfloat16)
-            nb_h, k_h, m_h, lo_h, hi_h = hist_plan
+            nb_h, k_h, m_h, lo_h, hi_h, pay_h = hist_plan
             _radix_accumulate(hist_ref, block, hmask, n_blocks=nb_h, k=k_h,
-                              m=m_h, lo_n=lo_h, hi_n=hi_h, tile=tile)
+                              m=m_h, lo_n=lo_h, hi_n=hi_h, tile=tile,
+                              payload=pay_h)
 
         # ONE batched prefix scan for all subblocks of both streams — the
         # per-subblock scans were 2*K*log2(SUB) serial roll steps, the
@@ -433,11 +456,13 @@ def _partition_kernel(sc_ref, feat_onehot_ref, mask_ref, arena_any, pred_any,
 
 
 @functools.partial(jax.jit, static_argnames=("tile", "interpret",
-                                             "num_features", "max_bin"))
+                                             "num_features", "max_bin",
+                                             "quantized"))
 def partition_segment(arena, pred, start, cnt, dstA, dstB,
                       decision=None, hist_stream=None,
                       num_features: int = 0, max_bin: int = 0,
-                      tile: int = TILE, interpret: bool = False):
+                      tile: int = TILE, interpret: bool = False,
+                      quantized: bool = False):
     """Partition arena columns [start, start+cnt) into stream A at dstA
     (dstA == start allowed: in-place with lagging writes) and stream B at
     dstB (must not overlap [start, start+cnt+tile)).
@@ -487,13 +512,14 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
                  pl.BlockSpec(memory_space=pltpu.SMEM))
     out_shape = [jax.ShapeDtypeStruct((C, cap), ARENA_DT),
                  jax.ShapeDtypeStruct((2,), jnp.int32)]
+    payload = 3 if quantized else 7
     if with_hist:
         lo_n, hi_n, m = _radix_plan(max_bin)
         f_blk = max(m, 8)
         k = f_blk // m
         n_blocks = feature_channels(num_features) // f_blk
-        hist_plan = (n_blocks, k, m, lo_n, hi_n)
-        Mc, N = 7 * hi_n * m, lo_n * m
+        hist_plan = (n_blocks, k, m, lo_n, hi_n, payload)
+        Mc, N = payload * hi_n * m, lo_n * m
         out_specs = out_specs + (pl.BlockSpec(memory_space=pltpu.VMEM),)
         out_shape.append(
             jax.ShapeDtypeStruct((n_blocks * k * Mc, N), jnp.float32))
@@ -521,13 +547,14 @@ def partition_segment(arena, pred, start, cnt, dstA, dstB,
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
         input_output_aliases={3: 0},
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_side_effect_params(),
         interpret=interpret,
     )(sc, feat_onehot, goleft, arena, pred)
     if not with_hist:
         return outs[0], outs[1]
     hist = split_radix_epilogue(outs[2], n_blocks * k, m, hi_n=hi_n,
-                                lo_n=lo_n)[:num_features, :max_bin, :]
+                                lo_n=lo_n,
+                                payload=payload)[:num_features, :max_bin, :]
     return outs[0], outs[1], hist
 
 
@@ -679,7 +706,7 @@ def compact_carry(arena, starts, cnts, num_live, dst0,
             pltpu.SemaphoreType.DMA((2,)),
         ],
         input_output_aliases={3: 0},
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_side_effect_params(),
         interpret=interpret,
     )(sc, jnp.asarray(starts, jnp.int32), jnp.asarray(cnts, jnp.int32),
       arena)
@@ -807,41 +834,47 @@ def compact_segments(arena, starts, cnts, vals, num_live, dummy_rowid,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA((2,)),
         ],
-        compiler_params=pltpu.CompilerParams(has_side_effects=True),
+        compiler_params=_side_effect_params(),
         interpret=interpret,
     )(sc, jnp.asarray(starts, jnp.int32), jnp.asarray(cnts, jnp.int32),
       jnp.asarray(vals, jnp.float32), arena)
     return out, used
 
 
-def _comp_chunks(hi_n: int, m: int):
-    """Split the 7 payload components (g_hi,g_mid,g_lo, h_hi,h_mid,h_lo,
-    cnt) into dot chunks with chunk*hi_n*m <= 128 rows each."""
+def _comp_chunks(hi_n: int, m: int, payload: int = 7):
+    """Split the payload components (f32: g_hi,g_mid,g_lo, h_hi,h_mid,h_lo,
+    cnt; quantized: g_code, h_code, cnt) into dot chunks with
+    chunk*hi_n*m <= 128 rows each."""
     per = max(1, 128 // (hi_n * m))
     chunks = []
     i = 0
-    while i < 7:
-        chunks.append(min(per, 7 - i))
+    while i < payload:
+        chunks.append(min(per, payload - i))
         i += chunks[-1]
     return chunks
 
 
 def _radix_accumulate(out_ref, block, mask, *, n_blocks: int, k: int,
-                      m: int, lo_n: int, hi_n: int, tile: int):
+                      m: int, lo_n: int, hi_n: int, tile: int,
+                      payload: int = 7):
     """Accumulate the radix-factorized split-payload histogram of `block`
     [C, tile] bf16 rows selected by `mask` [1, tile] bf16 (0/1) into
-    out_ref [n_blocks*k*7*hi_n*m, lo_n*m] f32 — the shared inner loop of
-    the segment-histogram kernel and the fused partition+histogram pass."""
+    out_ref [n_blocks*k*payload*hi_n*m, lo_n*m] f32 — the shared inner
+    loop of the segment-histogram kernel and the fused
+    partition/refresh+histogram passes.  payload=7 is the f32-exact mode
+    (6 residue planes + count); payload=3 is the quantized mode (int8
+    g/h codes + count — the accumulator then holds exact integer code
+    sums, see ops/quantize)."""
     N = lo_n * m
-    Mc = 7 * hi_n * m
+    Mc = payload * hi_n * m
     f_blk = k * m
-    chunks = _comp_chunks(hi_n, m)
+    chunks = _comp_chunks(hi_n, m, payload)
     Fp = n_blocks * f_blk
-    # 7 payload planes: the 6 bf16 split planes of (g, h) plus count;
-    # masking by 0/1 keeps every entry a bf16-exact plane value
-    comps = [block[Fp + i:Fp + i + 1, :] * mask for i in range(6)]
+    # payload planes after the feature rows; masking by 0/1 keeps every
+    # entry a bf16-exact plane value (residue planes or int8 codes)
+    comps = [block[Fp + i:Fp + i + 1, :] * mask for i in range(payload - 1)]
     comps.append(mask)
-    gh = jnp.concatenate(comps, axis=0)               # [7, T] bf16
+    gh = jnp.concatenate(comps, axis=0)               # [payload, T] bf16
 
     for b in range(n_blocks):
         bins = block[b * f_blk:(b + 1) * f_blk, :].astype(jnp.float32)
@@ -873,12 +906,12 @@ def _radix_accumulate(out_ref, block, mask, *, n_blocks: int, k: int,
                 preferred_element_type=jnp.float32)   # [k, m*csz*hi_n, N]
             r0 = b * k * Mc
             # part rows are (f, c_local, hi); the accumulator layout is
-            # (f, c, hi) with the FULL 7-component c axis — each
+            # (f, c, hi) with the FULL payload-component c axis — each
             # feature's chunk block lands at its own strided offset
             for kk in range(k):
                 for f in range(m):
                     src = (f * csz) * hi_n
-                    dst = r0 + kk * Mc + (f * 7 + c0) * hi_n
+                    dst = r0 + kk * Mc + (f * payload + c0) * hi_n
                     sz = csz * hi_n
                     out_ref[dst:dst + sz, :] = (
                         out_ref[dst:dst + sz, :]
@@ -889,19 +922,23 @@ def _radix_accumulate(out_ref, block, mask, *, n_blocks: int, k: int,
 def _seg_hist_kernel(sc_ref, arena_any, out_ref, in_buf, read_sems,
                      *, C: int, F: int,
                      n_blocks: int, k: int, m: int, lo_n: int, hi_n: int,
-                     tile: int):
+                     tile: int, payload: int = 7, read_rows: int = 0):
     """sc_ref (SMEM [2] i32): start, cnt.  out_ref VMEM
-    [n_blocks*k*7*hi_n*m, N]: 7 split-payload components per feature —
+    [n_blocks*k*payload*hi_n*m, N]: payload split components per feature —
     every lhs entry is a bf16-exact payload plane value times a one-hot,
     so the dots run as single bf16 MXU passes and the f32 values are
-    reconstructed exactly in the epilogue."""
+    reconstructed exactly in the epilogue.  read_rows < C (quantized
+    mode) restricts the per-tile DMA to the leading arena rows that the
+    3-component payload actually consumes — the row stripe is the
+    kernel's whole byte bill, so this IS the quantized bandwidth win."""
     s, cnt = sc_ref[0], sc_ref[1]
     n_tiles = jax.lax.div(cnt + jnp.int32(tile - 1), jnp.int32(tile))
+    rows = read_rows or C
 
     def read_dma(j, slot):
         src = pl.multiple_of(s + j * tile, 128)
         return pltpu.make_async_copy(
-            arena_any.at[:, pl.ds(src, tile)],
+            arena_any.at[pl.ds(0, rows), pl.ds(src, tile)],
             in_buf.at[slot], read_sems.at[slot])
 
     out_ref[:] = jnp.zeros_like(out_ref)
@@ -918,11 +955,12 @@ def _seg_hist_kernel(sc_ref, arena_any, out_ref, in_buf, read_sems,
         def _():
             read_dma(j + 1, jax.lax.rem(j + jnp.int32(1), jnp.int32(2))).start()
 
-        block = in_buf[slot]                              # [C, T] bf16
+        block = in_buf[slot]                              # [rows, T] bf16
         valid = (jax.lax.broadcasted_iota(jnp.int32, (1, tile), 1)
                  < (cnt - j * tile)).astype(jnp.bfloat16)
         _radix_accumulate(out_ref, block, valid, n_blocks=n_blocks, k=k,
-                          m=m, lo_n=lo_n, hi_n=hi_n, tile=tile)
+                          m=m, lo_n=lo_n, hi_n=hi_n, tile=tile,
+                          payload=payload)
 
         @pl.when(j + 1 < n_tiles)
         def _():
@@ -932,12 +970,16 @@ def _seg_hist_kernel(sc_ref, arena_any, out_ref, in_buf, read_sems,
     jax.lax.fori_loop(0, n_tiles, loop, 0)
 
 
-def split_radix_epilogue(out, G: int, m: int, hi_n: int, lo_n: int):
-    """[G*7*hi_n*m, N] split-component accumulator -> [G*m, B, 3]: the f32
-    (g, h) values are the sums of their three split-plane partials."""
-    out = out.reshape(G, m, 7, hi_n, m, lo_n)
+def split_radix_epilogue(out, G: int, m: int, hi_n: int, lo_n: int,
+                         payload: int = 7):
+    """[G*payload*hi_n*m, N] split-component accumulator -> [G*m, B, 3]:
+    payload=7 sums each f32 value's three split-plane partials; payload=3
+    (quantized) passes the integer code sums through unchanged."""
+    out = out.reshape(G, m, payload, hi_n, m, lo_n)
     diag = jnp.moveaxis(jnp.diagonal(out, axis1=1, axis2=4), -1, 1)
-    comp = diag.reshape(G * m, 7, hi_n * lo_n)
+    comp = diag.reshape(G * m, payload, hi_n * lo_n)
+    if payload == 3:
+        return jnp.stack([comp[:, 0], comp[:, 1], comp[:, 2]], axis=-1)
     g = comp[:, 0] + comp[:, 1] + comp[:, 2]
     h = comp[:, 3] + comp[:, 4] + comp[:, 5]
     return jnp.stack([g, h, comp[:, 6]], axis=-1)         # [G*m, B, 3]
@@ -945,10 +987,17 @@ def split_radix_epilogue(out, G: int, m: int, hi_n: int, lo_n: int):
 
 @functools.partial(jax.jit,
                    static_argnames=("num_features", "max_bin", "tile",
-                                    "interpret"))
+                                    "interpret", "quantized"))
 def segment_histogram(arena, start, cnt, num_features: int, max_bin: int,
-                      tile: int = TILE, interpret: bool = False):
-    """[F, max_bin, 3] f32 histogram of arena columns [start, start+cnt)."""
+                      tile: int = TILE, interpret: bool = False,
+                      quantized: bool = False):
+    """[F, max_bin, 3] f32 histogram of arena columns [start, start+cnt).
+
+    quantized=True reads the two int8-code payload planes (arena rows
+    Fp+0/Fp+1, see pack_code_planes) instead of the six f32-residue
+    planes AND restricts the per-tile DMA to the leading Fp+2 arena rows
+    — the returned planes are then exact integer (g_code, h_code, count)
+    sums to recover with ops.quantize.dequantize_hist."""
     C, cap = arena.shape
     F = num_features
     lo_n, hi_n, m = _radix_plan(max_bin)
@@ -957,11 +1006,16 @@ def segment_histogram(arena, start, cnt, num_features: int, max_bin: int,
     n_blocks = feature_channels(F) // f_blk
     if n_blocks * f_blk + N_AUX > C:
         raise ValueError("arena channels too small for feature layout")
-    Mc, N = 7 * hi_n * m, lo_n * m
+    payload = 3 if quantized else 7
+    # quantized rows: features + the two code planes, DMA-aligned to the
+    # 8-sublane granule; everything past that row never leaves HBM
+    read_rows = min(C, _align8(n_blocks * f_blk + 2)) if quantized else C
+    Mc, N = payload * hi_n * m, lo_n * m
     sc = jnp.stack([jnp.asarray(start), jnp.asarray(cnt)]).astype(jnp.int32)
     kernel = functools.partial(
         _seg_hist_kernel, C=C, F=F, n_blocks=n_blocks, k=k, m=m,
-        lo_n=lo_n, hi_n=hi_n, tile=tile)
+        lo_n=lo_n, hi_n=hi_n, tile=tile, payload=payload,
+        read_rows=read_rows)
     out = pl.pallas_call(
         kernel,
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -969,13 +1023,172 @@ def segment_histogram(arena, start, cnt, num_features: int, max_bin: int,
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((n_blocks * k * Mc, N), jnp.float32),
         scratch_shapes=[
-            pltpu.VMEM((2, C, tile), ARENA_DT),
+            pltpu.VMEM((2, read_rows, tile), ARENA_DT),
             pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=interpret,
     )(sc, arena)
-    hist = split_radix_epilogue(out, n_blocks * k, m, hi_n=hi_n, lo_n=lo_n)
+    hist = split_radix_epilogue(out, n_blocks * k, m, hi_n=hi_n, lo_n=lo_n,
+                                payload=payload)
     return hist[:F, :max_bin, :]
+
+
+def _fused_root_kernel(sc_ref, codes_any, arena_any, out_any, hist_ref,
+                       in_buf, code_buf, read_sems, code_sems, write_sems,
+                       *, n_blocks: int, k: int, m: int, lo_n: int,
+                       hi_n: int, tile: int):
+    """Fused per-tree g/h-plane refresh + root histogram over ONE arena
+    pass (quantized mode): per tile, DMA in the feature rows and the
+    fresh code tile, DMA the codes OUT to the arena's payload planes
+    (dynamic-destination HBM DMA — legal, unlike dynamic-offset VMEM
+    stores in a fori_loop), and accumulate the 3-component radix
+    histogram from the values already in VMEM.
+
+    This replaces the XLA plane update + separate segment_histogram
+    launch of the separate-pass schedule: the root segment's rows are
+    read ONCE (features only — the stale payload planes never leave
+    HBM), and the fresh codes are touched once on the way in instead of
+    write-then-re-read.  Naive per-CHILD fusion was measured ~10% worse
+    (see grow_partition's dead-end note); the root is different — its
+    histogram covers every row of a segment the refresh must stream
+    anyway, so the fusion is pure saving, exactly like the bagging root
+    partition's hist_stream.
+
+    sc_ref (SMEM [2] i32): start, cnt.  codes_any [2, n_al] bf16 code
+    planes in segment order; arena_any/out_any [C, cap] bf16 aliased;
+    hist_ref VMEM [n_blocks*k*3*hi_n*m, lo_n*m] f32.
+
+    Write-DMA discipline: write j uses sem slot j%2; it is waited at
+    iteration j+1 (before the slot's buffer is refilled for tile j+2),
+    and the final two writes are drained after the loop — strict per-slot
+    alternation, no global counters.
+    """
+    s, cnt = sc_ref[0], sc_ref[1]
+    n_tiles = jax.lax.div(cnt + jnp.int32(tile - 1), jnp.int32(tile))
+    Fp = n_blocks * k * m
+
+    def feat_dma(j, slot):
+        src = pl.multiple_of(s + j * tile, 128)
+        return pltpu.make_async_copy(
+            arena_any.at[pl.ds(0, Fp), pl.ds(src, tile)],
+            in_buf.at[slot], read_sems.at[slot])
+
+    def code_read_dma(j, slot):
+        src = pl.multiple_of(j * tile, 128)
+        return pltpu.make_async_copy(
+            codes_any.at[:, pl.ds(src, tile)],
+            code_buf.at[slot], code_sems.at[slot])
+
+    def code_write_dma(j, slot):
+        dst = pl.multiple_of(s + j * tile, 128)
+        return pltpu.make_async_copy(
+            code_buf.at[slot],
+            out_any.at[pl.ds(Fp, 2), pl.ds(dst, tile)],
+            write_sems.at[slot])
+
+    hist_ref[:] = jnp.zeros_like(hist_ref)
+
+    @pl.when(n_tiles > 0)
+    def _():
+        feat_dma(0, 0).start()
+        code_read_dma(0, 0).start()
+        feat_dma(0, 0).wait()
+        code_read_dma(0, 0).wait()
+
+    def loop(j, _):
+        slot = jax.lax.rem(j, jnp.int32(2))
+        nslot = jax.lax.rem(j + jnp.int32(1), jnp.int32(2))
+
+        @pl.when(j + 1 < n_tiles)
+        def _():
+            # nslot's outbound write (issued at j-1) must land before the
+            # slot's code buffer is refilled
+            @pl.when(j >= 1)
+            def _():
+                code_write_dma(0, nslot).wait()
+            feat_dma(j + 1, nslot).start()
+            code_read_dma(j + 1, nslot).start()
+
+        code_write_dma(j, slot).start()
+
+        block = jnp.concatenate([in_buf[slot], code_buf[slot]], axis=0)
+        valid = (jax.lax.broadcasted_iota(jnp.int32, (1, tile), 1)
+                 < (cnt - j * tile)).astype(jnp.bfloat16)
+        _radix_accumulate(hist_ref, block, valid, n_blocks=n_blocks, k=k,
+                          m=m, lo_n=lo_n, hi_n=hi_n, tile=tile, payload=3)
+
+        @pl.when(j + 1 < n_tiles)
+        def _():
+            feat_dma(j + 1, nslot).wait()
+            code_read_dma(j + 1, nslot).wait()
+        return 0
+
+    jax.lax.fori_loop(0, n_tiles, loop, 0)
+
+    # drain: writes n_tiles-1 and n_tiles-2 are still outstanding (the
+    # in-loop wait is skipped on the last iteration)
+    @pl.when(n_tiles >= 2)
+    def _():
+        code_write_dma(0, jax.lax.rem(n_tiles - 2, jnp.int32(2))).wait()
+
+    @pl.when(n_tiles >= 1)
+    def _():
+        code_write_dma(0, jax.lax.rem(n_tiles - 1, jnp.int32(2))).wait()
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_features", "max_bin", "tile",
+                                    "interpret"))
+def fused_refresh_histogram(arena, codes, start, cnt, num_features: int,
+                            max_bin: int, tile: int = TILE,
+                            interpret: bool = False):
+    """(arena', hist): write the quantized code planes for arena columns
+    [start, start+cnt) AND build the segment's integer-code histogram in
+    one pass.  codes [2, n] bf16-castable int8-valued planes in segment
+    order (pack_code_planes); hist is [F, max_bin, 3] exact integer
+    (g_code, h_code, count) sums — recover with quantize.dequantize_hist.
+    """
+    C, cap = arena.shape
+    F = num_features
+    lo_n, hi_n, m = _radix_plan(max_bin)
+    f_blk = max(m, 8)
+    k = f_blk // m
+    n_blocks = feature_channels(F) // f_blk
+    if n_blocks * f_blk + N_AUX > C:
+        raise ValueError("arena channels too small for feature layout")
+    Fp = n_blocks * f_blk
+    Mc, N = 3 * hi_n * m, lo_n * m
+    n = codes.shape[1]
+    n_al = -(-n // tile) * tile
+    codes = jnp.pad(codes.astype(ARENA_DT), ((0, 0), (0, n_al - n)))
+    sc = jnp.stack([jnp.asarray(start), jnp.asarray(cnt)]).astype(jnp.int32)
+    kernel = functools.partial(
+        _fused_root_kernel, n_blocks=n_blocks, k=k, m=m, lo_n=lo_n,
+        hi_n=hi_n, tile=tile)
+    outs = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pl.ANY),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        out_shape=(jax.ShapeDtypeStruct((C, cap), ARENA_DT),
+                   jax.ShapeDtypeStruct((n_blocks * k * Mc, N),
+                                        jnp.float32)),
+        scratch_shapes=[
+            pltpu.VMEM((2, Fp, tile), ARENA_DT),
+            pltpu.VMEM((2, 2, tile), ARENA_DT),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        input_output_aliases={2: 0},
+        compiler_params=_side_effect_params(),
+        interpret=interpret,
+    )(sc, codes, arena)
+    hist = split_radix_epilogue(outs[1], n_blocks * k, m, hi_n=hi_n,
+                                lo_n=lo_n, payload=3)
+    return outs[0], hist[:F, :max_bin, :]
 
 
 # -- roofline cost models (obs/perf) ------------------------------------- #
@@ -1007,6 +1220,35 @@ def _cost_seg_hist(rows: int, features: int, max_bin: int) -> KernelCost:
     row_b = _ARENA_B * arena_channels(F)
     return KernelCost("partition/hist", n * row_b + F * B * 3 * 4,
                       3 * n * F, "one arena pass, %dB/row" % row_b)
+
+
+@cost_model("partition/hist_quantized")
+def _cost_seg_hist_q(rows: int, features: int, max_bin: int) -> KernelCost:
+    """Quantized segment histogram: the per-tile DMA stops after the
+    feature rows + TWO code planes (8-sublane aligned), so the stale
+    residue/rowid rows never leave HBM — the row stripe is the whole
+    byte bill, so this IS the quantized win over partition/hist."""
+    n, F, B = int(rows), int(features), int(max_bin)
+    read_rows = min(arena_channels(F), _align8(feature_channels(F) + 2))
+    row_b = _ARENA_B * read_rows
+    return KernelCost("partition/hist_quantized",
+                      n * row_b + F * B * 3 * 4, 3 * n * F,
+                      "partial arena pass, %dB/row (f32: %dB)"
+                      % (row_b, _ARENA_B * arena_channels(F)))
+
+
+@cost_model("partition/fused_root")
+def _cost_fused_root(rows: int, features: int, max_bin: int) -> KernelCost:
+    """Fused refresh+histogram: read the feature rows once plus the
+    fresh code planes, write the code planes — replaces the separate
+    schedule's plane update (read codes + write planes) AND the full
+    arena row stripe of the f32 root segment_histogram."""
+    n, F, B = int(rows), int(features), int(max_bin)
+    row_b = _ARENA_B * (feature_channels(F) + 2 + 2)   # feats + code r/w
+    return KernelCost("partition/fused_root",
+                      n * row_b + F * B * 3 * 4, 3 * n * F,
+                      "one fused pass, %dB/row vs %dB separate"
+                      % (row_b, _ARENA_B * (arena_channels(F) + 2 + 6)))
 
 
 @cost_model("partition/compact")

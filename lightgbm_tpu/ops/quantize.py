@@ -1,0 +1,100 @@
+"""Gradient/hessian quantization for histogram training.
+
+LightGBM's quantized-training mode ("Quantized Training of Gradient
+Boosting Decision Trees", NeurIPS 2022) observes that histogram
+construction is bandwidth-bound and that low-bit gradient codes keep
+split quality when gradients are STOCHASTICALLY rounded (the rounding
+noise stays zero-mean, so bin sums are unbiased estimates).  On this
+chip the observation is sharper than on CPU/GPU: NOTES.md measures the
+same ~24 TFLOP/s in every dtype, so int8 buys BYTES, not FLOPs — and
+HBM bytes (~161 GB/s) are the binding resource for every histogram
+kernel (see docs/Quantized.md and obs/perf.iteration_budget).
+
+Codes here are int8 in [-127, 127] with ONE scale per (tree, g|h):
+
+    g_code = stochastic_round(g / g_scale),   g_scale = max|g| / 127
+    h_code = nearest_round(h / h_scale),      h_scale = max h  / 127
+
+Histogram kernels accumulate the integer codes (plus a count plane) in
+f32, which is EXACT while every partial sum stays below 2^24 — the
+bin-count-aware envelope `exact_rows()` reports.  Within that envelope
+recovered bin sums `code_sum * scale` are float64-exact functions of
+the integer sums, so sibling subtraction and leaf-output recovery lose
+nothing beyond the initial rounding itself.
+
+Stochastic rounding uses `jax.random` (threefry) with a key folded from
+(tpu_quantized_seed or seed, iteration) — a pure function of restored
+trainer state, so checkpoint kill-and-resume is bitwise identical.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# int8 code range is symmetric [-127, 127]: reserving -128 keeps the
+# negation of every code representable (sibling subtraction in code
+# space) and matches LightGBM's grad_quant convention.
+CODE_MAX = 127
+
+# f32 accumulates integers exactly below 2^24; a single bin's |code sum|
+# is bounded by CODE_MAX * rows_in_bin, so this many rows in ONE bin is
+# the worst-case exactness envelope.
+_F32_EXACT = 1 << 24
+
+
+def exact_rows(bits: int = 8) -> int:
+    """Max rows a single histogram bin may hold with the integer code
+    sums still exactly representable in the f32 accumulator (the
+    bin-count-aware overflow guard: occupancy of the fullest bin, not
+    the bin count, is what bounds exactness)."""
+    code_max = (1 << (bits - 1)) - 1
+    return _F32_EXACT // code_max
+
+
+def overflow_safe(segment_rows: int, bits: int = 8) -> bool:
+    """True when a segment of `segment_rows` rows cannot overflow the
+    integer-exactness envelope even if every row lands in one bin."""
+    return int(segment_rows) <= exact_rows(bits)
+
+
+def quantize_gradients(grad, hess, key):
+    """(g_code, h_code, g_scale, h_scale): int8-valued f32 codes plus the
+    per-call scales.
+
+    Gradients are stochastically rounded (unbiased — split gains stay
+    unbiased estimates of the f32 gains); hessians are deterministically
+    rounded to nearest (they sit in denominators, where zero-mean noise
+    does NOT cancel).  Codes are returned as f32 arrays holding exact
+    small integers so they can be cast losslessly to the bf16 arena
+    payload planes (bf16 represents every integer up to 256 exactly).
+    """
+    g = jnp.asarray(grad, jnp.float32)
+    h = jnp.asarray(hess, jnp.float32)
+    g_scale = jnp.maximum(jnp.max(jnp.abs(g)), 1e-30) / CODE_MAX
+    h_scale = jnp.maximum(jnp.max(jnp.abs(h)), 1e-30) / CODE_MAX
+    u = jax.random.uniform(key, g.shape, jnp.float32)
+    g_code = jnp.clip(jnp.floor(g / g_scale + u), -CODE_MAX, CODE_MAX)
+    h_code = jnp.clip(jnp.round(h / h_scale), -CODE_MAX, CODE_MAX)
+    return g_code, h_code, g_scale, h_scale
+
+
+def quantize_key(seed: int, iteration) -> jax.Array:
+    """Stochastic-rounding key for one boosting iteration — a pure
+    function of (config seed, iteration index) so a resumed run draws
+    the identical rounding noise."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed & 0x7FFFFFFF),
+                              jnp.asarray(iteration, jnp.int32))
+
+
+def dequantize_hist(hist_code, g_scale, h_scale):
+    """Recover f32 (g, h, count) histograms from integer code sums.
+
+    hist_code [..., 3] carries (sum g_code, sum h_code, count); the
+    count plane is already exact.  Within the exact_rows() envelope the
+    code sums are exact integers, so this multiply IS the float64-exact
+    recovery (one rounding per bin, from the scale multiply itself).
+    """
+    scale = jnp.stack([jnp.asarray(g_scale, jnp.float32),
+                       jnp.asarray(h_scale, jnp.float32),
+                       jnp.float32(1.0)])
+    return hist_code.astype(jnp.float32) * scale

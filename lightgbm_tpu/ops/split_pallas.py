@@ -282,12 +282,31 @@ def build_feature_statics(num_bins, default_bins, missing_types,
 
 
 def _pack_inputs(hist, sum_g, sum_h, num_data, min_constraints,
-                 max_constraints, params: SplitParams):
+                 max_constraints, params: SplitParams,
+                 quant_scales=None):
     """(pvec, svec, hist3) shared by both kernel entry points — ONE place
-    owns the lane layouts (_SG.._MAXC / _L1.._CEGBS)."""
+    owns the lane layouts (_SG.._MAXC / _L1.._CEGBS).
+
+    quant_scales=(g_scale, h_scale) accepts CODE-domain histograms and
+    sums (integer code sums from ops/quantize) and folds the dequantize
+    multiply into this pack pass, so the scan itself always runs on real
+    g/h values: leaf outputs recover as -(Σg_code·gs) / (Σh_code·hs + λ)
+    — float64-exact functions of the integer sums within the
+    qz.exact_rows() envelope, one rounding per scale multiply.  The
+    partition grow loop instead dequantizes each histogram as it leaves
+    its kernel (grow_partition `deq`): cached, psum'd and
+    sibling-subtracted histograms there mix with REAL-domain sums read
+    back from earlier scan outputs, so a single domain everywhere beats
+    saving one [F, B, 3] multiply."""
     CH, F, B, _ = hist.shape
     f32 = jnp.float32
     hist3 = jnp.moveaxis(hist.astype(f32), 3, 0).reshape(3, CH * F, B)
+    if quant_scales is not None:
+        gs = jnp.asarray(quant_scales[0], f32)
+        hs = jnp.asarray(quant_scales[1], f32)
+        hist3 = hist3 * jnp.stack([gs, hs, jnp.float32(1.0)])[:, None, None]
+        sum_g = jnp.asarray(sum_g, f32) * gs
+        sum_h = jnp.asarray(sum_h, f32) * hs
     ninf = jnp.full((CH,), -jnp.inf, f32)
     pinf = jnp.full((CH,), jnp.inf, f32)
     svec = jnp.stack([
@@ -316,6 +335,7 @@ def best_splits_pallas(hist,            # [CH, F, B, 3]
                        fvec,            # [CH*F, 8] from build_feature_statics
                        params: SplitParams,
                        min_constraints=None, max_constraints=None,  # [CH]
+                       quant_scales=None,
                        interpret: bool = False) -> PerFeatureSplit:
     """Numerical best split per feature for CH children in one kernel
     launch.  Returns a PerFeatureSplit with [CH, F] fields (cat_mask
@@ -328,7 +348,7 @@ def best_splits_pallas(hist,            # [CH, F, B, 3]
     CH, F, B, _ = hist.shape
     pvec, svec, hist3 = _pack_inputs(hist, sum_g, sum_h, num_data,
                                      min_constraints, max_constraints,
-                                     params)
+                                     params, quant_scales=quant_scales)
     out, _ = _run_scan(pvec, svec, fvec, hist3, interpret=interpret)
     out = out.reshape(CH, F, ROW_W)
     gain = out[..., _OG]
@@ -351,6 +371,7 @@ def best_splits_pallas(hist,            # [CH, F, B, 3]
 def best_split_rows_pallas(hist, sum_g, sum_h, num_data, fvec,
                            params: SplitParams,
                            min_constraints=None, max_constraints=None,
+                           quant_scales=None,
                            interpret: bool = False):
     """[CH, ROW_W] packed best-split rows (lane layout _O*): the kernel's
     in-kernel select_best_feature output, ready to scatter into the
@@ -359,7 +380,7 @@ def best_split_rows_pallas(hist, sum_g, sum_h, num_data, fvec,
     valid split."""
     pvec, svec, hist3 = _pack_inputs(hist, sum_g, sum_h, num_data,
                                      min_constraints, max_constraints,
-                                     params)
+                                     params, quant_scales=quant_scales)
     _, best = _run_scan(pvec, svec, fvec, hist3, interpret=interpret)
     return best
 

@@ -101,6 +101,9 @@ def extract_metrics(bench: Dict) -> Dict:
     mslr = (detail.get("lambdarank") or {}).get("throughput_mrows_iter_s")
     if mslr is not None:
         out["mslr_mrows_iter_s"] = float(mslr)
+    quant = (detail.get("quantized") or {}).get("throughput_mrows_iter_s")
+    if quant is not None:
+        out["higgs_quantized_mrows_iter_s"] = float(quant)
     return out
 
 
@@ -145,6 +148,14 @@ def check(metrics: Dict, roofline: Optional[Dict[str, float]],
     return breaches
 
 
+# metric name -> its short history-entry key.  Explicit because the old
+# ``name.split("_")[0]`` shorthand would collide "higgs_quantized_..."
+# into "higgs" and silently overwrite the f32 trail.
+TRACKED_METRICS = {"higgs_mrows_iter_s": "higgs",
+                   "mslr_mrows_iter_s": "mslr",
+                   "higgs_quantized_mrows_iter_s": "higgs_quantized"}
+
+
 def make_baseline(metrics: Dict, roofline: Optional[Dict[str, float]],
                   prev: Optional[Dict], margin: float) -> Dict:
     """Derive/refresh a baseline from a known-good bench run, keeping
@@ -153,11 +164,11 @@ def make_baseline(metrics: Dict, roofline: Optional[Dict[str, float]],
     if prev:
         out["history"] = list(prev.get("history") or [])
     entry = {"round": metrics.get("round")}
-    for name in ("higgs_mrows_iter_s", "mslr_mrows_iter_s"):
+    for name, short in TRACKED_METRICS.items():
         if name in metrics:
             out["metrics"][name] = {"baseline": round(metrics[name], 3),
                                     "tolerance": margin}
-            entry[name.split("_")[0]] = round(metrics[name], 3)
+            entry[short] = round(metrics[name], 3)
     out["history"].append(entry)
     if roofline:
         out["roofline"] = {

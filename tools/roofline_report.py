@@ -72,6 +72,16 @@ def _build_kernels(args, interpret: bool):
                                   interpret=interpret)),
         (bins, grad, hess, leaf_ids, 0)))
 
+    # -- quantized histograms (ops/quantize codes, docs/Quantized.md) ---- #
+    from lightgbm_tpu.ops import quantize as qz
+    g_code, h_code, _gs, _hs = qz.quantize_gradients(
+        grad, hess, qz.quantize_key(0, 0))
+    kernels.append((
+        "hist/quantized", dict(rows=n, features=F, max_bin=B),
+        jax.jit(functools.partial(hist_pl.leaf_histogram_quantized,
+                                  max_bin=B, interpret=interpret)),
+        (bins, g_code, h_code, leaf_ids, 0)))
+
     # -- split scans ----------------------------------------------------- #
     hist = jnp.asarray(rng.uniform(0.0, 1.0, (F, B, 3)).astype(np.float32))
     sum_g = jnp.sum(hist[0, :, 0])
@@ -131,6 +141,44 @@ def _build_kernels(args, interpret: bool):
         lambda a: pp.segment_histogram(a, 0, n, F, B, interpret=interpret))
     kernels.append(("partition/hist", dict(rows=n, features=F, max_bin=B),
                     lambda: seg_jit(fresh_arena()), ()))
+
+    # quantized segment histogram: same arena with the two int8-code
+    # payload planes written at rows Fp/Fp+1 (the partial-row DMA path)
+    codes = pp.pack_code_planes(g_code, h_code)
+    qarena_state = {"arena": None}
+
+    def quant_arena():
+        if qarena_state["arena"] is None:
+            a = pp.init_pristine(jnp.zeros((C, cap), pp.ARENA_DT), bins.T)
+            qarena_state["arena"] = jax.lax.dynamic_update_slice(
+                a, codes, (pp.feature_channels(F), 0))
+        return qarena_state["arena"]
+
+    segq_jit = jax.jit(
+        lambda a: pp.segment_histogram(a, 0, n, F, B, quantized=True,
+                                       interpret=interpret))
+    kernels.append(("partition/hist_quantized",
+                    dict(rows=n, features=F, max_bin=B),
+                    lambda: segq_jit(quant_arena()), ()))
+
+    # fused refresh+histogram mega-kernel: aliases the arena in/out, so
+    # keep the donation chain alive like partition/segment above
+    fused_jit = jax.jit(
+        lambda a, c: pp.fused_refresh_histogram(a, c, 0, n, num_features=F,
+                                                max_bin=B,
+                                                interpret=interpret),
+        donate_argnums=0)
+    fused_state = {"arena": None}
+
+    def fused_fn():
+        if fused_state["arena"] is None:
+            fused_state["arena"] = pp.init_pristine(
+                jnp.zeros((C, cap), pp.ARENA_DT), bins.T)
+        out, hist = fused_jit(fused_state["arena"], codes)
+        fused_state["arena"] = out
+        return hist
+    kernels.append(("partition/fused_root",
+                    dict(rows=n, features=F, max_bin=B), fused_fn, ()))
 
     starts = jnp.zeros(1, jnp.int32)
     cnts = jnp.full(1, n, jnp.int32)
@@ -205,13 +253,37 @@ def run(args) -> dict:
 
     budget = perf.iteration_budget(args.rows, args.features, args.max_bin,
                                    args.leaves, engine=args.engine)
-    return {"backend": backend,
-            "rooflines": {"hbm_gbps": roof.hbm_gbps,
-                          "peak_tflops": roof.peak_tflops},
-            "shapes": {"rows": args.rows, "features": args.features,
-                       "max_bin": args.max_bin, "num_leaves": args.leaves,
-                       "chain": args.chain},
-            "kernels": rows, "budget": budget}
+    summary = {"backend": backend,
+               "rooflines": {"hbm_gbps": roof.hbm_gbps,
+                             "peak_tflops": roof.peak_tflops},
+               "shapes": {"rows": args.rows, "features": args.features,
+                          "max_bin": args.max_bin, "num_leaves": args.leaves,
+                          "chain": args.chain},
+               "kernels": rows, "budget": budget}
+    if args.engine == "partition":
+        # quantized-mode byte budget + the headline analytic ratio: the
+        # quantized histogram kernel's compulsory bytes over the f32
+        # arena histogram's, at the SAME shape (the ISSUE-8 ≤0.55 gate)
+        summary["budget_quantized"] = perf.iteration_budget(
+            args.rows, args.features, args.max_bin, args.leaves,
+            engine="partition", quantized=True)
+        perf.cost_models()          # ensure the ops registries are loaded
+        # evaluate at the TPU-scale dispatch (not the interpret-mode
+        # timing shape) so the fixed [F, max_bin, 3] output terms don't
+        # mask the per-row stream the gate is about
+        floor_rows = max(args.rows, 4194304)
+        kq = perf.cost("hist/quantized", rows=floor_rows,
+                       features=args.features, max_bin=args.max_bin)
+        kf = perf.cost("partition/hist", rows=floor_rows,
+                       features=args.features, max_bin=args.max_bin)
+        summary["quantized_floor"] = {
+            "rows": floor_rows,
+            "quantized_kernel": kq.kernel,
+            "quantized_bytes": int(kq.hbm_bytes),
+            "f32_kernel": kf.kernel,
+            "f32_bytes": int(kf.hbm_bytes),
+            "ratio": round(kq.hbm_bytes / max(kf.hbm_bytes, 1), 4)}
+    return summary
 
 
 def print_report(summary: dict) -> None:
@@ -246,6 +318,26 @@ def print_report(summary: dict) -> None:
         print("  %-14s %9.2f MB  %6.1f%%  %s"
               % (p["phase"], p["bytes"] / 1e6, p["share"] * 100,
                  p["note"]))
+    bq = summary.get("budget_quantized")
+    if bq is not None:
+        print()
+        print("iteration byte budget [engine=%s, quantized]: %.1f MB "
+              "(%.1f%% of f32) -> %.1f ms at the HBM roof"
+              % (bq["engine"], bq["total_bytes"] / 1e6,
+                 bq["total_bytes"] / max(b["total_bytes"], 1) * 100,
+                 bq["total_bytes"] / 1e9 / roof["hbm_gbps"] * 1e3))
+        for p in bq["phases"]:
+            print("  %-14s %9.2f MB  %6.1f%%  %s"
+                  % (p["phase"], p["bytes"] / 1e6, p["share"] * 100,
+                     p["note"]))
+    qf = summary.get("quantized_floor")
+    if qf is not None:
+        print()
+        print("quantized histogram byte floor @ %d rows: %s %.1f MB vs "
+              "%s %.1f MB -> %.1f%% of the f32 path (gate: <= 55%%)"
+              % (qf["rows"], qf["quantized_kernel"],
+                 qf["quantized_bytes"] / 1e6, qf["f32_kernel"],
+                 qf["f32_bytes"] / 1e6, qf["ratio"] * 100))
 
 
 def main(argv=None) -> int:

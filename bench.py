@@ -352,9 +352,10 @@ def lint_smoke():
 
     Proves the static-analysis gate still loads and the tree is clean
     against tools/lint_baseline.json — the same signal CI enforces, so
-    a bench run on a dirty checkout shows "new N" right in the output.
-    Pure-stdlib path (no jax involved).  Never fails the bench: any
-    problem becomes the summary.
+    a bench run on a dirty checkout shows "new N" right in the output,
+    followed by per-family counts (jit/locks/config/hygiene/
+    collectives/wireproto/donation).  Pure-stdlib path (no jax
+    involved).  Never fails the bench: any problem becomes the summary.
     """
     import importlib.util
     import os

@@ -12,8 +12,9 @@ from __future__ import annotations
 from .core import (DEFAULT_ROOTS, Finding, HIGH, LOW, MEDIUM, Project,
                    SEVERITIES, SourceFile, collect_files, run_suite,
                    severity_counts)
-from . import baseline, report
+from . import baseline, checkers, report
 
 __all__ = ["DEFAULT_ROOTS", "Finding", "HIGH", "LOW", "MEDIUM",
            "Project", "SEVERITIES", "SourceFile", "baseline",
-           "collect_files", "report", "run_suite", "severity_counts"]
+           "checkers", "collect_files", "report", "run_suite",
+           "severity_counts"]

@@ -8,14 +8,26 @@ from __future__ import annotations
 
 from typing import List
 
+from typing import Dict
+
 from ..core import Checker
 from .jit_hazards import JitHazardChecker
 from .lock_discipline import LockDisciplineChecker
 from .config_drift import ConfigDriftChecker
 from .hygiene import HygieneChecker
+from .collectives import CollectiveSymmetryChecker
+from .wireproto import WireProtocolChecker
+from .donation import DonationChecker
 
 CHECKER_CLASSES = (JitHazardChecker, LockDisciplineChecker,
-                   ConfigDriftChecker, HygieneChecker)
+                   ConfigDriftChecker, HygieneChecker,
+                   CollectiveSymmetryChecker, WireProtocolChecker,
+                   DonationChecker)
+
+#: check id -> owning family id, for per-family summary counts
+CHECK_FAMILY: Dict[str, str] = {
+    check: cls.id for cls in CHECKER_CLASSES
+    for check in getattr(cls, "checks", ())}
 
 
 def all_checkers() -> List[Checker]:

@@ -1,0 +1,272 @@
+"""Checker family 5: SPMD collective symmetry.
+
+Every collective — a `jax.lax.psum`/`all_gather` inside a `shard_map`
+body, or the host-side hub-and-spoke ``comm.allgather`` — is a
+rendezvous: all ranks of the current generation must reach the same
+sequence of collectives in the same order, or the world deadlocks
+(some ranks waiting in an allgather the others never enter).  The bug
+class ROADMAP item 1's ``Collective`` refactor risks is exactly a
+collective that became reachable on *some* ranks only.
+
+The checker builds on the shared project call graph (core.CallGraph):
+a function is *collective-bearing* when its body performs a collective
+directly or (transitively, with the shared name-resolution ambiguity
+policy) calls one that does.  Flagged, all HIGH:
+
+- ``collective-rank-branch``       collective reachable under
+                                   rank-dependent control flow (a
+                                   branch on ``rank`` / ``world_size``
+                                   / hub-election state, or a loop
+                                   whose trip count is shard-local)
+- ``collective-divergent-sequence`` a rank-dependent ``if`` whose two
+                                   arms perform *different* collective
+                                   sequences (identical sequences in
+                                   both arms are symmetric and exempt)
+- ``collective-under-lock``        collective reachable while holding
+                                   a lock — the rendezvous then blocks
+                                   every thread waiting on that lock,
+                                   and a dead peer turns the lock into
+                                   a process-wide stall
+
+Guard-and-raise prologues (``if self.orig_rank in dead: raise``) do
+not flag: the collective after the guard is reached by every surviving
+rank.  Branches on static config (``if learner == "voting"``, ``if
+dp``) are rank-symmetric by construction and never match the
+rank-dependence test.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..core import (CallSite, Checker, ControlCtx, Finding, FunctionInfo,
+                    HIGH, Project, expr_text, lock_ctor_name, self_attr)
+
+CHECK_RANK_BRANCH = "collective-rank-branch"
+CHECK_DIVERGENT = "collective-divergent-sequence"
+CHECK_UNDER_LOCK = "collective-under-lock"
+
+#: exact collective names (jax.lax device collectives + host comm verbs)
+_COLLECTIVE_EXACT = frozenset({
+    "psum", "psum_scatter", "pmean", "pmax", "pmin", "all_to_all",
+    "ppermute", "pshuffle", "pgather", "all_gather"})
+#: substring-matched collective names — catches ``allgather``,
+#: ``_allgather_impl``, ``allreduce_histograms`` and friends
+_COLLECTIVE_SUBSTR = ("allgather", "all_gather", "allreduce",
+                      "all_reduce", "broadcast", "barrier", "sync_wait")
+#: never substring-match these (``broadcasted_iota``/``broadcast_to``
+#: are shape ops, not communication)
+_NOT_COLLECTIVE = re.compile(r"broadcast(_to|ed)")
+
+#: identifier fragments that make a branch/loop test rank-dependent
+_RANK_EXACT = frozenset({"world", "world_size", "hub", "is_hub",
+                         "hub_rank", "leader", "is_leader"})
+_LOCKISH = re.compile(r"lock|mutex|cond", re.IGNORECASE)
+
+
+def is_collective_name(name: str) -> bool:
+    if not name or _NOT_COLLECTIVE.search(name):
+        return False
+    if name in _COLLECTIVE_EXACT:
+        return True
+    return any(s in name for s in _COLLECTIVE_SUBSTR)
+
+
+def _rank_names(expr: ast.AST) -> Set[str]:
+    """Identifiers inside ``expr`` that tie its value to this rank's
+    identity (rank numbers, hub election, per-rank liveness sets)."""
+    out: Set[str] = set()
+    for n in ast.walk(expr):
+        name = None
+        if isinstance(n, ast.Name):
+            name = n.id
+        elif isinstance(n, ast.Attribute):
+            name = n.attr
+        if name is None:
+            continue
+        low = name.lower()
+        if "rank" in low or low in _RANK_EXACT:
+            out.add(name)
+    return out
+
+
+class CollectiveSymmetryChecker(Checker):
+    id = "collectives"
+    description = ("collectives reachable under rank-dependent control "
+                   "flow, rank-divergent collective sequences, "
+                   "collectives held under locks")
+    checks = (CHECK_RANK_BRANCH, CHECK_DIVERGENT, CHECK_UNDER_LOCK)
+
+    #: the shared call graph for the current run, set by run() so the
+    #: per-function helpers don't thread it positionally everywhere
+    _graph = None
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        graph = project.call_graph
+        self._graph = graph
+        bearing = self._bearing_closure(graph)
+        lock_names = self._lock_name_inventory(project)
+        findings: List[Finding] = []
+        for fi in graph.functions.values():
+            findings.extend(self._check_function(fi, bearing, lock_names))
+        return findings
+
+    # -- collective-bearing closure -------------------------------------
+    def _bearing_closure(self, graph) -> Set[str]:
+        """Keys of functions from which a collective is reachable.
+        Seeds are functions performing one directly; propagation walks
+        caller edges through the shared name resolution (common names
+        and over-ambiguous names never propagate)."""
+        bearing: Set[str] = set()
+        for fi in graph.functions.values():
+            if any(is_collective_name(cs.name) for cs in fi.calls):
+                bearing.add(fi.key)
+        changed = True
+        while changed:
+            changed = False
+            for fi in graph.functions.values():
+                if fi.key in bearing:
+                    continue
+                for cs in fi.calls:
+                    if is_collective_name(cs.name):
+                        continue    # already a direct seed match
+                    cands = graph.resolve(cs.name)
+                    if cands and all(c.key in bearing for c in cands):
+                        bearing.add(fi.key)
+                        changed = True
+                        break
+        return bearing
+
+    def _lock_name_inventory(self, project: Project) -> Set[str]:
+        """Terminal names known to be threading locks anywhere in the
+        project (class attrs and module-level), plus anything matching
+        the lock-ish spelling pattern at use sites."""
+        names: Set[str] = set()
+        for sf in project.files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                if lock_ctor_name(node.value) is None:
+                    continue
+                for tgt in node.targets:
+                    attr = self_attr(tgt)
+                    if attr is not None:
+                        names.add(attr)
+                    elif isinstance(tgt, ast.Name):
+                        names.add(tgt.id)
+        return names
+
+    # -- per-function checks --------------------------------------------
+    def _is_collective_call(self, cs: CallSite, graph=None,
+                            bearing: Optional[Set[str]] = None) -> bool:
+        if is_collective_name(cs.name):
+            return True
+        if graph is None or bearing is None:
+            return False
+        cands = graph.resolve(cs.name)
+        return bool(cands) and all(c.key in bearing for c in cands)
+
+    def _check_function(self, fi: FunctionInfo, bearing: Set[str],
+                        lock_names: Set[str]) -> List[Finding]:
+        graph = self._graph
+        out: List[Finding] = []
+        divergent_ifs: Set[int] = set()
+        symmetric_ifs: Set[int] = set()
+        # pass 1: classify every rank-dependent If by its two arms'
+        # collective sequences
+        rank_ifs: Dict[int, ast.If] = {}
+        for cs in fi.calls:
+            for kind, stmt in cs.ctx.branches:
+                if kind in ("if", "else") and isinstance(stmt, ast.If):
+                    rank_ifs.setdefault(id(stmt), stmt)
+        for key, stmt in rank_ifs.items():
+            if not _rank_names(stmt.test):
+                continue
+            body_seq = self._collective_seq(fi, stmt, "if", bearing)
+            else_seq = self._collective_seq(fi, stmt, "else", bearing)
+            if body_seq and else_seq:
+                if body_seq == else_seq:
+                    symmetric_ifs.add(key)
+                else:
+                    divergent_ifs.add(key)
+                    out.append(self.finding(
+                        fi.sf, stmt, HIGH,
+                        "rank-dependent branch (%s) runs different "
+                        "collective sequences per arm (%s vs %s) — "
+                        "ranks taking opposite arms rendezvous on "
+                        "mismatched collectives and deadlock"
+                        % (", ".join(sorted(_rank_names(stmt.test))),
+                           "+".join(body_seq), "+".join(else_seq)),
+                        check=CHECK_DIVERGENT))
+        # pass 2: per-call-site findings
+        for cs in fi.calls:
+            if not self._is_collective_call(cs, graph, bearing):
+                continue
+            reason = self._rank_dependence(cs.ctx, symmetric_ifs,
+                                           divergent_ifs)
+            if reason is not None:
+                out.append(self.finding(
+                    fi.sf, cs.node, HIGH,
+                    "collective %s() reachable only under rank-dependent "
+                    "control flow (%s) — ranks that skip it leave the "
+                    "others blocked in the rendezvous" % (cs.name, reason),
+                    check=CHECK_RANK_BRANCH))
+            held = [expr_text(w) for w in cs.ctx.withs
+                    if self._is_lock_expr(w, lock_names)]
+            if held:
+                out.append(self.finding(
+                    fi.sf, cs.node, HIGH,
+                    "collective %s() while holding %s — the rendezvous "
+                    "blocks on the slowest/dead peer with the lock held, "
+                    "stalling every other thread on this process"
+                    % (cs.name, held[-1]), check=CHECK_UNDER_LOCK))
+        return out
+
+    def _collective_seq(self, fi: FunctionInfo, if_stmt: ast.If,
+                        arm: str, bearing: Set[str]) -> Tuple[str, ...]:
+        """Ordered collective call names inside one arm of an If."""
+        graph = self._graph
+        seq: List[Tuple[int, int, str]] = []
+        for cs in fi.calls:
+            for kind, stmt in cs.ctx.branches:
+                if stmt is if_stmt and kind == arm:
+                    if self._is_collective_call(cs, graph, bearing):
+                        seq.append((cs.node.lineno, cs.node.col_offset,
+                                    cs.name))
+                    break
+        return tuple(name for _, _, name in sorted(seq))
+
+    def _rank_dependence(self, ctx: ControlCtx, symmetric: Set[int],
+                         divergent: Set[int]) -> Optional[str]:
+        """Why this path is rank-dependent, or None when symmetric."""
+        for kind, stmt in ctx.branches:
+            if kind in ("if", "else") and isinstance(stmt, ast.If):
+                if id(stmt) in symmetric or id(stmt) in divergent:
+                    continue    # symmetric exempt; divergent reported once
+                names = _rank_names(stmt.test)
+                if names:
+                    return "branch on %s" % ", ".join(sorted(names))
+            elif kind == "while":
+                names = _rank_names(stmt.test)
+                if names:
+                    return "loop bounded by %s" % ", ".join(sorted(names))
+            elif kind == "for":
+                names = _rank_names(stmt.iter)
+                if names:
+                    return ("loop over shard-local iterable (%s)"
+                            % ", ".join(sorted(names)))
+        return None
+
+    def _is_lock_expr(self, expr: ast.AST, lock_names: Set[str]) -> bool:
+        text = expr_text(expr)
+        if not text:
+            # ``with self._lock_for(x):`` style — look at the call name
+            if isinstance(expr, ast.Call):
+                name, _ = (expr.func.attr, None) \
+                    if isinstance(expr.func, ast.Attribute) \
+                    else (getattr(expr.func, "id", ""), None)
+                return bool(_LOCKISH.search(name or ""))
+            return False
+        tail = text.rsplit(".", 1)[-1]
+        return tail in lock_names or bool(_LOCKISH.search(tail))

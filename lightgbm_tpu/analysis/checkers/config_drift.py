@@ -130,6 +130,8 @@ def _string_key_reads(node: ast.AST) -> Iterable[Tuple[str, ast.AST]]:
 
 class ConfigDriftChecker(Checker):
     id = "config"
+    checks = (CHECK_DEAD, CHECK_UNDOC, CHECK_STALE, CHECK_ALIAS,
+              CHECK_PHANTOM)
     description = ("schema params unread in code, schema<->Parameters.md "
                    "drift, broken aliases, phantom param reads")
 

@@ -105,6 +105,8 @@ def _names_broad(handler: ast.ExceptHandler) -> bool:
 
 class HygieneChecker(Checker):
     id = "hygiene"
+    checks = (CHECK_BARE, CHECK_SWALLOW, CHECK_OPEN, CHECK_SOCKET,
+              CHECK_FSYNC)
     description = ("unmanaged open()/sockets, exception swallowing, "
                    "fsync-less durable writes")
 

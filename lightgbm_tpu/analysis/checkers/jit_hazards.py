@@ -138,6 +138,7 @@ def _is_shape_only(node: ast.AST,
 
 class JitHazardChecker(Checker):
     id = "jit"
+    checks = (CHECK_SYNC, CHECK_CAST, CHECK_BRANCH)
     description = ("host syncs, host casts and Python branching on traced "
                    "values inside @jax.jit bodies")
 

@@ -41,7 +41,9 @@ import ast
 import os
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-from ..core import Checker, Finding, HIGH, MEDIUM, Project, SourceFile
+from ..core import (AMBIGUITY_CAP, Checker, COMMON_CALL_NAMES, Finding,
+                    HIGH, LOCK_CTORS, MEDIUM, MUTATOR_METHODS, Project,
+                    SourceFile, lock_ctor_name, self_attr, shallow_exprs)
 
 CHECK_UNGUARDED = "lock-unguarded-write"
 CHECK_SHARED = "lock-shared-write"
@@ -54,60 +56,16 @@ _BLOCK_HIGH_ATTRS = {"recv", "recv_into", "recvfrom", "accept", "connect",
 _DISPATCH_ATTRS = {"block_until_ready", "device_put", "predict_fn",
                    "predict", "predict_device", "predict_bucketed",
                    "warmup", "warmup_buckets"}
-_MUTATOR_METHODS = {"append", "extend", "insert", "remove", "pop", "clear",
-                    "update", "add", "discard", "setdefault", "popitem",
-                    "sort", "reverse", "appendleft", "popleft"}
-_LOCK_CTORS = {"Lock", "RLock"}
-_AMBIGUITY_CAP = 3       # cross-class call edges only when <= this many
-#                          lock-owning classes define the method name
-#: method names shared with dict/list/set/queue — a ``.get()`` under a
-#: lock is overwhelmingly a dict read, not a call into another
-#: lock-owning class; never build cross-class order edges from these.
-_COMMON_METHOD_NAMES = _MUTATOR_METHODS | {
-    "get", "keys", "values", "items", "copy", "put", "close", "join",
-    "start", "stop", "wait", "notify", "notify_all", "acquire",
-    "release", "send", "recv", "read", "write", "flush"}
-
-
-def _self_attr(node: ast.AST) -> Optional[str]:
-    if (isinstance(node, ast.Attribute)
-            and isinstance(node.value, ast.Name) and node.value.id == "self"):
-        return node.attr
-    return None
-
-
-def _ctor_name(value: ast.AST) -> Optional[str]:
-    """'Lock' / 'RLock' / 'Condition' when value is threading.X(...)."""
-    if not isinstance(value, ast.Call):
-        return None
-    f = value.func
-    if isinstance(f, ast.Attribute) and f.attr in _LOCK_CTORS | {"Condition"}:
-        return f.attr
-    if isinstance(f, ast.Name) and f.id in _LOCK_CTORS | {"Condition"}:
-        return f.id
-    return None
-
-
-def _shallow_nodes(stmt: ast.stmt) -> Iterable[ast.AST]:
-    """Expression-level nodes belonging to this statement, without
-    descending into nested statements, nested defs, or lambda bodies
-    (those do not execute under the current lock context)."""
-    stack: List[ast.AST] = []
-
-    def push_children(n: ast.AST) -> None:
-        for child in ast.iter_child_nodes(n):
-            if isinstance(child, (ast.stmt, ast.FunctionDef,
-                                  ast.AsyncFunctionDef, ast.Lambda,
-                                  ast.excepthandler)):
-                continue
-            stack.append(child)
-
-    push_children(stmt)
-    while stack:
-        n = stack.pop()
-        yield n
-        if not isinstance(n, ast.Lambda):
-            push_children(n)
+# the syntactic primitives (self-attr matching, lock-ctor detection,
+# shallow statement walks, common-name ambiguity policy) are shared
+# core infrastructure since the v2 call-graph refactor
+_MUTATOR_METHODS = MUTATOR_METHODS
+_LOCK_CTORS = LOCK_CTORS
+_AMBIGUITY_CAP = AMBIGUITY_CAP
+_COMMON_METHOD_NAMES = COMMON_CALL_NAMES
+_self_attr = self_attr
+_ctor_name = lock_ctor_name
+_shallow_nodes = shallow_exprs
 
 
 class _Access:
@@ -151,6 +109,8 @@ class _ScopeInfo:
 
 class LockDisciplineChecker(Checker):
     id = "locks"
+    checks = (CHECK_UNGUARDED, CHECK_SHARED, CHECK_BLOCKING, CHECK_ORDER,
+              CHECK_REENTRANT)
     description = ("guarded-attribute mutations outside locks, blocking "
                    "calls under locks, lock-order cycles")
 
@@ -170,7 +130,7 @@ class LockDisciplineChecker(Checker):
             if not info.is_module:
                 findings.extend(self._write_findings(info))
             findings.extend(self._blocking_findings(info))
-        findings.extend(self._order_findings(scopes))
+        findings.extend(self._order_findings(project, scopes))
         return findings
 
     # -- scope scans ----------------------------------------------------
@@ -431,12 +391,19 @@ class LockDisciplineChecker(Checker):
         return out
 
     # -- findings: lock-order cycles ------------------------------------
-    def _order_findings(self, scopes: List[_ScopeInfo]) -> List[Finding]:
-        method_locks: Dict[str, List[Tuple[_ScopeInfo, Set[str]]]] = {}
+    def _order_findings(self, project: Project,
+                        scopes: List[_ScopeInfo]) -> List[Finding]:
+        # cross-object edges resolve callee names through the shared
+        # project call graph (core.CallGraph), then keep only candidates
+        # that are methods of a lock-acquiring scope — same ambiguity
+        # policy as before the v2 migration, but the resolution itself is
+        # now cross-module and shared with the collectives checker.
+        graph = project.call_graph
+        locks_by_node: Dict[int, Tuple[_ScopeInfo, Set[str]]] = {}
         for info in scopes:
             for mname, locks in info.acquires.items():
-                if locks:
-                    method_locks.setdefault(mname, []).append((info, locks))
+                if locks and mname in info.methods:
+                    locks_by_node[id(info.methods[mname])] = (info, locks)
         edges: Dict[Tuple[str, str], Tuple[SourceFile, ast.AST]] = {}
         findings: List[Finding] = []
         for info in scopes:
@@ -467,7 +434,10 @@ class LockDisciplineChecker(Checker):
                             edges.setdefault((lock, inner),
                                              (info.sf, call))
                     continue
-                owners = method_locks.get(callee, [])
+                owners = [locks_by_node[id(fi.node)]
+                          for fi in graph.resolve(callee, cap=None,
+                                                  allow_common=True)
+                          if id(fi.node) in locks_by_node]
                 if not is_self_call and callee not in _COMMON_METHOD_NAMES \
                         and 0 < len(owners) <= _AMBIGUITY_CAP:
                     for other, locks in owners:

@@ -1,0 +1,246 @@
+"""Checker family 6: ElasticComm wire-protocol state machine.
+
+The v3 frame format is 8-byte length + 16-byte trace id + 8-byte span
+id + 8-byte generation + 1-byte kind; the kind byte (FRAME_DATA /
+FRAME_POISON / FRAME_PING / FRAME_PONG) is the whole control-plane
+state machine, and the generation stamp is the fence that keeps a
+re-formed world from consuming frames of a dead one.  Three properties
+must hold or the protocol wedges in ways tests rarely reproduce (they
+need a failure + a reconnection in the right order):
+
+- ``wire-unhandled-kind``  HIGH   a frame kind is sent somewhere but no
+                                  recv path ever compares against it —
+                                  the peer treats it as data or drops
+                                  it, and the sender's state machine
+                                  waits forever
+- ``wire-unfenced-recv``   MEDIUM a function consumes frames without
+                                  ever comparing a generation — frames
+                                  of a dead world are indistinguishable
+                                  from live ones.  Pre-formation
+                                  handshake helpers are exempted with
+                                  an inline ``# tpulint: ok=`` (the
+                                  generation does not exist yet there)
+- ``wire-blocking-handler`` HIGH  a frame-dispatch loop recvs with no
+                                  ``select``/``settimeout`` bound — a
+                                  convicted (dead, fenced) peer blocks
+                                  the handler thread forever
+- ``wire-dead-kind``       LOW    a kind constant neither sent nor
+                                  handled (value 0 is the implicit
+                                  data default and exempt)
+
+Scope: any module defining ``FRAME_<NAME> = <int>`` constants is a
+wire-protocol module and is analyzed standalone; the kind namespace is
+per-module (the fixture mini-protocols under tests/ exercise the
+checker without touching the real one).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..core import (Checker, Finding, HIGH, LOW, MEDIUM, Project,
+                    SourceFile, call_name)
+
+CHECK_UNHANDLED = "wire-unhandled-kind"
+CHECK_UNFENCED = "wire-unfenced-recv"
+CHECK_BLOCKING = "wire-blocking-handler"
+CHECK_DEAD = "wire-dead-kind"
+
+_FRAME_RE = re.compile(r"^FRAME_[A-Z0-9_]+$")
+#: names whose value is a generation stamp in a fence comparison
+_GEN_NAMES = frozenset({"g", "gen", "generation", "peer_gen", "hub_gen",
+                        "peer_generation"})
+#: callee-name fragments that consume a wire frame
+_RECV_FRAGMENTS = ("recv_frame", "recv_msg", "recv_blob", "recv_counted")
+#: callee-name fragments that emit one
+_SEND_FRAGMENTS = ("send_frame", "send_msg", "send_blob", "send_counted",
+                   "send_kind")
+
+
+def _is_recv_callee(name: str) -> bool:
+    return any(s in name for s in _RECV_FRAGMENTS)
+
+
+def _frame_consts(sf: SourceFile) -> Dict[str, Tuple[int, ast.AST]]:
+    """FRAME_* integer constants assigned at module level."""
+    out: Dict[str, Tuple[int, ast.AST]] = {}
+    for stmt in sf.tree.body:
+        if not isinstance(stmt, ast.Assign):
+            continue
+        if not (isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, int)):
+            continue
+        for tgt in stmt.targets:
+            if isinstance(tgt, ast.Name) and _FRAME_RE.match(tgt.id):
+                out[tgt.id] = (stmt.value.value, stmt)
+    return out
+
+
+class WireProtocolChecker(Checker):
+    id = "wireproto"
+    description = ("frame kinds sent without a recv handler, recv paths "
+                   "without generation fences, frame-dispatch loops that "
+                   "can block on a dead peer")
+    checks = (CHECK_UNHANDLED, CHECK_UNFENCED, CHECK_BLOCKING, CHECK_DEAD)
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for sf in project.files:
+            consts = _frame_consts(sf)
+            if not consts:
+                continue
+            findings.extend(self._check_module(sf, consts))
+        return findings
+
+    def _check_module(self, sf: SourceFile,
+                      consts: Dict[str, Tuple[int, ast.AST]]
+                      ) -> List[Finding]:
+        sent: Dict[str, ast.AST] = {}      # kind -> first sending call
+        handled: Set[str] = set()
+        referenced: Set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Compare):
+                for side in [node.left] + list(node.comparators):
+                    name = self._frame_name(side, consts)
+                    if name is not None:
+                        handled.add(name)
+                        referenced.add(name)
+            elif isinstance(node, ast.Call):
+                callee, _ = call_name(node)
+                for kw in node.keywords:
+                    name = self._frame_name(kw.value, consts)
+                    if name is not None and kw.arg == "kind":
+                        sent.setdefault(name, node)
+                        referenced.add(name)
+                if any(s in callee for s in _SEND_FRAGMENTS):
+                    for arg in node.args:
+                        name = self._frame_name(arg, consts)
+                        if name is not None:
+                            sent.setdefault(name, node)
+                            referenced.add(name)
+            elif isinstance(node, ast.Name) and node.id in consts \
+                    and isinstance(getattr(node, "ctx", None), ast.Load):
+                referenced.add(node.id)
+
+        out: List[Finding] = []
+        for kind in sorted(sent):
+            if kind not in handled:
+                out.append(self.finding(
+                    sf, sent[kind], HIGH,
+                    "frame kind %s is sent but no recv path in this "
+                    "module ever compares against it — the peer's state "
+                    "machine drops or misreads the frame and the sender "
+                    "waits forever" % kind, check=CHECK_UNHANDLED))
+        for kind, (value, node) in sorted(consts.items()):
+            if value == 0:
+                continue    # the implicit data default
+            if kind not in sent and kind not in handled \
+                    and kind not in referenced:
+                out.append(self.finding(
+                    sf, node, LOW,
+                    "frame kind %s (=%d) is neither sent nor handled — "
+                    "dead protocol state" % (kind, value),
+                    check=CHECK_DEAD))
+        out.extend(self._recv_path_findings(sf, consts))
+        return out
+
+    def _frame_name(self, expr: ast.AST,
+                    consts: Dict[str, Tuple[int, ast.AST]]
+                    ) -> Optional[str]:
+        if isinstance(expr, ast.Name) and expr.id in consts:
+            return expr.id
+        if isinstance(expr, ast.Attribute) and expr.attr in consts:
+            return expr.attr
+        return None
+
+    # -- per-function recv-path analysis --------------------------------
+    def _recv_path_findings(self, sf: SourceFile,
+                            consts: Dict[str, Tuple[int, ast.AST]]
+                            ) -> List[Finding]:
+        out: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            recv_call = self._first_recv_call(node)
+            if recv_call is None:
+                continue
+            if not self._has_generation_fence(node):
+                out.append(self.finding(
+                    sf, recv_call, MEDIUM,
+                    "recv path %s() never compares a generation stamp — "
+                    "frames of a torn-down world are indistinguishable "
+                    "from live ones; fence on generation or exempt the "
+                    "pre-formation path explicitly" % node.name,
+                    check=CHECK_UNFENCED))
+            blocking = self._blocking_dispatch(node, consts)
+            if blocking is not None:
+                out.append(self.finding(
+                    sf, blocking, HIGH,
+                    "frame-dispatch loop in %s() recvs with no select/"
+                    "settimeout bound — a convicted peer that stops "
+                    "sending blocks this handler thread forever"
+                    % node.name, check=CHECK_BLOCKING))
+        return out
+
+    def _first_recv_call(self, func: ast.AST) -> Optional[ast.Call]:
+        for n in self._own_nodes(func):
+            if isinstance(n, ast.Call):
+                callee, _ = call_name(n)
+                if _is_recv_callee(callee):
+                    return n
+        return None
+
+    def _has_generation_fence(self, func: ast.AST) -> bool:
+        for n in self._own_nodes(func):
+            if not isinstance(n, ast.Compare):
+                continue
+            for side in [n.left] + list(n.comparators):
+                if isinstance(side, ast.Name) and side.id in _GEN_NAMES:
+                    return True
+                if isinstance(side, ast.Attribute) \
+                        and side.attr in _GEN_NAMES:
+                    return True
+        return False
+
+    def _blocking_dispatch(self, func: ast.AST,
+                           consts: Dict[str, Tuple[int, ast.AST]]
+                           ) -> Optional[ast.AST]:
+        """The offending recv call when ``func`` loops, recvs inside the
+        loop, dispatches on frame kinds, and never bounds the wait."""
+        dispatches = False
+        for n in self._own_nodes(func):
+            if isinstance(n, ast.Compare):
+                for side in [n.left] + list(n.comparators):
+                    if self._frame_name(side, consts) is not None:
+                        dispatches = True
+        if not dispatches:
+            return None
+        bounded = False
+        for n in self._own_nodes(func):
+            if isinstance(n, ast.Call):
+                callee, recv = call_name(n)
+                if callee in ("select", "poll", "settimeout") \
+                        or recv.endswith("select"):
+                    bounded = True
+        if bounded:
+            return None
+        for n in self._own_nodes(func):
+            if isinstance(n, (ast.While, ast.For)):
+                for inner in ast.walk(n):
+                    if isinstance(inner, ast.Call):
+                        callee, _ = call_name(inner)
+                        if _is_recv_callee(callee):
+                            return inner
+        return None
+
+    def _own_nodes(self, func: ast.AST) -> Iterable[ast.AST]:
+        """All nodes of ``func`` excluding nested function bodies."""
+        stack: List[ast.AST] = list(ast.iter_child_nodes(func))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))

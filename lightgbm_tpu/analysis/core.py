@@ -147,6 +147,15 @@ class Project:
         self.root = root
         self.files = list(files)
         self.by_rel = {f.rel: f for f in self.files}
+        self._call_graph: Optional["CallGraph"] = None
+
+    @property
+    def call_graph(self) -> "CallGraph":
+        """Lazy project-wide call graph (built once per run; the
+        collectives, wireproto and lock-order analyses all share it)."""
+        if self._call_graph is None:
+            self._call_graph = CallGraph(self)
+        return self._call_graph
 
     def iter_files(self, prefixes: Optional[Sequence[str]] = None
                    ) -> Iterable[SourceFile]:
@@ -176,6 +185,239 @@ class Checker:
                        getattr(node, "lineno", 1),
                        getattr(node, "col_offset", 0) + 1,
                        message, scope=sf.qualname(node))
+
+
+# -- shared syntactic helpers ----------------------------------------------
+#
+# These used to live inside the lock checker; the collectives / wireproto /
+# donation families need the same primitives, so they are core now.
+
+MUTATOR_METHODS = frozenset({
+    "append", "extend", "insert", "remove", "pop", "clear", "update",
+    "add", "discard", "setdefault", "popitem", "sort", "reverse",
+    "appendleft", "popleft"})
+
+#: method names shared with dict/list/set/queue/thread — never resolve a
+#: cross-object call edge through one of these; a ``.get()`` is
+#: overwhelmingly a dict read, not a call into another analyzed class.
+COMMON_CALL_NAMES = MUTATOR_METHODS | frozenset({
+    "get", "keys", "values", "items", "copy", "put", "close", "join",
+    "start", "stop", "wait", "notify", "notify_all", "acquire",
+    "release", "send", "recv", "read", "write", "flush"})
+
+#: cross-object call edges only when <= this many definitions share the name
+AMBIGUITY_CAP = 3
+
+LOCK_CTORS = frozenset({"Lock", "RLock"})
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """'x' when node is ``self.x``, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def lock_ctor_name(value: ast.AST) -> Optional[str]:
+    """'Lock' / 'RLock' / 'Condition' when value is ``threading.X(...)``."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    if isinstance(f, ast.Attribute) and f.attr in LOCK_CTORS | {"Condition"}:
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in LOCK_CTORS | {"Condition"}:
+        return f.id
+    return None
+
+
+def shallow_exprs(stmt: ast.stmt) -> Iterable[ast.AST]:
+    """Expression-level nodes belonging to this statement, without
+    descending into nested statements, nested defs, or lambda bodies
+    (those do not execute at the statement's own control point)."""
+    stack: List[ast.AST] = []
+
+    def push_children(n: ast.AST) -> None:
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.stmt, ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda,
+                                  ast.excepthandler)):
+                continue
+            stack.append(child)
+
+    push_children(stmt)
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, ast.Lambda):
+            push_children(n)
+
+
+def expr_text(node: ast.AST) -> str:
+    """Dotted text of a Name/Attribute chain ('self.comm', 'jax.lax'),
+    or '' when the expression is anything more dynamic."""
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def binding_key(node: ast.AST) -> Optional[str]:
+    """Stable key for a rebindable storage location: a plain name
+    ('arena'), a dotted attribute chain ('self._arena',
+    'self.train_state.score'), or a constant-keyed subscript
+    ('state["arena"]').  None for fresh temporaries / dynamic refs."""
+    if isinstance(node, ast.Subscript):
+        base = expr_text(node.value)
+        sl = node.slice
+        if base and isinstance(sl, ast.Constant):
+            return "%s[%r]" % (base, sl.value)
+        return None
+    text = expr_text(node)
+    return text or None
+
+
+def call_name(call: ast.Call) -> Tuple[str, str]:
+    """(simple callee name, receiver text) — ('allgather', 'self.comm')
+    for ``self.comm.allgather(x)``, ('psum', 'jax.lax') for
+    ``jax.lax.psum(...)``, ('f', '') for ``f(x)``."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr, expr_text(f.value)
+    if isinstance(f, ast.Name):
+        return f.id, ""
+    return "", ""
+
+
+# -- call graph + path-sensitive call contexts ------------------------------
+
+class ControlCtx:
+    """The control-flow path context a call executes under: the stack of
+    enclosing branch/loop statements (as (kind, stmt) pairs, kind in
+    {'if', 'else', 'while', 'for'}) and the with-contexts held."""
+
+    __slots__ = ("branches", "withs")
+
+    def __init__(self, branches: Tuple = (), withs: Tuple = ()):
+        self.branches = branches
+        self.withs = withs
+
+    def push_branch(self, kind: str, stmt: ast.stmt) -> "ControlCtx":
+        return ControlCtx(self.branches + ((kind, stmt),), self.withs)
+
+    def push_withs(self, exprs: Sequence[ast.AST]) -> "ControlCtx":
+        return ControlCtx(self.branches, self.withs + tuple(exprs))
+
+
+class CallSite:
+    """One call expression inside a function, with its path context."""
+
+    __slots__ = ("node", "name", "recv", "ctx")
+
+    def __init__(self, node: ast.Call, name: str, recv: str,
+                 ctx: ControlCtx):
+        self.node = node
+        self.name = name
+        self.recv = recv
+        self.ctx = ctx
+
+
+class FunctionInfo:
+    """One function/method definition in the project."""
+
+    __slots__ = ("sf", "node", "qualname", "key", "calls")
+
+    def __init__(self, sf: SourceFile, node: ast.AST):
+        self.sf = sf
+        self.node = node
+        self.qualname = sf.qualname(node)
+        self.key = "%s:%s:%d" % (sf.rel, self.qualname, node.lineno)
+        self.calls: List[CallSite] = []
+
+
+class CallGraph:
+    """Project-wide, name-resolved call graph.  Every def/method becomes
+    a FunctionInfo whose ``calls`` carry path-sensitive ControlCtx;
+    ``resolve`` maps a simple callee name to candidate definitions with
+    the shared ambiguity cap, so interprocedural checks (collective
+    reachability, cross-module lock order) share one resolution policy."""
+
+    def __init__(self, project: "Project"):
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.by_name: Dict[str, List[FunctionInfo]] = {}
+        for sf in project.files:
+            for node in ast.walk(sf.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fi = FunctionInfo(sf, node)
+                    self._collect_calls(fi)
+                    self.functions[fi.key] = fi
+                    self.by_name.setdefault(node.name, []).append(fi)
+
+    def resolve(self, name: str, cap: Optional[int] = AMBIGUITY_CAP,
+                allow_common: bool = False) -> List[FunctionInfo]:
+        """Candidate definitions for a simple callee name.  Empty when
+        the name is too common to resolve or has more than ``cap``
+        definitions (ambiguous edges create false positives)."""
+        if not name or (not allow_common and name in COMMON_CALL_NAMES):
+            return []
+        cands = self.by_name.get(name, [])
+        if cap is not None and len(cands) > cap:
+            return []
+        return list(cands)
+
+    def _collect_calls(self, fi: FunctionInfo) -> None:
+        def record(expr: ast.AST, ctx: ControlCtx) -> None:
+            stack: List[ast.AST] = [expr]
+            while stack:
+                n = stack.pop()
+                if isinstance(n, ast.Lambda):
+                    continue        # lambda bodies run later, elsewhere
+                if isinstance(n, ast.Call):
+                    name, recv = call_name(n)
+                    fi.calls.append(CallSite(n, name, recv, ctx))
+                stack.extend(ast.iter_child_nodes(n))
+
+        def walk(body: Sequence[ast.stmt], ctx: ControlCtx) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue        # separate FunctionInfo / class scope
+                if isinstance(stmt, ast.If):
+                    record(stmt.test, ctx)
+                    walk(stmt.body, ctx.push_branch("if", stmt))
+                    walk(stmt.orelse, ctx.push_branch("else", stmt))
+                elif isinstance(stmt, ast.While):
+                    record(stmt.test, ctx)
+                    walk(stmt.body, ctx.push_branch("while", stmt))
+                    walk(stmt.orelse, ctx)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    record(stmt.iter, ctx)  # iter evaluates once, outside
+                    walk(stmt.body, ctx.push_branch("for", stmt))
+                    walk(stmt.orelse, ctx)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    exprs = []
+                    for item in stmt.items:
+                        record(item.context_expr, ctx)
+                        exprs.append(item.context_expr)
+                    walk(stmt.body, ctx.push_withs(exprs))
+                elif isinstance(stmt, ast.Try):
+                    walk(stmt.body, ctx)
+                    for h in stmt.handlers:
+                        walk(h.body, ctx)
+                    walk(stmt.orelse, ctx)
+                    walk(stmt.finalbody, ctx)
+                else:
+                    for n in shallow_exprs(stmt):
+                        if isinstance(n, ast.Call):
+                            name, recv = call_name(n)
+                            fi.calls.append(CallSite(n, name, recv, ctx))
+
+        walk(fi.node.body, ControlCtx())
 
 
 # -- fingerprints ----------------------------------------------------------

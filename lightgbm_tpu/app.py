@@ -189,8 +189,9 @@ class Application:
             from .obs import default_registry
             prom_path = cfg.tpu_telemetry_path + ".prom"
             try:
-                with open(prom_path, "w") as f:
-                    f.write(default_registry().render_prometheus())
+                from .io.file_io import atomic_write_text
+                atomic_write_text(
+                    prom_path, default_registry().render_prometheus())
                 log.info("Telemetry written: events in %s, final metrics "
                          "in %s", cfg.tpu_telemetry_path, prom_path)
             except OSError as e:
@@ -302,6 +303,9 @@ class Application:
         out = np.atleast_2d(np.asarray(out))
         if out.shape[0] == 1 and out.size > 1:
             out = out.T if out.shape[1] == len(d.X) else out
+        # streamed, regenerable prediction output; durability is
+        # the caller's concern
+        # tpulint: disable-next-line=write-no-fsync
         with open(cfg.output_result, "w") as f:
             for row in np.asarray(out).reshape(len(d.X), -1):
                 f.write("\t".join(_fmt(v) for v in row) + "\n")
@@ -361,8 +365,8 @@ class Application:
                       % cfg.convert_model_language)
         booster = basic.Booster(model_file=cfg.input_model)
         code = booster._gbdt.model_to_if_else()
-        with open(cfg.convert_model, "w") as f:
-            f.write(code)
+        from .io.file_io import atomic_write_text
+        atomic_write_text(cfg.convert_model, code)
         log.info("Finished converting model; code saved to %s",
                  cfg.convert_model)
 

@@ -506,6 +506,9 @@ def LGBM_BoosterPredictForFile(handle, data_filename, data_has_header,
     pred = np.asarray(_predict(
         bst, d.X, getattr(predict_type, "value", predict_type),
         getattr(num_iteration, "value", num_iteration)), np.float64)
+    # streamed, regenerable prediction rows; matches the reference
+    # C API's plain fprintf loop
+    # tpulint: disable-next-line=write-no-fsync
     with open(_to_str(result_filename), "w") as f:
         if pred.ndim == 1:
             for v in pred:
@@ -770,6 +773,7 @@ def LGBM_DatasetDumpText(handle, filename):
     ds = _resolve(handle)
     ds.construct()
     b = ds._binned
+    # tpulint: disable-next-line=write-no-fsync — debug text dump
     with open(_to_str(filename), "w") as f:
         f.write("num_data: %d\n" % b.num_data)
         f.write("num_features: %d\n" % b.num_total_features)

@@ -225,8 +225,8 @@ class GBDT:
                 log.warning("telemetry finalize failed: %s", exc)
         try:
             self._trace.stop()
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as exc:  # noqa: BLE001
+            log.debug("trace stop failed during finalize: %s", exc)
         if getattr(self, "_tracing", False):
             self._tracing = False
             try:
@@ -250,6 +250,9 @@ class GBDT:
                 self.profile_report()
             if getattr(self, "_trace", None) is not None:
                 self._trace.stop()
+        # __del__ runs at interpreter teardown where even logging
+        # can raise; stay silent by design.
+        # tpulint: disable-next-line=except-swallow
         except Exception:  # noqa: BLE001 — teardown must never raise
             pass
 
@@ -2036,8 +2039,8 @@ def _device_memory_budget() -> int:
         total = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
         if total:
             return int(total * 0.6)
-    except Exception:
-        pass
+    except Exception as exc:  # noqa: BLE001
+        log.debug("device memory stats unavailable: %s", exc)
     return 8 << 30
 
 

@@ -19,6 +19,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
+from ..utils import log
+
 _lock = threading.Lock()
 _installed = False
 _install_count = 0           # registration attempts that found hooks live
@@ -119,8 +121,8 @@ def analyze_compiled(fn, args, signature: str = "") -> Optional[Dict]:
                     "utilization operand 0", "transcendentals"):
             if cost and key in cost:
                 stats[key.replace(" ", "_")] = float(cost[key])
-    except Exception:  # noqa: BLE001
-        pass
+    except Exception as exc:  # noqa: BLE001
+        log.debug("cost analysis unavailable: %s", exc)
     try:
         mem = lowered.compile().memory_analysis()
         for attr in ("temp_size_in_bytes", "argument_size_in_bytes",
@@ -131,8 +133,8 @@ def analyze_compiled(fn, args, signature: str = "") -> Optional[Dict]:
         if "temp_size_in_bytes" in stats:
             stats["peak_hbm_bytes"] = (stats["temp_size_in_bytes"]
                                        + stats.get("output_size_in_bytes", 0))
-    except Exception:  # noqa: BLE001
-        pass
+    except Exception as exc:  # noqa: BLE001
+        log.debug("memory analysis unavailable: %s", exc)
     if not stats:
         return None
     stats["signature"] = signature
@@ -180,9 +182,12 @@ def device_stats() -> Dict[str, int]:
             buffers += 1
             try:
                 nbytes += int(a.nbytes)
+            # donated arrays raise on .nbytes by design, once per
+            # buffer per scan; logging would spam every telemetry tick
+            # tpulint: disable-next-line=except-swallow
             except Exception:  # noqa: BLE001 — deleted/donated arrays
                 pass
-    except Exception:  # noqa: BLE001
-        pass
+    except Exception as exc:  # noqa: BLE001
+        log.debug("live-array scan unavailable: %s", exc)
     return {"live_buffers": buffers, "live_bytes": nbytes,
             "jit_cache_entries": jit_cache_size()}

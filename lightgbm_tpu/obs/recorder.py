@@ -206,8 +206,9 @@ class TrainingRecorder:
                             self.path, exc)
             try:
                 self._file.close()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as exc:  # noqa: BLE001
+                log.debug("telemetry: close of %s failed: %s",
+                          self.path, exc)
             self._file = None
         log.debug("telemetry: event log written to %s", self.path)
 
@@ -321,8 +322,9 @@ class TrainingRecorder:
             if self._file is not None:
                 try:
                     self._file.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:  # noqa: BLE001
+                    log.debug("telemetry: close after failed write "
+                              "also failed: %s", exc)
                 self._file = None
 
 

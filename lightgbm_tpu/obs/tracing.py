@@ -266,8 +266,8 @@ class SpanTracer:
         try:
             from . import device
             meta["compile_counts"] = device.compile_counts()
-        except Exception:  # noqa: BLE001 — metadata only
-            pass
+        except Exception as exc:  # noqa: BLE001 — metadata only
+            log.debug("compile counts unavailable: %s", exc)
         payload = {"traceEvents": events, "displayTimeUnit": "ms",
                    "metadata": meta}
         try:
@@ -336,8 +336,8 @@ class SpanTracer:
             self._hist_cache[kind] = hist  # tpulint: ok=lock-shared-write
         try:
             hist.observe(ms)
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as exc:  # noqa: BLE001
+            log.debug("span histogram observe failed: %s", exc)
 
 
 _tracer = SpanTracer()

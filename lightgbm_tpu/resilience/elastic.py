@@ -382,8 +382,8 @@ class ElasticSupervisor:
             m["world"].set(world)
             m["reforms"].set(reforms)
             m["recovery_s"].set(recovery_s)
-        except Exception:   # noqa: BLE001 — metrics never break training
-            pass
+        except Exception as exc:   # noqa: BLE001 — metrics never break
+            log.debug("elastic metrics publish failed: %s", exc)
 
     def _record(self, cfg, what: str, generation: int, world: int,
                 reforms: int, recovery_s: float, dead=None) -> None:
@@ -395,5 +395,5 @@ class ElasticSupervisor:
                           generation=generation, world=world,
                           reforms=reforms, recovery_s=round(recovery_s, 4),
                           dead_ranks=dead or [])
-        except Exception:   # noqa: BLE001
-            pass
+        except Exception as exc:   # noqa: BLE001
+            log.debug("elastic telemetry event failed: %s", exc)

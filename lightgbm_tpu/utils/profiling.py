@@ -72,13 +72,13 @@ class Profiler:
             if self.sync_fn is not None:
                 try:
                     self.sync_fn()
-                except Exception:  # noqa: BLE001 — timing must not kill train
-                    pass
+                except Exception as exc:  # noqa: BLE001 — must not kill train
+                    log.debug("profiler sync failed: %s", exc)
             if span is not None:
                 try:
                     span.__exit__(None, None, None)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as exc:  # noqa: BLE001
+                    log.debug("profiler span exit failed: %s", exc)
             dt = time.perf_counter() - start
             if not self.enabled:
                 return

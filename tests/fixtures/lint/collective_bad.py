@@ -1,0 +1,52 @@
+"""Deliberate SPMD collective-symmetry violations — lint fixture.
+
+Never imported; parsed by tests/test_lint.py only.
+"""
+import threading
+
+
+def allreduce_histograms(hist):
+    return hist
+
+
+def _sync_wait(x):
+    return x
+
+
+def helper_reduce(h):
+    # collective-bearing only transitively: no collective name here
+    return allreduce_histograms(h)
+
+
+class Comm:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rank = 0
+        self.world = 1
+
+    def rank_gated(self, h):
+        if self.rank == 0:
+            return allreduce_histograms(h)      # collective-rank-branch
+        return h
+
+    def transitive_gated(self, h):
+        if self.rank == 0:
+            return helper_reduce(h)     # rank-branch via the call graph
+        return h
+
+    def loop_gated(self, h):
+        while self.world > 1:
+            h = _sync_wait(h)           # loop bounded by world size
+        return h
+
+    def divergent(self, h):
+        if self.rank == 0:
+            g = allreduce_histograms(h)     # collective-divergent-sequence
+            _sync_wait(g)
+        else:
+            g = _sync_wait(h)
+        return g
+
+    def under_lock(self, h):
+        with self._lock:
+            return allreduce_histograms(h)      # collective-under-lock

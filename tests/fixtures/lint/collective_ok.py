@@ -1,0 +1,52 @@
+"""Rank-symmetric collective usage — lint fixture, must stay clean.
+
+Never imported; parsed by tests/test_lint.py only.
+"""
+
+
+def allgather_rows(rows):
+    return rows
+
+
+def broadcasted_iota(n):
+    return list(range(n))
+
+
+class Comm:
+    def __init__(self):
+        self.rank = 0
+        self.dead = set()
+
+    def symmetric(self, h, hub_rank):
+        # identical collective sequence in both arms: exempt
+        if self.rank == hub_rank:
+            g = allgather_rows(h)
+        else:
+            g = allgather_rows(h)
+        return g
+
+    def static_branch(self, h, dp):
+        # config branch, identical on every rank by construction
+        if dp:
+            return allgather_rows(h)
+        return h
+
+    def guard_raise(self, h):
+        # guard-and-raise prologue: every surviving rank reaches the
+        # collective below
+        if self.rank in self.dead:
+            raise RuntimeError("fenced")
+        return allgather_rows(h)
+
+    def over_batches(self, batches):
+        out = []
+        for b in batches:       # symmetric loop: same on every rank
+            out.append(allgather_rows(b))
+        return out
+
+    def with_file(self, h, fh):
+        with fh:                # not a lock
+            return allgather_rows(h)
+
+    def shape_op(self, n):
+        return broadcasted_iota(n)      # shape op, not a collective

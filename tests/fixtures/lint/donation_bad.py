@@ -1,0 +1,53 @@
+"""Deliberate buffer-donation violations — lint fixture.
+
+Never imported (the jax import is only ever parsed); used by
+tests/test_lint.py only.
+"""
+import functools
+
+import jax
+
+
+def _impl(a, b):
+    return a
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def grow_step(arena, grads):
+    return arena + grads
+
+
+def use_after(arena, grads):
+    out = grow_step(arena, grads)
+    total = arena.sum() + out.sum()     # donation-use-after
+    return total
+
+
+def double_same_call(arena, grads):
+    fused = jax.jit(_impl, donate_argnums=(0, 1))
+    out = fused(arena, arena)           # donation-double, one call
+    return out
+
+
+def double_sequential(arena, grads):
+    g1 = grow_step(arena, grads)
+    g2 = grow_step(arena, grads)        # donation-double, no rebind
+    return g1 + g2
+
+
+def escape(arena, grads):
+    grow_step(arena, grads)
+    return arena                        # donation-escape
+
+
+class Trainer:
+    def __init__(self):
+        self._fused = self._build()
+
+    def _build(self):
+        fn = jax.jit(_impl, donate_argnums=(0,))
+        return fn
+
+    def step(self, state):
+        self._fused(state["arena"], 1)
+        return state["arena"]           # donation-escape via subscript

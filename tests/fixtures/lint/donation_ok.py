@@ -1,0 +1,47 @@
+"""Donation-correct idioms from the real tree — lint fixture, clean.
+
+Never imported (the jax import is only ever parsed); used by
+tests/test_lint.py only.
+"""
+import functools
+
+import jax
+
+
+def _impl3(a, b, c):
+    return a, b
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def grow_step(arena, grads):
+    return arena + grads
+
+
+def rebind_then_use(arena, grads):
+    arena = grow_step(arena, grads)     # rebound by its own statement
+    return arena
+
+
+def same_statement_rebind(arena, grads):
+    arena, stats = grow_step(arena, grads), None
+    return arena, stats
+
+
+def branch_isolated(arena, grads, flag):
+    if flag:
+        out = grow_step(arena, grads)   # donated in the if-arm only
+    else:
+        out = arena.sum()               # opposite arm: can't co-execute
+    return out
+
+
+def star_call(arena, bins, grads):
+    fused = jax.jit(_impl3, donate_argnums=(0, 1))
+    args = (arena, bins, grads)
+    arena, bins = fused(*args)          # star-call through tuple literal
+    return arena, bins
+
+
+def dict_closure(state, grads):
+    state["arena"] = grow_step(state["arena"], grads)
+    return state

@@ -1,0 +1,38 @@
+"""Deliberate wire-protocol violations — lint fixture.
+
+A miniature frame protocol: the FRAME_* module constants make this a
+wire module in the checker's eyes.  Never imported; parsed by
+tests/test_lint.py only.
+"""
+
+FRAME_DATA = 0
+FRAME_POISON = 1
+FRAME_PING = 2          # sent below, never handled -> unhandled-kind
+FRAME_RETIRED = 7       # never sent nor handled -> dead-kind
+
+
+def _send_frame(sock, payload, kind):
+    sock.sendall(payload)
+
+
+def _recv_frame(sock):
+    return sock.recv(1024), 0, 0
+
+
+def ping(sock):
+    _send_frame(sock, b"", kind=FRAME_PING)
+
+
+def drain(sock):
+    # wire-unfenced-recv: no generation compare anywhere in here
+    payload, gen_stamp, kind = _recv_frame(sock)
+    return payload
+
+
+def ctrl_loop(sock):
+    # wire-blocking-handler (and unfenced): dispatches on frame kinds,
+    # loops on a recv with no select/settimeout bound
+    while True:
+        payload, gen_stamp, kind = _recv_frame(sock)
+        if kind == FRAME_POISON:
+            return payload

@@ -1,0 +1,50 @@
+"""Well-formed miniature wire protocol — lint fixture, must be clean.
+
+Never imported; parsed by tests/test_lint.py only.
+"""
+import select
+
+FRAME_DATA = 0
+FRAME_POISON = 1
+
+
+def _send_frame(sock, payload, kind):
+    sock.sendall(payload)
+
+
+def _recv_frame(sock):
+    return sock.recv(1024), 0, 0
+
+
+def poison(sock):
+    _send_frame(sock, b"", kind=FRAME_POISON)
+
+
+class Comm:
+    def __init__(self):
+        self.generation = 0
+
+    def recv_fenced(self, sock):
+        payload, peer_gen, kind = _recv_frame(sock)
+        if peer_gen != self.generation:
+            return None
+        if kind == FRAME_POISON:
+            raise RuntimeError("poisoned")
+        return payload
+
+    def ctrl_loop(self, sock, stop):
+        while not stop.is_set():
+            ready, _, _ = select.select([sock], [], [], 0.5)
+            if not ready:
+                continue
+            payload, peer_gen, kind = _recv_frame(sock)
+            if peer_gen != self.generation:
+                continue
+            if kind == FRAME_POISON:
+                return payload
+
+
+def handshake(sock):
+    # pre-formation: the generation does not exist yet on this path
+    # tpulint: disable-next-line=wire-unfenced-recv
+    return _recv_frame(sock)[0]

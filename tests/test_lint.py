@@ -9,6 +9,7 @@ tests/, so they cannot dirty the shipped baseline.
 import importlib.util
 import json
 import os
+import re
 import shutil
 import subprocess
 import sys
@@ -137,6 +138,136 @@ def test_write_no_fsync_only_inside_package(tmp_path):
     assert [f.path for f in hits] == ["lightgbm_tpu/writer.py"]
 
 
+# -- SPMD collective symmetry ---------------------------------------------
+
+def test_collective_bad_fixture_fires():
+    fs = [f for f in _run("collective_bad.py")
+          if f.check.startswith("collective-")]
+    assert {"collective-rank-branch", "collective-divergent-sequence",
+            "collective-under-lock"} == _checks(fs)
+    assert all(f.severity == "HIGH" for f in fs)
+    # the call-graph layer: helper_reduce has no collective name, it is
+    # bearing only because it calls allreduce_histograms
+    assert any(f.scope == "Comm.transitive_gated" for f in fs
+               if f.check == "collective-rank-branch")
+    # rank-bounded loops count as rank-dependent control flow too
+    assert any(f.scope == "Comm.loop_gated" for f in fs)
+    # the divergent if is reported once, not once per call inside it
+    assert len([f for f in fs
+                if f.check == "collective-divergent-sequence"]) == 1
+
+
+def test_collective_ok_fixture_is_clean():
+    assert not [f for f in _run("collective_ok.py")
+                if f.check.startswith("collective-")]
+
+
+# -- wire protocol --------------------------------------------------------
+
+def test_wire_bad_fixture_fires():
+    fs = [f for f in _run("wire_bad.py") if f.check.startswith("wire-")]
+    by = {}
+    for f in fs:
+        by.setdefault(f.check, []).append(f)
+    assert set(by) == {"wire-unhandled-kind", "wire-unfenced-recv",
+                       "wire-blocking-handler", "wire-dead-kind"}
+    assert "FRAME_PING" in by["wire-unhandled-kind"][0].message
+    assert by["wire-unhandled-kind"][0].severity == "HIGH"
+    assert "FRAME_RETIRED" in by["wire-dead-kind"][0].message
+    assert by["wire-dead-kind"][0].severity == "LOW"
+    assert {f.scope for f in by["wire-unfenced-recv"]} == \
+        {"drain", "ctrl_loop"}
+    assert by["wire-blocking-handler"][0].scope == "ctrl_loop"
+
+
+def test_wire_ok_fixture_is_clean():
+    # the fenced/timeout handlers pass outright; the pre-formation
+    # handshake passes through its inline disable-next-line — the
+    # suppression machinery applies to the new families unchanged
+    assert not [f for f in _run("wire_ok.py")
+                if f.check.startswith("wire-")]
+
+
+# -- buffer donation ------------------------------------------------------
+
+def test_donation_bad_fixture_fires():
+    fs = [f for f in _run("donation_bad.py")
+          if f.check.startswith("donation-")]
+    assert {"donation-use-after", "donation-double",
+            "donation-escape"} == _checks(fs)
+    assert all(f.severity == "HIGH" for f in fs)
+    doubles = [f for f in fs if f.check == "donation-double"]
+    assert {f.scope for f in doubles} == \
+        {"double_same_call", "double_sequential"}
+    # attr-cached donating jits track through dict-key bindings
+    assert any(f.scope == "Trainer.step" and "state['arena']" in f.message
+               for f in fs if f.check == "donation-escape")
+
+
+def test_donation_ok_fixture_is_clean():
+    assert not [f for f in _run("donation_ok.py")
+                if f.check.startswith("donation-")]
+
+
+# -- seeded-bug regression: the checkers catch real-code mutations --------
+
+def _real(src):
+    return os.path.join(REPO, src)
+
+
+def test_seeded_rank_conditional_collective_is_caught(tmp_path):
+    src = open(_real("lightgbm_tpu/parallel/distributed.py")).read()
+    probe = '            return self._allgather_impl(' \
+            'payload, None, _ZERO_TRACE, 0, "")\n'
+    assert probe in src
+    clean = tmp_path / "clean"
+    seeded = tmp_path / "seeded"
+    for d in (clean, seeded):
+        d.mkdir()
+    (clean / "distributed.py").write_text(src)
+    (seeded / "distributed.py").write_text(src.replace(
+        probe,
+        '            if self.rank == 0:\n'
+        '                return self._allgather_impl('
+        'payload, None, _ZERO_TRACE, 0, "")\n'
+        '            return [payload]\n'))
+    assert not [f for f in ana.run_suite(str(clean), ["distributed.py"],
+                                         only=["collectives"])
+                if f.check.startswith("collective-")]
+    hits = [f for f in ana.run_suite(str(seeded), ["distributed.py"],
+                                     only=["collectives"])
+            if f.check == "collective-rank-branch"]
+    assert hits and all(f.severity == "HIGH" for f in hits)
+    assert any("_allgather_impl" in f.message for f in hits)
+
+
+def test_seeded_read_after_donate_is_caught(tmp_path):
+    bench = open(_real("tools/phase_bench.py")).read()
+    probe = "            arrays, out_ids, arena, _ = gp.grow_tree_partition("
+    tail = "                interpret=interp)\n"
+    assert probe in bench and tail in bench
+    seeded = bench.replace(
+        probe,
+        "            arrays, out_ids, arena_next, _ = "
+        "gp.grow_tree_partition(").replace(
+        tail, tail + "            checksum = arena.sum()\n")
+    for name, text in [
+            ("phase_bench.py", seeded),
+            ("grow_partition.py",
+             open(_real("lightgbm_tpu/ops/grow_partition.py")).read())]:
+        (tmp_path / name).write_text(text)
+    assert not [f for f in ana.run_suite(
+        str(tmp_path), ["."], only=["donation"])
+        if f.check.startswith("donation-")
+        and f.path == "grow_partition.py"]
+    hits = [f for f in ana.run_suite(str(tmp_path), ["."],
+                                     only=["donation"])
+            if f.check == "donation-use-after"]
+    assert hits and all(f.severity == "HIGH" for f in hits)
+    assert any("arena" in f.message and f.path == "phase_bench.py"
+               for f in hits)
+
+
 # -- config drift ---------------------------------------------------------
 
 def test_config_drift_fixture_project():
@@ -167,14 +298,16 @@ def test_fingerprints_stable_across_runs():
     assert a == b and a
 
 
-def test_fingerprints_survive_file_moves(tmp_path):
-    src = os.path.join(FIX, "lock_bad.py")
+@pytest.mark.parametrize("fixture", [
+    "lock_bad.py", "collective_bad.py", "wire_bad.py", "donation_bad.py"])
+def test_fingerprints_survive_file_moves(tmp_path, fixture):
+    src = os.path.join(FIX, fixture)
     flat = tmp_path / "proj1"
     nested = tmp_path / "proj2"
     flat.mkdir()
     (nested / "deep" / "inner").mkdir(parents=True)
-    shutil.copy(src, flat / "lock_bad.py")
-    shutil.copy(src, nested / "deep" / "inner" / "lock_bad.py")
+    shutil.copy(src, flat / fixture)
+    shutil.copy(src, nested / "deep" / "inner" / fixture)
     fp1 = {f.fingerprint for f in ana.run_suite(str(flat), ["."])}
     fp2 = {f.fingerprint for f in ana.run_suite(str(nested), ["."])}
     assert fp1 == fp2 and fp1
@@ -283,3 +416,48 @@ def test_cli_json_report(tmp_path):
     assert doc["tool"] == "tpulint"
     assert doc["total"] == len(doc["findings"]) > 0
     assert {f["check"] for f in doc["findings"]} >= {"jit-host-sync"}
+
+
+@pytest.mark.parametrize("family,fixture,check", [
+    ("collectives", "collective_bad.py", "collective-rank-branch"),
+    ("wireproto", "wire_bad.py", "wire-unhandled-kind"),
+    ("donation", "donation_bad.py", "donation-use-after"),
+])
+def test_cli_new_families_run_without_jax(tmp_path, family, fixture,
+                                          check):
+    """The poisoned-jax proof extended to the v2 checkers: each family
+    runs in a subprocess where any jax import raises."""
+    res = _cli(["--root", FIX, "--json", "--only", family, fixture],
+               tmp_path=tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    doc = json.loads(res.stdout)
+    checks = {f["check"] for f in doc["findings"]}
+    assert check in checks
+    assert all(c.startswith(check.split("-")[0] + "-") for c in checks)
+
+
+def test_cli_changed_mode(tmp_path):
+    # in the repo checkout: exits 0 whether or not files are dirty
+    # (dirty files are scanned against the same baseline CI uses)
+    res = _cli(["--changed", "--baseline", BASELINE], tmp_path=tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    # outside a git checkout: a hard usage error, not a silent pass
+    res = subprocess.run(
+        [sys.executable, "-S", os.path.join(REPO, "tools", "lint.py"),
+         "--changed", "--root", str(tmp_path)],
+        capture_output=True, text=True, cwd=str(tmp_path))
+    assert res.returncode == 2
+    assert "git" in res.stderr
+
+
+def test_cli_changed_rejects_explicit_paths(tmp_path):
+    res = _cli(["--changed", "lock_bad.py"], tmp_path=tmp_path)
+    assert res.returncode == 2
+
+
+def test_smoke_reports_per_family_counts():
+    line = CLI.smoke()
+    assert line.startswith("lint ")
+    for family in ("jit", "locks", "config", "hygiene", "collectives",
+                   "wireproto", "donation"):
+        assert re.search(r"\b%s \d+\b" % family, line), line

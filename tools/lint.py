@@ -11,6 +11,7 @@ Usage:
     python tools/lint.py                              # whole repo, no gate
     python tools/lint.py --baseline tools/lint_baseline.json   # CI gate
     python tools/lint.py --only locks --only jit some/dir
+    python tools/lint.py --changed --baseline tools/lint_baseline.json
     python tools/lint.py --json --baseline tools/lint_baseline.json
     python tools/lint.py --write-baseline tools/lint_baseline.json
 
@@ -23,6 +24,7 @@ from __future__ import annotations
 import argparse
 import importlib.util
 import os
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -42,6 +44,21 @@ def load_analysis():
     sys.modules[name] = mod
     spec.loader.exec_module(mod)
     return mod
+
+
+def _changed_files(root):
+    """Repo-relative .py files changed vs HEAD plus untracked ones, or
+    None when ``root`` is not a git checkout."""
+    def _git(*args):
+        return subprocess.run(
+            ("git", "-C", root) + args, capture_output=True, text=True)
+    diff = _git("diff", "--name-only", "HEAD", "--")
+    if diff.returncode != 0:
+        return None
+    untracked = _git("ls-files", "--others", "--exclude-standard")
+    names = set(diff.stdout.split()) | set(untracked.stdout.split())
+    return sorted(n for n in names if n.endswith(".py")
+                  and os.path.isfile(os.path.join(root, n)))
 
 
 def run(argv=None):
@@ -65,11 +82,39 @@ def run(argv=None):
                     help="machine-readable report on stdout")
     ap.add_argument("--only", action="append", metavar="CHECKER",
                     help="run only this checker family (repeatable): "
-                         "jit, locks, config, hygiene")
+                         "jit, locks, config, hygiene, collectives, "
+                         "wireproto, donation")
+    ap.add_argument("--changed", action="store_true",
+                    help="scan only .py files changed vs HEAD (plus "
+                         "untracked) — same baseline semantics; useful "
+                         "as a fast pre-commit gate")
     args = ap.parse_args(argv)
+
+    if args.changed:
+        if args.paths:
+            ap.error("--changed and explicit paths are mutually "
+                     "exclusive")
+        changed = _changed_files(args.root or REPO)
+        if changed is None:
+            print("tpulint: --changed requires a git checkout",
+                  file=sys.stderr)
+            return 2
 
     analysis = load_analysis()
     root = os.path.abspath(args.root)
+    if args.changed:
+        # only files the full-repo gate would scan anyway — fixture
+        # edits under tests/ must not fail the pre-commit run
+        roots = tuple(analysis.DEFAULT_ROOTS)
+        changed = [n for n in changed
+                   if n in roots
+                   or any(n.startswith(r.rstrip("/") + "/")
+                          for r in roots)]
+        if not changed:
+            print("tpulint: no changed .py files in scan scope, "
+                  "nothing to do")
+            return 0
+        args.paths = changed
     findings = analysis.run_suite(root, args.paths or None,
                                   only=args.only)
 
@@ -118,6 +163,13 @@ def smoke(root=None):
         len(findings), counts["HIGH"], counts["MEDIUM"], counts["LOW"])
     if new is not None:
         line += " new %d" % len(new)
+    fam_of = analysis.checkers.CHECK_FAMILY
+    per_family = {cls.id: 0 for cls in analysis.checkers.CHECKER_CLASSES}
+    for f in findings:
+        per_family[fam_of.get(f.check, "other")] = \
+            per_family.get(fam_of.get(f.check, "other"), 0) + 1
+    line += " | " + " ".join(
+        "%s %d" % (fam, n) for fam, n in per_family.items())
     return line
 
 

@@ -347,6 +347,39 @@ def chaos_smoke():
             os.environ["JAX_PLATFORMS"] = prev
 
 
+def mesh_smoke(on_tpu):
+    """Data-parallel mesh scaling sweep (dict in `detail`).
+
+    Runs tools/mesh_bench.py in a subprocess: Higgs-shaped data-parallel
+    training at world={1,2,4,8} over the local device mesh
+    (tpu_comm_backend=mesh), f32 and int8-quantized, reporting
+    Mrows*iter/s plus scaling efficiency per world size.  Off-TPU the
+    child is pinned to 8 virtual CPU devices so the sweep exercises the
+    real shard_map/psum path at smoke scale.  The `mesh8_mrows_iter_s`
+    headline feeds the perf ledger (higgs_mesh8_mrows_iter_s).  Never
+    fails the bench: any problem becomes an `error` entry.
+    """
+    import os
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    if not on_tpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(here, "tools", "mesh_bench.py")],
+            capture_output=True, text=True, timeout=2400, env=env)
+        if proc.returncode != 0:
+            return {"error": "rc=%d %s" % (
+                proc.returncode, (proc.stderr or "").strip()[-400:])}
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 — smoke only, never fatal
+        return {"error": "FAILED: %s" % e}
+
+
 def lint_smoke():
     """tpulint over the shipped tree (one line in `detail`).
 
@@ -451,6 +484,7 @@ def main():
                 "holdout_auc": higgs["holdout_auc"],
             },
             "quality_ok": ok,
+            "mesh_scaling": mesh_smoke(on_tpu),
             "trace_smoke": trace_smoke(lgb),
             "chaos_smoke": chaos_smoke(),
             "lint_smoke": lint_smoke(),

@@ -136,7 +136,30 @@ class CollectiveSymmetryChecker(Checker):
                         bearing.add(fi.key)
                         changed = True
                         break
+                    # the shard_map closure form: `shard_map(shard_fn,
+                    # ...)` never CALLS shard_fn by name, it passes it —
+                    # but the caller still owns the collective rendezvous
+                    # the wrapped body performs, so a bearing closure
+                    # handed to shard_map makes its owner bearing too
+                    if "shard_map" in cs.name and self._passes_bearing(
+                            cs, graph, bearing):
+                        bearing.add(fi.key)
+                        changed = True
+                        break
         return bearing
+
+    @staticmethod
+    def _passes_bearing(cs: CallSite, graph, bearing: Set[str]) -> bool:
+        """True when a call site passes a collective-bearing function as
+        an argument (positionally or by keyword)."""
+        args = list(cs.node.args) + [kw.value for kw in cs.node.keywords]
+        for arg in args:
+            if not isinstance(arg, ast.Name):
+                continue
+            cands = graph.resolve(arg.id)
+            if cands and all(c.key in bearing for c in cands):
+                return True
+        return False
 
     def _lock_name_inventory(self, project: Project) -> Set[str]:
         """Terminal names known to be threading locks anywhere in the

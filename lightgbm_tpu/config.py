@@ -201,6 +201,13 @@ _SCHEMA = [
     ("tpu_comm_backoff_max_ms", float, 2000.0),  # backoff cap
     ("tpu_comm_op_timeout_s", float, 0.0),   # per send/recv cap; 0 = inherit setup timeout
     ("tpu_comm_heartbeat_s", float, 0.0),    # >0 -> rank-liveness probe every N seconds
+    ("tpu_comm_backend", str, "auto"),       # auto|mesh|socket — collective
+    #   backend for the parallel learners (parallel/collective.py):
+    #   `mesh` = in-process shard_map/psum over the local device mesh
+    #   (single controller, histograms never leave HBM); `socket` = the
+    #   cross-host SocketComm wire behind the same Collective interface
+    #   (retry/heartbeat/elastic fencing preserved); `auto` = mesh when
+    #   >1 local device, else serial.  See docs/Distributed.md.
     # --- elasticity parameters (no reference analogue)
     # Elastic distributed training (lightgbm_tpu/resilience/elastic):
     # active liveness protocol, generation-fenced collectives, and
@@ -380,6 +387,8 @@ ALIAS_TABLE: Dict[str, str] = {
     "comm_retries": "tpu_comm_retries",
     "comm_backoff_ms": "tpu_comm_backoff_ms",
     "comm_heartbeat_s": "tpu_comm_heartbeat_s",
+    "comm_backend": "tpu_comm_backend",
+    "collective_backend": "tpu_comm_backend",
 }
 
 PARAMETER_TYPES: Dict[str, Any] = {name: typ for name, typ, _ in _SCHEMA}
@@ -551,6 +560,7 @@ class Config:
         if tl not in tl_map:
             log.fatal("Unknown tree learner type %s" % self.tree_learner)
         self.tree_learner = tl_map[tl]
+        self.tpu_comm_backend = self.tpu_comm_backend.lower()
 
     def check_param_conflict(self) -> None:
         """Cross-parameter validation (src/io/config.cpp:230-260)."""
@@ -596,6 +606,9 @@ class Config:
         if self.tpu_comm_backoff_ms < 0 or self.tpu_comm_backoff_max_ms < 0:
             log.fatal("tpu_comm_backoff_ms / tpu_comm_backoff_max_ms must "
                       "be >= 0")
+        if self.tpu_comm_backend not in ("auto", "mesh", "socket"):
+            log.fatal("tpu_comm_backend must be auto, mesh or socket, "
+                      "got %r" % self.tpu_comm_backend)
         if self.tpu_trace_max_events < 1024:
             log.fatal("tpu_trace_max_events must be >= 1024, got %d"
                       % self.tpu_trace_max_events)

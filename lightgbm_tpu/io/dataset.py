@@ -63,6 +63,13 @@ class BinnedDataset:
         self.monotone_constraints: Optional[np.ndarray] = None  # [F_used] i8
         self.feature_penalty: Optional[np.ndarray] = None       # [F_used] f64
         self.max_bin: int = 255
+        # distributed row-partition identity (parallel/dist_data.py):
+        # this shard's rows' GLOBAL indices and the global row count.
+        # Quantized data-parallel training draws its stochastic-rounding
+        # noise from the global stream at these indices so the union of
+        # every rank's codes is bitwise a single encoder's output.
+        self.dist_row_ids: Optional[np.ndarray] = None
+        self.dist_global_rows: Optional[int] = None
         self._device_cache: Dict[str, object] = {}
 
     # ------------------------------------------------------------------ #

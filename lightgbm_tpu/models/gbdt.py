@@ -1156,22 +1156,38 @@ class GBDT:
             self._last_truncated = None
             self._truncation_warned = False
             self._hist_slots = 0
-            self._quantized = False
-            if cfg.tpu_quantized_grad:
-                log.warning("tpu_quantized_grad is serial-only (per-shard "
-                            "code scales would desynchronize the psum'd "
-                            "integer histograms); ignoring")
+            backend = self._grower.collective.backend
             grower_ok = (base_ok and not self._forced_splits
                          and self._cegb_coupled is None)
             if eng == "partition" and not grower_ok:
                 log.warning("tpu_tree_engine=partition not applicable to "
                             "this distributed config; using label engine")
-            want = (eng == "partition"
+            if backend == "socket" and not grower_ok:
+                log.fatal("the socket collective backend requires the "
+                          "partition engine (f32, max_bin<=256, no forced "
+                          "splits/coupled CEGB); this config is not "
+                          "eligible")
+            # the socket backend has no label-engine path, so it implies
+            # the partition engine regardless of tpu_tree_engine
+            want = (eng == "partition" or backend == "socket"
                     or (eng == "auto" and jax.default_backend() == "tpu"))
-            if grower_ok and want:
+            partition_on = grower_ok and want
+            if partition_on:
                 self._grower.enable_partition()
             else:
                 self._grower.disable_partition()
+            # quantized distributed training: legal whenever the grower
+            # runs the partition engine — the collective backend agrees
+            # the code scales globally (ops/quantize.global_scales), so
+            # the psum'd integer histograms stay synchronized.  Only a
+            # label-engine grower still clears the flag.
+            self._quantized = bool(cfg.tpu_quantized_grad and partition_on)
+            self._quant_seed = int(cfg.tpu_quantized_seed or cfg.seed)
+            if cfg.tpu_quantized_grad and not self._quantized:
+                log.warning("tpu_quantized_grad requires the partition "
+                            "engine, which is unavailable under the %s "
+                            "collective backend for this config; training "
+                            "unquantized on the label engine", backend)
             return
         eligible = base_ok
         if eng == "partition" and not eligible:
@@ -1345,20 +1361,74 @@ class GBDT:
                                cegb_used_init=cegb_used)
         if self._grower is None and self._forced_splits:
             grow_fn = _partial(grow_fn, forced_splits=self._forced_splits)
-        result = grow_fn(
-            self.train_state.bins, grad, hess, row_init,
-            self._feature_sample(),
-            self.train_state.num_bins, self.train_state.default_bins,
-            self.train_state.missing_types,
-            self.split_params, self.monotone, self.penalty,
-            self.is_categorical,
-            bundle=self.train_state.bundle,
-            max_leaves=self.config.num_leaves,
-            max_depth=self.config.max_depth,
-            max_bin=self.max_bin,
-            hist_impl=self.config.tpu_histogram_impl,
-            rows_per_chunk=self.config.tpu_rows_per_tile,
-            max_cat_threshold=self.config.max_cat_threshold)
+        g_in, h_in, extra = grad, hess, {}
+        if self._grower is not None and getattr(self, "_quantized", False):
+            # distributed quantized path: code scales must be agreed
+            # across the world BEFORE encoding (ops/quantize docstring)
+            from ..ops import quantize as _qz
+            coll = self._grower.collective
+            key = _qz.quantize_key(self._quant_seed, self.iter)
+            if coll.backend == "mesh":
+                # single controller: host grad/hess are already global,
+                # so global quantization IS the serial computation —
+                # mesh quantized training is bitwise-identical to serial
+                g_in, h_in, _gs, _hs = _qz.quantize_gradients(grad, hess,
+                                                              key)
+            else:
+                _gs, _hs = _qz.global_scales(grad, hess, coll)
+                ids = getattr(self.train_set, "dist_row_ids", None)
+                if ids is not None and len(ids) == int(grad.shape[0]):
+                    # randomly pre-partitioned shard: gather the noise
+                    # at this rank's global row indices
+                    g_in, h_in = _qz.encode_with_scales(
+                        grad, hess, key, _gs, _hs,
+                        global_rows=self.train_set.dist_global_rows,
+                        row_ids=ids)
+                else:
+                    global_n, row0 = coll.row_layout(int(grad.shape[0]))
+                    g_in, h_in = _qz.encode_with_scales(
+                        grad, hess, key, _gs, _hs,
+                        global_rows=global_n, row_start=row0)
+            extra = dict(quantized=True, quant_scales=(_gs, _hs))
+        try:
+            result = grow_fn(
+                self.train_state.bins, g_in, h_in, row_init,
+                self._feature_sample(),
+                self.train_state.num_bins, self.train_state.default_bins,
+                self.train_state.missing_types,
+                self.split_params, self.monotone, self.penalty,
+                self.is_categorical,
+                bundle=self.train_state.bundle,
+                max_leaves=self.config.num_leaves,
+                max_depth=self.config.max_depth,
+                max_bin=self.max_bin,
+                hist_impl=self.config.tpu_histogram_impl,
+                rows_per_chunk=self.config.tpu_rows_per_tile,
+                max_cat_threshold=self.config.max_cat_threshold,
+                **extra)
+        except Exception as exc:
+            from ..resilience.comm import CommFailure, WorldChangedError
+            if not extra or isinstance(exc, (WorldChangedError,
+                                             CommFailure)):
+                raise      # wire/fence failures own their own recovery
+            log.warning("quantized grower path failed (%s: %s); retrying "
+                        "this booster unquantized",
+                        type(exc).__name__, str(exc).split("\n")[0][:200])
+            self._quantized = False
+            result = grow_fn(
+                self.train_state.bins, grad, hess, row_init,
+                self._feature_sample(),
+                self.train_state.num_bins, self.train_state.default_bins,
+                self.train_state.missing_types,
+                self.split_params, self.monotone, self.penalty,
+                self.is_categorical,
+                bundle=self.train_state.bundle,
+                max_leaves=self.config.num_leaves,
+                max_depth=self.config.max_depth,
+                max_bin=self.max_bin,
+                hist_impl=self.config.tpu_histogram_impl,
+                rows_per_chunk=self.config.tpu_rows_per_tile,
+                max_cat_threshold=self.config.max_cat_threshold)
         if self._grower is not None:
             # the grower's shard_map'd partition path reports arena
             # truncation the same way the serial path does — surface it

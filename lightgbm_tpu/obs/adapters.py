@@ -57,11 +57,15 @@ def ensure_device_metrics(reg: MetricsRegistry) -> None:
 
 
 def ensure_comm_metrics(reg: MetricsRegistry, rank: int = 0,
-                        world: int = 1) -> Dict[str, object]:
+                        world: int = 1,
+                        backend: str = "socket") -> Dict[str, object]:
     """Create the comm counter families for (rank, world) — SocketComm
-    calls this with its real coordinates; the serving server calls it
-    with the (0, 1) defaults so /metrics always exposes the families."""
-    labels = dict(rank=str(rank), world=str(world))
+    calls this with its real coordinates; MeshCollective calls it with
+    backend="mesh" so in-process collective traffic stays separable from
+    wire traffic; the serving server calls it with the (0, 1) defaults
+    so /metrics always exposes the families.  comm_totals() sums across
+    backends (family_sum is label-agnostic)."""
+    labels = dict(rank=str(rank), world=str(world), backend=str(backend))
     return {name: reg.counter(name, help=help_text, **labels)
             for name, help_text in COMM_COUNTERS}
 

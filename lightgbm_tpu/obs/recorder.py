@@ -356,3 +356,24 @@ def elastic_event(config, what: str, **fields) -> None:
     except Exception as exc:  # noqa: BLE001 — telemetry never raises
         log.warning("telemetry: elastic event write to %s failed: %s",
                     path, exc)
+
+
+def comm_backend_event(config, backend: str, **fields) -> None:
+    """Append one backend-selection event ({"event": "comm_backend",
+    "backend": "mesh"|"socket"|"none", "requested": ...}) to
+    Config.tpu_telemetry_path.  Emitted by make_collective each time a
+    booster resolves tpu_comm_backend, so chaos drills (and operators)
+    can assert which path training actually took — the mesh_unavailable
+    drill greps for exactly this line."""
+    path = getattr(config, "tpu_telemetry_path", "")
+    if not path:
+        return
+    event = {"event": "comm_backend", "backend": str(backend)}
+    event.update(fields)
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(event, default=_json_default,
+                               separators=(",", ":")) + "\n")
+    except Exception as exc:  # noqa: BLE001 — telemetry never raises
+        log.warning("telemetry: comm_backend event write to %s failed: %s",
+                    path, exc)

@@ -25,6 +25,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from ..parallel import collective as coll
 from . import histogram as hist_ops
 from .split import (K_MIN_SCORE, SplitParams, SplitResult,
                     best_split_for_leaf, best_split_per_feature,
@@ -276,7 +277,7 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
                 "num_machines (%d); pad features first (ParallelGrower does)"
                 % (F, num_machines))
         f_local = (F + scatter_pad) // num_machines
-        f_off = jax.lax.axis_index(axis_name).astype(jnp.int32) * f_local
+        f_off = coll.axis_index(axis_name).astype(jnp.int32) * f_local
 
         p_num_bins = _pad_feat(num_bins, 1)
         p_default_bins = _pad_feat(default_bins, 0)
@@ -320,9 +321,9 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
                     h = jnp.concatenate(
                         [h, jnp.zeros((scatter_pad,) + h.shape[1:],
                                       h.dtype)], axis=0)
-                return jax.lax.psum_scatter(h, axis_name,
+                return coll.psum_scatter(h, axis_name,
                                             scatter_dimension=0, tiled=True)
-            return jax.lax.psum(h, axis_name)
+            return coll.psum(h, axis_name)
         return h
 
     def unbundle(hist, sum_g, sum_h, cnt):
@@ -420,8 +421,8 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
             if local.cat_mask is not None:
                 ivec = jnp.concatenate(
                     [ivec, local.cat_mask.astype(jnp.int32)])
-            fall = jax.lax.all_gather(fvec, axis_name)             # [d, 8]
-            iall = jax.lax.all_gather(ivec, axis_name)             # [d, 4+W]
+            fall = coll.all_gather(fvec, axis_name)             # [d, 8]
+            iall = coll.all_gather(ivec, axis_name)             # [d, 4+W]
             winner = jnp.argmax(fall[:, 0]).astype(jnp.int32)
             fw, iw = fall[winner], iall[winner]
             res = SplitResult(
@@ -473,9 +474,9 @@ def grow_tree_impl(bins: jnp.ndarray,       # [n, F] uint8/16
     root_c = jnp.sum(in_bag).astype(jnp.int32)
     if distributed and learner in ("data", "voting"):
         # root (cnt, Σg, Σh) Allreduce (data_parallel_tree_learner.cpp:116-142)
-        root_g = jax.lax.psum(root_g, axis_name)
-        root_h = jax.lax.psum(root_h, axis_name)
-        root_c = jax.lax.psum(root_c, axis_name)
+        root_g = coll.psum(root_g, axis_name)
+        root_h = coll.psum(root_h, axis_name)
+        root_c = coll.psum(root_c, axis_name)
     tree = tree._replace(leaf_count=tree.leaf_count.at[0].set(root_c))
 
     cegb_used0 = (cegb_used_init if cegb_used_init is not None
@@ -827,8 +828,8 @@ def _voting_best_split(local_hist, sum_g, sum_h, cnt,
 
     _, top_idx = jax.lax.top_k(pf_local.gain, k)                # [k]
     top_valid = jnp.take(pf_local.gain, top_idx) > K_MIN_SCORE
-    all_top = jax.lax.all_gather(top_idx, axis_name)            # [d, k]
-    all_valid = jax.lax.all_gather(top_valid, axis_name)        # [d, k]
+    all_top = coll.all_gather(top_idx, axis_name)            # [d, k]
+    all_valid = coll.all_gather(top_valid, axis_name)        # [d, k]
 
     votes = jnp.zeros(F, jnp.int32).at[all_top.reshape(-1)].add(
         all_valid.reshape(-1).astype(jnp.int32))                # [F]
@@ -838,7 +839,7 @@ def _voting_best_split(local_hist, sum_g, sum_h, cnt,
     _, elected = jax.lax.top_k(votes, n_elect)                  # [n_elect]
     elected = elected.astype(jnp.int32)
 
-    glob_hist = jax.lax.psum(jnp.take(local_hist, elected, axis=0), axis_name)
+    glob_hist = coll.psum(jnp.take(local_hist, elected, axis=0), axis_name)
 
     def take(a):
         return None if a is None else jnp.take(a, elected, axis=0)

@@ -47,6 +47,7 @@ from typing import NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 
+from ..parallel import collective as coll
 from . import partition_pallas as pp
 from . import quantize as qz
 from . import split_pallas as sp_pl
@@ -172,11 +173,10 @@ def grow_tree_partition_impl(
             "feature-parallel requires num_features (%d) divisible by "
             "num_machines (%d); pad features first (ParallelGrower does)"
             % (F, num_machines))
-    if quantized and dist:
-        raise ValueError(
-            "quantized histogram mode is serial-only: code scales are "
-            "per-call maxima, so shard-local scales would desynchronize "
-            "the psum'd integer histograms")
+    # quantized + distributed is legal since the Collective refactor:
+    # callers agree code scales globally first (qz.global_scales — one
+    # allreduce-max of the two per-tree maxima), after which the psum'd
+    # integer histograms are exactly a single encoder's sums
     if quantized and quant_scales is None:
         raise ValueError("quantized=True requires quant_scales")
     dtype = jnp.float32
@@ -294,27 +294,31 @@ def grow_tree_partition_impl(
             # the root histogram covers every row the refresh touches
             # anyway, so this fusion is pure byte saving (the same
             # argument as the bagging hist_stream above).
-            arena, root_hist_q = pp.fused_refresh_histogram(
+            arena, root_hist = pp.fused_refresh_histogram(
                 arena, gh, root_s0, root_c, num_features=G,
                 max_bin=max_bin, interpret=interpret)
-            root_hist = deq(root_hist_q)
         else:
             root_hist = seg(arena, root_s0, root_c)
     else:
-        root_hist = deq(root_hist_b.astype(dtype))
+        root_hist = root_hist_b.astype(dtype)
     root_c_local = root_c
     if dp:
-        # DP: one histogram allreduce; global sums/counts fall out of it
-        root_hist = jax.lax.psum(root_hist, axis_name)
-        root_c = jax.lax.psum(root_c, axis_name)
+        # DP: one histogram allreduce; global sums/counts fall out of it.
+        # The psum runs BEFORE dequantization: integer code sums reduce
+        # exactly in f32, so the global quantized histogram is bitwise a
+        # single encoder's sums (the module docstring's contract); the
+        # unquantized histogram is f32 either way.
+        root_hist = coll.psum(root_hist, axis_name)
+        root_c = coll.psum(root_c, axis_name)
+    root_hist = deq(root_hist)
     root_g = jnp.sum(root_hist[0, :, 0])
     root_h = jnp.sum(root_hist[0, :, 1])
     if vp:
         # voting keeps histograms LOCAL; only the scalar root stats ride
         # an allreduce (data_parallel_tree_learner.cpp:116-142)
-        root_g = jax.lax.psum(root_g, axis_name)
-        root_h = jax.lax.psum(root_h, axis_name)
-        root_c = jax.lax.psum(root_c, axis_name)
+        root_g = coll.psum(root_g, axis_name)
+        root_h = coll.psum(root_h, axis_name)
+        root_c = coll.psum(root_c, axis_name)
 
     def unbundle(hist, sum_g, sum_h, cnt):
         from .grow import unbundle_hist
@@ -343,7 +347,7 @@ def grow_tree_partition_impl(
         # 30-49): each device SCANS only its own features; data (and so
         # histograms and partitions) are replicated
         f_local = F // num_machines
-        _dev = jax.lax.axis_index(axis_name).astype(jnp.int32)
+        _dev = coll.axis_index(axis_name).astype(jnp.int32)
         scan_feature_mask = feature_mask & (
             (jnp.arange(F, dtype=jnp.int32) // f_local) == _dev)
     else:
@@ -374,7 +378,7 @@ def grow_tree_partition_impl(
         device scanned only its feature shard; all_gather the packed
         rows and keep the max-gain winner per child.  argmax first-hit =
         lowest shard = lowest feature id, the reference's tie-break."""
-        allr = jax.lax.all_gather(rows, axis_name)       # [d, CH, RWC]
+        allr = coll.all_gather(rows, axis_name)       # [d, CH, RWC]
         win = jnp.argmax(allr[:, :, sp_pl._OG], axis=0)  # [CH]
         return jnp.take_along_axis(allr, win[None, :, None], axis=0)[0]
 
@@ -435,8 +439,8 @@ def grow_tree_partition_impl(
         # toward the smaller feature id (voting...cpp:166-195)
         _, top_idx = jax.lax.top_k(gains, k_top)           # [CH, k]
         top_ok = jnp.take_along_axis(gains, top_idx, axis=1) > K_MIN_SCORE
-        allt = jax.lax.all_gather(top_idx, axis_name)      # [d, CH, k]
-        allv = jax.lax.all_gather(top_ok, axis_name)
+        allt = coll.all_gather(top_idx, axis_name)      # [d, CH, k]
+        allv = coll.all_gather(top_ok, axis_name)
 
         def _tally(t, v):
             return jnp.zeros(F, jnp.int32).at[t.reshape(-1)].add(
@@ -448,7 +452,7 @@ def grow_tree_partition_impl(
         # psum of the elected features' histograms only — O(2k*B) bytes
         # instead of O(F*B) (CopyLocalHistogram + ReduceScatter)
         sel = jax.vmap(lambda h, e: jnp.take(h, e, axis=0))(hu, elected)
-        glob = jax.lax.psum(sel, axis_name)        # [CH, n_elect, B, 3]
+        glob = coll.psum(sel, axis_name)        # [CH, n_elect, B, 3]
 
         rows = []
         if use_scan_kernel:
@@ -665,7 +669,7 @@ def grow_tree_partition_impl(
             need_bound = _align(cntP_local, ALLOC)
         overflow = (~no_split) & (state.cursor + need_bound + pp.TILE > cap)
         if dp or vp:
-            overflow = jax.lax.psum(overflow.astype(jnp.int32),
+            overflow = coll.psum(overflow.astype(jnp.int32),
                                     axis_name) > 0
         no_split = no_split | overflow
 
@@ -688,9 +692,9 @@ def grow_tree_partition_impl(
             in_slot = state.slot_leaf == best_leaf
             found = jnp.any(in_slot)
             pslot = jnp.argmax(in_slot).astype(jnp.int32)
-            recomputed = deq(seg(state.arena, s0,
-                                 jnp.where(found | no_split, 0,
-                                           cntP_local)))
+            recomputed = seg(state.arena, s0,
+                             jnp.where(found | no_split, 0,
+                                       cntP_local))
             # under DP the recompute's allreduce is BATCHED with the
             # smaller-child histogram's below (one collective per split
             # even in pooled mode); only the kernel must run pre-split
@@ -742,8 +746,7 @@ def grow_tree_partition_impl(
         # fixed cost ever did.  Two launches stay the right shape here.
         arena, counts = part(state.arena, pred_dummy, s0, cntP, dstA, dstB,
                              decision=decision)
-        small_hist = deq(seg(arena, dstB,
-                             jnp.where(no_split, 0, counts[1])))
+        small_hist = seg(arena, dstB, jnp.where(no_split, 0, counts[1]))
         if dp:
             # DP: ONE collective per split — the smaller child's histogram
             # allreduce (the sibling still comes from subtraction, §3.4.2);
@@ -751,15 +754,18 @@ def grow_tree_partition_impl(
             # Voting and feature-parallel skip this: voting keeps local
             # histograms (the election psums only elected features),
             # feature-parallel's histograms are replicated already.
+            # As with the root, the psum reduces the raw (code-sum)
+            # histograms so quantized DP stays bitwise-serial.
             if pooled:
-                both_h = jax.lax.psum(jnp.stack([small_hist, recomputed]),
+                both_h = coll.psum(jnp.stack([small_hist, recomputed]),
                                       axis_name)
                 small_hist, recomputed = both_h[0], both_h[1]
             else:
-                small_hist = jax.lax.psum(small_hist, axis_name)
+                small_hist = coll.psum(small_hist, axis_name)
+        small_hist = deq(small_hist)
         if pooled:
             parent_hist = jnp.where(found, state.hist_cache[pslot],
-                                    recomputed.astype(dtype))
+                                    deq(recomputed).astype(dtype))
         large_hist = parent_hist - small_hist
         left_hist = jnp.where(left_smaller, small_hist, large_hist)
         right_hist = jnp.where(left_smaller, large_hist, small_hist)

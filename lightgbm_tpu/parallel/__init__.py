@@ -6,12 +6,19 @@ the cross-host comm layer.
   machine-list parsing and jax.distributed bring-up.
 - ``dist_data``: rank-sharded ingest with distributed find-bin.
 - ``learners``: shard_map'd parallel tree growers over a device mesh.
+- ``collective``: the Collective interface over both backends — the
+  in-process mesh (shard_map/psum) and the socket wire.
 """
+from .collective import (Collective, MeshCollective,  # noqa: F401
+                         SocketCollective, make_collective,
+                         set_process_comm)
 from .distributed import (ElasticComm, SocketComm,  # noqa: F401
                           WorldChangedError, initialize_from_config,
                           parse_machines, resolve_rank)
 
 __all__ = [
+    "Collective", "MeshCollective", "SocketCollective",
+    "make_collective", "set_process_comm",
     "ElasticComm", "SocketComm", "WorldChangedError",
     "initialize_from_config", "parse_machines", "resolve_rank",
 ]

@@ -135,6 +135,8 @@ def construct_rank_shard(X: np.ndarray, config, rank: int, world: int,
         fill_meta(mapper_ds.metadata, keep)
         if group is not None:
             mapper_ds.metadata.set_query(np.asarray(group))
+        mapper_ds.dist_row_ids = keep
+        mapper_ds.dist_global_rows = n
         return mapper_ds
 
     # bin ONLY this rank's rows against the agreed mappers
@@ -146,6 +148,10 @@ def construct_rank_shard(X: np.ndarray, config, rank: int, world: int,
         X[keep], config, metadata=meta,
         categorical_features=categorical_features,
         reference=mapper_ds)
+    # the partition draw is random per row, so downstream global-stream
+    # consumers (quantized stochastic rounding) need the actual indices
+    shard.dist_row_ids = keep
+    shard.dist_global_rows = n
     return shard
 
 

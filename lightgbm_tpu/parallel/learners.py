@@ -29,23 +29,9 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops import grow as grow_ops
 from ..utils import log
-
-AXIS = "mp"
-
-# jax moved shard_map out of experimental (and renamed check_rep to
-# check_vma) across the versions this repo meets; resolve once here so
-# every learner build site works on either spelling
-try:
-    from jax import shard_map as _shard_map
-    _SHARD_CHECK_KW = "check_vma"
-except ImportError:
-    from jax.experimental.shard_map import shard_map as _shard_map
-    _SHARD_CHECK_KW = "check_rep"
-
-
-def _shard_mapped(fn, mesh, in_specs, out_specs):
-    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs, **{_SHARD_CHECK_KW: False})
+from . import collective as coll_mod
+from .collective import AXIS  # noqa: F401 — canonical home moved there
+from .collective import shard_mapped as _shard_mapped
 
 
 def resolve_num_machines(config, available: Optional[int] = None) -> int:
@@ -71,13 +57,30 @@ class ParallelGrower:
     """
 
     def __init__(self, mode: str, num_machines: int, top_k: int = 20,
-                 devices=None):
+                 devices=None, collective=None):
         assert mode in ("data", "feature", "voting"), mode
         self.mode = mode
         self.d = num_machines
         self.top_k = top_k
-        devices = (jax.devices() if devices is None else devices)[:num_machines]
-        self.mesh = jax.sharding.Mesh(np.asarray(devices), (AXIS,))
+        if collective is None:
+            collective = coll_mod.MeshCollective(num_machines,
+                                                 devices=devices)
+        self.collective = collective
+        if collective.backend == "mesh":
+            self.mesh = collective.mesh
+            self._axis = AXIS
+        else:
+            # cross-host: every rank runs the SAME grow program over its
+            # local shard, collectives rendezvous on the wire through the
+            # SocketAxis handle — rows are already pre-partitioned, so
+            # only the data learner is meaningful here
+            if mode != "data":
+                raise ValueError(
+                    "tpu_comm_backend=socket supports tree_learner=data "
+                    "only (rows are pre-partitioned across hosts); got %r"
+                    % mode)
+            self.mesh = None
+            self._axis = collective.axis()
         self._cache = {}
         # partition (arena) engine fast path — opted in by the GBDT
         # driver when the dataset is eligible (f32, max_bin<=256, n<2^24,
@@ -106,6 +109,10 @@ class ParallelGrower:
         fn = self._cache.get(statics)
         if fn is not None:
             return fn
+        if self.mesh is None:
+            raise RuntimeError(
+                "the socket collective backend requires the partition "
+                "engine (label-engine collectives are mesh-only)")
         (max_leaves, max_depth, max_bin, hist_impl, rows_per_chunk,
          max_cat_threshold) = statics
         inner = partial(grow_ops.grow_tree_impl,
@@ -125,6 +132,8 @@ class ParallelGrower:
             in_specs = tuple(P() for _ in range(15))
             out_specs = (P(), P())
         fn = jax.jit(_shard_mapped(inner, self.mesh, in_specs, out_specs))
+        fn = self.collective.bind(("label",) + statics, fn) \
+            if isinstance(self.collective, coll_mod.MeshCollective) else fn
         self._cache[statics] = fn
         return fn
 
@@ -135,7 +144,8 @@ class ParallelGrower:
                  bundle=None, *,
                  max_leaves: int, max_depth: int = -1, max_bin: int,
                  hist_impl: str = "auto", rows_per_chunk: int = 16384,
-                 max_cat_threshold: int = 32):
+                 max_cat_threshold: int = 32,
+                 quantized: bool = False, quant_scales=None):
         n, F = bins.shape
         if bundle is not None and self.mode == "feature":
             raise ValueError("feature-parallel learner does not support "
@@ -148,14 +158,25 @@ class ParallelGrower:
                     num_bins, default_bins, missing_types, params,
                     monotone, penalty, is_categorical, bundle,
                     max_leaves=max_leaves, max_depth=max_depth,
-                    max_bin=max_bin, max_cat_threshold=max_cat_threshold)
+                    max_bin=max_bin, max_cat_threshold=max_cat_threshold,
+                    quantized=quantized, quant_scales=quant_scales)
             except Exception as exc:
+                from ..resilience.comm import WorldChangedError
+                if isinstance(exc, WorldChangedError):
+                    raise          # elastic fence — never degrade past it
+                if self.mesh is None or quantized:
+                    # socket worlds and quantized codes have no label-
+                    # engine equivalent; the driver owns the fallback
+                    raise
                 log.warning(
                     "partition engine failed under %s-parallel (%s: %s); "
                     "falling back to the label engine for this grower",
                     self.mode, type(exc).__name__,
                     str(exc).split("\n")[0][:200])
                 self.disable_partition()
+        if quantized:
+            raise RuntimeError("quantized codes require the partition "
+                               "engine; it is not enabled on this grower")
         self.last_truncated = None      # label engine never truncates
         if self.mode in ("data", "voting"):
             pad = (-n) % d
@@ -205,12 +226,12 @@ class ParallelGrower:
             return fn
         from ..ops import grow_partition as gp
         (max_leaves, max_depth, max_bin, max_cat_threshold, C, cap,
-         hist_slots, interpret) = statics
+         hist_slots, interpret, quantized) = statics
         d, mode, top_k = self.d, self.mode, self.top_k
         row_shard = mode in ("data", "voting")
 
         def shard_fn(arena, bins_t, g, h, r0, fmask, nb, db, mt, sparams,
-                     mono, pen, icat, bnd):
+                     mono, pen, icat, bnd, qsc):
             t, l, arena_out, trunc = gp.grow_tree_partition_impl(
                 arena[0], bins_t, g, h, r0, fmask, nb, db, mt, sparams,
                 mono, pen, None, None, icat, bnd,
@@ -218,34 +239,84 @@ class ParallelGrower:
                 max_bin=max_bin, emit="leaf_ids", full_bag=False,
                 max_cat_threshold=max_cat_threshold, axis_name=AXIS,
                 learner=mode, num_machines=d, top_k=top_k,
-                hist_slots=hist_slots, interpret=interpret)
+                hist_slots=hist_slots, interpret=interpret,
+                quantized=quantized,
+                quant_scales=(qsc[0], qsc[1]) if quantized else None)
             return t, l, arena_out[None], trunc
 
         rp = P(AXIS) if row_shard else P()
         in_specs = (P(AXIS, None, None),
                     P(None, AXIS) if row_shard else P(),
                     rp, rp, rp,
-                    P(), P(), P(), P(), P(), P(), P(), P(), P())
+                    P(), P(), P(), P(), P(), P(), P(), P(), P(), P())
         out_specs = (P(), rp, P(AXIS, None, None), P())
         fn = jax.jit(_shard_mapped(shard_fn, self.mesh, in_specs,
                                    out_specs),
                      donate_argnums=(0,))
+        fn = self.collective.bind(("partition",) + statics, fn)
         self._pcache[statics] = fn
         return fn
+
+    def _build_partition_socket(self, statics: tuple):
+        """Socket twin of _build_partition: no shard_map — each rank jits
+        the grow program over its LOCAL arena with the SocketAxis handle
+        as axis_name, so every collective inside rendezvouses on the
+        wire.  Programs are identical across ranks (same statics), which
+        is what keeps the ordered callbacks symmetric."""
+        fn = self._pcache.get(statics)
+        if fn is not None:
+            return fn
+        from ..ops import grow_partition as gp
+        (max_leaves, max_depth, max_bin, max_cat_threshold, C, cap,
+         hist_slots, interpret, quantized) = statics
+        d, mode, top_k, axis = self.d, self.mode, self.top_k, self._axis
+
+        def local_fn(arena, bins_t, g, h, r0, fmask, nb, db, mt, sparams,
+                     mono, pen, icat, bnd, qsc):
+            t, l, arena_out, trunc = gp.grow_tree_partition_impl(
+                arena[0], bins_t, g, h, r0, fmask, nb, db, mt, sparams,
+                mono, pen, None, None, icat, bnd,
+                max_leaves=max_leaves, max_depth=max_depth,
+                max_bin=max_bin, emit="leaf_ids", full_bag=False,
+                max_cat_threshold=max_cat_threshold, axis_name=axis,
+                learner=mode, num_machines=d, top_k=top_k,
+                hist_slots=hist_slots, interpret=interpret,
+                quantized=quantized,
+                quant_scales=(qsc[0], qsc[1]) if quantized else None)
+            return t, l, arena_out[None], trunc
+
+        jitted = jax.jit(local_fn, donate_argnums=(0,))
+
+        def wrapped(*args):
+            out = jitted(*args)
+            # surface wire failures parked by the host callbacks —
+            # WorldChangedError re-raises here with the fence intact
+            jax.block_until_ready(out[3])
+            axis.check_failure()
+            return out
+
+        self._pcache[statics] = wrapped
+        return wrapped
 
     def _call_partition(self, bins, grad, hess, row_leaf_init, feature_mask,
                         num_bins, default_bins, missing_types, params,
                         monotone, penalty, is_categorical, bundle, *,
                         max_leaves: int, max_depth: int, max_bin: int,
-                        max_cat_threshold: int):
+                        max_cat_threshold: int,
+                        quantized: bool = False, quant_scales=None):
         import jax.numpy as jnp
 
         from ..ops import partition_pallas as pp
         n, G = bins.shape
         F = num_bins.shape[0]
-        d = self.d
+        socket = self.mesh is None
+        # socket ranks hold only their local shard: one local arena, no
+        # cross-rank padding (the wire doesn't care about row counts)
+        d = 1 if socket else self.d
         row_shard = self.mode in ("data", "voting")
-        if row_shard:
+        if socket:
+            pad_r, pad_f = 0, 0
+        elif row_shard:
             pad_r, pad_f = (-n) % d, 0
         else:
             # FP shards the SEARCH by features: pad features to d; data
@@ -289,13 +360,20 @@ class ParallelGrower:
                 is_categorical = jnp.pad(is_categorical, (0, pad_f))
 
         interpret = jax.default_backend() != "tpu"
-        fn = self._build_partition(
-            (max_leaves, max_depth, max_bin, max_cat_threshold, C, cap,
-             self._partition["hist_slots"], interpret))
+        statics = (max_leaves, max_depth, max_bin, max_cat_threshold, C,
+                   cap, self._partition["hist_slots"], interpret,
+                   bool(quantized))
+        fn = (self._build_partition_socket(statics) if socket
+              else self._build_partition(statics))
+        if quantized:
+            qsc = jnp.stack([jnp.asarray(quant_scales[0], jnp.float32),
+                             jnp.asarray(quant_scales[1], jnp.float32)])
+        else:
+            qsc = jnp.zeros((2,), jnp.float32)
         tree, leaf_ids, self._arena, self.last_truncated = fn(
             self._arena, self._bins_t, grad, hess, row_leaf_init,
             feature_mask, num_bins, default_bins, missing_types, params,
-            monotone, penalty, is_categorical, bundle)
+            monotone, penalty, is_categorical, bundle, qsc)
         if leaf_ids.shape[0] != n:
             leaf_ids = leaf_ids[:n]
         return tree, leaf_ids
@@ -304,17 +382,23 @@ class ParallelGrower:
 def make_grower(config, dataset_num_features: int):
     """GBDT-facing factory (TreeLearner::CreateTreeLearner,
     src/treelearner/tree_learner.cpp:9-33): returns None for the serial
-    learner, else a ParallelGrower over the local mesh."""
+    learner, else a ParallelGrower over the resolved Collective backend
+    (mesh when the local devices allow it, socket when a cross-host comm
+    is attached and tpu_comm_backend selects it — see
+    parallel/collective.py and docs/Distributed.md)."""
     mode = config.tree_learner
     if mode == "serial":
         return None
-    d = resolve_num_machines(config)
-    if d <= 1:
-        log.warning("tree_learner=%s requested but only one device is "
-                    "visible; using serial learner", mode)
+    collective = coll_mod.make_collective(config)
+    if collective is None:
+        log.warning("tree_learner=%s requested but no collective backend "
+                    "is available (one device, no attached comm); using "
+                    "serial learner", mode)
         return None
+    d = collective.world
     if mode == "feature" and dataset_num_features < d:
         log.warning("feature-parallel with fewer features (%d) than devices "
                     "(%d); padded features will idle some devices",
                     dataset_num_features, d)
-    return ParallelGrower(mode, d, top_k=config.top_k)
+    return ParallelGrower(mode, d, top_k=config.top_k,
+                          collective=collective)

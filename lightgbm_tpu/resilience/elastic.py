@@ -305,11 +305,20 @@ class ElasticSupervisor:
                 log.info("elastic: rank %d/%d resuming from %s",
                          comm.rank, comm.world, resume)
         cbs = [self._sync_callback(comm, cfg)] + list(self.callbacks)
-        return engine_train(params, ds,
-                            num_boost_round=self.num_boost_round,
-                            resume_from=resume,
-                            resume_mode="reshard" if resume else "strict",
-                            callbacks=cbs)
+        # make this incarnation's fenced comm visible to the Collective
+        # backend resolver: tpu_comm_backend=socket rides THIS comm (so
+        # training collectives inherit its retry/heartbeat/generation
+        # fencing), and a torn-down world never leaks into the next one
+        from ..parallel import collective as coll_mod
+        coll_mod.set_process_comm(comm)
+        try:
+            return engine_train(params, ds,
+                                num_boost_round=self.num_boost_round,
+                                resume_from=resume,
+                                resume_mode="reshard" if resume else "strict",
+                                callbacks=cbs)
+        finally:
+            coll_mod.set_process_comm(None)
 
     def _sync_callback(self, comm, cfg):
         """The failure-propagation seam: a tiny allgather every

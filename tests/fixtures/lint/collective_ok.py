@@ -50,3 +50,33 @@ class Comm:
 
     def shape_op(self, n):
         return broadcasted_iota(n)      # shape op, not a collective
+
+
+def shard_psum(x):
+    return psum(x, "mp")        # noqa: F821 — parsed, never imported
+
+
+def mesh_reduce(x):
+    # bearing via the shard_map closure rule ...
+    return shard_map(shard_psum, None)      # noqa: F821
+
+
+def mesh_square(x):
+    # ... but a lambda closure is anonymous: nothing to resolve, clean
+    return shard_map(lambda v: v * v, None)     # noqa: F821
+
+
+class MeshComm:
+    def __init__(self):
+        self.rank = 0
+
+    def every_rank(self, x):
+        # reached unconditionally on every rank: symmetric, clean
+        return mesh_reduce(x)
+
+    def gated_non_collective(self, x):
+        # rank branch, but the shard_map'd closure performs no
+        # collective — must NOT flag
+        if self.rank == 0:
+            return mesh_square(x)
+        return x

@@ -153,7 +153,7 @@ def test_partition_engine_feature_parallel(rng):
 
     from lightgbm_tpu.ops import grow_partition as gp
     from lightgbm_tpu.ops import partition_pallas as pp_mod
-    from lightgbm_tpu.parallel.learners import AXIS
+    from lightgbm_tpu.parallel.collective import AXIS, shard_mapped
 
     (bins, grad, hess, m, params, statics,
      ts, ls) = _partition_serial_tree(rng)
@@ -170,9 +170,8 @@ def test_partition_engine_feature_parallel(rng):
             **statics)
         return t, l
 
-    fn = jax.jit(jax.shard_map(
-        shard_fn, mesh=mesh, in_specs=(P(), P(), P(), P()),
-        out_specs=(P(), P()), check_vma=False))
+    fn = jax.jit(shard_mapped(
+        shard_fn, mesh, (P(), P(), P(), P()), (P(), P())))
     tp, lp = fn(jnp.asarray(bins.T, pp_mod.ARENA_DT), jnp.asarray(grad),
                 jnp.asarray(hess), m["row0"])
     _assert_trees_equal(ts, ls, tp, lp)
@@ -191,7 +190,7 @@ def test_partition_engine_voting_parallel(rng, top_k):
 
     from lightgbm_tpu.ops import grow_partition as gp
     from lightgbm_tpu.ops import partition_pallas as pp_mod
-    from lightgbm_tpu.parallel.learners import AXIS
+    from lightgbm_tpu.parallel.collective import AXIS, shard_mapped
 
     (bins, grad, hess, m, params, statics,
      ts, ls) = _partition_serial_tree(rng)
@@ -209,10 +208,10 @@ def test_partition_engine_voting_parallel(rng, top_k):
             top_k=top_k, **statics)
         return t, l
 
-    fn = jax.jit(jax.shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS)),
-        out_specs=(P(), P(AXIS)), check_vma=False))
+    fn = jax.jit(shard_mapped(
+        shard_fn, mesh,
+        (P(None, AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        (P(), P(AXIS))))
     tp, lp = fn(jnp.asarray(bins.T, pp_mod.ARENA_DT), jnp.asarray(grad),
                 jnp.asarray(hess), m["row0"])
     if top_k >= F:
@@ -261,7 +260,7 @@ def test_partition_engine_data_parallel(rng):
 
     from lightgbm_tpu.ops import grow_partition as gp
     from lightgbm_tpu.ops import partition_pallas as pp_mod
-    from lightgbm_tpu.parallel.learners import AXIS
+    from lightgbm_tpu.parallel.collective import AXIS, shard_mapped
 
     n, F, B = 1024, 6, 24
     bins = rng.randint(0, B, (n, F)).astype(np.float32)
@@ -296,10 +295,10 @@ def test_partition_engine_data_parallel(rng):
             axis_name=AXIS, **statics)
         return t, l
 
-    fn = jax.jit(jax.shard_map(
-        shard_fn, mesh=mesh,
-        in_specs=(P(None, AXIS), P(AXIS), P(AXIS), P(AXIS)),
-        out_specs=(P(), P(AXIS)), check_vma=False))
+    fn = jax.jit(shard_mapped(
+        shard_fn, mesh,
+        (P(None, AXIS), P(AXIS), P(AXIS), P(AXIS)),
+        (P(), P(AXIS))))
     tp, lp = fn(jnp.asarray(bins.T, pp_mod.ARENA_DT), jnp.asarray(grad),
                 jnp.asarray(hess), row0)
 

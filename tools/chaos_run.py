@@ -9,6 +9,7 @@ printing one JSON summary with the measured recovery time.
     python tools/chaos_run.py --scenario slow_rank          # hang > suspect
     python tools/chaos_run.py --scenario partition          # ctrl cut
     python tools/chaos_run.py --scenario kill_hub           # kill rank 0
+    python tools/chaos_run.py --scenario mesh_unavailable   # backend fallback
     python tools/chaos_run.py --scenario none               # control run
     python tools/chaos_run.py --scenario kill_rank --fast   # CI smoke
 
@@ -72,7 +73,8 @@ def _worker(orig_rank, machines, params, n_rows, rounds, q):
         q.put((orig_rank, {"outcome": "aborted", "error": str(e)}))
 
 
-SCENARIOS = ("kill_rank", "kill_hub", "slow_rank", "partition", "none")
+SCENARIOS = ("kill_rank", "kill_hub", "slow_rank", "partition",
+             "mesh_unavailable", "none")
 
 
 def run_scenario(scenario: str, world: int = 3, rounds: int = 8,
@@ -99,6 +101,15 @@ def run_scenario(scenario: str, world: int = 3, rounds: int = 8,
         "tpu_checkpoint_path": os.path.join(tmp, "ckpts"),
         "tpu_checkpoint_interval": 1,
     }
+    telemetry = None
+    if scenario == "mesh_unavailable":
+        # backend-fallback drill: every rank ASKS for the mesh backend
+        # while the chaos hook makes the device mesh report empty;
+        # training must fall back to the socket collective cleanly and
+        # say so via the recorder's comm_backend telemetry event
+        telemetry = os.path.join(tmp, "telemetry.jsonl")
+        params["tpu_comm_backend"] = "mesh"
+        params["tpu_telemetry_path"] = telemetry
     env_chaos = None
     if scenario in ("kill_rank", "kill_hub"):
         env_chaos = "kill:%d:%d" % (victim, chaos_round)
@@ -106,6 +117,10 @@ def run_scenario(scenario: str, world: int = 3, rounds: int = 8,
         env_chaos = "slow:%d:%d:%.1f" % (victim, chaos_round, 20.0)
     elif scenario == "partition":
         env_chaos = "partition:%d:%d:%.1f" % (victim, chaos_round, 20.0)
+    elif scenario == "mesh_unavailable":
+        # rank -1 never matches, so no rank self-injures; only the kind
+        # prefix matters (collective._mesh_devices_available reads it)
+        env_chaos = "mesh_unavailable:-1:0"
     if env_chaos is not None:
         os.environ["LGBM_TPU_CHAOS"] = env_chaos
     else:
@@ -124,7 +139,7 @@ def run_scenario(scenario: str, world: int = 3, rounds: int = 8,
         deadline = time.monotonic() + join_timeout_s
         # wait for the survivors only; a stalled victim's abort report
         # can arrive minutes later and is informational
-        want = world if scenario == "none" else world - 1
+        want = world if victim is None else world - 1
         while len(results) < want and time.monotonic() < deadline:
             try:
                 rank, out = q.get(timeout=1.0)
@@ -143,13 +158,29 @@ def run_scenario(scenario: str, world: int = 3, rounds: int = 8,
                  if o.get("outcome") == "complete"}
     fenced = sorted(r for r, o in results.items()
                     if o.get("outcome") == "fenced")
-    expect_world = world if scenario == "none" else world - 1
+    expect_world = world if victim is None else world - 1
     ok = bool(completed) and all(
         o["world"] == expect_world and o["num_trees"] >= rounds
         for o in completed.values())
-    if scenario != "none":
+    if victim is not None:
         ok = ok and all(o["reforms"] >= 1 and victim in o["dead_ranks"]
                         for o in completed.values())
+    backend_events = None
+    if telemetry is not None:
+        # the drill's observable: every rank REQUESTED mesh but trained
+        # on the socket backend (make_collective's comm_backend event)
+        backend_events = []
+        try:
+            with open(telemetry) as f:
+                for line in f:
+                    ev = json.loads(line)
+                    if ev.get("event") == "comm_backend":
+                        backend_events.append(ev)
+        except (OSError, ValueError):
+            pass
+        ok = ok and any(e.get("requested") == "mesh"
+                        and e.get("backend") == "socket"
+                        for e in backend_events)
     recovery = max((o.get("recovery_s", 0.0)
                     for o in completed.values()), default=None)
     return {
@@ -159,6 +190,7 @@ def run_scenario(scenario: str, world: int = 3, rounds: int = 8,
         "fenced_ranks": fenced,
         "recovery_s": recovery,
         "total_s": round(total_s, 3),
+        "comm_backend_events": backend_events,
         "results": results,
     }
 

@@ -104,6 +104,11 @@ def extract_metrics(bench: Dict) -> Dict:
     quant = (detail.get("quantized") or {}).get("throughput_mrows_iter_s")
     if quant is not None:
         out["higgs_quantized_mrows_iter_s"] = float(quant)
+    mesh = detail.get("mesh_scaling")
+    if isinstance(mesh, dict):
+        mesh8 = mesh.get("mesh8_mrows_iter_s")
+        if mesh8 is not None:
+            out["higgs_mesh8_mrows_iter_s"] = float(mesh8)
     return out
 
 
@@ -153,22 +158,34 @@ def check(metrics: Dict, roofline: Optional[Dict[str, float]],
 # into "higgs" and silently overwrite the f32 trail.
 TRACKED_METRICS = {"higgs_mrows_iter_s": "higgs",
                    "mslr_mrows_iter_s": "mslr",
-                   "higgs_quantized_mrows_iter_s": "higgs_quantized"}
+                   "higgs_quantized_mrows_iter_s": "higgs_quantized",
+                   "higgs_mesh8_mrows_iter_s": "higgs_mesh8"}
 
 
 def make_baseline(metrics: Dict, roofline: Optional[Dict[str, float]],
                   prev: Optional[Dict], margin: float) -> Dict:
     """Derive/refresh a baseline from a known-good bench run, keeping
-    the history trail from the previous ledger."""
+    the history trail from the previous ledger.
+
+    Metrics absent from THIS run keep their previous floors: a partial
+    run (say a mesh-only rerun) refreshes only what it measured instead
+    of silently dropping the other floors from the ledger."""
     out: Dict = {"schema": 1, "metrics": {}, "history": []}
     if prev:
         out["history"] = list(prev.get("history") or [])
+        out["metrics"] = {k: dict(v)
+                          for k, v in (prev.get("metrics") or {}).items()}
+        if prev.get("roofline"):
+            out["roofline"] = {k: dict(v)
+                               for k, v in prev["roofline"].items()}
     entry = {"round": metrics.get("round")}
     for name, short in TRACKED_METRICS.items():
         if name in metrics:
-            out["metrics"][name] = {"baseline": round(metrics[name], 3),
+            # 6 decimals: CPU-smoke mesh throughputs sit around 1e-4
+            # Mrows·iter/s and must not round to a vacuous 0.0 floor
+            out["metrics"][name] = {"baseline": round(metrics[name], 6),
                                     "tolerance": margin}
-            entry[short] = round(metrics[name], 3)
+            entry[short] = round(metrics[name], 6)
     out["history"].append(entry)
     if roofline:
         out["roofline"] = {

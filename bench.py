@@ -380,6 +380,82 @@ def mesh_smoke(on_tpu):
         return {"error": "FAILED: %s" % e}
 
 
+def supervisor_smoke():
+    """Continuous-learning loop drill (one line in `detail`).
+
+    Runs the full ingest -> refit -> shadow -> promote cycle in-process
+    against a deliberately drifted stream (resilience/supervisor.py):
+    serve a stale model, ingest labeled drifted rows, let the supervisor
+    refit a candidate, shadow-score it on the held-out window and
+    hot-swap it through the registry past the quality floor.  Children
+    of the timed TPU runs are unaffected — everything rides the host
+    predict walk.  Never fails the bench: any problem becomes the
+    summary.
+    """
+    import os
+    import shutil
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.resilience.supervisor import (
+        ContinuousLearningSupervisor)
+    from lightgbm_tpu.serving import Server
+    root = tempfile.mkdtemp(prefix="lgbm_bench_sup_")
+    try:
+        rng = np.random.RandomState(5)
+
+        def stream(n, drift):
+            X = rng.rand(n, 8)
+            y = (X[:, 0] * 2.0 + X[:, 1] + drift * 3.0 * X[:, 2]
+                 + 0.01 * rng.randn(n))
+            return X, y
+
+        params = {"objective": "regression", "num_leaves": 15,
+                  "min_data_in_leaf": 5, "verbosity": -1}
+        Xb, yb = stream(1200, 0.0)
+        base = lgb.train(dict(params), lgb.Dataset(Xb, label=yb),
+                         num_boost_round=10)
+        srv = Server(verbosity=-1)
+        srv.load_model("m", model_str=base.model_to_string())
+        sup = ContinuousLearningSupervisor(
+            srv, {"tpu_continuous_learning": True,
+                  "tpu_checkpoint_path": root,
+                  "tpu_refit_interval_s": 0.05, "tpu_refit_min_rows": 200,
+                  "tpu_promote_min_samples": 40,
+                  "tpu_refit_holdout_fraction": 0.3,
+                  "tpu_promote_min_delta": 0.0,
+                  "objective": "regression", "verbosity": -1},
+            model_name="m", train_params=params)
+        Xd, yd = stream(800, 1.0)                 # the drift
+        accepted, shed = sup.ingest(Xd, yd)
+        t0 = _time.monotonic()
+        state, deadline = "idle", _time.monotonic() + 30.0
+        while _time.monotonic() < deadline:
+            _time.sleep(0.05)
+            state = sup.tick()
+            if state == "watch":
+                break
+        snap = sup.snapshot()
+        version = srv.registry.get("m").version
+        srv.shutdown()
+        delta = (snap.get("last_shadow") or {}).get("delta")
+        return ("ingest %d (shed %d) -> refit %d -> shadow delta %s -> "
+                "v%d %s in %.2fs, ok=%s"
+                % (accepted, shed, snap["refits"],
+                   "%.4f" % delta if delta is not None else "?",
+                   version, snap["state"],
+                   _time.monotonic() - t0,
+                   snap["promotes"] == 1 and version == 2
+                   and state == "watch"))
+    except Exception as e:  # noqa: BLE001 — smoke only, never fatal
+        return "FAILED: %s" % e
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def lint_smoke():
     """tpulint over the shipped tree (one line in `detail`).
 
@@ -487,6 +563,7 @@ def main():
             "mesh_scaling": mesh_smoke(on_tpu),
             "trace_smoke": trace_smoke(lgb),
             "chaos_smoke": chaos_smoke(),
+            "supervisor_smoke": supervisor_smoke(),
             "lint_smoke": lint_smoke(),
         },
     }

@@ -349,6 +349,23 @@ class Application:
         log.info("Loaded %s v%d (%d trees); serving on %s:%d",
                  entry.name, entry.version, entry.num_trees,
                  cfg.serve_host, cfg.serve_port)
+        if cfg.tpu_continuous_learning:
+            # the self-updating loop: POST /ingest feeds labeled rows,
+            # the supervisor refits/shadow-scores/promotes behind the
+            # quality gate (docs/ContinuousLearning.md); with `data`
+            # given, continue-mode candidates bin against its mappers
+            from .resilience.supervisor import ContinuousLearningSupervisor
+            base = None
+            if cfg.data and cfg.tpu_refit_mode == "continue":
+                base = self._load_train_data()
+                base.construct()
+            sup = ContinuousLearningSupervisor(
+                server, cfg, model_name=entry.name, base_dataset=base)
+            sup.start()
+            log.info("continuous learning on: mode=%s interval=%.1fs "
+                     "min_rows=%d (POST /ingest, GET /supervisor)",
+                     cfg.tpu_refit_mode, cfg.tpu_refit_interval_s,
+                     cfg.tpu_refit_min_rows)
         # SIGTERM -> graceful drain: finish queued + in-flight requests
         # (bounded by tpu_serve_drain_timeout_s), then exit
         server.install_signal_handlers()

@@ -272,6 +272,39 @@ _SCHEMA = [
     ("tpu_quantized_seed", int, 0),       # stochastic-rounding seed for the
     #   gradient codes (0 = derive from the main `seed`); folded with the
     #   iteration index so checkpoint resume is bitwise-identical
+    # --- continuous-learning parameters (no reference analogue)
+    # Streaming refit -> shadow eval -> gated hot-swap with automatic
+    # rollback (resilience/supervisor.py + serving/shadow.py); the CLI
+    # face is `task=serve tpu_continuous_learning=true`.  See
+    # docs/ContinuousLearning.md for the loop and failure matrix.
+    ("tpu_continuous_learning", bool, False),  # run the supervisor loop next
+    #   to task=serve: POST /ingest feeds fresh labeled rows, candidates
+    #   are produced/shadow-scored/promoted automatically
+    ("tpu_refit_interval_s", float, 30.0),   # min seconds between candidate
+    #   builds (the loop also waits for tpu_refit_min_rows)
+    ("tpu_refit_min_rows", int, 256),        # buffered training rows required
+    #   before a candidate is produced
+    ("tpu_refit_mode", str, "refit"),        # refit|continue — leaf-value
+    #   renewal via Booster.refit vs continued training (init_model) with
+    #   tpu_refit_rounds extra trees; continue falls back to refit when
+    #   no base dataset is available for frozen-mapper binning
+    ("tpu_refit_rounds", int, 10),           # continue-mode boosting rounds
+    #   added per candidate
+    ("tpu_refit_buffer_rows", int, 100000),  # bounded ingest buffer: beyond
+    #   this many buffered rows the OLDEST rows are shed (counted on
+    #   lgbm_ingest_shed_total{reason=overflow}), never the loop crashed
+    ("tpu_refit_holdout_fraction", float, 0.2),  # fraction of ingested rows
+    #   diverted to the held-out shadow-metric window (never trained on)
+    ("tpu_promote_min_delta", float, 0.0),   # quality floor: candidate loss
+    #   must beat live loss by MORE than this on the held-out window
+    ("tpu_promote_min_samples", int, 200),   # min held-out rows scored before
+    #   a promote/reject verdict (smaller windows keep the candidate in
+    #   shadow)
+    ("tpu_promote_watch_s", float, 60.0),    # post-promotion watch window:
+    #   live metrics breaching the floor inside it trigger auto-rollback
+    ("tpu_promote_rollback_delta", float, 0.0),  # rollback floor: watch-window
+    #   live loss may exceed the pre-promote baseline by at most this
+    #   before the prior registry version is reinstalled
 ]
 
 # alias -> canonical name (src/io/config_auto.cpp:4-157)
@@ -389,6 +422,12 @@ ALIAS_TABLE: Dict[str, str] = {
     "comm_heartbeat_s": "tpu_comm_heartbeat_s",
     "comm_backend": "tpu_comm_backend",
     "collective_backend": "tpu_comm_backend",
+    "continuous_learning": "tpu_continuous_learning",
+    "refit_interval_s": "tpu_refit_interval_s",
+    "refit_min_rows": "tpu_refit_min_rows",
+    "refit_mode": "tpu_refit_mode",
+    "promote_min_delta": "tpu_promote_min_delta",
+    "promote_watch_s": "tpu_promote_watch_s",
 }
 
 PARAMETER_TYPES: Dict[str, Any] = {name: typ for name, typ, _ in _SCHEMA}
@@ -658,6 +697,33 @@ class Config:
         if self.tpu_quantized_seed < 0:
             log.fatal("tpu_quantized_seed must be >= 0, got %d"
                       % self.tpu_quantized_seed)
+        if self.tpu_refit_mode not in ("refit", "continue"):
+            log.fatal("tpu_refit_mode must be 'refit' or 'continue', got %r"
+                      % self.tpu_refit_mode)
+        if not 0 <= self.tpu_refit_holdout_fraction < 1:
+            log.fatal("tpu_refit_holdout_fraction must be in [0, 1), got %g"
+                      % self.tpu_refit_holdout_fraction)
+        if self.tpu_continuous_learning:
+            if self.tpu_refit_interval_s <= 0:
+                log.fatal("tpu_refit_interval_s must be > 0, got %g"
+                          % self.tpu_refit_interval_s)
+            if self.tpu_refit_min_rows < 1:
+                log.fatal("tpu_refit_min_rows must be >= 1, got %d"
+                          % self.tpu_refit_min_rows)
+            if self.tpu_refit_rounds < 1:
+                log.fatal("tpu_refit_rounds must be >= 1, got %d"
+                          % self.tpu_refit_rounds)
+            if self.tpu_refit_buffer_rows < self.tpu_refit_min_rows:
+                log.fatal("tpu_refit_buffer_rows (%d) must be >= "
+                          "tpu_refit_min_rows (%d)"
+                          % (self.tpu_refit_buffer_rows,
+                             self.tpu_refit_min_rows))
+            if self.tpu_promote_min_samples < 1:
+                log.fatal("tpu_promote_min_samples must be >= 1, got %d"
+                          % self.tpu_promote_min_samples)
+            if self.tpu_promote_watch_s < 0:
+                log.fatal("tpu_promote_watch_s must be >= 0, got %g"
+                          % self.tpu_promote_watch_s)
 
     def is_single_machine(self) -> bool:
         return self.num_machines <= 1

@@ -44,6 +44,77 @@ def concat_fill(a, b, n0: int, n1: int, fill: float):
     return np.concatenate([a, b])
 
 
+class IngestError(ValueError):
+    """A streaming-ingest block was rejected at the validation boundary.
+
+    `reason` is the shed-counter label: "feature_mismatch", "bad_shape"
+    or "bad_label"."""
+
+    def __init__(self, reason: str, msg: str):
+        super().__init__(msg)
+        self.reason = reason
+
+
+def _shed(reason: str, rows: int) -> None:
+    from ..obs import default_registry
+    default_registry().counter(
+        "lgbm_ingest_shed_total",
+        help="ingest rows shed at the validation boundary",
+        reason=reason).inc(rows)
+
+
+def validate_ingest_block(X, label=None, weight=None, *, num_features: int,
+                          shed: bool = False):
+    """Validate one raw ingest block against the frozen feature schema.
+
+    Returns ``(X, label, weight)`` as float64 arrays.  Block-level
+    malformations — wrong rank, feature-count mismatch, label/weight
+    length mismatch — raise :class:`IngestError`: there is no defensible
+    per-row repair, and letting them through is exactly how NaNs reach
+    the score planes.  Per-row bad labels (NaN/inf) also raise unless
+    ``shed=True``, in which case only the offending rows are dropped.
+    Every rejected row lands on ``lgbm_ingest_shed_total{reason=...}``.
+    """
+    X = np.asarray(X, dtype=np.float64)
+    if X.ndim == 1:
+        X = X.reshape(1, -1)
+    if X.ndim != 2:
+        raise IngestError("bad_shape",
+                          "ingest block must be 2-D, got ndim=%d" % X.ndim)
+    n = int(X.shape[0])
+    if X.shape[1] != num_features:
+        _shed("feature_mismatch", n)
+        raise IngestError("feature_mismatch",
+                          "ingest block has %d features, dataset expects %d"
+                          % (X.shape[1], num_features))
+    if label is not None:
+        label = np.asarray(label, dtype=np.float64).reshape(-1)
+        if label.shape[0] != n:
+            _shed("bad_shape", n)
+            raise IngestError("bad_shape", "%d labels for %d rows"
+                              % (label.shape[0], n))
+    if weight is not None:
+        weight = np.asarray(weight, dtype=np.float64).reshape(-1)
+        if weight.shape[0] != n:
+            _shed("bad_shape", n)
+            raise IngestError("bad_shape", "%d weights for %d rows"
+                              % (weight.shape[0], n))
+    if label is not None:
+        bad = ~np.isfinite(label)
+        nbad = int(bad.sum())
+        if nbad:
+            _shed("bad_label", nbad)
+            if not shed:
+                raise IngestError("bad_label",
+                                  "%d of %d rows carry NaN/inf labels"
+                                  % (nbad, n))
+            keep = ~bad
+            X, label = X[keep], label[keep]
+            if weight is not None:
+                weight = weight[keep]
+    return X, label, weight
+
+
 class BinnedDataset:
     """Binned feature matrix + per-feature mappers + metadata."""
 
@@ -505,6 +576,47 @@ class BinnedDataset:
             md.init_score = np.concatenate(
                 [a.reshape(k, n0), b.reshape(k, n1)], axis=1).reshape(-1)
         self._device_cache.clear()
+
+    def append_raw(self, X, label=None, weight=None) -> int:
+        """Bin and append a block of RAW rows against the frozen mappers —
+        the streaming-ingest edge (continuous-learning supervisor).
+
+        Strict: any malformation raises :class:`IngestError` (lenient
+        callers shed upstream via `validate_ingest_block(shed=True)`),
+        ranking datasets refuse unranked rows, and sharded datasets
+        refuse appends that would desync the global row partition.
+        Returns the number of appended rows."""
+        if self.bins is None:
+            log.fatal("append_raw requires a constructed dataset")
+        if self.metadata.query_boundaries is not None:
+            raise IngestError("bad_shape", "cannot stream-append unranked "
+                              "rows to a ranking dataset")
+        if self.dist_row_ids is not None:
+            raise IngestError("bad_shape", "cannot stream-append to a "
+                              "distributed row shard")
+        X, label, weight = validate_ingest_block(
+            X, label, weight, num_features=self.num_total_features)
+        n1 = int(X.shape[0])
+        if n1 == 0:
+            return 0
+        new_bins = self.bin_block(X)
+        self.bins = np.vstack([self.bins,
+                               new_bins.astype(self.bins.dtype, copy=False)])
+        n0 = self.num_data
+        self.num_data = n0 + n1
+        md = self.metadata
+        md.num_data = self.num_data
+        md.label = concat_fill(md.label, label, n0, n1, 0.0)
+        if md.weights is not None or weight is not None:
+            md.weights = concat_fill(md.weights, weight, n0, n1, 1.0)
+        if md.init_score is not None:
+            # appended rows start at a zero init score on every class plane
+            k = md.init_score.size // n0 if n0 else 1
+            a = np.asarray(md.init_score).reshape(k, n0)
+            md.init_score = np.concatenate(
+                [a, np.zeros((k, n1))], axis=1).reshape(-1)
+        self._device_cache.clear()
+        return n1
 
     # ------------------------------------------------------------------ #
     # Accessors

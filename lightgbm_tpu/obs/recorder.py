@@ -358,6 +358,27 @@ def elastic_event(config, what: str, **fields) -> None:
                     path, exc)
 
 
+def supervisor_event(config, what: str, **fields) -> None:
+    """Append one continuous-learning event ({"event": "supervisor",
+    "what": "refit"|"shadow"|"promote"|"rollback"|"reject"|"resume",
+    ...}) to Config.tpu_telemetry_path.  The supervisor spans boosters
+    (live + candidate) exactly like the elastic lifecycle, so it appends
+    directly — same JSONL contract, best-effort; the chaos drills and
+    bench grep these lines for the promote/rollback observables."""
+    path = getattr(config, "tpu_telemetry_path", "")
+    if not path:
+        return
+    event = {"event": "supervisor", "what": str(what)}
+    event.update(fields)
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(event, default=_json_default,
+                               separators=(",", ":")) + "\n")
+    except Exception as exc:  # noqa: BLE001 — telemetry never raises
+        log.warning("telemetry: supervisor event write to %s failed: %s",
+                    path, exc)
+
+
 def comm_backend_event(config, backend: str, **fields) -> None:
     """Append one backend-selection event ({"event": "comm_backend",
     "backend": "mesh"|"socket"|"none", "requested": ...}) to

@@ -13,6 +13,9 @@ Two halves:
   comm world at a smaller size when a rank dies, re-shards the row
   partition and resumes from the newest checkpoint
   (docs/Elasticity.md).
+- ``supervisor``: the continuous-learning loop — streaming ingest ->
+  candidate refit -> shadow eval -> gated hot-swap -> automatic
+  rollback, against a serving.Server (docs/ContinuousLearning.md).
 
 See docs/Resilience.md for the checkpoint format and failure modes.
 """
@@ -22,11 +25,13 @@ from .checkpoint import (CheckpointData, CheckpointError, CheckpointManager,
 from .comm import CommFailure, FaultInjector, Heartbeat, RetryPolicy
 from .elastic import (ElasticAborted, ElasticFenced, ElasticResult,
                       ElasticSupervisor)
+from .supervisor import ContinuousLearningSupervisor, IngestBuffer
 
 __all__ = [
     "CheckpointData", "CheckpointError", "CheckpointManager",
-    "CheckpointMismatchError", "CommFailure", "ElasticAborted",
+    "CheckpointMismatchError", "CommFailure",
+    "ContinuousLearningSupervisor", "ElasticAborted",
     "ElasticFenced", "ElasticResult", "ElasticSupervisor", "FaultInjector",
-    "Heartbeat", "RetryPolicy", "config_hash", "dataset_fingerprint",
-    "list_checkpoints", "verify",
+    "Heartbeat", "IngestBuffer", "RetryPolicy", "config_hash",
+    "dataset_fingerprint", "list_checkpoints", "verify",
 ]

@@ -15,11 +15,12 @@ from .metrics import Histogram, ModelStats  # noqa: F401
 from .registry import (ModelEntry, ModelNotFoundError,  # noqa: F401
                        ModelRegistry)
 from .server import Server  # noqa: F401
+from .shadow import ShadowMirror  # noqa: F401
 
 __all__ = [
     "Server", "ServingClient", "ServingError",
     "ModelRegistry", "ModelEntry", "ModelNotFoundError",
     "MicroBatcher", "QueueFullError", "RequestTimeoutError",
     "BatcherStoppedError", "ModelStats", "Histogram",
-    "CircuitBreaker", "DrainingError", "ShedError",
+    "CircuitBreaker", "DrainingError", "ShedError", "ShadowMirror",
 ]

@@ -119,6 +119,8 @@ class ModelRegistry:
         self._entries: Dict[str, ModelEntry] = {}
         self._versions: Dict[str, int] = {}
         self._last_used: Dict[str, float] = {}
+        # the entry each hot-swap DEMOTED, kept warm for rollback()
+        self._prior: Dict[str, ModelEntry] = {}
 
     # -- lifecycle ----------------------------------------------------- #
     def load(self, name: str, model_str: Optional[str] = None,
@@ -167,6 +169,9 @@ class ModelRegistry:
                 log.warning("stale load of %s v%d discarded (v%d is live)",
                             name, version, current)
                 return self._entries[name]
+            demoted = self._entries.get(name)
+            if demoted is not None:
+                self._prior[name] = demoted
             self._entries[name] = entry
             self._last_used[name] = time.time()
             while len(self._entries) > self.max_models:
@@ -174,6 +179,7 @@ class ModelRegistry:
                           key=lambda n: self._last_used.get(n, 0.0))
                 del self._entries[lru]
                 self._last_used.pop(lru, None)
+                self._prior.pop(lru, None)
                 evicted.append(lru)
         for n in evicted:
             log.warning("registry over capacity (%d): evicted %s",
@@ -187,6 +193,39 @@ class ModelRegistry:
             model=name).inc()
         return entry
 
+    def rollback(self, name: str) -> ModelEntry:
+        """Reinstall the version the last hot-swap demoted, under a NEW
+        monotonic version — versions never reuse, so clients watching
+        `info()` observe v_n -> v_{n+1} rather than time running
+        backwards.  The demoted booster is still warm (bucket
+        executables live on its device ensemble), so rollback is
+        install-only: no parse, no compile, and the swap itself is one
+        dict assignment under the lock — concurrent predictions either
+        see the whole old entry or the whole new one, never a torn mix.
+        Current and prior swap places, so a bad rollback can itself be
+        rolled back.  Raises ModelNotFoundError when there is no prior
+        version to return to."""
+        with self._lock:
+            current = self._entries.get(name)
+            prior = self._prior.get(name)
+            if current is None or prior is None:
+                raise ModelNotFoundError(name)
+            version = self._versions.get(name, 0) + 1
+            self._versions[name] = version
+            entry = ModelEntry(name, version, prior.booster,
+                               self.min_device_work, self.max_batch_rows)
+            entry.warmed_buckets = list(prior.warmed_buckets)
+            self._entries[name] = entry
+            self._prior[name] = current
+            self._last_used[name] = time.time()
+        log.warning("registry: %s rolled back to v%d (the v%d booster)",
+                    name, version, prior.version)
+        default_registry().counter(
+            "lgbm_serve_rollbacks_total",
+            help="Registry rollbacks to the prior model version",
+            model=name).inc()
+        return entry
+
     def get(self, name: str) -> ModelEntry:
         with self._lock:
             entry = self._entries.get(name)
@@ -195,10 +234,17 @@ class ModelRegistry:
             self._last_used[name] = time.time()
             return entry
 
+    def prior_entry(self, name: str) -> Optional[ModelEntry]:
+        """The entry the last hot-swap demoted (rollback's target), or
+        None — the supervisor scores it to establish a watch baseline."""
+        with self._lock:
+            return self._prior.get(name)
+
     def evict(self, name: str) -> bool:
         with self._lock:
             existed = self._entries.pop(name, None) is not None
             self._last_used.pop(name, None)
+            self._prior.pop(name, None)
             # keep the version counter: a re-load of the same name must
             # not reuse a version clients may have already seen
         if existed:

@@ -39,6 +39,7 @@ from .batcher import (BatcherStoppedError, MicroBatcher, QueueFullError,
                       RequestTimeoutError)
 from .metrics import ModelStats
 from .registry import ModelEntry, ModelNotFoundError, ModelRegistry
+from .shadow import ShadowMirror
 
 
 class Server:
@@ -63,6 +64,8 @@ class Server:
         self._batchers: Dict[str, MicroBatcher] = {}
         self._stats: Dict[str, ModelStats] = {}
         self._breakers: Dict[str, CircuitBreaker] = {}
+        self._shadows: Dict[str, ShadowMirror] = {}
+        self._supervisor = None   # ContinuousLearningSupervisor, if attached
         self._draining = False
         # GET /metrics renders the process-wide registry: per-model
         # request counters published below, plus the device gauges and
@@ -119,8 +122,35 @@ class Server:
             self._breakers.pop(name, None)
         if batcher is not None:
             batcher.stop()
+        self.detach_shadow(name)
         obs_adapters.unpublish_model_stats(self.metrics, name)
         return existed
+
+    # -- continuous learning ------------------------------------------- #
+    def attach_shadow(self, name: str, mirror: ShadowMirror) -> None:
+        """Mirror `name`'s served batches onto a candidate (replacing
+        any previous mirror).  The swap is one dict assignment — traffic
+        already in `_batch_predict` finishes on whichever mirror it
+        resolved."""
+        with self._lock:
+            old = self._shadows.get(name)
+            self._shadows[name] = mirror
+        if old is not None:
+            old.stop()
+
+    def detach_shadow(self, name: str):
+        with self._lock:
+            mirror = self._shadows.pop(name, None)
+        if mirror is not None:
+            mirror.stop()
+        return mirror
+
+    def attach_supervisor(self, supervisor) -> None:
+        """Expose a ContinuousLearningSupervisor on the HTTP frontend
+        (POST /ingest, GET /supervisor).  Duck-typed: anything with
+        ingest(rows, labels, weights) and snapshot()."""
+        with self._lock:
+            self._supervisor = supervisor
 
     # -- predict path -------------------------------------------------- #
     def _batch_predict(self, name: str, X: np.ndarray) -> np.ndarray:
@@ -139,7 +169,9 @@ class Server:
             if stats is not None:
                 stats.record_breaker_batch()
                 stats.record_batch(X.shape[0], device=False)
-            return np.asarray(out)
+            out = np.asarray(out)
+            self._mirror(name, X, out)
+            return out
         try:
             with self.profiler.phase("serve/batch_predict"):
                 out, device = entry.predict(X)
@@ -156,7 +188,21 @@ class Server:
             breaker.record_success()
         if stats is not None:
             stats.record_batch(X.shape[0], device)
-        return np.asarray(out)
+        out = np.asarray(out)
+        self._mirror(name, X, out)
+        return out
+
+    def _mirror(self, name: str, X: np.ndarray, out: np.ndarray) -> None:
+        """Offer a finished batch to the shadow mirror.  The live `out`
+        is already final — observe() copies, never blocks and never
+        raises, so the served response is bitwise mirror-independent."""
+        shadow = self._shadows.get(name)
+        if shadow is None:
+            return
+        try:
+            shadow.observe(X, out)
+        except Exception as exc:  # noqa: BLE001 — shadow never hurts serving
+            log.debug("shadow observe failed for %s: %s", name, exc)
 
     def predict(self, rows, model: Optional[str] = None,
                 timeout_ms: Optional[float] = None) -> np.ndarray:
@@ -330,6 +376,13 @@ class Server:
 
     def shutdown(self) -> None:
         with self._lock:
+            supervisor, self._supervisor = self._supervisor, None
+        if supervisor is not None:
+            try:
+                supervisor.stop()
+            except Exception as exc:  # noqa: BLE001 — teardown never raises
+                log.warning("supervisor stop failed: %s", exc)
+        with self._lock:
             httpd, self._httpd = self._httpd, None
         if httpd is not None:
             httpd.shutdown()
@@ -337,8 +390,12 @@ class Server:
         with self._lock:
             batchers = list(self._batchers.values())
             self._batchers.clear()
+            shadows = list(self._shadows.values())
+            self._shadows.clear()
         for b in batchers:
             b.stop()
+        for s in shadows:
+            s.stop()
         with self._lock:
             tracing, self._tracing = self._tracing, False
         if tracing:
@@ -397,6 +454,12 @@ def _make_handler(server: Server):
                 # its in-flight requests)
                 self._reply(200, {"status": "ok",
                                   "models": server.registry.names()})
+            elif path == "/supervisor":
+                sup = server._supervisor
+                if sup is None:
+                    self._reply(404, {"error": "no supervisor attached"})
+                else:
+                    self._reply(200, sup.snapshot())
             elif path == "/readyz":
                 # readiness: route traffic here?  503 while draining or
                 # model-less so load balancers rotate this replica out
@@ -420,6 +483,8 @@ def _make_handler(server: Server):
             try:
                 if path == "/predict":
                     self._predict(payload)
+                elif path == "/ingest":
+                    self._ingest(payload)
                 elif path == "/models/load":
                     self._load(payload)
                 elif path == "/models/evict":
@@ -458,6 +523,18 @@ def _make_handler(server: Server):
             version = server.registry.get(name).version
             self._reply(200, {"model": name, "version": version,
                               "predictions": np.asarray(out).tolist()})
+
+        def _ingest(self, payload: Dict) -> None:
+            sup = server._supervisor
+            if sup is None:
+                self._reply(404, {"error": "no supervisor attached"})
+                return
+            rows = payload.get("rows")
+            if rows is None:
+                raise ValueError('payload needs "rows" ([[...], ...])')
+            accepted, shed = sup.ingest(rows, payload.get("labels"),
+                                        payload.get("weights"))
+            self._reply(200, {"accepted": accepted, "shed": shed})
 
         def _load(self, payload: Dict) -> None:
             name = payload.get("name") or server.config.serve_model_name

@@ -228,6 +228,77 @@ def test_registry_evict_and_version_monotonic(booster):
     assert v2 > v1                      # versions never reused after evict
 
 
+def test_registry_rollback_semantics(booster, booster_v2):
+    reg = ModelRegistry(warmup_buckets=[1], min_device_work=1 << 62)
+    X = np.random.RandomState(7).rand(6, 8)
+    with pytest.raises(ModelNotFoundError):
+        reg.rollback("m")               # nothing loaded at all
+    reg.load("m", model_str=booster.model_to_string())
+    with pytest.raises(ModelNotFoundError):
+        reg.rollback("m")               # no prior version yet
+    out1 = booster._gbdt.predict(X, device=False)
+    out2 = booster_v2._gbdt.predict(X, device=False)
+    reg.load("m", model_str=booster_v2.model_to_string())   # v2 hot-swap
+    assert reg.prior_entry("m").version == 1
+    e3 = reg.rollback("m")              # back to booster, NEW version
+    assert e3.version == 3
+    np.testing.assert_array_equal(
+        reg.get("m").booster._gbdt.predict(X, device=False), out1)
+    # current/prior swapped places: a bad rollback rolls back too
+    e4 = reg.rollback("m")
+    assert e4.version == 4
+    np.testing.assert_array_equal(
+        reg.get("m").booster._gbdt.predict(X, device=False), out2)
+    # eviction clears the rollback target
+    reg.evict("m")
+    reg.load("m", model_str=booster.model_to_string())
+    with pytest.raises(ModelNotFoundError):
+        reg.rollback("m")
+
+
+def test_registry_rollback_under_concurrent_load(booster, booster_v2):
+    """Hot-swap/rollback churn races threaded prediction: every result
+    must be EXACTLY one model's output (no torn entry), and observed
+    versions must be monotonic per thread."""
+    reg = ModelRegistry(warmup_buckets=[1], min_device_work=1 << 62)
+    X = np.random.RandomState(9).rand(8, 8)
+    out1 = booster._gbdt.predict(X, device=False)
+    out2 = booster_v2._gbdt.predict(X, device=False)
+    reg.load("m", model_str=booster.model_to_string())
+    reg.load("m", model_str=booster_v2.model_to_string())
+    stop = threading.Event()
+    errors = []
+
+    def client():
+        last_version = 0
+        try:
+            while not stop.is_set():
+                entry = reg.get("m")
+                out, _ = entry.predict(X)
+                if not (np.array_equal(out, out1)
+                        or np.array_equal(out, out2)):
+                    errors.append("torn output")
+                    return
+                if entry.version < last_version:
+                    errors.append("version went backwards: %d -> %d"
+                                  % (last_version, entry.version))
+                    return
+                last_version = entry.version
+        except Exception as exc:   # noqa: BLE001 — fail the test, not the thread
+            errors.append(repr(exc))
+
+    threads = [threading.Thread(target=client) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for _ in range(40):
+        reg.rollback("m")
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not errors, errors
+    assert reg.get("m").version == 42   # 2 loads + 40 rollbacks
+
+
 # --------------------------------------------------------------------- #
 # Server: bitwise identity + degradation + HTTP
 # --------------------------------------------------------------------- #

@@ -1,0 +1,412 @@
+"""Continuous-learning supervisor (resilience/supervisor.py): ingest
+validation + shed accounting, the spooled IngestBuffer's holdout split /
+overflow trim / crash replay, the IDLE->REFIT->SHADOW->WATCH state
+machine with promotion gating and automatic rollback, shadow
+non-perturbation, and the HTTP ingest/supervisor surface — all on the
+fast tier (JAX_PLATFORMS=cpu, conftest)."""
+import glob
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.dataset import IngestError, validate_ingest_block
+from lightgbm_tpu.resilience.supervisor import (ContinuousLearningSupervisor,
+                                                IngestBuffer, read_state)
+from lightgbm_tpu.serving import Server
+from lightgbm_tpu.serving.shadow import ShadowMirror
+
+NF = 8
+PARAMS = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+          "min_data_in_leaf": 5, "learning_rate": 0.1}
+
+
+def _stream(n, seed=0, drift=0.0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, NF)
+    y = (X[:, 0] * 2.0 + X[:, 1] + drift * 3.0 * X[:, 2]
+         + 0.01 * rng.randn(n))
+    return X, y
+
+
+def _train(n=1200, seed=1, iters=10):
+    X, y = _stream(n, seed=seed)
+    return lgb.train(dict(PARAMS), lgb.Dataset(X, label=y),
+                     num_boost_round=iters)
+
+
+@pytest.fixture(scope="module")
+def base_booster():
+    return _train()
+
+
+def _cfg(root, **over):
+    cfg = {"tpu_continuous_learning": True, "tpu_checkpoint_path": str(root),
+           "tpu_refit_interval_s": 0.01, "tpu_refit_min_rows": 100,
+           "tpu_refit_mode": "refit", "tpu_refit_holdout_fraction": 0.3,
+           "tpu_promote_min_samples": 30, "tpu_promote_min_delta": -1e9,
+           "tpu_promote_watch_s": 30.0, "objective": "regression",
+           "verbosity": -1}
+    cfg.update(over)
+    return cfg
+
+
+def _supervised_server(base_booster, root, **over):
+    srv = Server(verbosity=-1)
+    srv.load_model("m", model_str=base_booster.model_to_string())
+    sup = ContinuousLearningSupervisor(srv, _cfg(root, **over),
+                                       model_name="m",
+                                       train_params=dict(PARAMS))
+    return srv, sup
+
+
+# --------------------------------------------------------------------- #
+# Ingest-edge validation (io/dataset.py)
+# --------------------------------------------------------------------- #
+def test_validate_ingest_block_accepts_and_coerces():
+    X, y, w = validate_ingest_block([[1, 2, 3]], label=[0.5],
+                                    num_features=3)
+    assert X.shape == (1, 3) and X.dtype == np.float64
+    assert y.shape == (1,) and w is None
+
+
+def test_validate_ingest_block_rejects_feature_mismatch():
+    with pytest.raises(IngestError) as ei:
+        validate_ingest_block(np.zeros((4, 5)), num_features=3)
+    assert ei.value.reason == "feature_mismatch"
+
+
+def test_validate_ingest_block_rejects_bad_shape_and_lengths():
+    with pytest.raises(IngestError):
+        validate_ingest_block(np.zeros((2, 2, 2)), num_features=4)
+    with pytest.raises(IngestError):
+        validate_ingest_block(np.zeros((4, 3)), label=[1.0],
+                              num_features=3)
+
+
+def test_validate_ingest_block_sheds_nonfinite_labels():
+    X = np.arange(12, dtype=np.float64).reshape(4, 3)
+    y = np.array([0.0, np.nan, 2.0, np.inf])
+    # strict mode: the whole block is refused
+    with pytest.raises(IngestError) as ei:
+        validate_ingest_block(X, label=y, num_features=3)
+    assert ei.value.reason == "bad_label"
+    # shed mode: bad rows drop, the rest survives, counter ticks
+    from lightgbm_tpu.obs import default_registry
+    c = default_registry().counter("lgbm_ingest_shed_total",
+                                   reason="bad_label")
+    before = c.value
+    Xk, yk, _ = validate_ingest_block(X, label=y, num_features=3,
+                                      shed=True)
+    assert Xk.shape == (2, 3)
+    np.testing.assert_array_equal(yk, [0.0, 2.0])
+    assert c.value == before + 2
+
+
+def test_append_raw_extends_binned_dataset(base_booster):
+    X, y = _stream(300, seed=4)
+    ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    binned = ds._binned
+    n0 = binned.num_data
+    X1, y1 = _stream(50, seed=5)
+    expect_bins = binned.bin_block(X1)
+    added = binned.append_raw(X1, label=y1)
+    assert added == 50 and binned.num_data == n0 + 50
+    np.testing.assert_array_equal(np.asarray(binned.bins)[n0:],
+                                  np.asarray(expect_bins))
+    np.testing.assert_allclose(np.asarray(binned.metadata.label)[n0:], y1)
+    with pytest.raises(IngestError):
+        binned.append_raw(np.zeros((2, NF + 3)))
+
+
+# --------------------------------------------------------------------- #
+# IngestBuffer: holdout split, overflow trim, crash replay
+# --------------------------------------------------------------------- #
+def test_ingest_buffer_split_trim_and_overflow(tmp_path):
+    buf = IngestBuffer(NF, capacity=300, holdout_fraction=0.25,
+                       spool_dir=str(tmp_path), seed=3)
+    total = 0
+    for i in range(6):
+        X, y = _stream(100, seed=10 + i)
+        total += buf.add(X, y)
+    assert total == 600
+    assert buf.train_rows() <= 300 + 100        # trim keeps ~capacity
+    assert buf.shed_overflow_rows() > 0
+    assert buf.train_rows() + buf.window_rows_count(-1) \
+        + buf.shed_overflow_rows() == 600
+    # spool files for trimmed blocks are gone too
+    segs = glob.glob(os.path.join(str(tmp_path), "seg_*.npz"))
+    spooled = 0
+    for p in segs:
+        with np.load(p) as z:
+            spooled += z["X"].shape[0]
+    assert spooled == buf.train_rows()
+
+
+def test_ingest_buffer_crash_replay(tmp_path):
+    buf = IngestBuffer(NF, capacity=10000, holdout_fraction=0.3,
+                       spool_dir=str(tmp_path), seed=1)
+    X, y = _stream(400, seed=6)
+    buf.add(X[:200], y[:200])
+    buf.add(X[200:], y[200:])
+    train, window = buf.train_rows(), buf.window_rows_count(-1)
+    # a torn tail segment (partial write) must not poison the replay
+    with open(os.path.join(str(tmp_path), "seg_00000099.npz"), "wb") as f:
+        f.write(b"\x00garbage")
+    buf2 = IngestBuffer(NF, capacity=10000, holdout_fraction=0.3,
+                        spool_dir=str(tmp_path), seed=1)
+    assert buf2.restore() == train
+    assert buf2.train_rows() == train
+    assert buf2.window_rows_count(-1) == window   # win_* segments replay
+    # consumed watermark deletes training segments but keeps the window
+    _, _, _, upto = buf2.take_training()
+    buf2.discard_upto(upto)
+    buf3 = IngestBuffer(NF, capacity=10000, holdout_fraction=0.3,
+                        spool_dir=str(tmp_path), seed=1)
+    buf3.restore(consumed_upto=upto)
+    assert buf3.train_rows() == 0
+    assert buf3.window_rows_count(-1) == window
+
+
+# --------------------------------------------------------------------- #
+# Supervisor state machine
+# --------------------------------------------------------------------- #
+def test_supervisor_promotes_on_drift(base_booster, tmp_path):
+    telemetry = str(tmp_path / "telemetry.jsonl")
+    srv, sup = _supervised_server(base_booster, tmp_path,
+                                  tpu_promote_min_delta=0.0,
+                                  tpu_telemetry_path=telemetry)
+    try:
+        X, y = _stream(600, seed=20, drift=1.0)
+        accepted, shed = sup.ingest(X, y)
+        assert (accepted, shed) == (600, 0)
+        time.sleep(0.05)
+        assert sup.tick() == "shadow"       # candidate built + mirrored
+        assert sup.tick() == "watch"        # shadow verdict -> hot-swap
+        assert srv.registry.get("m").version == 2
+        snap = sup.snapshot()
+        assert snap["promotes"] == 1 and snap["refits"] == 1
+        assert snap["last_shadow"]["delta"] > 0.0
+        events = [json.loads(line) for line in open(telemetry)]
+        whats = [e["what"] for e in events if e["event"] == "supervisor"]
+        assert whats[:3] == ["refit", "shadow", "promote"]
+        promote = next(e for e in events if e.get("what") == "promote")
+        assert promote["delta"] > 0.0 and promote["version"] == 2
+    finally:
+        srv.shutdown()
+
+
+def test_supervisor_rejects_below_floor(base_booster, tmp_path):
+    srv, sup = _supervised_server(base_booster, tmp_path,
+                                  tpu_promote_min_delta=1e9)
+    try:
+        X, y = _stream(600, seed=21, drift=1.0)
+        sup.ingest(X, y)
+        time.sleep(0.05)
+        assert sup.tick() == "shadow"
+        assert sup.tick() == "idle"         # floor not cleared -> reject
+        assert srv.registry.get("m").version == 1
+        assert sup.snapshot()["promotes"] == 0
+    finally:
+        srv.shutdown()
+
+
+def test_supervisor_idle_waits_for_rows_and_interval(base_booster,
+                                                     tmp_path):
+    srv, sup = _supervised_server(base_booster, tmp_path,
+                                  tpu_refit_interval_s=0.01)
+    try:
+        time.sleep(0.05)
+        assert sup.tick() == "idle"         # no rows buffered
+        X, y = _stream(50, seed=22)
+        sup.ingest(X, y)
+        time.sleep(0.05)
+        assert sup.tick() == "idle"         # below tpu_refit_min_rows
+    finally:
+        srv.shutdown()
+
+
+def test_supervisor_force_promote_then_rollback(base_booster, tmp_path):
+    srv, sup = _supervised_server(base_booster, tmp_path,
+                                  tpu_promote_rollback_delta=0.0)
+    try:
+        X, y = _stream(400, seed=23)
+        sup.ingest(X, y)                    # window -> promote baseline
+        Xb, yb = _stream(1200, seed=24)
+        rng = np.random.RandomState(0)
+        degraded = lgb.train(dict(PARAMS),
+                             lgb.Dataset(Xb, label=rng.permutation(yb)),
+                             num_boost_round=4)
+        sup.force_promote(booster=degraded)
+        assert srv.registry.get("m").version == 2
+        X2, y2 = _stream(400, seed=25)      # fresh labels for the watch
+        sup.ingest(X2, y2)
+        assert sup.tick() == "idle"         # breach -> rollback -> idle
+        assert srv.registry.get("m").version == 3
+        assert sup.snapshot()["rollbacks"] == 1
+        Xq = X[:5]
+        np.testing.assert_array_equal(
+            srv.registry.get("m").booster._gbdt.predict(Xq, device=False),
+            base_booster._gbdt.predict(Xq, device=False))
+    finally:
+        srv.shutdown()
+
+
+def test_supervisor_ingest_sheds_malformed(base_booster, tmp_path):
+    srv, sup = _supervised_server(base_booster, tmp_path)
+    try:
+        accepted, shed = sup.ingest(np.zeros((3, NF + 2)))
+        assert (accepted, shed) == (0, 3)   # wrong width: shed, no crash
+        X, y = _stream(4, seed=26)
+        y[1] = np.nan
+        accepted, shed = sup.ingest(X, y)
+        assert (accepted, shed) == (3, 1)
+    finally:
+        srv.shutdown()
+
+
+def test_supervisor_restart_resumes_without_ingest_loss(base_booster,
+                                                        tmp_path):
+    srv, sup = _supervised_server(base_booster, tmp_path)
+    X, y = _stream(300, seed=27, drift=1.0)
+    sup.ingest(X, y)
+    rows = sup.snapshot()
+    srv.shutdown()                          # die before any refit
+    srv2, sup2 = _supervised_server(base_booster, tmp_path,
+                                    tpu_promote_min_delta=0.0)
+    try:
+        snap = sup2.snapshot()
+        assert snap["buffer_rows"] == rows["buffer_rows"]
+        assert snap["window_rows"] == rows["window_rows"]
+        assert snap["buffer_rows"] + snap["window_rows"] == 300
+        time.sleep(0.05)
+        assert sup2.tick() == "shadow"
+        assert sup2.tick() == "watch"       # promote purely from replay
+        assert srv2.registry.get("m").version == 2
+        assert read_state(str(tmp_path))["state"] == "watch"
+    finally:
+        srv2.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# Shadow mirror: bitwise non-perturbation of served responses
+# --------------------------------------------------------------------- #
+def test_shadow_mirror_does_not_perturb_serving(base_booster):
+    cand = _train(seed=9, iters=6)
+    srv = Server(verbosity=-1, serve_batch_wait_ms=1.0)
+    srv.load_model("m", model_str=base_booster.model_to_string())
+    try:
+        X = np.random.RandomState(31).rand(13, NF)
+        before = srv.predict(X, model="m")
+        mirror = ShadowMirror("m", cand)
+        srv.attach_shadow("m", mirror)
+        during = srv.predict(X, model="m")
+        np.testing.assert_array_equal(before, during)   # bitwise
+        assert mirror.drain()
+        snap = mirror.snapshot()
+        assert snap["rows"] == 13 and snap["errors"] == 0
+        expect = np.abs(np.asarray(cand._gbdt.predict(X, device=False))
+                        - np.asarray(before))
+        np.testing.assert_allclose(snap["max_abs_delta"], expect.max())
+        srv.detach_shadow("m")
+        after = srv.predict(X, model="m")
+        np.testing.assert_array_equal(before, after)
+    finally:
+        srv.shutdown()
+
+
+def test_shadow_mirror_errors_never_propagate(base_booster):
+    mirror = ShadowMirror("m", _train(seed=9, iters=6))
+    try:
+        # too-narrow block: the worker records the error, serving never
+        # sees it (the tree walk indexes features past the edge)
+        mirror.observe(np.zeros((2, 2)), np.zeros(2))
+        assert mirror.drain()
+        assert mirror.snapshot()["errors"] == 1
+    finally:
+        mirror.stop()
+
+
+# --------------------------------------------------------------------- #
+# HTTP surface: POST /ingest + GET /supervisor
+# --------------------------------------------------------------------- #
+def test_http_ingest_and_supervisor_endpoints(base_booster, tmp_path):
+    srv, sup = _supervised_server(base_booster, tmp_path)
+    httpd = srv.serve_http(port=0, block=False)
+    try:
+        port = httpd.server_address[1]
+        X, y = _stream(5, seed=33)
+        body = json.dumps({"rows": X.tolist(),
+                           "labels": y.tolist()}).encode()
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/ingest" % port, data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            out = json.loads(resp.read())
+        assert out == {"accepted": 5, "shed": 0}
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/supervisor" % port) as resp:
+            snap = json.loads(resp.read())
+        assert snap["model"] == "m" and snap["state"] == "idle"
+        assert snap["buffer_rows"] + snap["window_rows"] == 5
+    finally:
+        srv.shutdown()
+
+
+def test_supervisor_background_loop_runs(base_booster, tmp_path):
+    srv, sup = _supervised_server(base_booster, tmp_path,
+                                  tpu_promote_min_delta=0.0)
+    try:
+        X, y = _stream(600, seed=35, drift=1.0)
+        sup.ingest(X, y)
+        sup.start(poll_s=0.02)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if sup.snapshot()["promotes"] >= 1:
+                break
+            time.sleep(0.05)
+        assert sup.snapshot()["promotes"] == 1
+        assert srv.registry.get("m").version == 2
+    finally:
+        srv.shutdown()
+
+
+def test_concurrent_ingest_and_ticks(base_booster, tmp_path):
+    """Threaded ingest racing the tick loop: every accepted row is
+    accounted for (buffered, consumed, windowed or overflow-shed) and
+    the state machine never wedges."""
+    srv, sup = _supervised_server(base_booster, tmp_path,
+                                  tpu_promote_min_delta=0.0,
+                                  tpu_refit_buffer_rows=100000)
+    try:
+        errors = []
+
+        def feeder(seed):
+            try:
+                for i in range(5):
+                    X, y = _stream(60, seed=seed * 100 + i, drift=1.0)
+                    acc, shed = sup.ingest(X, y)
+                    assert (acc, shed) == (60, 0)
+            except Exception as exc:   # noqa: BLE001 — surface in main thread
+                errors.append(repr(exc))
+
+        threads = [threading.Thread(target=feeder, args=(s,))
+                   for s in range(4)]
+        for t in threads:
+            t.start()
+        for _ in range(20):
+            sup.tick()
+            time.sleep(0.01)
+        for t in threads:
+            t.join(timeout=10.0)
+        assert not errors, errors
+        assert sup.snapshot()["shed_overflow_rows"] == 0
+        assert srv.registry.get("m").version >= 1
+    finally:
+        srv.shutdown()

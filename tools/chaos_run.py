@@ -13,6 +13,13 @@ printing one JSON summary with the measured recovery time.
     python tools/chaos_run.py --scenario none               # control run
     python tools/chaos_run.py --scenario kill_rank --fast   # CI smoke
 
+Two continuous-learning drills ride the same driver against the
+serving supervisor (resilience/supervisor.py) instead of the elastic
+trainer:
+
+    python tools/chaos_run.py --scenario kill_refit   # SIGKILL mid-refit
+    python tools/chaos_run.py --scenario bad_promote  # forced rollback
+
 Exit code 0 iff the scenario's expectations held (survivors completed
 at the expected world size with a usable model).  The injury rides the
 LGBM_TPU_CHAOS env hook (kind:orig_rank:round[:secs]) the supervisor's
@@ -75,6 +82,9 @@ def _worker(orig_rank, machines, params, n_rows, rounds, q):
 
 SCENARIOS = ("kill_rank", "kill_hub", "slow_rank", "partition",
              "mesh_unavailable", "none")
+# continuous-learning drills (resilience/supervisor.py), dispatched to
+# run_supervisor_scenario instead of the elastic world driver
+SUPERVISOR_SCENARIOS = ("kill_refit", "bad_promote")
 
 
 def run_scenario(scenario: str, world: int = 3, rounds: int = 8,
@@ -195,9 +205,230 @@ def run_scenario(scenario: str, world: int = 3, rounds: int = 8,
     }
 
 
+def _drift_data(n: int, f: int = 6, seed: int = 11, drift: float = 0.0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f)
+    y = (X[:, 0] * 2.0 + X[:, 1] + drift * 3.0 * X[:, 2]
+         + 0.01 * rng.randn(n))
+    return X, y
+
+
+def _sup_worker(root, model_str, cfg, train_params, n_rows, seed, q):
+    """One life of the continuous-learning loop: serve the base model,
+    ingest drifted rows, tick until promotion (or death by the
+    kill_refit chaos hook, in which case nothing reaches the queue)."""
+    from lightgbm_tpu.resilience.supervisor import (
+        ContinuousLearningSupervisor)
+    from lightgbm_tpu.serving import Server
+    srv = Server(verbosity=-1)
+    srv.load_model("m", model_str=model_str)
+    sup = ContinuousLearningSupervisor(srv, cfg, model_name="m",
+                                       train_params=train_params)
+    snap = sup.snapshot()
+    restored = snap["buffer_rows"] + snap["window_rows"]
+    if restored < cfg["tpu_refit_min_rows"]:
+        # first life: ingest fresh drifted traffic (spooled before the
+        # refit the chaos hook murders, so the second life replays it)
+        X, y = _drift_data(n_rows, seed=seed, drift=1.0)
+        sup.ingest(X, y)
+    Xq, _ = _drift_data(16, seed=99, drift=1.0)
+    predict_failures = 0
+    state = snap["state"]
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        try:
+            srv.predict(Xq, model="m")
+        except Exception:   # noqa: BLE001 — the drill counts ANY failure
+            predict_failures += 1
+        state = sup.tick()   # kill_refit SIGKILLs inside this call
+        if state == "watch":
+            break
+        time.sleep(0.05)
+    q.put({
+        "restored_rows": restored,
+        "state": state,
+        "version": srv.registry.get("m").version,
+        "predict_failures": predict_failures,
+        "snapshot": {k: v for k, v in sup.snapshot().items()
+                     if k != "last_shadow"},
+    })
+    srv.shutdown()
+
+
+def _telemetry_events(path):
+    events = []
+    try:
+        with open(path) as f:
+            for line in f:
+                ev = json.loads(line)
+                if ev.get("event") == "supervisor":
+                    events.append(ev)
+    except (OSError, ValueError):
+        pass
+    return events
+
+
+def run_supervisor_scenario(scenario: str, n_rows: int = 600,
+                            join_timeout_s: float = 120.0) -> dict:
+    """Continuous-learning drills.
+
+    kill_refit: SIGKILL the serving+supervisor process mid-refit (after
+    the training snapshot, before the candidate persists), restart it on
+    the same state directory and require the second life to replay every
+    spooled row, rebuild the candidate and promote — with zero failed
+    client predictions in the surviving life.
+
+    bad_promote: force-promote a deliberately degraded candidate while
+    prediction threads hammer the server; the watch loop must roll the
+    registry back to the prior version on fresh labeled traffic, again
+    with zero failed client predictions.
+    """
+    assert scenario in SUPERVISOR_SCENARIOS, scenario
+    import lightgbm_tpu as lgb
+    tmp = tempfile.mkdtemp(prefix="lgbm_chaos_sup_")
+    telemetry = os.path.join(tmp, "telemetry.jsonl")
+    Xb, yb = _drift_data(1500, seed=3)
+    train_params = {"objective": "regression", "num_leaves": 15,
+                    "min_data_in_leaf": 5, "learning_rate": 0.1,
+                    "verbosity": -1}
+    base = lgb.train(dict(train_params), lgb.Dataset(Xb, label=yb),
+                     num_boost_round=12)
+    cfg = {
+        "tpu_continuous_learning": True, "tpu_checkpoint_path": tmp,
+        "tpu_telemetry_path": telemetry, "objective": "regression",
+        "tpu_refit_interval_s": 0.05, "tpu_refit_min_rows": 200,
+        "tpu_refit_mode": "refit", "tpu_refit_holdout_fraction": 0.3,
+        "tpu_promote_min_samples": 40, "tpu_promote_min_delta": 0.0,
+        "tpu_promote_watch_s": 30.0, "verbosity": -1,
+    }
+    t0 = time.monotonic()
+    if scenario == "kill_refit":
+        summary = _run_kill_refit(tmp, base, cfg, train_params, n_rows,
+                                  join_timeout_s)
+    else:
+        summary = _run_bad_promote(tmp, base, cfg, train_params, n_rows)
+    events = _telemetry_events(telemetry)
+    summary["supervisor_events"] = [e.get("what") for e in events]
+    if scenario == "kill_refit":
+        promote = [e for e in events if e.get("what") == "promote"]
+        summary["ok"] = (summary["ok"] and "refit" in
+                         summary["supervisor_events"] and bool(promote)
+                         and "delta" in promote[0])
+    else:
+        summary["ok"] = (summary["ok"]
+                         and "rollback" in summary["supervisor_events"])
+    summary.update(scenario=scenario,
+                   total_s=round(time.monotonic() - t0, 3))
+    return summary
+
+
+def _run_kill_refit(tmp, base, cfg, train_params, n_rows,
+                    join_timeout_s) -> dict:
+    ctx = mp.get_context("spawn")
+    model_str = base.model_to_string()
+    # life 1: the chaos hook SIGKILLs the process inside its first refit
+    os.environ["LGBM_TPU_CHAOS"] = "kill_refit:0:0"
+    try:
+        q1 = ctx.Queue()
+        p1 = ctx.Process(target=_sup_worker,
+                         args=(tmp, model_str, cfg, train_params,
+                               n_rows, 21, q1))
+        p1.start()
+        p1.join(timeout=join_timeout_s)
+        if p1.is_alive():
+            p1.terminate()
+            p1.join(timeout=5.0)
+    finally:
+        os.environ.pop("LGBM_TPU_CHAOS", None)
+    killed = p1.exitcode == -9
+    spool = sorted(os.listdir(os.path.join(tmp, "supervisor_spool"))) \
+        if os.path.isdir(os.path.join(tmp, "supervisor_spool")) else []
+    # life 2: same state directory, no chaos — must replay the spool,
+    # rebuild the candidate and promote
+    q2 = ctx.Queue()
+    p2 = ctx.Process(target=_sup_worker,
+                     args=(tmp, model_str, cfg, train_params,
+                           n_rows, 21, q2))
+    p2.start()
+    try:
+        life2 = q2.get(timeout=join_timeout_s)
+    except Exception:   # noqa: BLE001 — queue.Empty
+        life2 = None
+    p2.join(timeout=10.0)
+    if p2.is_alive():
+        p2.terminate()
+    ok = (killed and bool(spool) and life2 is not None
+          and life2["restored_rows"] >= n_rows       # zero ingest loss
+          and life2["state"] == "watch"
+          and life2["version"] == 2                  # promoted exactly once
+          and life2["predict_failures"] == 0)
+    return {"ok": ok, "killed_exitcode": p1.exitcode,
+            "spool_after_kill": spool, "life2": life2}
+
+
+def _run_bad_promote(tmp, base, cfg, train_params, n_rows) -> dict:
+    import threading
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.resilience.supervisor import (
+        ContinuousLearningSupervisor)
+    from lightgbm_tpu.serving import Server
+    Xb, yb = _drift_data(1500, seed=3)
+    rng = np.random.RandomState(0)
+    degraded = lgb.train(dict(train_params),
+                         lgb.Dataset(Xb, label=rng.permutation(yb)),
+                         num_boost_round=4)
+    srv = Server(verbosity=-1)
+    srv.load_model("m", model_str=base.model_to_string())
+    sup = ContinuousLearningSupervisor(srv, cfg, model_name="m",
+                                       train_params=train_params)
+    X1, y1 = _drift_data(400, seed=31)
+    sup.ingest(X1, y1)                       # window -> promote baseline
+    Xq, _ = _drift_data(16, seed=99)
+    failures = [0]
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                srv.predict(Xq, model="m")
+            except Exception:   # noqa: BLE001 — the drill counts ANY failure
+                failures[0] += 1
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    v1 = srv.registry.get("m").version
+    sup.force_promote(booster=degraded)
+    v2 = srv.registry.get("m").version
+    X2, y2 = _drift_data(400, seed=32)       # fresh labels for the watch
+    sup.ingest(X2, y2)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        sup.tick()
+        if sup.snapshot()["rollbacks"] >= 1:
+            break
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5.0)
+    v3 = srv.registry.get("m").version
+    served = srv.registry.get("m").booster.predict(Xq)
+    restored = bool(np.allclose(served, base.predict(Xq)))
+    srv.shutdown()
+    ok = (v2 == v1 + 1 and v3 == v2 + 1 and restored
+          and sup.snapshot()["rollbacks"] == 1 and failures[0] == 0)
+    return {"ok": ok, "versions": [v1, v2, v3],
+            "served_matches_prior": restored,
+            "predict_failures": failures[0],
+            "rollbacks": sup.snapshot()["rollbacks"]}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    ap.add_argument("--scenario", choices=SCENARIOS, default="kill_rank")
+    ap.add_argument("--scenario",
+                    choices=SCENARIOS + SUPERVISOR_SCENARIOS,
+                    default="kill_rank")
     ap.add_argument("--world", type=int, default=3)
     ap.add_argument("--rounds", type=int, default=8)
     ap.add_argument("--rows", type=int, default=240)
@@ -210,10 +441,15 @@ def main(argv=None) -> int:
         args.rounds = min(args.rounds, 5)
         args.rows = min(args.rows, 180)
         args.chaos_round = min(args.chaos_round, 2)
-    summary = run_scenario(args.scenario, world=args.world,
-                           rounds=args.rounds, n_rows=args.rows,
-                           chaos_round=args.chaos_round,
-                           join_timeout_s=args.timeout)
+    if args.scenario in SUPERVISOR_SCENARIOS:
+        summary = run_supervisor_scenario(args.scenario,
+                                          n_rows=max(args.rows, 400),
+                                          join_timeout_s=args.timeout)
+    else:
+        summary = run_scenario(args.scenario, world=args.world,
+                               rounds=args.rounds, n_rows=args.rows,
+                               chaos_round=args.chaos_round,
+                               join_timeout_s=args.timeout)
     print(json.dumps(summary, indent=2, sort_keys=True))
     return 0 if summary["ok"] else 1
 

@@ -73,6 +73,40 @@ def describe(ckpt_dir: str, verify: bool) -> bool:
     return ok
 
 
+def describe_supervisor(root: str) -> bool:
+    """Print the continuous-learning supervisor's persisted state when
+    the root doubles as a supervisor state directory (SUPERVISOR.json
+    written by resilience/supervisor.py).  Returns True when present."""
+    from lightgbm_tpu.resilience import supervisor as sup_mod
+    state = sup_mod.read_state(root)
+    if state is None:
+        return False
+    print("supervisor state (%s):" % os.path.join(root, sup_mod.STATE_FILE))
+    print("  model=%s state=%s refits=%s promotes=%s rollbacks=%s"
+          % (state.get("model"), state.get("state"), state.get("refits"),
+             state.get("promotes"), state.get("rollbacks")))
+    print("  consumed_upto=%s watch_from_seq=%s baseline_loss=%s"
+          % (state.get("consumed_upto"), state.get("watch_from_seq"),
+             state.get("baseline_loss")))
+    if state.get("updated_at"):
+        print("  updated_at=%s" % state["updated_at"])
+    cand = os.path.join(root, sup_mod.CANDIDATE_FILE)
+    if os.path.exists(cand):
+        print("  candidate: %s (%s)" % (cand,
+                                        _fmt_bytes(os.path.getsize(cand))))
+    spool = os.path.join(root, sup_mod.SPOOL_DIR)
+    if os.path.isdir(spool):
+        segs = sorted(os.listdir(spool))
+        train = [s for s in segs if s.startswith("seg_")]
+        window = [s for s in segs if s.startswith("win_")]
+        print("  spool: %d training segment(s), %d window segment(s), "
+              "%s" % (len(train), len(window),
+                      _fmt_bytes(sum(os.path.getsize(
+                          os.path.join(spool, s)) for s in segs))))
+    print()
+    return True
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="Inspect/verify lightgbm_tpu training checkpoints")
@@ -89,8 +123,11 @@ def main(argv=None) -> int:
     if os.path.exists(os.path.join(path, ckpt_mod.MANIFEST)):
         return 0 if describe(path, args.verify) else 1
 
+    has_supervisor = describe_supervisor(path)
     ckpts = ckpt_mod.list_checkpoints(path)
     if not ckpts:
+        if has_supervisor:
+            return 0
         print("%s: no checkpoints" % path)
         return 1
     keep_hint = {d for d, _ in ckpts[-1:]}

@@ -456,6 +456,31 @@ def supervisor_smoke():
         shutil.rmtree(root, ignore_errors=True)
 
 
+def fleet_smoke():
+    """Multi-tenant fleet residency drill (one line in `detail`).
+
+    Runs tools/fleet_bench.py in-process at smoke scale: 8 tenants
+    behind an HBM budget sized for 2 resident models, mixed hot/cold
+    traffic through the byte-accounted residency manager
+    (serving/fleet.py) — reporting aggregate throughput, hot/cold p99
+    and the cold-load latency tail, with zero tolerated prediction
+    failures and the budget's peak high-water mark enforced.  Never
+    fails the bench: any problem becomes the summary.
+    """
+    import importlib.util
+    import os
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_bench_fleet", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tools", "fleet_bench.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.smoke()
+    except Exception as e:  # noqa: BLE001 — smoke only, never fatal
+        return "FAILED: %s" % e
+
+
 def lint_smoke():
     """tpulint over the shipped tree (one line in `detail`).
 
@@ -564,6 +589,7 @@ def main():
             "trace_smoke": trace_smoke(lgb),
             "chaos_smoke": chaos_smoke(),
             "supervisor_smoke": supervisor_smoke(),
+            "fleet_smoke": fleet_smoke(),
             "lint_smoke": lint_smoke(),
         },
     }

@@ -242,6 +242,29 @@ _SCHEMA = [
     ("tpu_serve_breaker_reset_s", float, 30.0),  # open -> half-open probe delay
     ("tpu_serve_drain_timeout_s", float, 10.0),  # SIGTERM: max wait for in-flight
     #   requests before the server exits
+    # --- fleet residency parameters (no reference analogue)
+    # Multi-tenant HBM residency manager (serving/fleet.py): a byte-
+    # accounted device budget with LRU spill to a host-RAM tier and
+    # asynchronous re-promotion, a fleet-wide shape-bucketed compile
+    # cache, and per-tenant admission quotas.  See docs/Fleet.md.
+    ("tpu_fleet_hbm_budget_mb", float, 0.0),  # device-byte budget for resident
+    #   prediction ensembles; 0 disables the residency manager (every loaded
+    #   model stays device-resident forever — the pre-fleet behavior)
+    ("tpu_fleet_high_watermark", float, 0.9),  # budget fraction that triggers
+    #   LRU eviction BEFORE a new ensemble is built (never after an OOM)
+    ("tpu_fleet_low_watermark", float, 0.7),  # eviction target: spill LRU
+    #   tenants until resident bytes fit under this fraction of the budget
+    ("tpu_fleet_promote_retries", int, 3),   # async promotion retry budget;
+    #   exponential backoff between attempts, exhaustion degrades the tenant
+    #   to the host walk (counted, never raised to clients)
+    ("tpu_fleet_promote_backoff_ms", float, 50.0),  # first-retry backoff for
+    #   failed promotions (doubles per attempt, jittered)
+    ("tpu_fleet_tenant_qps", float, 0.0),    # per-tenant admission quota in
+    #   requests/s (token bucket; 0 = no quota).  A breaching tenant sheds
+    #   with 429 + Retry-After and a per-tenant counter — one noisy tenant
+    #   cannot starve the fleet
+    ("tpu_fleet_tenant_burst", float, 0.0),  # token-bucket burst depth
+    #   (0 = 2x the qps quota, floor 1)
     # --- perf / roofline parameters (no reference analogue)
     # Roofline performance observatory (obs/perf, tools/roofline_report,
     # tools/perf_gate): analytic HBM-byte/FLOP floors per hot kernel vs
@@ -428,6 +451,10 @@ ALIAS_TABLE: Dict[str, str] = {
     "refit_mode": "tpu_refit_mode",
     "promote_min_delta": "tpu_promote_min_delta",
     "promote_watch_s": "tpu_promote_watch_s",
+    "fleet_hbm_budget_mb": "tpu_fleet_hbm_budget_mb",
+    "hbm_budget_mb": "tpu_fleet_hbm_budget_mb",
+    "fleet_tenant_qps": "tpu_fleet_tenant_qps",
+    "tenant_qps": "tpu_fleet_tenant_qps",
 }
 
 PARAMETER_TYPES: Dict[str, Any] = {name: typ for name, typ, _ in _SCHEMA}
@@ -681,6 +708,22 @@ class Config:
             log.fatal("tpu_serve_shed_retry_after_s / "
                       "tpu_serve_breaker_reset_s / tpu_serve_drain_timeout_s "
                       "must be >= 0")
+        if self.tpu_fleet_hbm_budget_mb < 0:
+            log.fatal("tpu_fleet_hbm_budget_mb must be >= 0, got %g"
+                      % self.tpu_fleet_hbm_budget_mb)
+        if not (0.0 < self.tpu_fleet_low_watermark
+                <= self.tpu_fleet_high_watermark <= 1.0):
+            log.fatal("fleet watermarks must satisfy 0 < low <= high <= 1, "
+                      "got low=%g high=%g"
+                      % (self.tpu_fleet_low_watermark,
+                         self.tpu_fleet_high_watermark))
+        if (self.tpu_fleet_promote_retries < 0
+                or self.tpu_fleet_promote_backoff_ms < 0):
+            log.fatal("tpu_fleet_promote_retries / "
+                      "tpu_fleet_promote_backoff_ms must be >= 0")
+        if self.tpu_fleet_tenant_qps < 0 or self.tpu_fleet_tenant_burst < 0:
+            log.fatal("tpu_fleet_tenant_qps / tpu_fleet_tenant_burst must "
+                      "be >= 0")
         if self.tpu_perf_hbm_gbps <= 0 or self.tpu_perf_peak_tflops <= 0:
             log.fatal("tpu_perf_hbm_gbps and tpu_perf_peak_tflops must be "
                       "> 0, got %g / %g" % (self.tpu_perf_hbm_gbps,

@@ -1685,12 +1685,18 @@ class GBDT:
 
     def predict_bucketed(self, X: np.ndarray, num_iteration: int = -1,
                          raw_score: bool = False,
-                         max_bucket: int = 1 << 20) -> np.ndarray:
+                         max_bucket: int = 1 << 20,
+                         ensemble=None) -> np.ndarray:
         """Serving hot path: rows padded to the power-of-two bucket so
         concurrent request sizes share ONE compiled executable per
         bucket (ops/predict.py predict_bucketed).  Per-row outputs are
         bitwise identical to the device path of predict(); falls back
-        to the host walk when the ensemble cannot run on device."""
+        to the host walk when the ensemble cannot run on device.
+
+        `ensemble`: dispatch on THIS DeviceEnsemble instead of the
+        cached one — the fleet residency manager checks an ensemble out
+        under its byte accounting and must not let a concurrent eviction
+        trigger a silent (unaccounted) rebuild through the cache."""
         self._sync_model()
         X = np.ascontiguousarray(np.asarray(X, np.float64))
         if X.ndim != 2 or X.shape[1] <= self.max_feature_idx:
@@ -1698,7 +1704,7 @@ class GBDT:
                       "it was in training data (%d)"
                       % (X.shape[1] if X.ndim == 2 else 0,
                          self.max_feature_idx + 1))
-        ens = self._device_ensemble()
+        ens = ensemble if ensemble is not None else self._device_ensemble()
         if ens is None:
             return self.predict(X, num_iteration, raw_score=raw_score,
                                 device=False)

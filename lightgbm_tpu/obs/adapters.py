@@ -151,6 +151,36 @@ def publish_model_stats(reg: MetricsRegistry, name: str, stats,
                help="Queue wait before dispatch (ms)", model=name)
 
 
+_BREAKER_STATE_CODE = {"closed": 0, "half_open": 1, "open": 2}
+
+
+def publish_breaker_metrics(reg: MetricsRegistry, name: str,
+                            breaker) -> None:
+    """Per-tenant circuit-breaker exposition, labeled model=<name>: a
+    state gauge (0 closed / 1 half-open / 2 open) and a trip counter.
+    Before this, a circuit-broken tenant was only visible in the /stats
+    JSON snapshot — /metrics scrapers could not attribute which tenant
+    was riding the host walk without grepping logs."""
+    reg.gauge("lgbm_serve_breaker_state",
+              help="Circuit breaker state: 0 closed, 1 half-open, 2 open",
+              model=name).set_fn(
+        lambda: _BREAKER_STATE_CODE.get(breaker.state, -1))
+    reg.counter("lgbm_serve_breaker_open_total",
+                help="Times the circuit breaker tripped open",
+                model=name).set_fn(lambda: breaker.open_count)
+
+
+def publish_quota_metrics(reg: MetricsRegistry, name: str, quota) -> None:
+    """Per-tenant admission-quota shed counter, labeled model=<name> —
+    a quota-shed tenant is attributable in /metrics, separately from
+    queue-depth sheds (lgbm_serve_shed_total counts both)."""
+    reg.counter("lgbm_serve_quota_shed_total",
+                help="Requests shed by the per-tenant admission quota "
+                     "(429 + Retry-After)",
+                model=name).set_fn(lambda: quota.shed_count(name))
+
+
 def unpublish_model_stats(reg: MetricsRegistry, name: str) -> int:
-    """Drop every child labeled model=<name> (model eviction)."""
+    """Drop every child labeled model=<name> (model eviction) — serving
+    stats, breaker and quota children alike."""
     return reg.remove(model=name)

@@ -398,3 +398,26 @@ def comm_backend_event(config, backend: str, **fields) -> None:
     except Exception as exc:  # noqa: BLE001 — telemetry never raises
         log.warning("telemetry: comm_backend event write to %s failed: %s",
                     path, exc)
+
+
+def fleet_event(config, what: str, **fields) -> None:
+    """Append one fleet-residency event ({"event": "fleet", "what":
+    "admit"|"spill"|"promote"|"demote"|"degrade"|"spill_corrupt"|
+    "oversize"|"release", "model": ..., ...}) to
+    Config.tpu_telemetry_path.  The residency manager spans every tenant
+    of a serving process (a spill is caused by one model and suffered by
+    another), so it appends directly like the elastic/supervisor events
+    — same JSONL contract, best-effort; the tenant_storm chaos drill
+    greps these lines for the spill/promote/degrade observables."""
+    path = getattr(config, "tpu_telemetry_path", "")
+    if not path:
+        return
+    event = {"event": "fleet", "what": str(what)}
+    event.update(fields)
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(event, default=_json_default,
+                               separators=(",", ":")) + "\n")
+    except Exception as exc:  # noqa: BLE001 — telemetry never raises
+        log.warning("telemetry: fleet event write to %s failed: %s",
+                    path, exc)

@@ -69,29 +69,84 @@ def pow2_buckets(max_batch: int) -> List[int]:
     return out
 
 
+def ensemble_layout(trees: List, num_classes: int) -> dict:
+    """The padded device-array shapes DeviceEnsemble will build for
+    these trees, computed WITHOUT touching the device.  Trees are padded
+    to k * pow2(iterations) — keeps the per-class reshape exact and
+    quantizes shapes for executable reuse.  ``ok`` False means the
+    ensemble cannot run on device (giant signature tensor / category
+    ids) and the host walk keeps prediction duty.
+
+    The serving residency manager (serving/fleet.py) sizes ensembles
+    from this layout BEFORE building them, so eviction happens ahead of
+    allocation instead of after an OOM."""
+    k = max(num_classes, 1)
+    T = k * _next_pow2(max(-(-len(trees) // k), 1))
+    N = max(max((t.num_leaves - 1 for t in trees), default=1), 1)
+    L = _next_pow2(N + 1)
+    any_cat = any(t.num_cat > 0 for t in trees)
+    # O(trees * leaves^2) signature tensor must fit; the categorical
+    # bitset tensor [T*N, W] has its own budget
+    ok = T * L * N <= _MAX_SIG_ELEMS
+    W = 0
+    if ok and any_cat:
+        if T * N * _MAX_CAT_W > _MAX_SIG_ELEMS:
+            ok = False
+        else:
+            mx = 31
+            for t in trees:
+                if t.num_cat > 0:
+                    bits = np.asarray(t.cat_threshold, np.uint32)
+                    nz = np.flatnonzero(bits)
+                    if len(nz):
+                        mx = max(mx, 32 * int(nz[-1]) + 31)
+            W = _next_pow2(mx + 1)
+            if W > _MAX_CAT_W:
+                ok = False          # enormous category ids: host path
+    return {"k": k, "T": T, "N": N, "L": L, "W": W,
+            "any_cat": any_cat, "ok": ok}
+
+
+def estimate_device_bytes(trees: List, num_classes: int,
+                          x64: bool = None) -> int:
+    """HBM bytes the DeviceEnsemble for `trees` will hold, from the
+    layout alone — exact (matches device_bytes() of the built ensemble),
+    so byte-budget reservations made before the build never drift from
+    the accounting after it.  None when the ensemble is host-only."""
+    lay = ensemble_layout(trees, num_classes)
+    if not lay["ok"]:
+        return None
+    if x64 is None:
+        x64 = bool(jax.config.jax_enable_x64)
+    T, N, L, W = lay["T"], lay["N"], lay["L"], lay["W"]
+    fb = 8 if x64 else 4
+    total = T * N * 4                       # sf_flat  int32
+    total += T * N * fb                     # thr_flat f64/f32
+    if not x64:
+        total += T * N * 4                  # thr_lo   f32 (double-single)
+    total += T * N * 1                      # dl_flat  bool
+    total += T * N * 4                      # mt_flat  int32
+    if lay["any_cat"]:
+        total += T * N * 1                  # ic_flat  bool
+        total += T * N * max(W, 1) * 1      # cat bitset bool
+    total += T * L * N * 2                  # sig      bf16
+    total += T * L * 4                      # path_len f32
+    total += T * L * fb                     # lv       f64/f32
+    return int(total)
+
+
 class DeviceEnsemble:
     """Stacked ensemble for device prediction; built once per model state
     (callers cache on len(models))."""
 
     def __init__(self, trees: List, num_classes: int):
-        self.k = max(num_classes, 1)
+        lay = ensemble_layout(trees, num_classes)
+        self.k = lay["k"]
         self.num_trees = len(trees)
-        self.ok = True
-        # trees padded to k * pow2(iterations): keeps the per-class
-        # reshape exact and quantizes shapes for executable reuse
-        T = self.k * _next_pow2(max(-(-len(trees) // self.k), 1))
-        N = max(max((t.num_leaves - 1 for t in trees), default=1), 1)
-        L = _next_pow2(N + 1)
-        self.T, self.N, self.L = T, N, L
-        if T * L * N > _MAX_SIG_ELEMS:
-            # O(trees * leaves^2) signature tensor would not fit: keep
-            # the host walk for deep-leaf x many-tree ensembles
-            self.ok = False
-            return
-        if any(t.num_cat > 0 for t in trees) \
-                and T * N * _MAX_CAT_W > _MAX_SIG_ELEMS:
-            # the categorical bitset tensor [T*N, W] has its own budget
-            self.ok = False
+        self.ok = lay["ok"]
+        T, N, L, W = lay["T"], lay["N"], lay["L"], lay["W"]
+        self.T, self.N, self.L, self.W = T, N, L, W
+        if not self.ok:
             return
 
         sf = np.zeros((T, N), np.int64)
@@ -103,20 +158,7 @@ class DeviceEnsemble:
         path_len = np.full((T, L), -1, np.int32)  # -1: no such leaf
         lv = np.zeros((T, L), np.float64)
 
-        any_cat = any(t.num_cat > 0 for t in trees)
-        W = 0
-        if any_cat:
-            mx = 31
-            for t in trees:
-                if t.num_cat > 0:
-                    bits = np.asarray(t.cat_threshold, np.uint32)
-                    nz = np.flatnonzero(bits)
-                    if len(nz):
-                        mx = max(mx, 32 * int(nz[-1]) + 31)
-            W = _next_pow2(mx + 1)
-            if W > _MAX_CAT_W:
-                self.ok = False     # enormous category ids: host path
-                return
+        any_cat = lay["any_cat"]
         cat = np.zeros((T * N, max(W, 1)), bool) if any_cat else None
 
         for ti, t in enumerate(trees):
@@ -175,7 +217,6 @@ class DeviceEnsemble:
         self.sig = jnp.asarray(sig, jnp.bfloat16)          # +-1/0 exact
         self.path_len = jnp.asarray(path_len.astype(np.float32))
         self.lv = jnp.asarray(lv, fdt)
-        self.W = W
 
     def predict_sum(self, X: np.ndarray, num_iteration: int) -> np.ndarray:
         """[k, n] summed raw scores over the first num_iteration*k trees."""
@@ -214,6 +255,26 @@ class DeviceEnsemble:
         return out[:, :n]
 
     # -- serving hooks ----------------------------------------------- #
+    def device_bytes(self) -> int:
+        """HBM bytes held by this ensemble's device arrays (0 when the
+        ensemble is host-only) — the residency manager's accounting
+        unit; equals estimate_device_bytes() for the same trees."""
+        if not self.ok:
+            return 0
+        arrs = (self.sf_flat, self.thr_flat, self.thr_lo, self.dl_flat,
+                self.mt_flat, self.ic_flat, self.cat, self.sig,
+                self.path_len, self.lv)
+        return int(sum(a.nbytes for a in arrs if a is not None))
+
+    def shape_signature(self, num_features: int) -> tuple:
+        """Executable identity for the fleet compile cache: two
+        ensembles with equal signatures hit the SAME `_chunk_scores`
+        executables per row bucket — the jit statics (k, T, N) and every
+        traced array shape/dtype are functions of these values, so equal
+        signatures cannot false-share and unequal ones cannot collide."""
+        return (self.k, self.T, self.N, self.L, self.W,
+                int(num_features), self.x64)
+
     def predict_bucketed(self, X: np.ndarray, num_iteration: int,
                          max_bucket: int = 1 << 20) -> np.ndarray:
         """predict_sum with rows padded to the power-of-two bucket, so

@@ -3,14 +3,18 @@
 A model registry with versioned hot-swap (registry.py), an adaptive
 micro-batcher amortizing the ~100 ms device dispatch floor across
 concurrent requests (batcher.py), an in-process + stdlib-HTTP frontend
-(server.py, CLI task=serve), request-path observability (metrics.py)
-and a small client (client.py).  See docs/Serving.md.
+(server.py, CLI task=serve), a byte-accounted HBM residency manager for
+multi-tenant fleets (fleet.py), request-path observability (metrics.py)
+and a small client (client.py).  See docs/Serving.md and docs/Fleet.md.
 """
 from .admission import (CircuitBreaker, DrainingError,  # noqa: F401
-                        ShedError)
+                        ShedError, TenantQuota)
 from .batcher import (BatcherStoppedError, MicroBatcher,  # noqa: F401
                       QueueFullError, RequestTimeoutError)
 from .client import ServingClient, ServingError  # noqa: F401
+from .fleet import (FleetFaultInjector,  # noqa: F401
+                    HbmResidencyManager, ShapeBucketCache,
+                    publish_fleet_metrics)
 from .metrics import Histogram, ModelStats  # noqa: F401
 from .registry import (ModelEntry, ModelNotFoundError,  # noqa: F401
                        ModelRegistry)
@@ -23,4 +27,6 @@ __all__ = [
     "MicroBatcher", "QueueFullError", "RequestTimeoutError",
     "BatcherStoppedError", "ModelStats", "Histogram",
     "CircuitBreaker", "DrainingError", "ShedError", "ShadowMirror",
+    "TenantQuota", "HbmResidencyManager", "ShapeBucketCache",
+    "FleetFaultInjector", "publish_fleet_metrics",
 ]

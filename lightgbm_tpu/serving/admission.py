@@ -1,6 +1,6 @@
 """Admission control for the serving predict path.
 
-Three protections sit in front of the micro-batcher so overload and
+Four protections sit in front of the micro-batcher so overload and
 device trouble degrade predictably instead of cascading:
 
 - **Load shedding** (ShedError -> HTTP 429 + Retry-After): requests are
@@ -17,11 +17,17 @@ device trouble degrade predictably instead of cascading:
   stops admitting work, finishes every queued and in-flight request
   within ``tpu_serve_drain_timeout_s``, then exits — no request is
   abandoned mid-predict.
+- **Per-tenant quotas** (``TenantQuota`` -> HTTP 429 + Retry-After):
+  with ``tpu_fleet_tenant_qps`` set, each model name gets its own token
+  bucket, so one noisy tenant sheds against its OWN quota instead of
+  starving every other tenant's batcher — the multi-tenant counterpart
+  of the global queue-depth shed.
 """
 from __future__ import annotations
 
 import threading
 import time
+from typing import Dict
 
 
 class ShedError(Exception):
@@ -34,6 +40,56 @@ class ShedError(Exception):
 
 class DrainingError(Exception):
     """The server is draining for shutdown — HTTP 503."""
+
+
+class TenantQuota:
+    """Per-tenant token-bucket admission quota.
+
+    Each tenant (model name) refills at ``qps`` tokens/s up to a
+    ``burst`` ceiling (default 2x qps, floor 1 — a tenant idle for a
+    while may burst briefly, steady state is capped at qps).
+    ``try_admit`` consumes one token and returns None, or returns the
+    seconds until a token refills — the Retry-After hint for the 429.
+    Sheds are counted per tenant so a quota-limited tenant is
+    attributable in /metrics.  Thread-safe; clock injectable for tests.
+    """
+
+    def __init__(self, qps: float, burst: float = 0.0,
+                 clock=time.monotonic):
+        self.qps = max(float(qps), 1e-9)
+        self.burst = float(burst) if burst > 0 else max(2.0 * self.qps, 1.0)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._buckets: Dict[str, list] = {}      # name -> [tokens, last_t]
+        self._sheds: Dict[str, int] = {}
+
+    def try_admit(self, tenant: str):
+        """None = admitted (one token consumed); otherwise the seconds
+        until the tenant's next token — shed with 429 + Retry-After."""
+        now = self._clock()
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                bucket = self._buckets[tenant] = [self.burst, now]
+            tokens, last = bucket
+            tokens = min(self.burst, tokens + (now - last) * self.qps)
+            if tokens >= 1.0:
+                bucket[0] = tokens - 1.0
+                bucket[1] = now
+                return None
+            bucket[0] = tokens
+            bucket[1] = now
+            self._sheds[tenant] = self._sheds.get(tenant, 0) + 1
+            return (1.0 - tokens) / self.qps
+
+    def shed_count(self, tenant: str) -> int:
+        with self._lock:
+            return self._sheds.get(tenant, 0)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"qps": self.qps, "burst": self.burst,
+                    "sheds": dict(self._sheds)}
 
 
 class CircuitBreaker:

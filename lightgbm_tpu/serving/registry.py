@@ -34,12 +34,16 @@ class ModelEntry:
     per-batch device/host dispatch decision."""
 
     def __init__(self, name: str, version: int, booster: Booster,
-                 min_device_work: int, max_bucket: int):
+                 min_device_work: int, max_bucket: int, fleet=None):
         self.name = name
         self.version = version
         self.booster = booster
         self.min_device_work = int(min_device_work)
         self.max_bucket = int(max_bucket)
+        # HbmResidencyManager when the registry is fleet-managed: the
+        # per-batch device/host decision then also asks "is this tenant
+        # device-RESIDENT right now?" (serving/fleet.py)
+        self.fleet = fleet
         self.loaded_at = time.time()
         self.warmed_buckets: List[int] = []
         g = booster._gbdt
@@ -59,10 +63,22 @@ class ModelEntry:
         batches ride the bucket-padded compiled executable; host
         batches walk the trees exactly like Booster.predict on small
         inputs — both bitwise-identical to the corresponding
-        Booster.predict path."""
+        Booster.predict path.
+
+        Fleet-managed entries add a residency gate: a SPILLED tenant is
+        served IMMEDIATELY on the host walk (checkout schedules an async
+        promotion), and a resident dispatch rides the checked-out
+        ensemble explicitly so a concurrent eviction can never trigger a
+        silent unaccounted rebuild through the gbdt cache."""
         g = self.booster._gbdt
         if self.use_device(X.shape[0]):
-            return self.predict_device(X, raw_score=raw_score), True
+            if self.fleet is None:
+                return self.predict_device(X, raw_score=raw_score), True
+            ens = self.fleet.checkout(self.name, self)
+            if ens is not None:
+                return g.predict_bucketed(X, raw_score=raw_score,
+                                          max_bucket=self.max_bucket,
+                                          ensemble=ens), True
         return g.predict(X, raw_score=raw_score, device=False), False
 
     def predict_device(self, X: np.ndarray, raw_score: bool = False):
@@ -85,7 +101,16 @@ class ModelEntry:
         return self.warmed_buckets
 
     def info(self) -> Dict:
-        return {
+        g = self.booster._gbdt
+        if self.fleet is not None:
+            # layout-only eligibility: _device_ensemble() would BUILD
+            # (and cache) device arrays outside the fleet's accounting
+            # for every spilled tenant a /stats scrape touches
+            eligible = predict_ops.estimate_device_bytes(
+                g.models, g.num_tree_per_iteration) is not None
+        else:
+            eligible = g._device_ensemble() is not None
+        out = {
             "name": self.name,
             "version": self.version,
             "num_trees": self.num_trees,
@@ -93,9 +118,11 @@ class ModelEntry:
             "num_class": self.num_class,
             "loaded_at": self.loaded_at,
             "warmed_buckets": list(self.warmed_buckets),
-            "device_eligible": self.booster._gbdt._device_ensemble()
-            is not None,
+            "device_eligible": eligible,
         }
+        if self.fleet is not None:
+            out["residency"] = self.fleet.residency(self.name)
+        return out
 
 
 class ModelRegistry:
@@ -106,8 +133,12 @@ class ModelRegistry:
                  min_device_work: int = predict_ops.MIN_DEVICE_WORK,
                  max_batch_rows: int = 256,
                  warmup_buckets: Optional[List[int]] = None,
-                 profiler: Optional[Profiler] = None):
+                 profiler: Optional[Profiler] = None,
+                 fleet=None):
         self.max_models = max(int(max_models), 1)
+        # HbmResidencyManager (serving/fleet.py) when device residency is
+        # byte-budgeted; None keeps the pre-fleet always-resident behavior
+        self.fleet = fleet
         self.min_device_work = int(min_device_work)
         self.max_batch_rows = int(max_batch_rows)
         # [] / None -> every pow2 bucket the batcher can emit
@@ -156,8 +187,11 @@ class ModelRegistry:
             version = self._versions.get(name, 0) + 1
             self._versions[name] = version
         entry = ModelEntry(name, version, booster,
-                           self.min_device_work, self.max_batch_rows)
-        if warmup:
+                           self.min_device_work, self.max_batch_rows,
+                           fleet=self.fleet)
+        if warmup and self.fleet is None:
+            # fleet-managed entries warm via admit() AFTER install, so
+            # residency accounting only ever tracks the live version
             with self.profiler.phase("serve/model_warmup"):
                 entry.warmup(self.warmup_bucket_list)
         evicted: List[str] = []
@@ -184,6 +218,11 @@ class ModelRegistry:
         for n in evicted:
             log.warning("registry over capacity (%d): evicted %s",
                         self.max_models, n)
+            if self.fleet is not None:
+                self.fleet.release(n)
+        if self.fleet is not None:
+            with self.profiler.phase("serve/model_warmup"):
+                self.fleet.admit(entry, promote=warmup)
         log.info("registry: %s v%d live (%d trees, %d features, "
                  "buckets %s)", name, entry.version, entry.num_trees,
                  entry.num_features, entry.warmed_buckets or "host-only")
@@ -197,11 +236,18 @@ class ModelRegistry:
         """Reinstall the version the last hot-swap demoted, under a NEW
         monotonic version — versions never reuse, so clients watching
         `info()` observe v_n -> v_{n+1} rather than time running
-        backwards.  The demoted booster is still warm (bucket
-        executables live on its device ensemble), so rollback is
+        backwards.  When the demoted booster is still warm (bucket
+        executables live on its device ensemble), rollback is
         install-only: no parse, no compile, and the swap itself is one
         dict assignment under the lock — concurrent predictions either
         see the whole old entry or the whole new one, never a torn mix.
+        When the prior's device buffers were EVICTED in the meantime
+        (fleet spill, cache invalidation), the new entry must not
+        inherit the stale warmed-bucket list — that would advertise a
+        torn entry whose "warm" executables are gone.  Instead it
+        installs host-serving and is transparently re-promoted: the
+        fleet admits it for asynchronous promotion, or (no fleet) it is
+        re-warmed right after install, outside the lock.
         Current and prior swap places, so a bad rollback can itself be
         rolled back.  Raises ModelNotFoundError when there is no prior
         version to return to."""
@@ -213,11 +259,28 @@ class ModelRegistry:
             version = self._versions.get(name, 0) + 1
             self._versions[name] = version
             entry = ModelEntry(name, version, prior.booster,
-                               self.min_device_work, self.max_batch_rows)
-            entry.warmed_buckets = list(prior.warmed_buckets)
+                               self.min_device_work, self.max_batch_rows,
+                               fleet=self.fleet)
+            g = prior.booster._gbdt
+            cache = getattr(g, "_dev_ens_cache", None)
+            cache_key = (len(g.models), getattr(g, "_model_gen", 0))
+            still_warm = (self.fleet is None and cache is not None
+                          and cache[0] == cache_key
+                          and cache[1] is not None)
+            entry.warmed_buckets = (list(prior.warmed_buckets)
+                                    if still_warm else [])
             self._entries[name] = entry
             self._prior[name] = current
             self._last_used[name] = time.time()
+        if self.fleet is not None:
+            # async re-promotion: the rollback stays O(dict assignment),
+            # requests ride the host walk until the build commits
+            self.fleet.admit(entry, promote=False)
+        elif not still_warm and prior.warmed_buckets:
+            # the prior's device buffers were evicted while demoted:
+            # re-promote now (outside the lock) instead of serving a
+            # torn entry that claims warm buckets it does not have
+            entry.warmup(self.warmup_bucket_list)
         log.warning("registry: %s rolled back to v%d (the v%d booster)",
                     name, version, prior.version)
         default_registry().counter(
@@ -248,6 +311,8 @@ class ModelRegistry:
             # keep the version counter: a re-load of the same name must
             # not reuse a version clients may have already seen
         if existed:
+            if self.fleet is not None:
+                self.fleet.release(name)
             log.info("registry: evicted %s", name)
         return existed
 

@@ -34,9 +34,10 @@ from ..obs import default_registry
 from ..obs import tracing as obs_tracing
 from ..utils import log
 from ..utils.profiling import Profiler
-from .admission import CircuitBreaker, DrainingError, ShedError
+from .admission import CircuitBreaker, DrainingError, ShedError, TenantQuota
 from .batcher import (BatcherStoppedError, MicroBatcher, QueueFullError,
                       RequestTimeoutError)
+from .fleet import HbmResidencyManager, publish_fleet_metrics
 from .metrics import ModelStats
 from .registry import ModelEntry, ModelNotFoundError, ModelRegistry
 from .shadow import ShadowMirror
@@ -55,12 +56,20 @@ class Server:
             cfg = Config(dict(config or {}, **overrides))
         self.config = cfg
         self.profiler = Profiler(enabled=True)
+        # fleet residency: with a byte budget set, device memory becomes
+        # an LRU-managed cache over the registry's models (serving/fleet)
+        self.fleet = (HbmResidencyManager.from_config(cfg)
+                      if cfg.tpu_fleet_hbm_budget_mb > 0 else None)
+        self._quota = (TenantQuota(cfg.tpu_fleet_tenant_qps,
+                                   cfg.tpu_fleet_tenant_burst)
+                       if cfg.tpu_fleet_tenant_qps > 0 else None)
         self.registry = ModelRegistry(
             max_models=cfg.serve_max_models,
             min_device_work=cfg.serve_min_device_work,
             max_batch_rows=cfg.serve_max_batch_rows,
             warmup_buckets=cfg.serve_warmup_buckets or None,
-            profiler=self.profiler)
+            profiler=self.profiler,
+            fleet=self.fleet)
         self._batchers: Dict[str, MicroBatcher] = {}
         self._stats: Dict[str, ModelStats] = {}
         self._breakers: Dict[str, CircuitBreaker] = {}
@@ -74,6 +83,8 @@ class Server:
         self.metrics = default_registry()
         obs_adapters.ensure_device_metrics(self.metrics)
         obs_adapters.ensure_comm_metrics(self.metrics)
+        if self.fleet is not None:
+            publish_fleet_metrics(self.metrics, self.fleet)
         # span timeline for the request lifecycle (enqueue -> micro-batch
         # -> device -> respond) when tpu_trace_path is set; flushed on
         # shutdown and harmless to leave armed
@@ -112,6 +123,11 @@ class Server:
                 obs_adapters.publish_model_stats(
                     self.metrics, name, stats,
                     queue_depth_fn=self._batchers[name].queue_depth_rows)
+                obs_adapters.publish_breaker_metrics(
+                    self.metrics, name, self._breakers[name])
+                if self._quota is not None:
+                    obs_adapters.publish_quota_metrics(
+                        self.metrics, name, self._quota)
         return entry
 
     def evict_model(self, name: str) -> bool:
@@ -224,6 +240,17 @@ class Server:
             stats = self._stats.get(name)
         if batcher is None:
             raise ModelNotFoundError(name)
+        if self._quota is not None:
+            # per-tenant quota BEFORE the global queue shed: a noisy
+            # tenant sheds against its own token bucket instead of
+            # filling the shared queue until everyone sheds
+            retry_after = self._quota.try_admit(name)
+            if retry_after is not None:
+                stats.record_shed()
+                raise ShedError(
+                    "tenant %s over its %.1f qps admission quota" % (
+                        name, self._quota.qps),
+                    retry_after_s=retry_after)
         shed_rows = self.config.tpu_serve_shed_queue_rows
         if shed_rows > 0 and (batcher.queue_depth_rows() + X.shape[0]
                               > shed_rows):
@@ -272,6 +299,10 @@ class Server:
                                   breaker=breakers.get(name))
                        for name, s in stats.items()},
             "registry": self.registry.info(),
+            "fleet": (self.fleet.snapshot()
+                      if self.fleet is not None else None),
+            "quota": (self._quota.snapshot()
+                      if self._quota is not None else None),
             "phases": self.profiler.snapshot(),
         }
 
@@ -396,6 +427,8 @@ class Server:
             b.stop()
         for s in shadows:
             s.stop()
+        if self.fleet is not None:
+            self.fleet.stop()
         with self._lock:
             tracing, self._tracing = self._tracing, False
         if tracing:
@@ -460,6 +493,12 @@ def _make_handler(server: Server):
                     self._reply(404, {"error": "no supervisor attached"})
                 else:
                     self._reply(200, sup.snapshot())
+            elif path == "/fleet":
+                if server.fleet is None:
+                    self._reply(404, {"error": "no fleet residency manager "
+                                      "(set tpu_fleet_hbm_budget_mb)"})
+                else:
+                    self._reply(200, server.fleet.snapshot())
             elif path == "/readyz":
                 # readiness: route traffic here?  503 while draining or
                 # model-less so load balancers rotate this replica out

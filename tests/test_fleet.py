@@ -1,0 +1,465 @@
+"""serving/fleet.py: byte-accounted HBM residency for multi-tenant model
+fleets — LRU spill/promote under a budget, shape-bucketed compile-cache
+sharing, fault-injected promotion with graceful degradation, per-tenant
+admission quotas, and the server integration (all on the fast tier)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import default_registry, device as obs_device
+from lightgbm_tpu.ops import predict as predict_ops
+from lightgbm_tpu.resilience.comm import RetryPolicy
+from lightgbm_tpu.serving import (FleetFaultInjector, HbmResidencyManager,
+                                  ModelRegistry, Server, ShapeBucketCache,
+                                  ShedError, TenantQuota)
+from lightgbm_tpu.serving.fleet import RESIDENT, SPILLED
+
+
+def _train(params=None, n=400, nf=8, iters=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, nf)
+    y = 2.0 * X[:, 0] - X[:, 1] + 0.05 * rng.randn(n)
+    base = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+            "min_data_in_leaf": 5}
+    base.update(params or {})
+    bst = lgb.Booster(params=base, train_set=lgb.Dataset(X, label=y))
+    for _ in range(iters):
+        bst.update()
+    bst._gbdt._sync_model()
+    return bst
+
+
+@pytest.fixture(scope="module")
+def model_strs():
+    """Three same-shape models (equal signatures) under different seeds."""
+    return [_train(seed=s).model_to_string() for s in range(3)]
+
+
+@pytest.fixture(scope="module")
+def small_model_str():
+    """A differently-shaped model: different num_leaves -> different
+    padded node/leaf widths -> different shape signature."""
+    return _train({"num_leaves": 4}, iters=4, seed=9).model_to_string()
+
+
+@pytest.fixture(scope="module")
+def est_bytes(model_strs):
+    b = lgb.Booster(model_str=model_strs[0])
+    return predict_ops.estimate_device_bytes(
+        b._gbdt.models, b._gbdt.num_tree_per_iteration)
+
+
+def _wait_for(cond, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+X16 = np.random.RandomState(3).rand(16, 8)
+X64 = np.random.RandomState(4).rand(64, 8)
+
+
+# --------------------------------------------------------------------- #
+# byte accounting
+# --------------------------------------------------------------------- #
+def test_estimate_matches_built_device_bytes(model_strs):
+    """The layout-only estimate must be EXACT: reservations made before
+    the build can never drift from the accounting after it."""
+    g = lgb.Booster(model_str=model_strs[0])._gbdt
+    est = predict_ops.estimate_device_bytes(g.models,
+                                            g.num_tree_per_iteration)
+    ens = g._device_ensemble()
+    assert ens is not None and est == ens.device_bytes() > 0
+
+
+def test_budget_evicts_lru_before_allocation(model_strs, est_bytes):
+    budget = int(est_bytes * 2.5)          # room for two residents
+    fleet = HbmResidencyManager(budget, warmup_buckets=[16])
+    reg = ModelRegistry(max_models=8, min_device_work=1, fleet=fleet)
+    try:
+        reg.load("a", model_str=model_strs[0])
+        reg.load("b", model_str=model_strs[1])
+        assert fleet.state_counts()[RESIDENT] == 2
+        reg.get("b").predict(X64)          # refresh b: a becomes LRU
+        reg.load("c", model_str=model_strs[2])
+        counts = fleet.state_counts()
+        assert counts[RESIDENT] == 2 and counts[SPILLED] == 1
+        assert fleet.residency("a") == SPILLED      # LRU victim
+        assert fleet.residency("c") == RESIDENT
+        assert fleet.evictions >= 1
+        assert fleet.resident_bytes <= budget
+        assert fleet.peak_resident_bytes <= budget  # held at EVERY instant
+    finally:
+        fleet.stop()
+
+
+def test_oversize_model_serves_host_only(model_strs, est_bytes):
+    fleet = HbmResidencyManager(est_bytes // 2, warmup_buckets=[16])
+    reg = ModelRegistry(max_models=8, min_device_work=1, fleet=fleet)
+    try:
+        entry = reg.load("big", model_str=model_strs[0])
+        assert fleet.snapshot()["tenants"]["big"]["host_only"]
+        assert fleet.resident_bytes == 0
+        out, dev = entry.predict(X64)
+        assert dev is False
+        np.testing.assert_array_equal(
+            np.asarray(out), entry.booster._gbdt.predict(X64, device=False))
+    finally:
+        fleet.stop()
+
+
+def test_release_on_registry_evict(model_strs, est_bytes):
+    fleet = HbmResidencyManager(est_bytes * 4, warmup_buckets=[16])
+    reg = ModelRegistry(max_models=8, min_device_work=1, fleet=fleet)
+    try:
+        reg.load("m", model_str=model_strs[0])
+        assert fleet.resident_bytes == est_bytes
+        reg.evict("m")
+        assert fleet.residency("m") is None
+        assert fleet.resident_bytes == 0
+    finally:
+        fleet.stop()
+
+
+# --------------------------------------------------------------------- #
+# spilled tenants: immediate host serve + async promotion
+# --------------------------------------------------------------------- #
+def test_spilled_tenant_serves_immediately_then_promotes(model_strs,
+                                                         est_bytes):
+    budget = int(est_bytes * 1.4)          # exactly one resident
+    fleet = HbmResidencyManager(budget, warmup_buckets=[16])
+    reg = ModelRegistry(max_models=8, min_device_work=1, fleet=fleet)
+    try:
+        reg.load("a", model_str=model_strs[0])
+        reg.load("b", model_str=model_strs[1])   # spills a
+        assert fleet.residency("a") == SPILLED
+        entry = reg.get("a")
+        t0 = time.perf_counter()
+        out, dev = entry.predict(X64)
+        host_ms = (time.perf_counter() - t0) * 1e3
+        assert dev is False                 # served NOW on the host walk
+        assert host_ms < 5000.0
+        np.testing.assert_array_equal(
+            np.asarray(out), entry.booster._gbdt.predict(X64, device=False))
+        # the checkout scheduled an async promotion; b gets spilled
+        assert _wait_for(lambda: fleet.residency("a") == RESIDENT)
+        out2, dev2 = entry.predict(X64)
+        assert dev2 is True
+        np.testing.assert_array_equal(
+            np.asarray(out2), entry.booster._gbdt.predict(X64, device=True))
+        assert fleet.peak_resident_bytes <= budget
+        assert fleet.host_serves >= 1 and fleet.device_hits >= 1
+    finally:
+        fleet.stop()
+
+
+def test_spill_snapshot_roundtrip_and_corruption_heal(model_strs,
+                                                      est_bytes):
+    inj = FleetFaultInjector()
+    fleet = HbmResidencyManager(int(est_bytes * 1.4), warmup_buckets=[16],
+                                injector=inj)
+    reg = ModelRegistry(max_models=8, min_device_work=1, fleet=fleet)
+    try:
+        reg.load("p", model_str=model_strs[0])
+        reg.load("q", model_str=model_strs[1])   # spills p with a snapshot
+        assert fleet.snapshot()["tenants"]["p"]["spilled_snapshot"]
+        inj.corrupt("spill_read")                # next spill read: bad sha
+        entry = reg.get("p")
+        entry.predict(X64)                       # re-promote p
+        assert _wait_for(lambda: fleet.residency("p") == RESIDENT)
+        assert fleet.spill_corruptions == 1      # detected ...
+        out, _ = entry.predict(X64)              # ... and healed: the
+        np.testing.assert_array_equal(           # in-memory trees win
+            np.asarray(out), entry.booster._gbdt.predict(X64, device=True))
+    finally:
+        fleet.stop()
+
+
+# --------------------------------------------------------------------- #
+# promotion faults: retry with backoff, degrade, re-arm
+# --------------------------------------------------------------------- #
+def test_promotion_fault_retries_then_degrades_then_heals(model_strs,
+                                                          est_bytes):
+    inj = FleetFaultInjector()
+    fleet = HbmResidencyManager(est_bytes * 4, warmup_buckets=[16],
+                                injector=inj,
+                                retry=RetryPolicy(retries=1, base_ms=1.0),
+                                degrade_cooldown_s=0.05)
+    reg = ModelRegistry(max_models=8, min_device_work=1, fleet=fleet)
+    try:
+        inj.fail("promote", count=2)             # both attempts fail
+        entry = reg.load("x", model_str=model_strs[0])   # never raises
+        assert fleet.residency("x") == SPILLED
+        assert fleet.promote_retries == 1 and fleet.promote_failures == 1
+        assert fleet.snapshot()["tenants"]["x"]["degraded"]
+        out, dev = entry.predict(X64)            # degraded -> host walk
+        assert dev is False
+        np.testing.assert_array_equal(
+            np.asarray(out), entry.booster._gbdt.predict(X64, device=False))
+        time.sleep(0.1)                          # past the cool-down
+        entry.predict(X64)                       # re-arms promotion
+        assert _wait_for(lambda: fleet.residency("x") == RESIDENT)
+        assert not fleet.snapshot()["tenants"]["x"]["degraded"]
+    finally:
+        fleet.stop()
+
+
+def test_degraded_cooldown_suppresses_promotion_churn(model_strs,
+                                                      est_bytes):
+    inj = FleetFaultInjector()
+    fleet = HbmResidencyManager(est_bytes * 4, warmup_buckets=[16],
+                                injector=inj,
+                                retry=RetryPolicy(retries=0, base_ms=1.0),
+                                degrade_cooldown_s=60.0)
+    reg = ModelRegistry(max_models=8, min_device_work=1, fleet=fleet)
+    try:
+        inj.fail("promote", count=1)
+        entry = reg.load("x", model_str=model_strs[0])
+        assert fleet.promote_failures == 1
+        for _ in range(5):
+            entry.predict(X64)                   # inside the cool-down:
+        assert fleet.promote_failures == 1       # no promotion churn
+    finally:
+        fleet.stop()
+
+
+# --------------------------------------------------------------------- #
+# shape-bucketed compile cache
+# --------------------------------------------------------------------- #
+def test_equal_signatures_share_one_executable(model_strs, small_model_str,
+                                               est_bytes):
+    """Two same-shape tenants must compile ONCE: the second promotion's
+    warmup is a compile-cache hit, observable as zero new jaxpr traces
+    (the lgbm_xla_traces_total feed).  A differently-shaped tenant must
+    NOT false-share: its warmup traces fresh executables."""
+    obs_device.install_compile_listeners()
+    cache = ShapeBucketCache()
+    fleet = HbmResidencyManager(est_bytes * 16, warmup_buckets=[16, 64],
+                                compile_cache=cache)
+    reg = ModelRegistry(max_models=8, min_device_work=1, fleet=fleet)
+    try:
+        reg.load("a", model_str=model_strs[0])
+        hits0 = cache.hits
+        traces0 = obs_device.compile_counts()["traces"]
+        # a replica tenant: same model text -> identical shape signature
+        reg.load("b", model_str=model_strs[0])
+        assert fleet.residency("b") == RESIDENT
+        assert cache.hits >= hits0 + 2           # both buckets shared
+        assert obs_device.compile_counts()["traces"] == traces0  # no retrace
+        # same signature, same bucket -> the jit cache agrees it's one
+        # executable: a device predict on b triggers no new trace either
+        out, dev = reg.get("b").predict(X64)
+        assert dev is True
+        assert obs_device.compile_counts()["traces"] == traces0
+        # different shape: no false sharing — its warmup compiles fresh
+        misses0 = cache.misses
+        reg.load("s", model_str=small_model_str)
+        assert cache.misses > misses0
+        assert obs_device.compile_counts()["traces"] > traces0
+        es = reg.get("s")
+        outs, _ = es.predict(X64)
+        np.testing.assert_array_equal(
+            np.asarray(outs), es.booster._gbdt.predict(X64, device=True))
+    finally:
+        fleet.stop()
+
+
+def test_shape_bucket_cache_counts():
+    c = ShapeBucketCache()
+    sig = (1, 8, 14, 16, 0, 8, True)
+    assert c.check(sig, 16) is False and c.misses == 1
+    c.mark(sig, 16)
+    assert c.check(sig, 16) is True and c.hits == 1
+    assert c.check(sig, 32) is False        # same sig, new bucket
+    assert c.check((2,) + sig[1:], 16) is False   # new sig, same bucket
+    assert len(c) == 1
+    snap = c.snapshot()
+    assert snap == {"entries": 1, "hits": 1, "misses": 3}
+
+
+# --------------------------------------------------------------------- #
+# per-tenant quotas
+# --------------------------------------------------------------------- #
+def test_tenant_quota_token_bucket():
+    clock = [0.0]
+    q = TenantQuota(qps=10.0, burst=2.0, clock=lambda: clock[0])
+    assert q.try_admit("a") is None and q.try_admit("a") is None
+    retry = q.try_admit("a")                 # bucket drained
+    assert retry is not None and 0.0 < retry <= 0.1
+    assert q.shed_count("a") == 1
+    assert q.try_admit("b") is None          # other tenants unaffected
+    clock[0] += 0.1                          # one token refilled
+    assert q.try_admit("a") is None
+    assert q.snapshot()["sheds"] == {"a": 1}
+
+
+def test_quota_burst_defaults():
+    q = TenantQuota(qps=3.0)
+    assert q.burst == 6.0                    # 2x qps
+    assert TenantQuota(qps=0.1).burst == 1.0  # floor
+
+
+# --------------------------------------------------------------------- #
+# server integration
+# --------------------------------------------------------------------- #
+def test_server_fleet_quota_and_metrics(model_strs, est_bytes):
+    srv = Server(verbosity=-1,
+                 serve_min_device_work=1,
+                 serve_max_models=8,
+                 serve_max_batch_rows=64,
+                 serve_warmup_buckets=[16, 64],
+                 tpu_fleet_hbm_budget_mb=(est_bytes * 1.4) / float(1 << 20),
+                 tpu_fleet_tenant_qps=0.5,   # slow refill: no token can
+                 tpu_fleet_tenant_burst=2.0)  # come back mid-test
+    try:
+        assert srv.fleet is not None
+        srv.load_model("a", model_str=model_strs[0])
+        srv.load_model("b", model_str=model_strs[1])   # spills a
+        out = srv.predict(X16, model="b")
+        np.testing.assert_allclose(
+            np.asarray(out).ravel(),
+            np.asarray(srv.registry.get("b").booster.predict(X16)).ravel(),
+            rtol=1e-12, atol=1e-12)
+        # tenant b exhausts its burst of 2 (one token spent above)
+        with pytest.raises(ShedError) as exc:
+            srv.predict(X16, model="b")
+            srv.predict(X16, model="b")
+        assert exc.value.retry_after_s > 0
+        # the OTHER tenant is untouched by b's quota breach
+        out_a = srv.predict(X16, model="a")
+        np.testing.assert_allclose(
+            np.asarray(out_a).ravel(),
+            np.asarray(srv.registry.get("a").booster.predict(X16)).ravel(),
+            rtol=1e-12, atol=1e-12)
+        snap = srv.stats_snapshot()
+        assert snap["fleet"]["budget_bytes"] == int(est_bytes * 1.4)
+        assert snap["quota"]["sheds"].get("b", 0) >= 1
+        assert "residency" in snap["registry"]["a"]
+        text = srv.metrics_text()
+        for fam in ("lgbm_fleet_budget_bytes", "lgbm_fleet_resident_bytes",
+                    "lgbm_fleet_promotions_total",
+                    "lgbm_fleet_evictions_total",
+                    "lgbm_fleet_compile_cache_hits_total",
+                    "lgbm_serve_quota_shed_total",
+                    "lgbm_serve_breaker_state",
+                    "lgbm_serve_breaker_open_total"):
+            assert fam in text, fam
+    finally:
+        srv.shutdown()
+        default_registry().remove(model="a")
+        default_registry().remove(model="b")
+
+
+def test_server_fleet_http_endpoint(model_strs, est_bytes):
+    import json
+    import urllib.request
+    srv = Server(verbosity=-1, serve_min_device_work=1,
+                 serve_warmup_buckets=[16],
+                 tpu_fleet_hbm_budget_mb=(est_bytes * 4) / float(1 << 20))
+    httpd = srv.serve_http(host="127.0.0.1", port=0, block=False)
+    try:
+        srv.load_model("m", model_str=model_strs[0])
+        url = "http://127.0.0.1:%d/fleet" % srv.http_port
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            body = json.loads(resp.read().decode())
+        assert body["budget_bytes"] == est_bytes * 4
+        assert body["tenants"]["m"]["state"] == RESIDENT
+    finally:
+        httpd.shutdown()
+        srv.shutdown()
+        default_registry().remove(model="m")
+
+
+def test_server_without_budget_has_no_fleet(model_strs):
+    srv = Server(verbosity=-1, serve_warmup_buckets=[16])
+    try:
+        assert srv.fleet is None and srv._quota is None
+        srv.load_model("m", model_str=model_strs[0])
+        out = srv.predict(X16, model="m")
+        np.testing.assert_allclose(
+            np.asarray(out).ravel(),
+            np.asarray(srv.registry.get("m").booster.predict(X16)).ravel(),
+            rtol=1e-12, atol=1e-12)
+        assert srv.stats_snapshot()["fleet"] is None
+    finally:
+        srv.shutdown()
+        default_registry().remove(model="m")
+
+
+def test_fleet_telemetry_events(model_strs, est_bytes, tmp_path):
+    from lightgbm_tpu.config import Config
+    import json
+    path = tmp_path / "telemetry.jsonl"
+    cfg = Config({"tpu_telemetry_path": str(path), "verbosity": -1})
+    fleet = HbmResidencyManager(int(est_bytes * 1.4), warmup_buckets=[16],
+                                config=cfg)
+    reg = ModelRegistry(max_models=8, min_device_work=1, fleet=fleet)
+    try:
+        reg.load("a", model_str=model_strs[0])
+        reg.load("b", model_str=model_strs[1])   # spills a
+        reg.evict("b")
+        events = [json.loads(ln) for ln in
+                  path.read_text().strip().splitlines()]
+        whats = [e["what"] for e in events if e.get("event") == "fleet"]
+        for expected in ("admit", "promote", "spill", "release"):
+            assert expected in whats, (expected, whats)
+    finally:
+        fleet.stop()
+
+
+# --------------------------------------------------------------------- #
+# mini tenant storm (the full drill lives in tools/chaos_run.py)
+# --------------------------------------------------------------------- #
+def test_mini_tenant_storm_zero_failures(model_strs, est_bytes):
+    budget = est_bytes * 3
+    srv = Server(verbosity=-1, serve_min_device_work=1,
+                 serve_max_models=16, serve_max_batch_rows=64,
+                 serve_warmup_buckets=[16],
+                 tpu_fleet_hbm_budget_mb=budget / float(1 << 20))
+    inj = FleetFaultInjector()
+    srv.fleet.injector = inj
+    srv.fleet.degrade_cooldown_s = 0.2
+    names = ["t%d" % i for i in range(12)]
+    for i, n in enumerate(names):
+        srv.load_model(n, model_str=model_strs[i % len(model_strs)])
+    failures, preds = [0], [0]
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def hammer(targets):
+        i = 0
+        while not stop.is_set():
+            try:
+                srv.predict(X16, model=targets[i % len(targets)])
+                with lock:
+                    preds[0] += 1
+            except Exception:   # noqa: BLE001 — the storm counts ANY failure
+                with lock:
+                    failures[0] += 1
+            i += 1
+
+    threads = [threading.Thread(target=hammer, args=(names[k::3],),
+                                daemon=True) for k in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.6)
+        inj.fail("promote", count=2)        # kill promotions mid-storm
+        time.sleep(1.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert failures[0] == 0 and preds[0] > 0
+        assert srv.fleet.peak_resident_bytes <= budget
+        assert srv.fleet.evictions > 0
+    finally:
+        stop.set()
+        srv.shutdown()
+        for n in names:
+            default_registry().remove(model=n)

@@ -299,6 +299,99 @@ def test_registry_rollback_under_concurrent_load(booster, booster_v2):
     assert reg.get("m").version == 42   # 2 loads + 40 rollbacks
 
 
+def test_rollback_after_device_cache_eviction(booster, booster_v2):
+    """Rolling back to a prior whose device ensemble was evicted must
+    NOT install a torn entry claiming warm buckets it no longer has:
+    the new entry re-warms, then serves the prior model on the device
+    path with correct outputs."""
+    reg = ModelRegistry(min_device_work=0, max_batch_rows=64,
+                        warmup_buckets=[1, 8])
+    X = np.random.RandomState(11).rand(8, 8)
+    reg.load("m", model_str=booster.model_to_string())
+    reg.load("m", model_str=booster_v2.model_to_string())
+    prior = reg.prior_entry("m")
+    assert prior.warmed_buckets          # v1 was warmed at load time...
+    prior.booster._gbdt._dev_ens_cache = None   # ...then evicted
+    entry = reg.rollback("m")
+    # the stale warm claim was detected: buckets were re-established,
+    # never inherited from the dropped cache
+    assert entry.booster._gbdt._dev_ens_cache is not None
+    out, dev = entry.predict(X)
+    assert dev is True
+    np.testing.assert_array_equal(out,
+                                  booster._gbdt.predict(X, device=True))
+
+
+def test_rollback_races_device_eviction(booster, booster_v2):
+    """An evictor dropping the prior entry's device buffers mid-rollback
+    must never produce a torn serve: every post-rollback prediction is
+    exactly one model's output and never raises."""
+    reg = ModelRegistry(min_device_work=0, max_batch_rows=64,
+                        warmup_buckets=[1, 8])
+    X = np.random.RandomState(13).rand(8, 8)
+    out1 = booster._gbdt.predict(X, device=True)
+    out2 = booster_v2._gbdt.predict(X, device=True)
+    reg.load("m", model_str=booster.model_to_string())
+    reg.load("m", model_str=booster_v2.model_to_string())
+    stop = threading.Event()
+
+    def evictor():
+        while not stop.is_set():
+            prior = reg.prior_entry("m")
+            if prior is not None:
+                prior.booster._gbdt._dev_ens_cache = None
+
+    t = threading.Thread(target=evictor, daemon=True)
+    t.start()
+    try:
+        for _ in range(20):
+            reg.rollback("m")
+            out, _ = reg.get("m").predict(X)
+            assert (np.array_equal(out, out1)
+                    or np.array_equal(out, out2)), "torn output"
+    finally:
+        stop.set()
+        t.join(timeout=10.0)
+
+
+def test_rollback_to_spilled_entry_repromotes_with_fleet(booster,
+                                                         booster_v2):
+    """Under a fleet residency manager, rollback re-admits the prior
+    entry: it serves immediately (host tier if its buffers were
+    spilled) and transparently re-promotes to the device."""
+    from lightgbm_tpu.ops import predict as predict_ops
+    from lightgbm_tpu.serving import HbmResidencyManager
+    g = booster._gbdt
+    g._sync_model()
+    booster_v2._gbdt._sync_model()
+    est = predict_ops.estimate_device_bytes(g.models,
+                                            g.num_tree_per_iteration)
+    fleet = HbmResidencyManager(int(est * 2.5), warmup_buckets=[8])
+    reg = ModelRegistry(min_device_work=0, max_batch_rows=64,
+                        warmup_buckets=[8], fleet=fleet)
+    X = np.random.RandomState(17).rand(8, 8)
+    try:
+        reg.load("m", model_str=booster.model_to_string())
+        reg.load("m", model_str=booster_v2.model_to_string())
+        entry = reg.rollback("m")
+        # correct output IMMEDIATELY, whatever tier serves it
+        out, _ = entry.predict(X)
+        np.testing.assert_array_equal(
+            np.asarray(out), booster._gbdt.predict(X, device=False))
+        # and the async promotion lands it back on the device
+        deadline = time.monotonic() + 10.0
+        while (fleet.residency("m") != "resident"
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert fleet.residency("m") == "resident"
+        out2, dev2 = entry.predict(X)
+        assert dev2 is True
+        np.testing.assert_array_equal(
+            np.asarray(out2), booster._gbdt.predict(X, device=True))
+    finally:
+        fleet.stop()
+
+
 # --------------------------------------------------------------------- #
 # Server: bitwise identity + degradation + HTTP
 # --------------------------------------------------------------------- #

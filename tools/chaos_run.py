@@ -20,6 +20,12 @@ trainer:
     python tools/chaos_run.py --scenario kill_refit   # SIGKILL mid-refit
     python tools/chaos_run.py --scenario bad_promote  # forced rollback
 
+One fleet-residency drill hammers a 64-tenant model fleet through a
+byte budget sized for 8 resident models (serving/fleet.py), killing
+promotions mid-flight:
+
+    python tools/chaos_run.py --scenario tenant_storm
+
 Exit code 0 iff the scenario's expectations held (survivors completed
 at the expected world size with a usable model).  The injury rides the
 LGBM_TPU_CHAOS env hook (kind:orig_rank:round[:secs]) the supervisor's
@@ -85,6 +91,8 @@ SCENARIOS = ("kill_rank", "kill_hub", "slow_rank", "partition",
 # continuous-learning drills (resilience/supervisor.py), dispatched to
 # run_supervisor_scenario instead of the elastic world driver
 SUPERVISOR_SCENARIOS = ("kill_refit", "bad_promote")
+# fleet-residency drill (serving/fleet.py)
+FLEET_SCENARIOS = ("tenant_storm",)
 
 
 def run_scenario(scenario: str, world: int = 3, rounds: int = 8,
@@ -424,10 +432,119 @@ def _run_bad_promote(tmp, base, cfg, train_params, n_rows) -> dict:
             "rollbacks": sup.snapshot()["rollbacks"]}
 
 
+def run_fleet_scenario(scenario: str, tenants: int = 64,
+                       resident_cap: int = 8,
+                       duration_s: float = 6.0) -> dict:
+    """tenant_storm: `tenants` models share an HBM budget sized for
+    `resident_cap` of them, under mixed traffic — a hot subset hammered
+    continuously, the cold tail swept round-robin — while promotion
+    faults are injected mid-storm.  The drill's contract is the fleet's:
+    ZERO failed predictions (cold/degraded tenants ride the host walk,
+    never an error) and the byte accounting NEVER exceeds the budget
+    (asserted on the peak high-water mark, not a sample)."""
+    assert scenario in FLEET_SCENARIOS, scenario
+    import threading
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.ops import predict as predict_ops
+    from lightgbm_tpu.serving import FleetFaultInjector, Server
+
+    train_params = {"objective": "regression", "num_leaves": 15,
+                    "min_data_in_leaf": 5, "verbosity": -1}
+    model_strs = []
+    for seed in range(4):
+        X, y = _drift_data(400, seed=seed)
+        model_strs.append(lgb.train(
+            dict(train_params), lgb.Dataset(X, label=y),
+            num_boost_round=8).model_to_string())
+    probe = lgb.Booster(model_str=model_strs[0])
+    est = predict_ops.estimate_device_bytes(
+        probe._gbdt.models, probe._gbdt.num_tree_per_iteration)
+    budget_bytes = est * resident_cap
+    srv = Server(verbosity=-1,
+                 serve_min_device_work=1,
+                 serve_max_models=tenants + 1,
+                 serve_max_batch_rows=64,
+                 serve_warmup_buckets=[16, 64],
+                 tpu_fleet_hbm_budget_mb=budget_bytes / float(1 << 20))
+    inj = FleetFaultInjector()
+    srv.fleet.injector = inj
+    srv.fleet.degrade_cooldown_s = 0.5
+    names = ["t%02d" % i for i in range(tenants)]
+    for i, name in enumerate(names):
+        srv.load_model(name, model_str=model_strs[i % len(model_strs)])
+    hot = names[:max(resident_cap // 2, 1)]
+    cold = names[len(hot):]
+    Xq, _ = _drift_data(16, seed=99)
+    failures, preds = [0], [0]
+    flock = threading.Lock()
+    stop = threading.Event()
+
+    def hammer(targets, pause_s):
+        i = 0
+        while not stop.is_set():
+            name = targets[i % len(targets)]
+            i += 1
+            try:
+                srv.predict(Xq, model=name)
+                with flock:
+                    preds[0] += 1
+            except Exception:   # noqa: BLE001 — the drill counts ANY failure
+                with flock:
+                    failures[0] += 1
+            if pause_s:
+                time.sleep(pause_s)
+
+    threads = ([threading.Thread(target=hammer, args=(hot, 0.0),
+                                 daemon=True) for _ in range(4)]
+               + [threading.Thread(target=hammer, args=(cold, 0.01),
+                                   daemon=True) for _ in range(2)])
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    # mid-storm: kill the next promotions in flight — the affected
+    # tenants must degrade to the host walk, then heal after cool-down
+    time.sleep(duration_s / 3.0)
+    inj.fail("promote", count=3)
+    time.sleep(duration_s * 2.0 / 3.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    snap = srv.fleet.snapshot()
+    # sampled correctness on a few tenants (device path is f32 on the
+    # fast tier, hence the tolerance)
+    sampled_ok = True
+    for name in (hot[0], cold[0], cold[-1]):
+        entry = srv.registry.get(name)
+        got = np.asarray(srv.predict(Xq, model=name)).ravel()
+        ref = np.asarray(entry.booster.predict(Xq)).ravel()
+        sampled_ok &= bool(np.allclose(got, ref, rtol=1e-4, atol=1e-5))
+    srv.shutdown()
+    ok = (failures[0] == 0 and sampled_ok
+          and snap["peak_resident_bytes"] <= budget_bytes
+          and snap["resident_bytes"] <= budget_bytes
+          and snap["evictions"] > 0
+          and snap["promotions"] >= resident_cap
+          and snap["promote_failures"] + snap["promote_retries"] >= 1)
+    return {
+        "scenario": scenario, "ok": ok,
+        "tenants": tenants, "resident_cap": resident_cap,
+        "budget_bytes": budget_bytes,
+        "predictions": preds[0], "predict_failures": failures[0],
+        "sampled_outputs_match": sampled_ok,
+        "fleet": {k: snap[k] for k in
+                  ("peak_resident_bytes", "resident_bytes", "promotions",
+                   "promote_retries", "promote_failures", "evictions",
+                   "host_serves", "device_hits", "compile_cache")},
+        "total_s": round(time.monotonic() - t0, 3),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--scenario",
-                    choices=SCENARIOS + SUPERVISOR_SCENARIOS,
+                    choices=SCENARIOS + SUPERVISOR_SCENARIOS
+                    + FLEET_SCENARIOS,
                     default="kill_rank")
     ap.add_argument("--world", type=int, default=3)
     ap.add_argument("--rounds", type=int, default=8)
@@ -441,7 +558,13 @@ def main(argv=None) -> int:
         args.rounds = min(args.rounds, 5)
         args.rows = min(args.rows, 180)
         args.chaos_round = min(args.chaos_round, 2)
-    if args.scenario in SUPERVISOR_SCENARIOS:
+    if args.scenario in FLEET_SCENARIOS:
+        summary = run_fleet_scenario(
+            args.scenario,
+            tenants=16 if args.fast else 64,
+            resident_cap=4 if args.fast else 8,
+            duration_s=3.0 if args.fast else 6.0)
+    elif args.scenario in SUPERVISOR_SCENARIOS:
         summary = run_supervisor_scenario(args.scenario,
                                           n_rows=max(args.rows, 400),
                                           join_timeout_s=args.timeout)

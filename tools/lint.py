@@ -85,9 +85,11 @@ def run(argv=None):
                          "jit, locks, config, hygiene, collectives, "
                          "wireproto, donation")
     ap.add_argument("--changed", action="store_true",
-                    help="scan only .py files changed vs HEAD (plus "
-                         "untracked) — same baseline semantics; useful "
-                         "as a fast pre-commit gate")
+                    help="gate only findings in .py files changed vs "
+                         "HEAD (plus untracked); the scan itself covers "
+                         "the full scope so cross-file checkers keep "
+                         "their context — same baseline semantics; "
+                         "useful as a pre-commit gate")
     args = ap.parse_args(argv)
 
     if args.changed:
@@ -114,9 +116,15 @@ def run(argv=None):
             print("tpulint: no changed .py files in scan scope, "
                   "nothing to do")
             return 0
-        args.paths = changed
     findings = analysis.run_suite(root, args.paths or None,
                                   only=args.only)
+    if args.changed:
+        # the suite ran over the FULL scan scope — cross-file checkers
+        # (config readers, call-graph lock/collective lookups) need the
+        # unchanged files as context or they report false positives —
+        # and only findings IN changed files gate the pre-commit run
+        changed_set = set(changed)
+        findings = [f for f in findings if f.path in changed_set]
 
     if args.write_baseline:
         analysis.baseline.save(args.write_baseline, findings)

@@ -347,6 +347,117 @@ def chaos_smoke():
             os.environ["JAX_PLATFORMS"] = prev
 
 
+def _hybrid_bench_worker(rank, world, machines, n_rows, rounds, q):
+    """One HOST of the hybrid_smoke world (spawned process): 2 local
+    CPU devices behind one wire rank.  Reports the timed train wall."""
+    import os
+    import time as _time
+    import traceback
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
+    try:
+        import numpy as np
+
+        import lightgbm_tpu as lgb
+        from lightgbm_tpu.basic import Dataset
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.parallel import collective as coll_mod
+        from lightgbm_tpu.parallel import distributed as dist
+        from lightgbm_tpu.parallel.dist_data import construct_rank_shard
+
+        rng = np.random.RandomState(7)
+        X = rng.rand(n_rows, 28).astype(np.float32)   # Higgs-shaped
+        y = (X[:, 0] + 0.3 * X[:, 1] > 0.65).astype(np.float32)
+        params = {"objective": "binary", "num_leaves": 31, "max_bin": 63,
+                  "min_data_in_leaf": 20, "verbose": -1,
+                  "tree_learner": "data", "num_machines": world,
+                  "machine_rank": rank, "tpu_comm_backend": "hybrid",
+                  "tpu_hybrid_local_devices": 2,
+                  "tpu_tree_engine": "partition"}
+        comm = dist.SocketComm(rank, world, machines, timeout_s=120,
+                               port_offset=0)
+        try:
+            coll_mod.set_process_comm(comm)
+            cfg = Config(dict(params))
+            shard = construct_rank_shard(X, cfg, rank, world, comm,
+                                         label=y)
+
+            def train(r):
+                ds = Dataset(X[shard.dist_row_ids], params=dict(params))
+                ds._binned = shard
+                return lgb.train(dict(params), ds, num_boost_round=r)
+
+            train(1)                          # compile warm-up
+            t0 = _time.monotonic()
+            b = train(rounds)
+            wall = _time.monotonic() - t0
+            g = b._gbdt._grower
+            hybrid_on = (g is not None
+                         and g.collective.backend == "hybrid")
+            q.put((rank, "ok", {"wall_s": wall, "hybrid": hybrid_on}))
+        finally:
+            coll_mod.set_process_comm(None)
+            comm.close()
+    except Exception:  # noqa: BLE001 — report to the parent, don't hang
+        q.put((rank, "fail", traceback.format_exc()[-400:]))
+
+
+def hybrid_smoke():
+    """Hybrid-topology throughput drill (dict in `detail`).
+
+    Spawns 2 localhost HOST processes, each running the inner 2-device
+    mesh with the cross-host leader wire between them
+    (parallel/hybrid.py), and times Higgs-shaped data-parallel training
+    end to end.  Children are pinned to the CPU backend so the drill
+    never competes with the timed TPU runs.  The
+    ``hybrid_mrows_iter_s`` headline feeds the perf ledger
+    (higgs_hybrid_mrows_iter_s).  Never fails the bench: any problem
+    becomes an `error` entry.
+    """
+    import multiprocessing as mp
+    import socket as _socket
+    world, n_rows, rounds = 2, 4096, 4
+    try:
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        machines = ["127.0.0.1:%d" % port] * world
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_hybrid_bench_worker,
+                             args=(r, world, machines, n_rows, rounds, q))
+                 for r in range(world)]
+        for p in procs:
+            p.start()
+        results = {}
+        try:
+            for _ in procs:
+                rank, status, payload = q.get(timeout=600)
+                results[rank] = (status, payload)
+        finally:
+            for p in procs:
+                p.join(timeout=10)
+                if p.is_alive():
+                    p.terminate()
+        bad = {r: p for r, (st, p) in results.items() if st != "ok"}
+        if bad:
+            return {"error": "host(s) %s failed: %s"
+                    % (sorted(bad), list(bad.values())[0])}
+        wall = max(p["wall_s"] for _, p in results.values())
+        return {
+            "hosts": world, "local_devices": 2,
+            "rows": n_rows, "rounds": rounds,
+            "hybrid_active": all(p["hybrid"]
+                                 for _, p in results.values()),
+            "wall_s": round(wall, 3),
+            "hybrid_mrows_iter_s": round(n_rows * rounds / wall / 1e6, 4),
+        }
+    except Exception as e:  # noqa: BLE001 — smoke only, never fatal
+        return {"error": "FAILED: %s" % e}
+
+
 def mesh_smoke(on_tpu):
     """Data-parallel mesh scaling sweep (dict in `detail`).
 
@@ -586,6 +697,7 @@ def main():
             },
             "quality_ok": ok,
             "mesh_scaling": mesh_smoke(on_tpu),
+            "hybrid_smoke": hybrid_smoke(),
             "trace_smoke": trace_smoke(lgb),
             "chaos_smoke": chaos_smoke(),
             "supervisor_smoke": supervisor_smoke(),

@@ -62,6 +62,13 @@ _NOT_COLLECTIVE = re.compile(r"broadcast(_to|ed)")
 #: identifier fragments that make a branch/loop test rank-dependent
 _RANK_EXACT = frozenset({"world", "world_size", "hub", "is_hub",
                          "hub_rank", "leader", "is_leader"})
+#: leader-election names whose dispatch is symmetric BY CONSTRUCTION
+#: inside the Hybrid* collective classes (parallel/hybrid.py): the
+#: "ranks" there are device shards of ONE process, the leader is the
+#: first callback arrival per (op, epoch), and exactly one wire
+#: exchange happens per host either way — followers block on the
+#: leader's published result, so no cross-host rendezvous is skipped
+_LEADER_EXACT = frozenset({"leader", "is_leader"})
 _LOCKISH = re.compile(r"lock|mutex|cond", re.IGNORECASE)
 
 
@@ -203,8 +210,18 @@ class CollectiveSymmetryChecker(Checker):
             for kind, stmt in cs.ctx.branches:
                 if kind in ("if", "else") and isinstance(stmt, ast.If):
                     rank_ifs.setdefault(id(stmt), stmt)
+        hybrid_cls = fi.qualname.split(".", 1)[0].startswith("Hybrid")
         for key, stmt in rank_ifs.items():
-            if not _rank_names(stmt.test):
+            names = _rank_names(stmt.test)
+            if not names:
+                continue
+            if hybrid_cls and names <= _LEADER_EXACT:
+                # HybridAxis/HybridCollective leader dispatch (see
+                # _LEADER_EXACT above): the is_leader branch decides
+                # which LOCAL shard performs the per-host wire exchange,
+                # not whether the exchange happens — symmetric by
+                # construction, never divergent
+                symmetric_ifs.add(key)
                 continue
             body_seq = self._collective_seq(fi, stmt, "if", bearing)
             else_seq = self._collective_seq(fi, stmt, "else", bearing)

@@ -201,13 +201,33 @@ _SCHEMA = [
     ("tpu_comm_backoff_max_ms", float, 2000.0),  # backoff cap
     ("tpu_comm_op_timeout_s", float, 0.0),   # per send/recv cap; 0 = inherit setup timeout
     ("tpu_comm_heartbeat_s", float, 0.0),    # >0 -> rank-liveness probe every N seconds
-    ("tpu_comm_backend", str, "auto"),       # auto|mesh|socket — collective
-    #   backend for the parallel learners (parallel/collective.py):
-    #   `mesh` = in-process shard_map/psum over the local device mesh
-    #   (single controller, histograms never leave HBM); `socket` = the
-    #   cross-host SocketComm wire behind the same Collective interface
-    #   (retry/heartbeat/elastic fencing preserved); `auto` = mesh when
-    #   >1 local device, else serial.  See docs/Distributed.md.
+    ("tpu_comm_backend", str, "auto"),       # auto|mesh|socket|hybrid —
+    #   collective backend for the parallel learners
+    #   (parallel/collective.py): `mesh` = in-process shard_map/psum
+    #   over the local device mesh (single controller, histograms never
+    #   leave HBM); `socket` = the cross-host SocketComm wire behind
+    #   the same Collective interface (retry/heartbeat/elastic fencing
+    #   preserved); `hybrid` = mesh within each host composed with the
+    #   socket wire between per-host leaders (parallel/hybrid.py) —
+    #   host-granular fault domains; `auto` = mesh when >1 local
+    #   device, else serial.  See docs/Distributed.md.
+    ("tpu_hybrid_local_devices", int, 0),    # inner-mesh size per host for
+    #   tpu_comm_backend=hybrid (0 = every visible local device)
+    ("tpu_hybrid_slow_ms", float, 0.0),      # >0 -> straggler detection: a
+    #   host whose leader-phase wait exceeds this is marked *slow* in
+    #   obs/recorder (per-round, before heartbeat conviction would mark
+    #   it dead); 0 disables the timer
+    ("tpu_hybrid_slow_rounds", int, 3),      # consecutive slow rounds before
+    #   the demotion policy fires
+    ("tpu_hybrid_slow_policy", str, "observe"),  # observe|demote — what to do
+    #   after tpu_hybrid_slow_rounds consecutive slow marks: `observe`
+    #   keeps emitting telemetry only; `demote` fences the straggler
+    #   host (it exits the formation exactly like a convicted host and
+    #   the survivors re-form)
+    ("tpu_dist_find_bin", bool, True),       # distributed find-bin: each rank
+    #   samples only its own row shard and bin boundaries are merged via
+    #   one allgather (bitwise-identical to single-rank binning; dense
+    #   inputs only — sparse falls back to full-matrix sampling)
     # --- elasticity parameters (no reference analogue)
     # Elastic distributed training (lightgbm_tpu/resilience/elastic):
     # active liveness protocol, generation-fenced collectives, and
@@ -445,6 +465,12 @@ ALIAS_TABLE: Dict[str, str] = {
     "comm_heartbeat_s": "tpu_comm_heartbeat_s",
     "comm_backend": "tpu_comm_backend",
     "collective_backend": "tpu_comm_backend",
+    "hybrid_local_devices": "tpu_hybrid_local_devices",
+    "hybrid_slow_ms": "tpu_hybrid_slow_ms",
+    "hybrid_slow_rounds": "tpu_hybrid_slow_rounds",
+    "hybrid_slow_policy": "tpu_hybrid_slow_policy",
+    "dist_find_bin": "tpu_dist_find_bin",
+    "distributed_find_bin": "tpu_dist_find_bin",
     "continuous_learning": "tpu_continuous_learning",
     "refit_interval_s": "tpu_refit_interval_s",
     "refit_min_rows": "tpu_refit_min_rows",
@@ -672,9 +698,21 @@ class Config:
         if self.tpu_comm_backoff_ms < 0 or self.tpu_comm_backoff_max_ms < 0:
             log.fatal("tpu_comm_backoff_ms / tpu_comm_backoff_max_ms must "
                       "be >= 0")
-        if self.tpu_comm_backend not in ("auto", "mesh", "socket"):
-            log.fatal("tpu_comm_backend must be auto, mesh or socket, "
-                      "got %r" % self.tpu_comm_backend)
+        if self.tpu_comm_backend not in ("auto", "mesh", "socket", "hybrid"):
+            log.fatal("tpu_comm_backend must be auto, mesh, socket or "
+                      "hybrid, got %r" % self.tpu_comm_backend)
+        if self.tpu_hybrid_local_devices < 0:
+            log.fatal("tpu_hybrid_local_devices must be >= 0, got %d"
+                      % self.tpu_hybrid_local_devices)
+        if self.tpu_hybrid_slow_ms < 0:
+            log.fatal("tpu_hybrid_slow_ms must be >= 0, got %g"
+                      % self.tpu_hybrid_slow_ms)
+        if self.tpu_hybrid_slow_rounds < 1:
+            log.fatal("tpu_hybrid_slow_rounds must be >= 1, got %d"
+                      % self.tpu_hybrid_slow_rounds)
+        if self.tpu_hybrid_slow_policy not in ("observe", "demote"):
+            log.fatal("tpu_hybrid_slow_policy must be observe or demote, "
+                      "got %r" % self.tpu_hybrid_slow_policy)
         if self.tpu_trace_max_events < 1024:
             log.fatal("tpu_trace_max_events must be >= 1024, got %d"
                       % self.tpu_trace_max_events)

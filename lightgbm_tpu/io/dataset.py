@@ -153,6 +153,7 @@ class BinnedDataset:
                   reference: Optional["BinnedDataset"] = None,
                   sample_indices: Optional[np.ndarray] = None,
                   find_bin_comm=None,
+                  sample_override=None,
                   bin_rows: bool = True) -> "BinnedDataset":
         """Build from a raw float matrix.
 
@@ -176,13 +177,13 @@ class BinnedDataset:
                 categorical_features=categorical_features,
                 feature_names=feature_names, reference=reference,
                 sample_indices=sample_indices, find_bin_comm=find_bin_comm,
-                bin_rows=bin_rows)
+                sample_override=sample_override, bin_rows=bin_rows)
 
     @classmethod
     def _construct_impl(cls, X, config, metadata=None,
                         categorical_features=(), feature_names=None,
                         reference=None, sample_indices=None,
-                        find_bin_comm=None,
+                        find_bin_comm=None, sample_override=None,
                         bin_rows: bool = True) -> "BinnedDataset":
         if _issparse(X):
             import scipy.sparse as sp
@@ -218,12 +219,21 @@ class BinnedDataset:
         ds.max_bin = config.max_bin
         cat_set = set(int(c) for c in categorical_features)
         # --- sample rows for bin finding (bin_construct_sample_cnt) -------
-        sample_cnt = min(config.bin_construct_sample_cnt, n)
-        if sample_indices is None:
-            rng = np.random.RandomState(config.data_random_seed)
-            sample_indices = (np.arange(n) if sample_cnt >= n else
-                              np.sort(rng.choice(n, sample_cnt, replace=False)))
-        Xs = X[sample_indices]
+        if sample_override is not None:
+            # distributed ingest pre-assembled the sample from per-rank
+            # row shards (dist_data.exchange_sample_rows): same indices
+            # and values the local extraction below would produce, so
+            # everything downstream is bitwise-identical
+            sample_indices, Xs = sample_override
+            sample_indices = np.asarray(sample_indices)
+        else:
+            sample_cnt = min(config.bin_construct_sample_cnt, n)
+            if sample_indices is None:
+                rng = np.random.RandomState(config.data_random_seed)
+                sample_indices = (np.arange(n) if sample_cnt >= n else
+                                  np.sort(rng.choice(n, sample_cnt,
+                                                     replace=False)))
+            Xs = X[sample_indices]
         if _issparse(Xs):
             Xs = Xs.tocsc()   # column access for find-bin / bundling
 

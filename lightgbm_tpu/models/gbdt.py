@@ -1162,14 +1162,15 @@ class GBDT:
             if eng == "partition" and not grower_ok:
                 log.warning("tpu_tree_engine=partition not applicable to "
                             "this distributed config; using label engine")
-            if backend == "socket" and not grower_ok:
-                log.fatal("the socket collective backend requires the "
+            if backend in ("socket", "hybrid") and not grower_ok:
+                log.fatal("the %s collective backend requires the "
                           "partition engine (f32, max_bin<=256, no forced "
                           "splits/coupled CEGB); this config is not "
-                          "eligible")
-            # the socket backend has no label-engine path, so it implies
-            # the partition engine regardless of tpu_tree_engine
-            want = (eng == "partition" or backend == "socket"
+                          "eligible" % backend)
+            # the socket/hybrid backends have no label-engine path, so
+            # they imply the partition engine regardless of
+            # tpu_tree_engine
+            want = (eng == "partition" or backend in ("socket", "hybrid")
                     or (eng == "auto" and jax.default_backend() == "tpu"))
             partition_on = grower_ok and want
             if partition_on:

@@ -92,6 +92,27 @@ def ensure_elastic_metrics(reg: MetricsRegistry,
     }
 
 
+def ensure_hybrid_metrics(reg: MetricsRegistry,
+                          host: int = 0) -> Dict[str, object]:
+    """Per-host liveness/straggler gauges for the hybrid collective
+    backend (parallel/hybrid.py), labeled by the host's ORIGINAL
+    machine-list rank: ``up`` is 1 while the host is in the current
+    formation and 0 once fenced; ``slow`` counts consecutive rounds the
+    host exceeded the tpu_hybrid_slow_ms leader-phase threshold (0 =
+    keeping pace)."""
+    labels = dict(host=str(host))
+    return {
+        "up": reg.gauge(
+            "lgbm_hybrid_host_up",
+            help="1 while this host is in the current hybrid formation",
+            **labels),
+        "slow": reg.gauge(
+            "lgbm_hybrid_host_slow",
+            help="Consecutive rounds this host exceeded the leader-phase "
+                 "straggler threshold", **labels),
+    }
+
+
 def comm_totals(reg: MetricsRegistry) -> Optional[Dict[str, float]]:
     """Cumulative comm traffic across every rank this process has seen,
     or None when no comm layer ever registered."""

@@ -23,10 +23,16 @@ scalar payloads plus rank/world/fence queries, with two backends:
   backends: ``axis_name`` is either a mesh axis string or a
   ``SocketAxis`` handle.
 
-Backend selection rides ``Config.tpu_comm_backend`` (auto|mesh|socket);
-``make_collective`` resolves it, emits a ``comm_backend`` recorder event
-and falls back socket-ward when the mesh is unavailable (fewer than two
-local devices, or the ``mesh_unavailable`` chaos drill) — see
+A third backend composes the two: ``HybridCollective``
+(parallel/hybrid.py) psums within the host's local mesh and rides the
+socket wire between per-host leaders — the topology docs/Distributed.md
+names, with whole-host fault domains.
+
+Backend selection rides ``Config.tpu_comm_backend``
+(auto|mesh|socket|hybrid); ``make_collective`` resolves it, emits one
+``comm_backend`` recorder event per (requested, resolved-topology)
+change and falls back socket-ward when the mesh is unavailable (fewer
+than two local devices, or the ``mesh_unavailable`` chaos drill) — see
 docs/Distributed.md.
 """
 from __future__ import annotations
@@ -156,6 +162,11 @@ def psum_scatter(x, axis, **kwargs):
 def axis_index(axis):
     """This shard's rank along the collective axis."""
     if isinstance(axis, SocketAxis):
+        # the hybrid axis nests a mesh inside the wire: its shard index
+        # is host-major * local-mesh-minor (HybridAxis.global_index)
+        gi = getattr(axis, "global_index", None)
+        if gi is not None:
+            return gi()
         return jnp.int32(axis.rank)
     return jax.lax.axis_index(axis)
 
@@ -512,12 +523,26 @@ def _mesh_devices_available() -> int:
 
 
 def resolve_backend(config) -> str:
-    """tpu_comm_backend -> concrete backend ('mesh'|'socket'|'none'),
-    given what is actually available in this process."""
+    """tpu_comm_backend -> concrete backend
+    ('hybrid'|'mesh'|'socket'|'none'), given what is actually available
+    in this process."""
     want = getattr(config, "tpu_comm_backend", "auto")
     comm = get_process_comm()
     have_socket = comm is not None and comm.world > 1
     have_mesh = _mesh_devices_available() > 1
+    if want == "hybrid":
+        if have_socket and have_mesh:
+            return "hybrid"
+        if have_socket:
+            log.warning("tpu_comm_backend=hybrid but fewer than two local "
+                        "devices are visible; falling back to the socket "
+                        "backend")
+            return "socket"
+        if have_mesh:
+            log.warning("tpu_comm_backend=hybrid but no cross-host comm is "
+                        "attached to this process; using the mesh backend")
+            return "mesh"
+        return "none"
     if want == "socket":
         if have_socket:
             return "socket"
@@ -541,15 +566,40 @@ def resolve_backend(config) -> str:
     return "mesh" if have_mesh else "none"
 
 
+# one comm_backend recorder event per backend RESOLUTION, not per
+# train() call: re-training on an unchanged topology says nothing new,
+# while an actual change (fallback, re-formation shrinking the world)
+# must stay observable for the chaos drills to assert on
+_comm_event_lock = threading.Lock()
+_last_comm_event: Optional[Tuple[str, str]] = None
+
+
+def _reset_comm_backend_event() -> None:
+    """Test hook: forget the last emitted (requested, topology) key."""
+    global _last_comm_event
+    with _comm_event_lock:
+        _last_comm_event = None
+
+
 def make_collective(config, num_machines: Optional[int] = None,
                     devices=None) -> Optional[Collective]:
-    """Resolve tpu_comm_backend and build the backend, emitting one
-    ``comm_backend`` recorder event (the chaos drill's observable).
-    Returns None when no collective backend is available (serial)."""
+    """Resolve tpu_comm_backend and build the backend, emitting a
+    ``comm_backend`` recorder event tagged requested-vs-resolved on
+    every topology change (the chaos drill's observable).  Returns None
+    when no collective backend is available (serial)."""
     requested = getattr(config, "tpu_comm_backend", "auto")
     backend = resolve_backend(config)
     coll: Optional[Collective] = None
-    if backend == "socket":
+    if backend == "hybrid":
+        from .hybrid import HybridCollective, resolve_local_devices
+        local = resolve_local_devices(config, _mesh_devices_available())
+        if local > 1:
+            coll = HybridCollective(get_process_comm(), local,
+                                    devices=devices)
+        else:
+            backend = "socket"
+            coll = SocketCollective(get_process_comm())
+    elif backend == "socket":
         coll = SocketCollective(get_process_comm())
     elif backend == "mesh":
         if num_machines is None:
@@ -559,7 +609,20 @@ def make_collective(config, num_machines: Optional[int] = None,
             coll = MeshCollective(num_machines, devices=devices)
         else:
             backend = "none"
-    from ..obs.recorder import comm_backend_event
-    comm_backend_event(config, backend, requested=requested,
-                       world=coll.world if coll is not None else 1)
+    if coll is None:
+        topology = "none"
+    elif backend == "hybrid":
+        topology = "hybrid[%dx%d]" % (coll.world, coll.local_world)
+    else:
+        topology = "%s[%d]" % (backend, coll.world)
+    global _last_comm_event
+    with _comm_event_lock:
+        emit = (requested, topology) != _last_comm_event
+        if emit:
+            _last_comm_event = (requested, topology)
+    if emit:
+        from ..obs.recorder import comm_backend_event
+        comm_backend_event(config, backend, requested=requested,
+                           topology=topology,
+                           world=coll.world if coll is not None else 1)
     return coll

@@ -25,7 +25,7 @@ from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
-from ..io.dataset import BinnedDataset
+from ..io.dataset import BinnedDataset, _issparse
 from ..io.metadata import Metadata
 from ..utils import log
 
@@ -86,6 +86,47 @@ def pre_partition_rows(n: int, rank: int, num_machines: int,
     return np.flatnonzero(q_rank[q_of_row] == rank), q_rank
 
 
+def exchange_sample_rows(X: np.ndarray, config, keep: np.ndarray,
+                         rank: int, world: int, allgather):
+    """Distributed find-bin sample assembly: each rank contributes only
+    the sample rows that live on ITS shard, one allgather reassembles
+    the full sample in global-row order.
+
+    Every rank replicates the global sample DRAW (a cheap index
+    computation seeded by data_random_seed — no data touched), then
+    slices X only at the drawn indices it owns.  The pre-partition is
+    exact — each global row lives on exactly one rank — so the
+    reassembled (rows, values) block equals the single-rank extraction
+    ``X[sample_indices]`` bitwise (JSON round-trips float64 exactly),
+    and every mapper derived from it is bitwise-identical to a
+    single-rank load.  Returns (sample_indices, Xs) for
+    ``BinnedDataset.construct(sample_override=...)``.
+    """
+    n, num_raw = X.shape
+    sample_cnt = min(config.bin_construct_sample_cnt, n)
+    rng = np.random.RandomState(config.data_random_seed)
+    sample_indices = (np.arange(n) if sample_cnt >= n else
+                      np.sort(rng.choice(n, sample_cnt, replace=False)))
+    mine = sample_indices[np.isin(sample_indices, keep)]
+    vals = np.asarray(X[mine], np.float64)
+    parts = allgather({"rows": mine.tolist(), "vals": vals.tolist()})
+    rows = np.concatenate(
+        [np.asarray(p["rows"], np.int64) for p in parts]) \
+        if parts else np.empty(0, np.int64)
+    blocks = [np.asarray(p["vals"], np.float64).reshape(len(p["rows"]),
+                                                        num_raw)
+              for p in parts]
+    xs = np.concatenate(blocks) if blocks else np.empty((0, num_raw))
+    order = np.argsort(rows, kind="stable")
+    rows, xs = rows[order], xs[order]
+    if not np.array_equal(rows, sample_indices):
+        log.fatal("distributed find-bin sample reassembly does not cover "
+                  "the global draw (%d of %d rows) — the row partition "
+                  "and the sample draw disagree on seed or world"
+                  % (len(rows), len(sample_indices)))
+    return sample_indices, xs
+
+
 def construct_rank_shard(X: np.ndarray, config, rank: int, world: int,
                          comm: LocalComm,
                          label: Optional[np.ndarray] = None,
@@ -126,10 +167,24 @@ def construct_rank_shard(X: np.ndarray, config, rank: int, world: int,
     # find-bin semantics; with pre_partition the reference accepts
     # shard-local mappers — we keep the exact variant, which is stronger)
     allgather = comm.allgather_fn(rank)
+    # distributed find-bin sampling: assemble the bin-construction
+    # sample from per-rank row shards instead of every rank slicing the
+    # full matrix (dense + pre-partitioned only: sparse find-bin works
+    # on stored entries per column, and without a row partition there
+    # is no shard to sample from)
+    sample_override = None
+    if (pre_partition and world > 1 and not _issparse(X)
+            and bool(getattr(config, "tpu_dist_find_bin", True))):
+        # symmetric: world, pre_partition, sparsity and config are
+        # identical on every rank, so all ranks take the same branch
+        # tpulint: disable-next-line=collective-rank-branch
+        sample_override = exchange_sample_rows(X, config, keep, rank,
+                                               world, allgather)
     mapper_ds = BinnedDataset.construct(
         X, config, metadata=Metadata(n),
         categorical_features=categorical_features,
         find_bin_comm=(rank, world, allgather),
+        sample_override=sample_override,
         bin_rows=not pre_partition)   # mapper-only when re-binning a shard
     if not pre_partition:
         fill_meta(mapper_ds.metadata, keep)

@@ -400,6 +400,9 @@ class SocketComm:
         self._seq = 0
         self._clock_offset_s = 0.0
         self._clock_rtt_s = 0.0
+        # hub-side straggler signal: per-peer blocking-recv seconds from
+        # the most recent allgather (slow_hosts reads it)
+        self._peer_waits: Dict[int, float] = {}
 
     @classmethod
     def from_config(cls, rank: int, world: int, machines: List[str],
@@ -489,6 +492,25 @@ class SocketComm:
         hb = self._heartbeat
         return hb.dead_ranks() if hb is not None else []
 
+    def slow_hosts(self, threshold_s: float) -> List[int]:
+        """Ranks whose last hub-side allgather blocking-recv exceeded
+        ``threshold_s`` — the leader-phase straggler signal for the
+        hybrid backend, where a wire rank IS a whole host.  Original
+        numbering when this comm knows its membership (ElasticComm),
+        else current ranks.  Hub only (spokes see no per-peer waits);
+        attribution is head-of-line: the hub drains peers in rank
+        order, so a slow early peer can mask a slow later one for a
+        round — conviction needs tpu_hybrid_slow_rounds consecutive
+        marks anyway."""
+        if self.rank != 0 or threshold_s <= 0:
+            return []
+        membership = getattr(self, "membership", None)
+        out = []
+        for i, dt in self._peer_waits.items():
+            if dt > threshold_s:
+                out.append(int(membership[i]) if membership else i)
+        return sorted(out)
+
     # -- span-trace correlation ----------------------------------------
     def _publish_trace_identity(self) -> None:
         """Hand the process tracer this rank's comm coordinates: session
@@ -535,11 +557,15 @@ class SocketComm:
         if self.rank == 0:
             out: List[Optional[dict]] = [None] * self.world
             out[0] = payload
+            waits: Dict[int, float] = {}
             for i, conn in enumerate(self._peers, start=1):
                 with _maybe_span(tr, "comm/wait", peer=i, trace_id=cid):
+                    t0 = time.monotonic()
                     got = self._with_retry(
                         "allgather", i, lambda c=conn: self._recv_counted(c))
+                    waits[i] = time.monotonic() - t0
                 out[i] = None if got is _DROPPED else got
+            self._peer_waits = waits
             blob = _encode(out)
             for i, conn in enumerate(self._peers, start=1):
                 with _maybe_span(tr, "comm/send", peer=i, trace_id=cid,
@@ -619,6 +645,13 @@ class ElasticComm(SocketComm):
     formation, an active ping/pong control channel, and poison-frame
     failure propagation.  resilience.elastic.ElasticSupervisor re-forms
     one of these per world incarnation.
+
+    Under the hybrid collective backend (parallel/hybrid.py) a wire
+    rank is a whole HOST: conviction of a host's leader fences every
+    device behind it (the local mesh has no other path to the world),
+    quorum (``min_world``) therefore counts hosts, and ``slow_hosts``
+    surfaces the leader-phase straggler signal rounds before the
+    heartbeat would convict — see docs/Elasticity.md (host fencing).
 
     Formation runs on ONE port per original rank (its machine-list
     entry + port_offset).  The hub is the lowest rank this process
@@ -780,7 +813,7 @@ class ElasticComm(SocketComm):
                     continue
                 conn.settimeout(timeout_s)
                 try:
-                    hello = _recv_msg(conn)
+                    hello, _hg = _recv_formation_msg(conn)
                 except (OSError, ConnectionError, ValueError):
                     conn.close()
                     continue
@@ -844,7 +877,7 @@ class ElasticComm(SocketComm):
                     continue
                 conn.settimeout(timeout_s)
                 try:
-                    hello = _recv_msg(conn)
+                    hello, _hg = _recv_formation_msg(conn)
                 except (OSError, ConnectionError, ValueError):
                     conn.close()
                     continue
@@ -902,8 +935,8 @@ class ElasticComm(SocketComm):
                              "generation": gen, "wall": wall_t0}, gen)
             # the generation is still being negotiated here; the
             # hub's JSON assign payload carries it, formation adopts it
-            # tpulint: disable-next-line=wire-unfenced-recv
-            assign = _recv_msg(conn)
+            # (stray control frames are dropped by kind)
+            assign, _ag = _recv_formation_msg(conn)
         except (OSError, ConnectionError, ValueError) as e:
             conn.close()
             raise ConnectionError(
@@ -920,8 +953,19 @@ class ElasticComm(SocketComm):
             conn.close()
             raise ConnectionError("unexpected formation reply %r"
                                   % assign.get("type"))
+        hub_gen = int(assign["generation"])
+        if hub_gen < gen:
+            # a fenced ex-hub that woke up mid-re-formation still
+            # answers on its old port at its old generation; adopting
+            # its stale world would fork the membership.  Refuse and
+            # keep sweeping at the next supervisor attempt — the hub's
+            # ASSIGN is only authoritative FORWARD in time.
+            conn.close()
+            raise ConnectionError(
+                "stale hub: assign at generation %d but this rank is "
+                "forming generation %d" % (hub_gen, gen))
         membership = [int(r) for r in assign["membership"]]
-        gen = int(assign["generation"])
+        gen = hub_gen
         t1, t2 = float(assign["t1"]), float(assign["t2"])
         clock = (((t1 - wall_t0) + (t2 - wall_t3)) / 2.0,
                  (wall_t3 - wall_t0) - (t2 - t1))
@@ -1203,6 +1247,35 @@ def _recv_msg(sock: socket.socket):
     # payloads by the callers
     # tpulint: disable-next-line=wire-unfenced-recv
     return json.loads(_recv_frame(sock)[0].decode("utf-8"))
+
+
+_FRAME_NAMES = {0: "data", 1: "poison", 2: "ping", 3: "pong"}
+
+
+def _recv_formation_msg(sock: socket.socket,
+                        max_skip: int = 8) -> Tuple[dict, int]:
+    """Formation-window transport: the next DATA frame as JSON, DROPPING
+    stray control frames.  A fenced host's control plane can still be
+    firing at its old generation while the survivors re-form — a stale
+    POISON (or a late PING/PONG) landing on a socket that is about to
+    carry a JOIN or ASSIGN must be skipped, not misparsed as the
+    formation message nor allowed to kill the connection a legitimate
+    frame follows on.  Returns (msg, frame generation) so the caller
+    can fence the payload's generation itself."""
+    for _ in range(max_skip):
+        # generation negotiation happens in the formation payloads; the
+        # kind filter here is what keeps stale control frames out, and
+        # every caller settimeout()s the socket before handing it here
+        # tpulint: disable-next-line=wire-unfenced-recv,wire-blocking-handler
+        blob, _tr, _sp, gen, kind = _recv_frame(sock)
+        if kind != FRAME_DATA:
+            log.warning("formation: dropping stray %s frame from "
+                        "generation %d",
+                        _FRAME_NAMES.get(kind, str(kind)), gen)
+            continue
+        return json.loads(blob.decode("utf-8")), gen
+    raise ConnectionError(
+        "formation: %d consecutive non-data frames" % max_skip)
 
 
 # mapper payloads are a few KB/feature and the hub broadcast carries

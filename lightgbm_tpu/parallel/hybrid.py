@@ -1,0 +1,342 @@
+"""Hybrid multi-host collective: ICI mesh within a host, socket stage
+between per-host leaders.
+
+PAPER.md layer 3 describes a machine-level Network topology over
+per-machine parallel learners; real TPU fleets fail at exactly that
+granularity — a host and its ICI-attached devices live and die
+together.  This backend composes the two existing collectives to match:
+
+- INNER: the grow loop runs ``shard_map``'d over the host's local
+  device mesh (``MeshCollective``), so per-level histograms are first
+  reduced over ICI with ``jax.lax.psum`` — after which every local
+  shard holds the identical host-local partial sum.
+- OUTER: one ordered host callback per collective op hands that
+  partial to the ``ElasticComm``/``SocketComm`` wire, where the
+  per-host LEADERS allreduce across hosts; the result is returned to
+  every local shard — the "broadcast back into the mesh" is the
+  callback's return value, replicated because every shard receives the
+  same array.
+
+Determinism: the reduce happens in two stages (ICI sum, then wire
+sum), but both stages add the SAME integer code sums the quantized
+path psums (ops/quantize: integer-code/psum-before-dequantize), and
+the f32 parity tests ride dyadic gradients — so hybrid training is
+bitwise identical to serial exactly like the mesh and socket backends
+(tests/test_hybrid_collective.py).
+
+Leader election rides the callback stream: under ``shard_map`` the
+ordered callback fires once per LOCAL shard with identical post-psum
+payloads, so the FIRST arrival of each (op, epoch) is the leader and
+performs the wire exchange; followers wait on the condition variable
+and return the leader's cached result.  The ordering invariant this
+relies on: each device issues its callbacks in program order
+(``ordered=True``), and a follower can only reach op B after ITS op A
+returned — which requires op A's wire exchange to have completed — so
+wire exchanges are issued in program order on every host and the
+``exchange_arrays`` tag rendezvous stays symmetric.
+
+Fault domain: the wire is the per-host leader plane, so heartbeat
+conviction of a leader (ElasticComm's liveness monitor) fences the
+WHOLE host — its local mesh has no other connection to the world.
+Re-formation quorum is counted in hosts (the ElasticComm world IS the
+host set), rows re-shard host-first (``pre_partition_rows`` over the
+surviving hosts) then device-second (the grower's local padding /
+shard_map split), and recovery resumes from the newest checkpoint via
+``resume_mode="reshard"`` — see docs/Distributed.md (hybrid topology)
+and docs/Elasticity.md (host fencing).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import log
+from .collective import (AXIS, Collective, MeshCollective, SocketAxis,
+                         SocketCollective, _account, capture_traced)
+
+
+class HybridAxis(SocketAxis):
+    """Traced-collective handle composing mesh psum with the leader wire.
+
+    Subclasses ``SocketAxis`` so the primitive dispatch in
+    parallel/collective.py routes here unchanged; every op performs the
+    ICI stage inline (``jax.lax.psum`` over the local mesh axis) before
+    the ordered callback performs the cross-host stage once per host.
+
+    ``rank``/``world`` are the HOST coordinates (the wire's view); the
+    local mesh size rides ``local_world``.
+    """
+
+    def __init__(self, collective: "HybridCollective"):
+        super().__init__(collective.socket)
+        self.local_world = int(collective.local_world)
+        self.mesh_axis = collective.mesh_axis
+        self._oid = 0                  # trace-time op id (program order)
+        self._cv = threading.Condition()
+        self._counts: Dict[int, int] = {}   # oid -> host-callback arrivals
+        self._epochs: Dict[int, int] = {}   # oid -> last published epoch
+        self._results: Dict[int, np.ndarray] = {}
+        self._wire_wait_s = 0.0        # cumulative leader-phase wire time
+
+    # -- trace-time op identity -----------------------------------------
+    def _next_oid(self) -> int:
+        """Unique id per traced op, assigned in program order at TRACE
+        time (jit traces once, so executions reuse the same ids — the
+        epoch counter below distinguishes successive executions)."""
+        self._oid += 1  # tpulint: ok=lock-shared-write — trace time only
+        return self._oid
+
+    # -- the deduped host callback --------------------------------------
+    def _host_hybrid(self, oid: int, kind: str, op: str, arr, stack: bool):
+        arr = np.asarray(arr)
+        with self._cv:
+            n = self._counts[oid] = self._counts.get(oid, 0) + 1
+            epoch, slot = divmod(n - 1, self.local_world)
+            is_leader = slot == 0
+        if is_leader:
+            out = self._leader_exchange(oid, epoch, kind, op, arr, stack)
+        else:
+            out = self._await_leader(oid, epoch, arr, stack)
+        return out
+
+    def _leader_exchange(self, oid: int, epoch: int, kind: str, op: str,
+                         arr: np.ndarray, stack: bool) -> np.ndarray:
+        """The leader phase: one wire collective per (op, epoch) across
+        the per-host leader ranks.  Failures park on ``failure`` (XLA
+        callbacks cannot raise) and degrade the payload to zeros, for
+        followers too — ``check_failure`` re-raises after the program."""
+        tag = "hybrid:%s:%d:%d" % (kind, oid, epoch)
+        t0 = time.monotonic()
+        try:
+            parts = self._coll.exchange_arrays(tag, arr)
+            if stack:
+                out = np.stack(parts)
+            else:
+                out = parts[0].copy()
+                for p in parts[1:]:
+                    out = np.maximum(out, p) if op == "max" else out + p
+                out = out.astype(arr.dtype, copy=False)
+        except BaseException as exc:  # noqa: BLE001 — park, don't crash XLA
+            with self._cv:
+                if self.failure is None:
+                    self.failure = exc
+            shape = ((self.world,) + arr.shape) if stack else arr.shape
+            out = np.zeros(shape, arr.dtype)
+        dt = time.monotonic() - t0
+        from ..obs import tracing
+        if tracing.get_tracer().enabled:
+            tracing.complete("comm/hybrid_%s" % kind, dt, cat="comm",
+                             tag=tag, nbytes=int(arr.nbytes),
+                             hosts=self.world, local=self.local_world)
+        with self._cv:
+            self._wire_wait_s += dt
+            self._results[oid] = out
+            self._epochs[oid] = epoch
+            self._cv.notify_all()
+        return out
+
+    def _await_leader(self, oid: int, epoch: int, arr: np.ndarray,
+                      stack: bool) -> np.ndarray:
+        """Follower shards block until the leader publishes this epoch's
+        result; a leader that never publishes (wire death mid-exchange)
+        bounds the wait at the comm timeout and degrades to zeros."""
+        deadline = time.monotonic() + max(
+            float(getattr(self._coll.comm, "timeout", 30.0)), 1.0) + 5.0
+        with self._cv:
+            while self._epochs.get(oid, -1) < epoch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(
+                        timeout=min(remaining, 0.25)):
+                    if time.monotonic() >= deadline:
+                        if self.failure is None:
+                            self.failure = RuntimeError(
+                                "hybrid leader callback never published "
+                                "op %d epoch %d" % (oid, epoch))
+                        shape = ((self.world,) + arr.shape) if stack \
+                            else arr.shape
+                        return np.zeros(shape, arr.dtype)
+            return self._results[oid]
+
+    def _wire(self, kind: str, op: str, x, out_shape, stack: bool):
+        oid = self._next_oid()
+        return self._call(partial(self._host_hybrid, oid, kind, op,
+                                  stack=stack), x, out_shape)
+
+    # -- the traced primitives ------------------------------------------
+    def allreduce(self, x, op: str):
+        x = (jax.lax.psum(x, self.mesh_axis) if op == "sum"
+             else jax.lax.pmax(x, self.mesh_axis))
+        _account("hybrid_" + op, x)
+        out = jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return self._wire("allreduce", op, x, out, stack=False)
+
+    def gather(self, x):
+        # local concat over the mesh, then one stacked wire gather: the
+        # leading dim is HOSTS, each carrying its mesh-tiled block, so
+        # flattening yields global host-major/device-minor shard order —
+        # the same order the rows were pre-partitioned in
+        g = jax.lax.all_gather(x, self.mesh_axis, tiled=True)
+        _account("hybrid_gather", g)
+        out = jax.ShapeDtypeStruct((self.world,) + g.shape, g.dtype)
+        return self._wire("gather", "sum", g, out, stack=True)
+
+    def scatter_reduce(self, x, **kwargs):
+        total = self.allreduce(x, "sum")
+        gw = self.world * self.local_world
+        per = total.shape[0] // gw
+        idx = (jnp.int32(self.rank * self.local_world)
+               + jax.lax.axis_index(self.mesh_axis)) * per
+        return jax.lax.dynamic_slice_in_dim(total, idx, per)
+
+    def global_index(self):
+        """This shard's GLOBAL index: host-major over the wire world,
+        device-minor over the local mesh."""
+        return (jnp.int32(self.rank * self.local_world)
+                + jax.lax.axis_index(self.mesh_axis))
+
+
+class HybridCollective(Collective):
+    """``Collective`` over H hosts x D local devices.
+
+    Host-payload semantics match ``SocketCollective`` exactly — the
+    interface's rank/world are the HOST coordinates, so the quantized
+    global-scale agreement, ``row_layout`` and the supervisor's
+    re-shard all work unchanged — while the traced side hands the
+    learners the local mesh plus a ``HybridAxis``.  ``local_world``
+    (D) and ``global_world`` (H*D) expose the two nesting levels.
+    """
+
+    backend = "hybrid"
+
+    def __init__(self, comm, local_devices: int, devices=None):
+        if comm is None or comm.world < 1:
+            raise ValueError("hybrid backend needs an attached cross-host "
+                             "comm (parallel.collective.set_process_comm)")
+        if local_devices < 2:
+            raise ValueError("hybrid backend needs >= 2 local devices for "
+                             "the inner mesh; got %d" % local_devices)
+        self.socket = SocketCollective(comm)
+        self._mesh_coll = MeshCollective(local_devices, devices=devices)
+        self.mesh = self._mesh_coll.mesh
+        self.mesh_axis = AXIS
+        self.local_world = int(local_devices)
+        self._axis: Optional[HybridAxis] = None
+        self._profiles: Dict = {}
+
+    # -- topology --------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return self.socket.rank          # host rank on the leader wire
+
+    @property
+    def world(self) -> int:
+        return self.socket.world         # number of hosts
+
+    @property
+    def hosts(self) -> int:
+        return self.socket.world
+
+    @property
+    def global_world(self) -> int:
+        return self.socket.world * self.local_world
+
+    @property
+    def comm(self):
+        return self.socket.comm
+
+    def axis(self) -> HybridAxis:
+        if self._axis is None:
+            self._axis = HybridAxis(self)
+        return self._axis
+
+    # -- host payloads ride the leader wire ------------------------------
+    def allreduce(self, value, op: str = "sum"):
+        return self.socket.allreduce(value, op)
+
+    def allgather(self, payload) -> List:
+        return self.socket.allgather(payload)
+
+    def exchange_arrays(self, tag: str, arr: np.ndarray) -> List[np.ndarray]:
+        return self.socket.exchange_arrays(tag, arr)
+
+    def row_layout(self, local_rows: int) -> Tuple[int, int]:
+        return self.socket.row_layout(local_rows)
+
+    # -- membership / fencing --------------------------------------------
+    def fence(self) -> int:
+        return self.socket.fence()
+
+    def generation(self) -> int:
+        return self.socket.generation()
+
+    def world_changed(self):
+        return self.socket.world_changed()
+
+    def fenced_ranks(self) -> Tuple[int, ...]:
+        return self.socket.fenced_ranks()
+
+    def close(self) -> None:
+        self.socket.close()
+
+    # -- grower binding ---------------------------------------------------
+    def bind(self, key, fn):
+        """Wrap a jitted shard_mapped grow callable: capture the traced
+        collective profile once (trace time), then on every dispatch
+        block for the program, surface parked wire failures
+        (WorldChangedError keeps the fence intact) and emit the
+        ``comm/hybrid_dispatch`` span + counters."""
+        axis = self.axis()
+
+        def wrapped(*args):
+            prof = self._profiles.get(key)
+            if prof is None:
+                prof = {}
+                with capture_traced(prof):
+                    out = fn(*args)
+                self._profiles[key] = prof
+            else:
+                out = fn(*args)
+            out = jax.block_until_ready(out)
+            axis.check_failure()
+            self._emit(prof, axis)
+            return out
+        return wrapped
+
+    def _emit(self, prof, axis: HybridAxis) -> None:
+        if not prof:
+            return
+        ops = sum(c for c, _ in prof.values())
+        nbytes = sum(b for _, b in prof.values())
+        self._mesh_coll._m_sent.inc(nbytes)
+        self._mesh_coll._m_recv.inc(nbytes)
+        self._mesh_coll._m_rounds.inc(ops)
+        from ..obs import tracing
+        if tracing.get_tracer().enabled:
+            tracing.complete(
+                "comm/hybrid_dispatch", 0.0, cat="comm", nbytes=nbytes,
+                ops=ops, hosts=self.world, local=self.local_world,
+                wire_wait_s=round(axis._wire_wait_s, 6),
+                **{k: dict(count=c, bytes=b) for k, (c, b) in prof.items()})
+
+
+def resolve_local_devices(config, available: Optional[int] = None) -> int:
+    """Inner-mesh size for the hybrid backend: ``tpu_hybrid_local_devices``
+    when positive, else every local device — clamped to what is visible."""
+    if available is None:
+        try:
+            available = jax.device_count()
+        except Exception:  # noqa: BLE001 — no backend at all
+            available = 0
+    want = int(getattr(config, "tpu_hybrid_local_devices", 0))
+    if want <= 0:
+        return available
+    if want > available:
+        log.warning("tpu_hybrid_local_devices=%d > visible devices=%d; "
+                    "clamping", want, available)
+    return min(want, available)

@@ -69,6 +69,19 @@ class ParallelGrower:
         if collective.backend == "mesh":
             self.mesh = collective.mesh
             self._axis = AXIS
+        elif collective.backend == "hybrid":
+            # host-first then device-second: this process holds its
+            # host's row shard, shard_map splits it over the local mesh,
+            # and the HybridAxis composes psum-over-ICI with the leader
+            # wire — rows are pre-partitioned across hosts, so only the
+            # data learner is meaningful (parallel/hybrid.py)
+            if mode != "data":
+                raise ValueError(
+                    "tpu_comm_backend=hybrid supports tree_learner=data "
+                    "only (rows are pre-partitioned across hosts); got %r"
+                    % mode)
+            self.mesh = collective.mesh
+            self._axis = collective.axis()
         else:
             # cross-host: every rank runs the SAME grow program over its
             # local shard, collectives rendezvous on the wire through the
@@ -109,10 +122,11 @@ class ParallelGrower:
         fn = self._cache.get(statics)
         if fn is not None:
             return fn
-        if self.mesh is None:
+        if self.mesh is None or self.collective.backend == "hybrid":
             raise RuntimeError(
-                "the socket collective backend requires the partition "
-                "engine (label-engine collectives are mesh-only)")
+                "the %s collective backend requires the partition "
+                "engine (label-engine collectives are mesh-only)"
+                % self.collective.backend)
         (max_leaves, max_depth, max_bin, hist_impl, rows_per_chunk,
          max_cat_threshold) = statics
         inner = partial(grow_ops.grow_tree_impl,
@@ -164,9 +178,11 @@ class ParallelGrower:
                 from ..resilience.comm import WorldChangedError
                 if isinstance(exc, WorldChangedError):
                     raise          # elastic fence — never degrade past it
-                if self.mesh is None or quantized:
-                    # socket worlds and quantized codes have no label-
-                    # engine equivalent; the driver owns the fallback
+                if (self.mesh is None or quantized
+                        or self.collective.backend == "hybrid"):
+                    # socket/hybrid worlds and quantized codes have no
+                    # label-engine equivalent; the driver owns the
+                    # fallback
                     raise
                 log.warning(
                     "partition engine failed under %s-parallel (%s: %s); "
@@ -228,6 +244,7 @@ class ParallelGrower:
         (max_leaves, max_depth, max_bin, max_cat_threshold, C, cap,
          hist_slots, interpret, quantized) = statics
         d, mode, top_k = self.d, self.mode, self.top_k
+        axis = self._axis      # AXIS for mesh, the HybridAxis for hybrid
         row_shard = mode in ("data", "voting")
 
         def shard_fn(arena, bins_t, g, h, r0, fmask, nb, db, mt, sparams,
@@ -237,7 +254,7 @@ class ParallelGrower:
                 mono, pen, None, None, icat, bnd,
                 max_leaves=max_leaves, max_depth=max_depth,
                 max_bin=max_bin, emit="leaf_ids", full_bag=False,
-                max_cat_threshold=max_cat_threshold, axis_name=AXIS,
+                max_cat_threshold=max_cat_threshold, axis_name=axis,
                 learner=mode, num_machines=d, top_k=top_k,
                 hist_slots=hist_slots, interpret=interpret,
                 quantized=quantized,
@@ -250,9 +267,35 @@ class ParallelGrower:
                     rp, rp, rp,
                     P(), P(), P(), P(), P(), P(), P(), P(), P(), P())
         out_specs = (P(), rp, P(AXIS, None, None), P())
+        jit_kw = {}
+        if not isinstance(axis, str):
+            # hybrid: the ordered io_callbacks inside thread an XLA token
+            # through the entry computation, adding a hidden parameter;
+            # with inferred shardings XLA's spmd-propagation-to-parameters
+            # vector is sized to the USER parameters only and the
+            # mismatch is a fatal CHECK (sharding_propagation.cc) that
+            # aborts the process.  Explicit shardings sidestep the
+            # propagation pass entirely.
+            def _ns(spec):
+                return jax.sharding.NamedSharding(self.mesh, spec)
+            jit_kw = dict(in_shardings=tuple(_ns(s) for s in in_specs),
+                          out_shardings=tuple(_ns(s) for s in out_specs))
         fn = jax.jit(_shard_mapped(shard_fn, self.mesh, in_specs,
                                    out_specs),
-                     donate_argnums=(0,))
+                     donate_argnums=(0,), **jit_kw)
+        if jit_kw:
+            # explicit in_shardings REFUSE already-committed args whose
+            # sharding differs (e.g. a replicated grad plane rebuilt by
+            # an elastic restore); device_put reshards them and is a
+            # no-op when the sharding already matches — the donated
+            # arena passes through untouched on the steady-state path
+            shardings = jit_kw["in_shardings"]
+            jitted = fn
+
+            def fn(*args):
+                args = tuple(a if a is None else jax.device_put(a, s)
+                             for a, s in zip(args, shardings))
+                return jitted(*args)
         fn = self.collective.bind(("partition",) + statics, fn)
         self._pcache[statics] = fn
         return fn
@@ -395,7 +438,11 @@ def make_grower(config, dataset_num_features: int):
                     "is available (one device, no attached comm); using "
                     "serial learner", mode)
         return None
-    d = collective.world
+    # the grower's machine count is the SHARD_MAP width: the local mesh
+    # for hybrid (host payloads ride the leader wire at host rank/world),
+    # the full world otherwise
+    d = (collective.local_world if collective.backend == "hybrid"
+         else collective.world)
     if mode == "feature" and dataset_num_features < d:
         log.warning("feature-parallel with fewer features (%d) than devices "
                     "(%d); padded features will idle some devices",

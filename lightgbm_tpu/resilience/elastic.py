@@ -32,10 +32,23 @@ checkpoint, same re-shard draw, same mappers.  It is NOT byte-identical
 to an undisturbed run — the row partition changed — which is the
 documented degraded-world promise (docs/Elasticity.md).
 
+Under the hybrid collective backend (parallel/hybrid.py) a wire rank
+is a whole HOST, so everything above is host-granular: conviction
+fences the host and every device behind it, ``min_world`` counts
+hosts, and re-sharding is host-first (this loop) then device-second
+(the grower's local shard_map).  The hub additionally watches the
+per-round leader-phase waits (``ElasticComm.slow_hosts``) and marks a
+host *slow* — gauge + ``hybrid_slow`` recorder event — rounds before
+the heartbeat could convict it; ``tpu_hybrid_slow_policy=demote``
+fences a host after ``tpu_hybrid_slow_rounds`` consecutive marks.
+
 Chaos hooks: ``LGBM_TPU_CHAOS=kill:<orig_rank>:<round>`` (also
 ``exit:``/``slow:<orig>:<round>:<secs>``/``partition:<orig>:<round>``)
 makes that rank injure itself at the start of that round of generation
 0 — tools/chaos_run.py drives real multi-process scenarios with it.
+``lag:<orig>:<round>:<secs>`` is the straggler drill: it sleeps in the
+TRAIN thread every round from ``<round>`` on while the control thread
+keeps answering pings, so the host is marked slow but never convicted.
 """
 from __future__ import annotations
 
@@ -177,7 +190,8 @@ class ElasticSupervisor:
                     log.warning("elastic: world re-formed at generation %d "
                                 "(world %d) %.2fs after failure",
                                 generation, comm.world, dt)
-                self._publish(generation, comm.world, reforms, recovery_s)
+                self._publish(generation, comm.world, reforms, recovery_s,
+                              membership=getattr(comm, "membership", None))
                 booster = self._train_once(comm)
                 # final barrier: nobody tears the world down while a
                 # peer is still inside its last sync collective
@@ -327,6 +341,11 @@ class ElasticSupervisor:
         how far ranks can drift past a failure."""
         every = max(1, int(getattr(cfg, "tpu_elastic_sync_every", 1)))
 
+        slow_ms = float(getattr(cfg, "tpu_hybrid_slow_ms", 0.0))
+        slow_rounds = max(1, int(getattr(cfg, "tpu_hybrid_slow_rounds", 3)))
+        slow_policy = str(getattr(cfg, "tpu_hybrid_slow_policy", "observe"))
+        slow_counts: Dict[int, int] = {}
+
         def _callback(env) -> None:
             self._maybe_chaos(comm, env.iteration)
             wc = comm.world_changed()
@@ -337,10 +356,48 @@ class ElasticSupervisor:
             comm.allgather({"type": "sync", "round": env.iteration,
                             "orig": comm.orig_rank,
                             "generation": comm.generation})
+            if slow_ms > 0 and comm.rank == 0:
+                self._check_stragglers(comm, cfg, env.iteration,
+                                       slow_ms / 1e3, slow_rounds,
+                                       slow_policy, slow_counts)
 
         _callback.before_iteration = True
         _callback.order = 1     # right after preemption (0)
         return _callback
+
+    def _check_stragglers(self, comm, cfg, round_idx: int,
+                          threshold_s: float, slow_rounds: int,
+                          policy: str, counts: Dict[int, int]) -> None:
+        """Hub-side straggler policy: a host whose leader-phase wait in
+        the sync allgather exceeded the threshold is marked *slow*
+        (per-host gauge + ``hybrid_slow`` recorder event) — observable
+        rounds before heartbeat conviction could fire, since a straggler
+        still answers pings.  After ``slow_rounds`` CONSECUTIVE marks
+        the ``demote`` policy fences the host exactly like a liveness
+        conviction (the survivors re-form without it); ``observe``
+        keeps emitting telemetry only."""
+        slow = set(comm.slow_hosts(threshold_s))
+        for orig in [o for o in counts if o not in slow]:
+            counts.pop(orig)
+            self._publish_host(orig, up=1, slow=0)
+        for orig in sorted(slow):
+            counts[orig] = counts.get(orig, 0) + 1
+            self._publish_host(orig, up=1, slow=counts[orig])
+            log.warning("elastic: host %d slow at round %d (%d consecutive "
+                        "round(s) over the %.0f ms leader-phase threshold)",
+                        orig, round_idx, counts[orig], threshold_s * 1e3)
+            try:
+                from ..obs.recorder import elastic_event
+                elastic_event(cfg, "hybrid_slow", orig_rank=self.orig_rank,
+                              slow_host=orig, rounds=counts[orig],
+                              round=round_idx, generation=comm.generation,
+                              policy=policy)
+            except Exception as exc:   # noqa: BLE001
+                log.debug("hybrid_slow telemetry event failed: %s", exc)
+            if counts[orig] >= slow_rounds and policy == "demote":
+                log.warning("elastic: demoting straggler host %d after %d "
+                            "consecutive slow round(s)", orig, counts[orig])
+                comm._fence({orig})
 
     # -- chaos ----------------------------------------------------------
     def _maybe_chaos(self, comm, round_idx: int) -> None:
@@ -357,6 +414,17 @@ class ElasticSupervisor:
                         CHAOS_ENV, spec)
             return
         if comm.orig_rank != target or round_idx < at:
+            return
+        if kind == "lag":
+            # straggler injection: delay the TRAIN thread only — the
+            # spoke's control thread keeps answering pings, so the host
+            # is marked *slow* by the hub's leader-phase timer but never
+            # convicted.  Fires every round from `at` on (no
+            # _chaos_fired), unlike the one-shot kinds.
+            secs = float(parts[3]) if len(parts) > 3 else 0.5
+            log.warning("chaos: lag %.2fs on rank %d at round %d",
+                        secs, comm.orig_rank, round_idx)
+            time.sleep(secs)
             return
         self._chaos_fired = True
         log.warning("chaos: %s on rank %d at round %d", kind,
@@ -381,7 +449,7 @@ class ElasticSupervisor:
 
     # -- observability ---------------------------------------------------
     def _publish(self, generation: int, world: int, reforms: int,
-                 recovery_s: float) -> None:
+                 recovery_s: float, membership=None) -> None:
         try:
             from ..obs.adapters import ensure_elastic_metrics
             from ..obs import default_registry
@@ -393,6 +461,23 @@ class ElasticSupervisor:
             m["recovery_s"].set(recovery_s)
         except Exception as exc:   # noqa: BLE001 — metrics never break
             log.debug("elastic metrics publish failed: %s", exc)
+        if membership is not None:
+            # per-host liveness: 1 while in the formation, 0 once
+            # fenced out; a fresh formation also clears the straggler
+            # counters (the slow host may have recovered or left)
+            alive = set(membership)
+            for orig in range(len(self.machines)):
+                self._publish_host(orig, up=int(orig in alive), slow=0)
+
+    def _publish_host(self, orig: int, up: int, slow: int) -> None:
+        try:
+            from ..obs.adapters import ensure_hybrid_metrics
+            from ..obs import default_registry
+            m = ensure_hybrid_metrics(default_registry(), host=orig)
+            m["up"].set(up)
+            m["slow"].set(slow)
+        except Exception as exc:   # noqa: BLE001
+            log.debug("hybrid host gauge publish failed: %s", exc)
 
     def _record(self, cfg, what: str, generation: int, world: int,
                 reforms: int, recovery_s: float, dead=None) -> None:

@@ -1,0 +1,487 @@
+"""Hybrid collective (parallel/hybrid.py): H hosts x D local devices.
+
+Three layers of coverage, mirroring the backend's composition:
+
+- UNIT: HybridAxis traced ops over a real 2-device mesh with a
+  loopback (world=1) wire — the ICI stage, leader dedupe and callback
+  plumbing without sockets; resolve_local_devices clamping; the
+  comm_backend recorder-event dedupe.
+- WIRE: ElasticComm formation hardening — stray POISON/PING frames in
+  the rejoin window are dropped by kind (never parsed as the formation
+  message), and a stale ex-hub's ASSIGN at an older generation is
+  refused (the fencing race of the ISSUE's satellite).
+- E2E (slow): 2 hosts x 2 devices trained over real spawned processes
+  is BITWISE identical to serial, f32 and int8-quantized, and a
+  checkpointed hybrid run resumes bitwise — the core parity
+  acceptance.
+
+The distributed find-bin satellite rides here too:
+exchange_sample_rows must reassemble the exact serial sample draw from
+per-rank shards.
+"""
+import json
+import multiprocessing as mp
+import socket
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.parallel import collective as coll_mod
+from lightgbm_tpu.parallel import distributed as dist
+from lightgbm_tpu.parallel.hybrid import (HybridCollective,
+                                          resolve_local_devices)
+
+N_ROWS = 608
+N_ROUNDS = 4
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# --------------------------------------------------------------------- #
+# UNIT: the axis over a loopback wire
+# --------------------------------------------------------------------- #
+
+class _OneHostComm:
+    """World-of-one wire: allgather echoes the payload back.  Lets the
+    whole HybridAxis path (psum + ordered callback + leader dedupe) run
+    in-process against a real local mesh."""
+
+    rank, world, generation, timeout = 0, 1, 0, 5.0
+
+    def allgather(self, payload):
+        return [payload]
+
+    def close(self):
+        pass
+
+
+def _hybrid_axis_fixture(local=2):
+    coll = HybridCollective(_OneHostComm(), local)
+    return coll, coll.axis()
+
+
+def test_hybrid_axis_ops_single_host():
+    """allreduce/gather/scatter_reduce/global_index over 2 local shards
+    with a loopback wire equal their plain-numpy oracles — and the
+    leader performed exactly one wire exchange per (op, execution)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from lightgbm_tpu.parallel.collective import AXIS, shard_mapped
+
+    coll, axis = _hybrid_axis_fixture()
+    x = np.arange(8, dtype=np.float32)
+
+    def fn(xs):
+        red = axis.allreduce(xs, "sum")
+        mx = axis.allreduce(xs, "max")
+        gat = axis.gather(xs)
+        sc = axis.scatter_reduce(xs)
+        gi = axis.global_index()
+        return red, mx, gat, sc, jnp.asarray([gi])
+
+    f = jax.jit(shard_mapped(
+        fn, coll.mesh, (P(AXIS),),
+        (P(), P(), P(), P(AXIS), P(AXIS))))
+    red, mx, gat, sc, gi = f(jnp.asarray(x))
+    lo, hi = x[:4], x[4:]
+    np.testing.assert_array_equal(np.asarray(red), lo + hi)
+    np.testing.assert_array_equal(np.asarray(mx), np.maximum(lo, hi))
+    # gather: leading dim is hosts (1), flattening restores shard order
+    np.testing.assert_array_equal(np.asarray(gat).reshape(-1), x)
+    # scatter_reduce: each shard holds its contiguous slice of the total
+    np.testing.assert_array_equal(np.asarray(sc), lo + hi)
+    np.testing.assert_array_equal(np.asarray(gi), [0, 1])
+    # host topology is the wire's, devices ride local_world
+    assert (coll.rank, coll.world) == (0, 1)
+    assert (coll.local_world, coll.global_world) == (2, 2)
+
+
+def test_hybrid_axis_parks_wire_failure():
+    """A wire that dies mid-exchange must not crash the XLA callback:
+    the leader parks the failure, followers degrade to zeros, and
+    check_failure re-raises after the program."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from lightgbm_tpu.parallel.collective import AXIS, shard_mapped
+
+    class _DeadComm(_OneHostComm):
+        def allgather(self, payload):
+            raise ConnectionError("wire died")
+
+    coll = HybridCollective(_DeadComm(), 2)
+    axis = coll.axis()
+
+    def fn(xs):
+        return axis.allreduce(xs, "sum")
+
+    f = jax.jit(shard_mapped(fn, coll.mesh, (P(AXIS),), P()))
+    out = jax.block_until_ready(f(jnp.ones(8, jnp.float32)))
+    np.testing.assert_array_equal(np.asarray(out), np.zeros(4))
+    with pytest.raises(ConnectionError, match="wire died"):
+        axis.check_failure()
+
+
+def test_hybrid_collective_rejects_degenerate_topologies():
+    with pytest.raises(ValueError, match="cross-host comm"):
+        HybridCollective(None, 2)
+    with pytest.raises(ValueError, match="local devices"):
+        HybridCollective(_OneHostComm(), 1)
+
+
+def test_resolve_local_devices_clamps():
+    cfg0 = Config({"verbose": -1})
+    assert resolve_local_devices(cfg0, 8) == 8          # 0 -> all visible
+    cfg2 = Config({"tpu_hybrid_local_devices": 2, "verbose": -1})
+    assert resolve_local_devices(cfg2, 8) == 2
+    cfg9 = Config({"tpu_hybrid_local_devices": 9, "verbose": -1})
+    assert resolve_local_devices(cfg9, 4) == 4          # clamped with warning
+
+
+def test_comm_backend_event_once_per_topology(tmp_path):
+    """One recorder event per backend RESOLUTION: retraining on an
+    unchanged topology stays silent, a topology change emits again,
+    each event tagged requested-vs-resolved."""
+    tel = str(tmp_path / "tel.jsonl")
+
+    def events():
+        out = []
+        try:
+            with open(tel) as f:
+                out = [json.loads(line) for line in f]
+        except OSError:
+            pass
+        return [e for e in out if e.get("event") == "comm_backend"]
+
+    coll_mod._reset_comm_backend_event()
+    try:
+        cfg = Config({"tpu_comm_backend": "mesh", "tree_learner": "data",
+                      "num_machines": 2, "tpu_telemetry_path": tel,
+                      "verbose": -1})
+        assert coll_mod.make_collective(cfg, num_machines=2) is not None
+        assert coll_mod.make_collective(cfg, num_machines=2) is not None
+        evs = events()
+        assert len(evs) == 1, evs
+        assert evs[0]["requested"] == "mesh"
+        assert evs[0]["backend"] == "mesh"
+        assert evs[0]["topology"] == "mesh[2]"
+        cfg4 = Config({"tpu_comm_backend": "mesh", "tree_learner": "data",
+                       "num_machines": 4, "tpu_telemetry_path": tel,
+                       "verbose": -1})
+        assert coll_mod.make_collective(cfg4, num_machines=4) is not None
+        evs = events()
+        assert [e["topology"] for e in evs] == ["mesh[2]", "mesh[4]"]
+    finally:
+        coll_mod._reset_comm_backend_event()
+
+
+# --------------------------------------------------------------------- #
+# WIRE: formation-window fencing
+# --------------------------------------------------------------------- #
+
+def test_recv_formation_msg_drops_control_frames():
+    """Stray POISON/PING frames from a fenced host's old generation are
+    dropped by KIND; the next DATA frame is the formation message."""
+    a, b = socket.socketpair()
+    with a, b:
+        b.settimeout(5.0)
+        dist._send_msg(a, {}, generation=1, kind=dist.FRAME_POISON)
+        dist._send_msg(a, {}, generation=1, kind=dist.FRAME_PING)
+        dist._send_msg(a, {"type": "assign", "generation": 4},
+                       generation=4)
+        msg, gen = dist._recv_formation_msg(b)
+        assert msg["type"] == "assign"
+        assert gen == 4
+
+
+def test_recv_formation_msg_bounds_the_skip():
+    a, b = socket.socketpair()
+    with a, b:
+        b.settimeout(5.0)
+        for _ in range(3):
+            dist._send_msg(a, {}, generation=1, kind=dist.FRAME_POISON)
+        with pytest.raises(ConnectionError, match="non-data frames"):
+            dist._recv_formation_msg(b, max_skip=3)
+
+
+def _bare_spoke(machines, orig_rank=1):
+    """An ElasticComm shell with only the attributes _form_spoke reads —
+    formation is exercised against a scripted hub, not a full world."""
+    c = object.__new__(dist.ElasticComm)
+    c.orig_rank = orig_rank
+    c.machines = list(machines)
+    c._alive = {0, 1}
+    c.rejoin_window_s = 1.0
+    return c
+
+
+def _scripted_hub(srv, assign_gen, poison_first, out):
+    """Accept the spoke's JOIN, optionally fire stale control frames,
+    send ASSIGN at ``assign_gen``, then accept the ctrl connection if
+    the spoke proceeds."""
+    try:
+        conn, _ = srv.accept()
+        conn.settimeout(5.0)
+        join = dist._recv_msg(conn)
+        out["join"] = join
+        if poison_first:
+            dist._send_msg(conn, {}, generation=2, kind=dist.FRAME_POISON)
+            dist._send_msg(conn, {}, generation=2, kind=dist.FRAME_PING)
+        now = time.time()
+        dist._send_msg(conn, {"type": "assign", "generation": assign_gen,
+                              "membership": [0, 1], "t1": now, "t2": now,
+                              "session": "ab" * 16}, assign_gen)
+        srv.settimeout(2.0)
+        try:
+            ctrl, _ = srv.accept()
+            ctrl.settimeout(5.0)
+            dist._recv_msg(ctrl)
+            out["ctrl"] = ctrl
+        except OSError:
+            pass
+        out["conn"] = conn
+    except Exception as exc:  # noqa: BLE001 — surfaced by the test body
+        out["error"] = exc
+
+
+def _run_formation(assign_gen, poison_first, spoke_gen=4):
+    port = _free_port()
+    machines = ["127.0.0.1:%d" % port, "127.0.0.1:%d" % _free_port()]
+    srv = socket.socket()  # tpulint: ok=socket-no-with — closed in finally
+    out = {}
+    try:
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(2)
+        t = threading.Thread(target=_scripted_hub,
+                             args=(srv, assign_gen, poison_first, out),
+                             daemon=True)
+        t.start()
+        spoke = _bare_spoke(machines)
+        result = spoke._form_spoke(spoke_gen, timeout_s=5.0, port_offset=0)
+        t.join(timeout=5.0)
+        return result, out
+    finally:
+        for k in ("conn", "ctrl"):
+            if k in out:
+                out[k].close()
+        srv.close()
+
+
+def test_form_spoke_survives_stale_poison_in_rejoin_window():
+    """The fencing race: a fenced ex-member's POISON lands on the
+    formation socket just before the hub's ASSIGN.  The frames must be
+    dropped — the spoke still adopts the legitimate ASSIGN and opens
+    its control channel."""
+    result, hub = _run_formation(assign_gen=4, poison_first=True)
+    assert "error" not in hub, hub.get("error")
+    assert hub["join"]["type"] == "join"
+    assert result["generation"] == 4
+    assert result["membership"] == [0, 1]
+    assert "ctrl" in hub, "spoke never opened its control channel"
+    result["data"].close()
+    result["ctrl"].close()
+
+
+def test_form_spoke_rejects_stale_generation_assign():
+    """A fenced ex-hub that wakes mid-re-formation still answers on its
+    old port at its old generation; adopting its ASSIGN would fork the
+    membership.  The spoke must refuse and keep sweeping."""
+    with pytest.raises(ConnectionError, match="stale hub"):
+        _run_formation(assign_gen=3, poison_first=False, spoke_gen=4)
+
+
+# --------------------------------------------------------------------- #
+# Distributed find-bin sampling
+# --------------------------------------------------------------------- #
+
+def test_exchange_sample_rows_matches_serial_draw():
+    """Each rank contributes only its shard's sample rows; one
+    allgather reassembles the EXACT serial draw — indices and float64
+    values bitwise."""
+    from lightgbm_tpu.parallel.dist_data import (LocalComm,
+                                                 exchange_sample_rows,
+                                                 pre_partition_rows)
+    world = 3
+    rng = np.random.RandomState(0)
+    X = rng.randn(500, 6)
+    cfg = Config({"bin_construct_sample_cnt": 200, "data_random_seed": 9,
+                  "verbose": -1})
+    # serial oracle: the draw a single rank makes over the full data
+    oracle_rng = np.random.RandomState(9)
+    oracle_idx = np.sort(oracle_rng.choice(500, 200, replace=False))
+    comm = LocalComm(world)
+    keeps = [pre_partition_rows(500, r, world, seed=9)[0]
+             for r in range(world)]
+
+    def one_rank(rank):
+        return exchange_sample_rows(X, cfg, keeps[rank], rank, world,
+                                    comm.allgather_fn(rank))
+
+    with ThreadPoolExecutor(max_workers=world) as ex:
+        results = list(ex.map(one_rank, range(world)))
+    for idx, xs in results:
+        np.testing.assert_array_equal(idx, oracle_idx)
+        np.testing.assert_array_equal(xs, X[oracle_idx])
+
+
+# --------------------------------------------------------------------- #
+# E2E: 2 hosts x 2 devices, bitwise vs serial
+# --------------------------------------------------------------------- #
+
+def _make_data(n=N_ROWS, f=8, seed=5):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, f).astype(np.float32)
+    # dyadic labels: every partial sum is exact in f32, so the reduction
+    # order (ICI psum, wire sequential add, serial sum) cannot move bits
+    y = np.clip(np.round(rng.randn(n) * 8) / 16, -2.0, 2.0)
+    return X, y.astype(np.float32)
+
+
+def _dyadic_fobj(preds, dataset):
+    lab = np.asarray(dataset.get_label(), np.float32)
+    return lab, 0.5 + np.abs(lab) / 2
+
+
+def _params(quantized):
+    p = {"num_leaves": 15, "learning_rate": 0.1, "verbose": -1,
+         "min_data_in_leaf": 5, "seed": 7, "max_bin": 63,
+         "tpu_tree_engine": "partition"}
+    if quantized:
+        p["tpu_quantized_grad"] = True
+    return p
+
+
+def _train_serial(X, y, quantized, rounds=N_ROUNDS):
+    params = dict(_params(quantized), tree_learner="serial")
+    b = lgb.train(params, lgb.Dataset(X, label=y),
+                  num_boost_round=rounds, fobj=_dyadic_fobj)
+    return b.model_to_string()
+
+
+def _hybrid_worker(rank, world, machines, X, y, quantized, resume, q):
+    """One HOST of the hybrid world (spawned process; module-level).
+    The inherited XLA_FLAGS (conftest) provides 8 CPU devices; the
+    hybrid backend takes 2 of them for the inner mesh.  With
+    ``resume``, also run checkpoint-then-resume and assert bitwise."""
+    import os
+    import traceback
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        from lightgbm_tpu.basic import Dataset
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.parallel import collective as cm
+        from lightgbm_tpu.parallel import distributed as dst
+        from lightgbm_tpu.parallel.dist_data import construct_rank_shard
+
+        comm = dst.SocketComm(rank, world, machines, timeout_s=60,
+                              port_offset=0)
+        try:
+            cm.set_process_comm(comm)
+            params = dict(_params(quantized), tree_learner="data",
+                          num_machines=world, machine_rank=rank,
+                          tpu_comm_backend="hybrid",
+                          tpu_hybrid_local_devices=2)
+            cfg = Config(dict(params))
+            shard = construct_rank_shard(X, cfg, rank, world, comm,
+                                         label=y, pre_partition=True)
+
+            def train(extra=None, rounds=N_ROUNDS, **kw):
+                ds = Dataset(X[shard.dist_row_ids], params=dict(params))
+                ds._binned = shard
+                b = lgb.train(dict(params, **(extra or {})), ds,
+                              num_boost_round=rounds, fobj=_dyadic_fobj,
+                              **kw)
+                g = b._gbdt._grower
+                assert g is not None and g.collective.backend == "hybrid"
+                assert g.collective.local_world == 2
+                if quantized:
+                    assert b._gbdt._quantized, "quantized path off"
+                return b
+
+            full = train()
+            texts = {"full": full.model_to_string()}
+            if resume:
+                root = os.path.join(resume, "ckpts")
+                train(extra={"tpu_checkpoint_path": root,
+                             "tpu_checkpoint_interval": 2}, rounds=2)
+                # reshard mode is the hybrid recovery path: rank 0 owns
+                # the shared checkpoint dir, every host restores the
+                # shard-independent state and rebuilds its own score
+                # plane — bitwise because the topology did not change
+                resumed = train(rounds=N_ROUNDS, resume_from=root,
+                                resume_mode="reshard")
+                texts["resumed"] = resumed.model_to_string()
+            q.put((rank, "ok", texts))
+        finally:
+            cm.set_process_comm(None)
+            comm.close()
+    except Exception:  # noqa: BLE001 — report to the parent, don't hang
+        q.put((rank, "fail", traceback.format_exc()))
+
+
+def _train_hybrid(X, y, quantized, world=2, resume=None):
+    port = _free_port()
+    machines = ["127.0.0.1:%d" % port] * world
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_hybrid_worker,
+                         args=(r, world, machines, X, y, quantized,
+                               resume, q))
+             for r in range(world)]
+    for p in procs:
+        p.start()
+    try:
+        results = [q.get(timeout=600) for _ in procs]
+        for p in procs:
+            p.join(timeout=30)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+    texts = {}
+    for rank, status, payload in results:
+        assert status == "ok", "host %d failed:\n%s" % (rank, payload)
+        texts[rank] = payload
+    # cross-host consistency before any serial comparison
+    assert texts[0]["full"] == texts[1]["full"]
+    return texts
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("quantized", [False, True],
+                         ids=["f32", "quantized"])
+def test_hybrid_two_hosts_bitwise_vs_serial(quantized):
+    """2 hosts x 2 local devices trains BITWISE identically to serial —
+    the ISSUE's parity acceptance: integer-code sums reduce over ICI
+    first, then over the leader wire, before any dequantize."""
+    X, y = _make_data()
+    serial = _train_serial(X, y, quantized)
+    hybrid = _train_hybrid(X, y, quantized)
+    assert hybrid[0]["full"] == serial, \
+        "hybrid 2x2 diverged from serial"
+
+
+@pytest.mark.slow
+def test_hybrid_checkpoint_resume_bitwise(tmp_path):
+    """A hybrid run checkpointed at round 2 and resumed to completion is
+    bitwise identical to the uninterrupted hybrid run — the determinism
+    half of mesh-granular recovery (the whole-host death half lives in
+    tools/chaos_run.py --scenario kill_host)."""
+    X, y = _make_data()
+    texts = _train_hybrid(X, y, quantized=False, resume=str(tmp_path))
+    for rank, t in texts.items():
+        assert t["resumed"] == t["full"], \
+            "host %d: resumed model diverged from uninterrupted run" % rank

@@ -268,6 +268,41 @@ def test_seeded_read_after_donate_is_caught(tmp_path):
                for f in hits)
 
 
+def test_hybrid_leader_dispatch_is_exempt(tmp_path):
+    """The is_leader branch inside Hybrid* classes is symmetric by
+    construction (one wire exchange per host either way) — exempt; the
+    IDENTICAL pattern in any other class still fires."""
+    body = ("""class %s:
+    def __init__(self):
+        self.is_leader = False
+
+    def op(self, arr):
+        if self.is_leader:
+            out = self.allgather_rows(arr)
+        else:
+            out = self.await_leader(arr)
+        return out
+
+    def allgather_rows(self, arr):
+        return [arr]
+
+    def await_leader(self, arr):
+        return arr
+""")
+    hyb = tmp_path / "hyb"
+    other = tmp_path / "other"
+    for d, cls in ((hyb, "HybridAxisProbe"), (other, "SocketAxisProbe")):
+        d.mkdir()
+        (d / "probe.py").write_text(body % cls)
+    assert not [f for f in ana.run_suite(str(hyb), ["probe.py"],
+                                         only=["collectives"])
+                if f.check.startswith("collective-")]
+    hits = [f for f in ana.run_suite(str(other), ["probe.py"],
+                                     only=["collectives"])
+            if f.check == "collective-rank-branch"]
+    assert hits, "leader branch outside Hybrid* must still fire"
+
+
 # -- config drift ---------------------------------------------------------
 
 def test_config_drift_fixture_project():

@@ -26,6 +26,19 @@ promotions mid-flight:
 
     python tools/chaos_run.py --scenario tenant_storm
 
+Two hybrid-topology drills run a multi-host world where every host
+process carries its own local device mesh (parallel/hybrid.py) — the
+fault domain is the whole host, not a single device:
+
+    python tools/chaos_run.py --scenario kill_host   # SIGKILL one mesh's host
+    python tools/chaos_run.py --scenario slow_host   # leader lag: slow, not dead
+
+kill_host requires the surviving hosts to re-form, resume from the
+newest checkpoint and finish with bitwise-identical models on every
+survivor.  slow_host delays one host's leader phase every round; the
+hub must mark it *slow* (a hybrid_slow telemetry event) without ever
+convicting it — all hosts finish at full world, models identical.
+
 Exit code 0 iff the scenario's expectations held (survivors completed
 at the expected world size with a usable model).  The injury rides the
 LGBM_TPU_CHAOS env hook (kind:orig_rank:round[:secs]) the supervisor's
@@ -88,6 +101,9 @@ def _worker(orig_rank, machines, params, n_rows, rounds, q):
 
 SCENARIOS = ("kill_rank", "kill_hub", "slow_rank", "partition",
              "mesh_unavailable", "none")
+# hybrid-topology drills (parallel/hybrid.py): hosts × local devices,
+# dispatched to run_hybrid_scenario
+HYBRID_SCENARIOS = ("kill_host", "slow_host")
 # continuous-learning drills (resilience/supervisor.py), dispatched to
 # run_supervisor_scenario instead of the elastic world driver
 SUPERVISOR_SCENARIOS = ("kill_refit", "bad_promote")
@@ -209,6 +225,170 @@ def run_scenario(scenario: str, world: int = 3, rounds: int = 8,
         "recovery_s": recovery,
         "total_s": round(total_s, 3),
         "comm_backend_events": backend_events,
+        "results": results,
+    }
+
+
+def _hybrid_worker(orig_rank, machines, params, n_rows, rounds, local, q):
+    """One HOST's process in a hybrid world: force `local` CPU devices
+    so this process carries a real local mesh, then run the elastic
+    supervisor with the hybrid backend.  Reports a model digest so the
+    driver can assert bitwise agreement across hosts."""
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=%d" % local)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from lightgbm_tpu.resilience.elastic import (ElasticAborted,
+                                                 ElasticFenced,
+                                                 ElasticSupervisor)
+    X, y = _data(n_rows)
+    sup = ElasticSupervisor(dict(params), X, y, orig_rank=orig_rank,
+                            machines=machines, num_boost_round=rounds,
+                            port_offset=0, timeout_s=30.0)
+    try:
+        r = sup.run()
+        import hashlib
+        digest = hashlib.sha256(
+            r.booster.model_to_string().encode("utf-8")).hexdigest()[:16]
+        q.put((orig_rank, {
+            "outcome": "complete", "rank": r.rank, "world": r.world,
+            "generation": r.generation, "reforms": r.reforms,
+            "dead_ranks": r.dead_ranks,
+            "recovery_s": round(r.recovery_s, 3),
+            "num_trees": r.booster.num_trees(),
+            "model_digest": digest,
+        }))
+    except ElasticFenced as e:
+        q.put((orig_rank, {"outcome": "fenced", "error": str(e)}))
+    except ElasticAborted as e:
+        q.put((orig_rank, {"outcome": "aborted", "error": str(e)}))
+
+
+def run_hybrid_scenario(scenario: str, hosts: int = 3, local: int = 2,
+                        rounds: int = 8, n_rows: int = 240,
+                        chaos_round: int = 3,
+                        join_timeout_s: float = 180.0) -> dict:
+    """Hybrid drills: `hosts` processes, each a whole local mesh of
+    `local` devices, composed by the hybrid collective.
+
+    kill_host: SIGKILL one host mid-round.  The whole mesh behind that
+    host leaves as one fault domain; survivors must re-form at
+    hosts-1, resume from the newest checkpoint and finish with
+    bitwise-identical models (same model digest on every survivor).
+
+    slow_host: delay one host's leader phase every round (the `lag`
+    chaos kind sleeps only in the train thread, so heartbeats keep
+    flowing).  The hub must mark the host slow (hybrid_slow telemetry
+    event, policy=observe) WITHOUT convicting it: every host finishes
+    at full world with identical models and zero re-forms."""
+    assert scenario in HYBRID_SCENARIOS, scenario
+    victim = hosts - 1
+    tmp = tempfile.mkdtemp(prefix="lgbm_chaos_hyb_")
+    telemetry = os.path.join(tmp, "telemetry.jsonl")
+    machines = ",".join("127.0.0.1:%d" % _free_port() for _ in range(hosts))
+    params = {
+        "objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+        "verbosity": -1,
+        # boost_from_average is computed from rank-LOCAL labels (no
+        # global sync yet — see ROADMAP), so it is the one per-rank
+        # divergence; off, the collectively-built trees must be
+        # identical on every host and the drill asserts ONE digest
+        "boost_from_average": False,
+        "num_machines": hosts, "machines": machines,
+        "tree_learner": "data", "pre_partition": True,
+        "tpu_comm_backend": "hybrid", "tpu_hybrid_local_devices": local,
+        "tpu_elastic": True,
+        "tpu_elastic_heartbeat_ms": 100.0, "tpu_elastic_suspect_ms": 500.0,
+        "tpu_elastic_rejoin_s": 1.0,
+        "tpu_elastic_min_world": max(1, min(2, hosts - 1)),
+        "tpu_checkpoint_path": os.path.join(tmp, "ckpts"),
+        "tpu_checkpoint_interval": 1,
+        "tpu_telemetry_path": telemetry,
+    }
+    if scenario == "slow_host":
+        params.update({
+            "tpu_hybrid_slow_ms": 50.0,
+            "tpu_hybrid_slow_rounds": 2,
+            "tpu_hybrid_slow_policy": "observe",
+        })
+        env_chaos = "lag:%d:%d:%.1f" % (victim, chaos_round, 0.4)
+        expect_world = hosts
+    else:
+        env_chaos = "kill:%d:%d" % (victim, chaos_round)
+        expect_world = hosts - 1
+    os.environ["LGBM_TPU_CHAOS"] = env_chaos
+    try:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        mlist = machines.split(",")
+        procs = [ctx.Process(target=_hybrid_worker,
+                             args=(r, mlist, params, n_rows, rounds,
+                                   local, q))
+                 for r in range(hosts)]
+        t0 = time.monotonic()
+        for p in procs:
+            p.start()
+        results = {}
+        deadline = time.monotonic() + join_timeout_s
+        want = expect_world
+        while len(results) < want and time.monotonic() < deadline:
+            try:
+                rank, out = q.get(timeout=1.0)
+                results[rank] = out
+            except Exception:   # noqa: BLE001 — queue.Empty
+                if not any(p.is_alive() for p in procs):
+                    break
+        total_s = time.monotonic() - t0
+        for p in procs:
+            p.join(timeout=5.0)
+            if p.is_alive():
+                p.terminate()
+    finally:
+        os.environ.pop("LGBM_TPU_CHAOS", None)
+    completed = {r: o for r, o in results.items()
+                 if o.get("outcome") == "complete"}
+    digests = sorted({o.get("model_digest") for o in completed.values()})
+    ok = (len(completed) == expect_world and all(
+        o["world"] == expect_world and o["num_trees"] >= rounds
+        for o in completed.values()) and len(digests) == 1)
+    slow_events = []
+    backend_events = []
+    try:
+        with open(telemetry) as f:
+            for line in f:
+                ev = json.loads(line)
+                if (ev.get("event") == "elastic"
+                        and ev.get("what") == "hybrid_slow"):
+                    slow_events.append(ev)
+                elif ev.get("event") == "comm_backend":
+                    backend_events.append(ev)
+    except (OSError, ValueError):
+        pass
+    hybrid_backends = [e for e in backend_events
+                       if e.get("backend") == "hybrid"]
+    ok = ok and bool(hybrid_backends)
+    if scenario == "kill_host":
+        ok = ok and all(o["reforms"] >= 1 and victim in o["dead_ranks"]
+                        for o in completed.values())
+    else:
+        # slow, not dead: the victim completed, nobody re-formed, and
+        # the hub called the victim out as slow under the observe policy
+        ok = (ok and victim in completed
+              and all(o["reforms"] == 0 for o in completed.values())
+              and any(e.get("slow_host") == victim
+                      and e.get("policy") == "observe"
+                      for e in slow_events))
+    recovery = max((o.get("recovery_s", 0.0)
+                    for o in completed.values()), default=None)
+    return {
+        "scenario": scenario, "hosts": hosts, "local_devices": local,
+        "victim": victim, "rounds": rounds, "ok": ok,
+        "final_world": expect_world,
+        "completed_ranks": sorted(completed),
+        "model_digests": digests,
+        "hybrid_slow_events": len(slow_events),
+        "comm_backend_events": hybrid_backends[:2],
+        "recovery_s": recovery,
+        "total_s": round(total_s, 3),
         "results": results,
     }
 
@@ -544,7 +724,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--scenario",
                     choices=SCENARIOS + SUPERVISOR_SCENARIOS
-                    + FLEET_SCENARIOS,
+                    + FLEET_SCENARIOS + HYBRID_SCENARIOS,
                     default="kill_rank")
     ap.add_argument("--world", type=int, default=3)
     ap.add_argument("--rounds", type=int, default=8)
@@ -568,6 +748,14 @@ def main(argv=None) -> int:
         summary = run_supervisor_scenario(args.scenario,
                                           n_rows=max(args.rows, 400),
                                           join_timeout_s=args.timeout)
+    elif args.scenario in HYBRID_SCENARIOS:
+        # kill_host keeps 3 hosts even in --fast so two survivors can
+        # re-form a quorum; slow_host convicts nobody, so 2 suffice
+        hosts = 2 if (args.fast and args.scenario == "slow_host") else 3
+        summary = run_hybrid_scenario(
+            args.scenario, hosts=hosts,
+            rounds=args.rounds, n_rows=args.rows,
+            chaos_round=args.chaos_round, join_timeout_s=args.timeout)
     else:
         summary = run_scenario(args.scenario, world=args.world,
                                rounds=args.rounds, n_rows=args.rows,

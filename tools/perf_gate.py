@@ -109,6 +109,11 @@ def extract_metrics(bench: Dict) -> Dict:
         mesh8 = mesh.get("mesh8_mrows_iter_s")
         if mesh8 is not None:
             out["higgs_mesh8_mrows_iter_s"] = float(mesh8)
+    hyb = detail.get("hybrid_smoke")
+    if isinstance(hyb, dict):
+        v = hyb.get("hybrid_mrows_iter_s")
+        if v is not None:
+            out["higgs_hybrid_mrows_iter_s"] = float(v)
     return out
 
 
@@ -159,7 +164,8 @@ def check(metrics: Dict, roofline: Optional[Dict[str, float]],
 TRACKED_METRICS = {"higgs_mrows_iter_s": "higgs",
                    "mslr_mrows_iter_s": "mslr",
                    "higgs_quantized_mrows_iter_s": "higgs_quantized",
-                   "higgs_mesh8_mrows_iter_s": "higgs_mesh8"}
+                   "higgs_mesh8_mrows_iter_s": "higgs_mesh8",
+                   "higgs_hybrid_mrows_iter_s": "higgs_hybrid"}
 
 
 def make_baseline(metrics: Dict, roofline: Optional[Dict[str, float]],

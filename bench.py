@@ -458,6 +458,144 @@ def hybrid_smoke():
         return {"error": "FAILED: %s" % e}
 
 
+def _cluster_bench_worker(rank, world, machines, n_rows, rounds, tele, q):
+    """One HOST of the cluster_smoke world: the hybrid bench worker
+    plus the full observability plane (federation + alerting); only the
+    hub (rank 0) carries the telemetry path, so the parent can read one
+    clean event stream."""
+    import os
+    import time as _time
+    import traceback
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4").strip()
+    try:
+        import numpy as np
+
+        import lightgbm_tpu as lgb
+        from lightgbm_tpu.basic import Dataset
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.parallel import collective as coll_mod
+        from lightgbm_tpu.parallel import distributed as dist
+        from lightgbm_tpu.parallel.dist_data import construct_rank_shard
+
+        rng = np.random.RandomState(7)
+        X = rng.rand(n_rows, 28).astype(np.float32)
+        y = (X[:, 0] + 0.3 * X[:, 1] > 0.65).astype(np.float32)
+        params = {"objective": "binary", "num_leaves": 31, "max_bin": 63,
+                  "min_data_in_leaf": 20, "verbose": -1,
+                  "tree_learner": "data", "num_machines": world,
+                  "machine_rank": rank, "tpu_comm_backend": "hybrid",
+                  "tpu_hybrid_local_devices": 2,
+                  "tpu_tree_engine": "partition",
+                  # the observability plane under test: federation on
+                  # every rank (the digest exchange must stay
+                  # collectively symmetric), alerting evaluated on the hub
+                  "tpu_federation": True, "tpu_alert": True}
+        if rank == 0 and tele:
+            params["tpu_telemetry_path"] = tele
+        comm = dist.SocketComm(rank, world, machines, timeout_s=120,
+                               port_offset=0)
+        try:
+            coll_mod.set_process_comm(comm)
+            cfg = Config(dict(params))
+            shard = construct_rank_shard(X, cfg, rank, world, comm,
+                                         label=y)
+            ds = Dataset(X[shard.dist_row_ids], params=dict(params))
+            ds._binned = shard
+            t0 = _time.monotonic()
+            b = lgb.train(dict(params), ds, num_boost_round=rounds)
+            wall = _time.monotonic() - t0
+            g = b._gbdt._grower
+            hybrid_on = (g is not None
+                         and g.collective.backend == "hybrid")
+            q.put((rank, "ok", {"wall_s": wall, "hybrid": hybrid_on}))
+        finally:
+            coll_mod.set_process_comm(None)
+            comm.close()
+    except Exception:  # noqa: BLE001 — report to the parent, don't hang
+        q.put((rank, "fail", traceback.format_exc()[-400:]))
+
+
+def cluster_smoke():
+    """Cluster-observability drill (dict in `detail`).
+
+    A 2-host localhost hybrid world trained with telemetry federation
+    and SLO alerting live (obs/federation.py, obs/alerts.py): the hub
+    must produce a non-empty per-round critical-path ledger and finish
+    with ZERO active alerts — on a healthy localhost world any firing
+    rule is a false positive.  Never fails the bench: any problem
+    becomes an `error` entry.
+    """
+    import json as _json
+    import multiprocessing as mp
+    import os
+    import socket as _socket
+    import tempfile
+    world, n_rows, rounds = 2, 4096, 4
+    try:
+        with _socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        machines = ["127.0.0.1:%d" % port] * world
+        tele = os.path.join(tempfile.mkdtemp(prefix="lgbm_cluster_smoke_"),
+                            "telemetry.jsonl")
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_cluster_bench_worker,
+                             args=(r, world, machines, n_rows, rounds,
+                                   tele, q))
+                 for r in range(world)]
+        for p in procs:
+            p.start()
+        results = {}
+        try:
+            for _ in procs:
+                rank, status, payload = q.get(timeout=600)
+                results[rank] = (status, payload)
+        finally:
+            for p in procs:
+                p.join(timeout=10)
+                if p.is_alive():
+                    p.terminate()
+        bad = {r: p for r, (st, p) in results.items() if st != "ok"}
+        if bad:
+            return {"error": "host(s) %s failed: %s"
+                    % (sorted(bad), list(bad.values())[0])}
+        ledgers, alerts = [], []
+        with open(tele) as f:
+            for line in f:
+                ev = _json.loads(line)
+                if ev.get("event") == "round_ledger":
+                    ledgers.append(ev)
+                elif ev.get("event") == "alert":
+                    alerts.append(ev)
+        # firing transitions never matched by a clear = still active
+        active = {}
+        for ev in alerts:
+            active[ev.get("rule")] = ev.get("state") == "firing"
+        active_rules = sorted(r for r, on in active.items() if on)
+        wall = max(p["wall_s"] for _, p in results.values())
+        return {
+            "hosts": world, "rows": n_rows, "rounds": rounds,
+            "hybrid_active": all(p["hybrid"]
+                                 for _, p in results.values()),
+            "round_ledgers": len(ledgers),
+            "ledger_nonempty": bool(ledgers) and all(
+                e.get("critical_host") is not None and e.get("hosts")
+                for e in ledgers),
+            "active_alerts": active_rules,
+            "alert_transitions": [(e.get("rule"), e.get("state"))
+                                  for e in alerts],
+            "wall_s": round(wall, 3),
+            "ok": (bool(ledgers) and not active_rules
+                   and all(p["hybrid"] for _, p in results.values())),
+        }
+    except Exception as e:  # noqa: BLE001 — smoke only, never fatal
+        return {"error": "FAILED: %s" % e}
+
+
 def mesh_smoke(on_tpu):
     """Data-parallel mesh scaling sweep (dict in `detail`).
 
@@ -698,6 +836,7 @@ def main():
             "quality_ok": ok,
             "mesh_scaling": mesh_smoke(on_tpu),
             "hybrid_smoke": hybrid_smoke(),
+            "cluster_smoke": cluster_smoke(),
             "trace_smoke": trace_smoke(lgb),
             "chaos_smoke": chaos_smoke(),
             "supervisor_smoke": supervisor_smoke(),

@@ -18,11 +18,12 @@ from .hygiene import HygieneChecker
 from .collectives import CollectiveSymmetryChecker
 from .wireproto import WireProtocolChecker
 from .donation import DonationChecker
+from .metrics import MetricsHygieneChecker
 
 CHECKER_CLASSES = (JitHazardChecker, LockDisciplineChecker,
                    ConfigDriftChecker, HygieneChecker,
                    CollectiveSymmetryChecker, WireProtocolChecker,
-                   DonationChecker)
+                   DonationChecker, MetricsHygieneChecker)
 
 #: check id -> owning family id, for per-family summary counts
 CHECK_FAMILY: Dict[str, str] = {

@@ -1,0 +1,136 @@
+"""Checker family 8: metrics hygiene over the MetricsRegistry.
+
+Every subsystem reports into one process-wide registry
+(obs/registry.py) that is scraped verbatim by `GET /metrics`, watched
+by the SLO alert engine (obs/alerts.py) and federated across hosts
+(obs/federation.py) — so a metric name outside the ``lgbm_`` namespace
+silently escapes every dashboard glob, and an unbounded label value
+(request id, row count, timestamp) multiplies the registry's child
+count per REQUEST until scraping, alert evaluation and the federation
+digest all slow down together.  Prometheus's own guidance is one
+bounded enum per label; these checks enforce the repo's version of it:
+
+- ``metrics-name-prefix``    HIGH   a literal metric name at a
+                                    registry call site does not start
+                                    with ``lgbm_`` — invisible to every
+                                    dashboard/alert glob of the fleet
+- ``metrics-unbounded-label`` MEDIUM a label VALUE is built with an
+                                    f-string / ``%`` / ``.format()`` —
+                                    the classic unbounded-cardinality
+                                    shape (ids, counts, timestamps
+                                    interpolated per call)
+- ``metrics-dynamic-name``   LOW    the metric name is not a literal —
+                                    the prefix check cannot audit it;
+                                    table-driven families exempt the
+                                    loop line with ``# tpulint:
+                                    ok=metrics-dynamic-name``
+
+Scope: calls to ``counter``/``gauge``/``histogram``/``attach`` whose
+receiver text looks like a registry (``reg``, ``*registry``,
+``*metrics``, ``default_registry()``); ``help=``/``bounds=`` keywords
+are metadata, not labels.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional
+
+from ..core import (Checker, Finding, HIGH, LOW, MEDIUM, Project,
+                    SourceFile, call_name)
+
+CHECK_PREFIX = "metrics-name-prefix"
+CHECK_LABEL = "metrics-unbounded-label"
+CHECK_DYNAMIC = "metrics-dynamic-name"
+
+_METRIC_METHODS = frozenset({"counter", "gauge", "histogram", "attach"})
+#: keywords that are registry metadata, never label values
+_META_KWARGS = frozenset({"help", "bounds"})
+_PREFIX = "lgbm_"
+
+
+def _is_registry_receiver(recv: str) -> bool:
+    """Heuristic: does the receiver text name a MetricsRegistry?"""
+    low = recv.lower()
+    tail = low.rsplit(".", 1)[-1]
+    return ("registr" in low or tail in ("reg", "metrics")
+            or tail.endswith("metrics"))
+
+
+def _formatted_string(expr: ast.AST) -> bool:
+    """True for the unbounded-cardinality shapes: f-strings, ``"%s" %
+    x`` and ``"...".format(x)`` — a value interpolated per call."""
+    if isinstance(expr, ast.JoinedStr):
+        return any(isinstance(v, ast.FormattedValue) for v in expr.values)
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Mod) \
+            and isinstance(expr.left, ast.Constant) \
+            and isinstance(expr.left.value, str):
+        return True
+    if isinstance(expr, ast.Call):
+        callee, _ = call_name(expr)
+        return callee == "format"
+    return False
+
+
+class MetricsHygieneChecker(Checker):
+    id = "metrics"
+    description = ("metric names outside the lgbm_ namespace, label "
+                   "values with unbounded cardinality, dynamic names "
+                   "the prefix audit cannot see")
+    checks = (CHECK_PREFIX, CHECK_LABEL, CHECK_DYNAMIC)
+
+    def run(self, project: Project) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for sf in project.files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                callee, recv = call_name(node)
+                if callee not in _METRIC_METHODS \
+                        or not _is_registry_receiver(recv):
+                    continue
+                findings.extend(self._check_site(sf, node))
+        return findings
+
+    def _check_site(self, sf: SourceFile, node: ast.Call) -> List[Finding]:
+        out: List[Finding] = []
+        name_expr = self._name_expr(node)
+        if name_expr is None:
+            pass        # no name argument at all: not a metric site
+        elif isinstance(name_expr, ast.Constant) \
+                and isinstance(name_expr.value, str):
+            if not name_expr.value.startswith(_PREFIX):
+                out.append(self.finding(
+                    sf, name_expr, HIGH,
+                    "metric name %r is outside the %s namespace — every "
+                    "dashboard and alert glob of the fleet matches %s*, "
+                    "so this series is invisible to all of them"
+                    % (name_expr.value, _PREFIX.rstrip("_"), _PREFIX),
+                    check=CHECK_PREFIX))
+        else:
+            out.append(self.finding(
+                sf, name_expr, LOW,
+                "metric name is not a string literal — the %s-prefix "
+                "audit cannot see it; exempt table-driven families "
+                "with `# tpulint: ok=%s` after checking the table"
+                % (_PREFIX, CHECK_DYNAMIC), check=CHECK_DYNAMIC))
+        for kw in node.keywords:
+            if kw.arg is None or kw.arg in _META_KWARGS:
+                continue
+            if _formatted_string(kw.value):
+                out.append(self.finding(
+                    sf, kw.value, MEDIUM,
+                    "label %r is built from a formatted string — a "
+                    "value interpolated per call is the unbounded-"
+                    "cardinality shape (ids, counts, timestamps) that "
+                    "grows the registry per request; use a bounded "
+                    "enum, or move the value into the sample"
+                    % kw.arg, check=CHECK_LABEL))
+        return out
+
+    def _name_expr(self, node: ast.Call) -> Optional[ast.AST]:
+        if node.args:
+            return node.args[0]
+        for kw in node.keywords:
+            if kw.arg == "name":
+                return kw.value
+        return None

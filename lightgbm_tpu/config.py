@@ -348,6 +348,44 @@ _SCHEMA = [
     ("tpu_promote_rollback_delta", float, 0.0),  # rollback floor: watch-window
     #   live loss may exceed the pre-promote baseline by at most this
     #   before the prior registry version is reinstalled
+    # --- cluster observability parameters (no reference analogue)
+    # Telemetry federation + per-round critical-path ledger + SLO alerting
+    # (lightgbm_tpu/obs/federation.py, critical_path.py, alerts.py): each
+    # rank ships a compact per-round digest to the hub, the hub decomposes
+    # round wall time and names the critical (rank, phase), and a rule
+    # engine watches the MetricsRegistry.  Strictly read-only on training
+    # state — models are bitwise-identical with all of it on or off.  See
+    # docs/ClusterObservability.md.
+    ("tpu_federation", bool, False),         # per-round telemetry digest
+    #   federation: every rank assembles phase deltas / comm-wait share /
+    #   heartbeat RTT / HBM bytes and ships them to the hub (one extra
+    #   small allgather on the socket/hybrid wire; gathered in-process on
+    #   mesh/serial).  The hub publishes lgbm_cluster_* gauges, appends
+    #   `cluster` + `round_ledger` telemetry events and feeds
+    #   tools/round_report.py
+    ("tpu_federation_every", int, 1),        # rounds between digest exchanges
+    #   (the ledger covers only federated rounds; higher = less wire)
+    ("tpu_federation_port", int, 0),         # >0 -> the hub serves GET
+    #   /cluster, /alerts and /metrics on this port while training
+    #   (0 = no hub HTTP endpoint; the serving server has its own)
+    ("tpu_federation_top_phases", int, 6),   # phase deltas per digest: only
+    #   the top-N phases by round time ride the wire
+    ("tpu_alert", bool, False),              # evaluate the alert rule engine
+    #   over the MetricsRegistry each federated round (training hub) and
+    #   each stats tick (serving); fires `alert` telemetry events and the
+    #   lgbm_alerts_active{rule} gauge
+    ("tpu_alert_rules", str, ""),            # JSON rules file ("" = built-in
+    #   rules: persistent straggler, comm-wait share, breaker flaps,
+    #   shed/quota-shed rate, promotion failures, heartbeat miss streak);
+    #   see docs/ClusterObservability.md for the rule syntax
+    ("tpu_alert_sustain_rounds", int, 3),    # default `for` of sustained
+    #   rules: consecutive breaching ticks before the alert fires
+    ("tpu_alert_burn_window", int, 16),      # burn-rate rule window in
+    #   evaluation ticks (rate = counter delta / window)
+    ("tpu_alert_comm_wait_share", float, 0.5),  # built-in comm-wait rule:
+    #   fraction of round wall a host may spend blocked on peers
+    ("tpu_alert_shed_rate", float, 5.0),     # built-in shed-rate rule:
+    #   shed (+ quota-shed) requests per evaluation tick
 ]
 
 # alias -> canonical name (src/io/config_auto.cpp:4-157)
@@ -481,6 +519,14 @@ ALIAS_TABLE: Dict[str, str] = {
     "hbm_budget_mb": "tpu_fleet_hbm_budget_mb",
     "fleet_tenant_qps": "tpu_fleet_tenant_qps",
     "tenant_qps": "tpu_fleet_tenant_qps",
+    "federation": "tpu_federation",
+    "telemetry_federation": "tpu_federation",
+    "federation_every": "tpu_federation_every",
+    "federation_port": "tpu_federation_port",
+    "alerts": "tpu_alert",
+    "alerting": "tpu_alert",
+    "alert_rules": "tpu_alert_rules",
+    "alert_sustain_rounds": "tpu_alert_sustain_rounds",
 }
 
 PARAMETER_TYPES: Dict[str, Any] = {name: typ for name, typ, _ in _SCHEMA}
@@ -805,6 +851,27 @@ class Config:
             if self.tpu_promote_watch_s < 0:
                 log.fatal("tpu_promote_watch_s must be >= 0, got %g"
                           % self.tpu_promote_watch_s)
+        if self.tpu_federation_every < 1:
+            log.fatal("tpu_federation_every must be >= 1, got %d"
+                      % self.tpu_federation_every)
+        if not 0 <= self.tpu_federation_port <= 65535:
+            log.fatal("tpu_federation_port must be in [0, 65535], got %d"
+                      % self.tpu_federation_port)
+        if self.tpu_federation_top_phases < 1:
+            log.fatal("tpu_federation_top_phases must be >= 1, got %d"
+                      % self.tpu_federation_top_phases)
+        if self.tpu_alert_sustain_rounds < 1:
+            log.fatal("tpu_alert_sustain_rounds must be >= 1, got %d"
+                      % self.tpu_alert_sustain_rounds)
+        if self.tpu_alert_burn_window < 2:
+            log.fatal("tpu_alert_burn_window must be >= 2, got %d"
+                      % self.tpu_alert_burn_window)
+        if not 0 < self.tpu_alert_comm_wait_share <= 1:
+            log.fatal("tpu_alert_comm_wait_share must be in (0, 1], got %g"
+                      % self.tpu_alert_comm_wait_share)
+        if self.tpu_alert_shed_rate < 0:
+            log.fatal("tpu_alert_shed_rate must be >= 0, got %g"
+                      % self.tpu_alert_shed_rate)
 
     def is_single_machine(self) -> bool:
         return self.num_machines <= 1

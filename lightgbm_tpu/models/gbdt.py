@@ -169,8 +169,10 @@ class GBDT:
         # telemetry contract is a bitwise-identical model).
         from ..utils.profiling import Profiler, TraceSession
         telemetry_path = getattr(config, "tpu_telemetry_path", "")
+        federated = bool(getattr(config, "tpu_federation", False)
+                         or getattr(config, "tpu_alert", False))
         self.profiler = Profiler(
-            enabled=config.tpu_profile or bool(telemetry_path),
+            enabled=config.tpu_profile or bool(telemetry_path) or federated,
             sync_fn=self._profile_sync if config.tpu_profile else None)
         self._trace = TraceSession(config.tpu_profile_trace_dir)
         # span timeline (obs/tracing.py): arming the process tracer makes
@@ -193,6 +195,18 @@ class GBDT:
                 self.recorder = TrainingRecorder(telemetry_path, config)
             except Exception as exc:  # noqa: BLE001
                 log.warning("telemetry disabled: recorder init failed (%s)",
+                            exc)
+        # cluster observability plane (obs/federation.py): per-round
+        # digest exchange + critical-path ledger + alert ticks; same
+        # degrade-to-warning, bitwise-identical-model contract as the
+        # recorder
+        self.federation = None
+        if federated:
+            try:
+                from ..obs.federation import Federation
+                self.federation = Federation(config)
+            except Exception as exc:  # noqa: BLE001
+                log.warning("cluster federation disabled: init failed (%s)",
                             exc)
 
         if train_set is not None:
@@ -223,6 +237,12 @@ class GBDT:
                 recorder.finalize(self)
             except Exception as exc:  # noqa: BLE001 — telemetry never raises
                 log.warning("telemetry finalize failed: %s", exc)
+        federation, self.federation = self.federation, None
+        if federation is not None:
+            try:
+                federation.close()
+            except Exception as exc:  # noqa: BLE001 — telemetry never raises
+                log.warning("federation close failed: %s", exc)
         try:
             self._trace.stop()
         except Exception as exc:  # noqa: BLE001
@@ -239,6 +259,7 @@ class GBDT:
     def __del__(self):
         try:
             if (getattr(self, "recorder", None) is not None
+                    or getattr(self, "federation", None) is not None
                     or getattr(self, "_tracing", False)):
                 self.finish_telemetry()
             # teardown report only for explicit tpu_profile runs: a
@@ -412,18 +433,32 @@ class GBDT:
         subclasses override): times the round and hands the recorder one
         event per iteration, for every boosting mode."""
         it = self.iter
-        if self.recorder is None:
+        if self.recorder is None and self.federation is None:
             with obs_tracing.span("train/iteration", "train", iter=it):
                 return self._train_one_iter_impl(gradients, hessians)
         t0 = time.perf_counter()
         with obs_tracing.span("train/iteration", "train", iter=it):
             finished = self._train_one_iter_impl(gradients, hessians)
         wall = time.perf_counter() - t0
-        try:
-            self.recorder.on_iteration(self, it, wall, finished)
-        except Exception as exc:  # noqa: BLE001 — telemetry must not kill train
-            log.warning("telemetry recorder failed (%s); disabling it", exc)
-            self.recorder = None
+        if self.recorder is not None:
+            try:
+                self.recorder.on_iteration(self, it, wall, finished)
+            except Exception as exc:  # noqa: BLE001 — telemetry must not kill train
+                log.warning("telemetry recorder failed (%s); disabling it",
+                            exc)
+                self.recorder = None
+        if self.federation is not None:
+            try:
+                self.federation.on_round(self, it, wall)
+            except Exception as exc:  # noqa: BLE001 — telemetry must not kill train
+                # a changed world is the elastic supervisor's signal to
+                # re-form — let it through; anything else degrades to a
+                # warning and disables federation
+                if type(exc).__name__ == "WorldChangedError":
+                    raise
+                log.warning("cluster federation failed (%s); disabling it",
+                            exc)
+                self.federation = None
         return finished
 
     def _train_one_iter_impl(self, gradients: Optional[np.ndarray] = None,
@@ -1441,13 +1476,39 @@ class GBDT:
         """Per-iteration gradient/row sampling hook (overridden by GOSS)."""
         return grad, hess
 
+    def _global_init_score(self, class_id: int) -> float:
+        """Init score for boost_from_average, synced across ranks.
+
+        On the socket/hybrid paths the objective sees only the
+        rank-local shard, so boost_from_score would seed every rank from
+        a different average (the C++ reference syncs it through
+        Network::GlobalSyncUpBy*).  Allreduce the objective's sufficient
+        statistics and recompute from the totals; objectives without
+        compact stats (percentile-based) fall back to the rank-local
+        score."""
+        coll = self._grower.collective if self._grower is not None else None
+        backend = getattr(coll, "backend", "none")
+        if (coll is None or backend not in ("socket", "hybrid")
+                or coll.world <= 1):
+            return self.objective.boost_from_score(class_id)
+        stats = self.objective.boost_stats(class_id)
+        if stats is None:
+            if self.objective.name in ("regression_l1", "quantile", "mape"):
+                log.warning(
+                    "boost_from_average: %s has no distributable sufficient "
+                    "statistics; using the rank-local init score",
+                    self.objective.name)
+            return self.objective.boost_from_score(class_id)
+        total = coll.allreduce(np.asarray(stats, np.float64), op="sum")
+        return self.objective.boost_from_stats(total, class_id)
+
     def _boost_from_average(self, class_id: int) -> float:
         if self.models or self.objective is None:
             return 0.0
         if self.train_set.metadata.init_score is not None:
             return 0.0  # already seeded at setup
         if self.config.boost_from_average or self.train_set.num_features == 0:
-            init_score = self.objective.boost_from_score(class_id)
+            init_score = self._global_init_score(class_id)
             if abs(init_score) > K_EPSILON:
                 self.train_state.add_constant(init_score, class_id)
                 for _, vs, _m in self.valid_states:

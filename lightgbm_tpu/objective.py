@@ -125,6 +125,20 @@ class ObjectiveFunction:
     def boost_from_score(self, class_id: int = 0) -> float:
         return 0.0
 
+    # Distributed boost_from_average: the socket/hybrid paths allreduce
+    # this f64 vector across ranks and feed the totals to
+    # boost_from_stats so the init score matches serial bitwise (the C++
+    # reference syncs it through Network::GlobalSyncUpBy*).  Return None
+    # (the default) when the init score has no compact sufficient
+    # statistics — percentile-based objectives — and callers fall back
+    # to the rank-local score.
+    def boost_stats(self, class_id: int = 0) -> Optional[np.ndarray]:
+        return None
+
+    def boost_from_stats(self, stats: np.ndarray,
+                         class_id: int = 0) -> float:
+        return self.boost_from_score(class_id)
+
     def convert_output(self, raw):
         return raw
 
@@ -188,6 +202,17 @@ class RegressionL2Loss(ObjectiveFunction):
             return float((label * w).sum() / max(w.sum(), K_EPSILON))
         return float(label.mean()) if len(label) else 0.0
 
+    def boost_stats(self, class_id: int = 0) -> Optional[np.ndarray]:
+        label = np.asarray(self.label, np.float64)
+        if self.weights is not None:
+            w = np.asarray(self.weights, np.float64)
+            return np.asarray([(label * w).sum(), w.sum()], np.float64)
+        return np.asarray([label.sum(), float(len(label))], np.float64)
+
+    def boost_from_stats(self, stats: np.ndarray,
+                         class_id: int = 0) -> float:
+        return float(stats[0] / max(float(stats[1]), K_EPSILON))
+
     def convert_output(self, raw):
         if self.sqrt:
             return jnp.sign(raw) * raw * raw
@@ -211,6 +236,9 @@ class RegressionL1Loss(RegressionL2Loss):
         if self.weights is not None:
             return weighted_percentile(label, np.asarray(self.weights), 0.5)
         return percentile(label, 0.5)
+
+    def boost_stats(self, class_id: int = 0) -> Optional[np.ndarray]:
+        return None  # percentile init: no compact sufficient statistics
 
     def is_renew_tree_output(self) -> bool:
         return True
@@ -256,6 +284,9 @@ class RegressionFairLoss(RegressionL2Loss):
     def boost_from_score(self, class_id: int = 0) -> float:
         return 0.0
 
+    def boost_stats(self, class_id: int = 0) -> Optional[np.ndarray]:
+        return None  # constant 0 init: nothing to sync
+
     def is_constant_hessian(self) -> bool:
         return False
 
@@ -279,6 +310,11 @@ class RegressionPoissonLoss(RegressionL2Loss):
 
     def boost_from_score(self, class_id: int = 0) -> float:
         mean = RegressionL2Loss.boost_from_score(self, class_id)
+        return math.log(max(mean, 1e-20))
+
+    def boost_from_stats(self, stats: np.ndarray,
+                         class_id: int = 0) -> float:
+        mean = RegressionL2Loss.boost_from_stats(self, stats, class_id)
         return math.log(max(mean, 1e-20))
 
     def convert_output(self, raw):
@@ -307,6 +343,9 @@ class RegressionQuantileLoss(RegressionL2Loss):
         if self.weights is not None:
             return weighted_percentile(label, np.asarray(self.weights), self.alpha)
         return percentile(label, self.alpha)
+
+    def boost_stats(self, class_id: int = 0) -> Optional[np.ndarray]:
+        return None  # percentile init: no compact sufficient statistics
 
     def is_renew_tree_output(self) -> bool:
         return True
@@ -340,6 +379,9 @@ class RegressionMAPELoss(RegressionL1Loss):
     def boost_from_score(self, class_id: int = 0) -> float:
         label = np.asarray(self.label, np.float64)
         return weighted_percentile(label, np.asarray(self.label_weight), 0.5)
+
+    def boost_stats(self, class_id: int = 0) -> Optional[np.ndarray]:
+        return None  # percentile init: no compact sufficient statistics
 
     def _renew_percentile(self, residuals, weights):
         # weights here are the per-row 1/|label| weights of the leaf rows
@@ -444,6 +486,30 @@ class BinaryLogloss(ObjectiveFunction):
         pavg = min(max(self._pos_frac, K_EPSILON), 1.0 - K_EPSILON)
         init = math.log(pavg / (1.0 - pavg)) / self.sigmoid
         log.info("[binary:BoostFromScore]: pavg=%f -> initscore=%f", pavg, init)
+        return init
+
+    def boost_stats(self, class_id: int = 0) -> Optional[np.ndarray]:
+        label = np.asarray(self.label)
+        pos = float((label > 0).sum())
+        neg = float(len(label)) - pos
+        if self.weights is not None:
+            w = np.asarray(self.weights, np.float64)
+            wpos = float((w * (label > 0)).sum())
+            wsum = float(w.sum())
+        else:
+            wpos, wsum = pos, float(len(label))
+        return np.asarray([pos, neg, wpos, wsum], np.float64)
+
+    def boost_from_stats(self, stats: np.ndarray,
+                         class_id: int = 0) -> float:
+        pos, neg, wpos, wsum = (float(v) for v in stats)
+        if pos <= 0 or neg <= 0:
+            return 0.0  # one global class: nothing to train from
+        pavg = min(max(wpos / max(wsum, K_EPSILON), K_EPSILON),
+                   1.0 - K_EPSILON)
+        init = math.log(pavg / (1.0 - pavg)) / self.sigmoid
+        log.info("[binary:BoostFromScore]: global pavg=%f -> initscore=%f",
+                 pavg, init)
         return init
 
     def convert_output(self, raw):

@@ -57,6 +57,20 @@ class MulticlassSoftmax(ObjectiveFunction):
     def boost_from_score(self, class_id: int = 0) -> float:
         return math.log(max(K_EPSILON, self.class_init_probs[class_id]))
 
+    def boost_stats(self, class_id: int = 0):
+        # same vector for every class_id: [per-class weight..., total]
+        label_int = np.asarray(self._label_int)
+        w = (np.asarray(self.weights, np.float64)
+             if self.weights is not None else np.ones(len(label_int)))
+        probs = np.zeros(self.num_class)
+        np.add.at(probs, label_int, w)
+        return np.concatenate([probs, [w.sum()]]).astype(np.float64)
+
+    def boost_from_stats(self, stats, class_id: int = 0) -> float:
+        prob = float(stats[class_id]) / max(float(stats[self.num_class]),
+                                            K_EPSILON)
+        return math.log(max(K_EPSILON, prob))
+
     def class_need_train(self, class_id: int) -> bool:
         p = abs(self.class_init_probs[class_id])
         return K_EPSILON < p < 1.0 - K_EPSILON
@@ -136,6 +150,12 @@ class MulticlassOVA(ObjectiveFunction):
 
     def boost_from_score(self, class_id: int = 0) -> float:
         return self.binary_loss[class_id].boost_from_score(0)
+
+    def boost_stats(self, class_id: int = 0):
+        return self.binary_loss[class_id].boost_stats(0)
+
+    def boost_from_stats(self, stats, class_id: int = 0) -> float:
+        return self.binary_loss[class_id].boost_from_stats(stats, 0)
 
     def class_need_train(self, class_id: int) -> bool:
         return self.binary_loss[class_id].class_need_train(0)

@@ -53,6 +53,20 @@ class CrossEntropy(ObjectiveFunction):
         log.info("[xentropy]: pavg = %f -> initscore = %f", pavg, init)
         return init
 
+    def boost_stats(self, class_id: int = 0):
+        label = np.asarray(self.label, np.float64)
+        if self.weights is not None:
+            w = np.asarray(self.weights, np.float64)
+            return np.asarray([(label * w).sum(), w.sum()], np.float64)
+        return np.asarray([label.sum(), float(len(label))], np.float64)
+
+    def boost_from_stats(self, stats, class_id: int = 0) -> float:
+        pavg = float(stats[0]) / max(float(stats[1]), K_EPSILON)
+        pavg = min(max(pavg, K_EPSILON), 1.0 - K_EPSILON)
+        init = math.log(pavg / (1.0 - pavg))
+        log.info("[xentropy]: global pavg = %f -> initscore = %f", pavg, init)
+        return init
+
     def convert_output(self, raw):
         return 1.0 / (1.0 + jnp.exp(-raw))
 
@@ -102,6 +116,20 @@ class CrossEntropyLambda(ObjectiveFunction):
             havg = label.mean() if len(label) else 0.0
         init = math.log(max(math.exp(havg) - 1.0, K_EPSILON))
         log.info("[xentlambda]: havg = %f -> initscore = %f", havg, init)
+        return init
+
+    def boost_stats(self, class_id: int = 0):
+        label = np.asarray(self.label, np.float64)
+        if self.weights is not None:
+            w = np.asarray(self.weights, np.float64)
+            return np.asarray([(label * w).sum(), w.sum()], np.float64)
+        return np.asarray([label.sum(), float(len(label))], np.float64)
+
+    def boost_from_stats(self, stats, class_id: int = 0) -> float:
+        havg = float(stats[0]) / max(float(stats[1]), K_EPSILON)
+        init = math.log(max(math.exp(havg) - 1.0, K_EPSILON))
+        log.info("[xentlambda]: global havg = %f -> initscore = %f",
+                 havg, init)
         return init
 
     def convert_output(self, raw):

@@ -1,0 +1,274 @@
+"""SLO alert rule engine over the MetricsRegistry.
+
+The observability plane's third leg (with obs/federation.py and
+obs/critical_path.py): a small rule engine evaluated once per federated
+training round (by the hub) and once per serving stats tick, watching
+the SAME registry every subsystem already reports into — no new
+instrumentation, just continuous evaluation of what is already there.
+
+Three rule kinds:
+
+- ``threshold``: fire the tick the watched value breaches, clear the
+  tick it stops breaching.
+- ``sustained``: fire after ``for`` CONSECUTIVE breaching ticks (the
+  persistent-straggler / comm-wait-share shape: one slow round is
+  noise, five in a row is an incident), clear on the first clean tick.
+- ``burn_rate``: for counters — fire when the per-tick increase rate
+  over a sliding ``window`` of ticks exceeds the threshold (breaker
+  flaps, shed rate, promotion failures: the level is meaningless, the
+  slope is the signal), clear when the rate falls back under.
+
+Every state transition appends an ``alert`` JSONL event (recorder
+idiom: best-effort, never raises) and flips the
+``lgbm_alerts_active{rule=...}`` gauge, so `GET /alerts`, `GET
+/metrics` and tools/telemetry_report.py all see the same incident
+timeline.  The engine is strictly read-only on the metrics it watches
+and on training state — evaluation failures degrade to a warning and
+skip the tick, exactly like the recorder contract.
+
+Rule files (``tpu_alert_rules``) are a JSON list of objects::
+
+    [{"name": "hot_host", "metric": "lgbm_cluster_host_comm_wait_share",
+      "op": ">", "threshold": 0.5, "kind": "sustained", "for": 3,
+      "labels": {"host": "2"}}]
+
+``labels`` is an optional subset match; omitted -> the rule watches
+the worst (max) child of the family.  See docs/ClusterObservability.md.
+"""
+from __future__ import annotations
+
+import json
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from ..utils import log
+from .registry import MetricsRegistry
+
+_OPS: Dict[str, Callable[[float, float], bool]] = {
+    ">": lambda v, t: v > t,
+    ">=": lambda v, t: v >= t,
+    "<": lambda v, t: v < t,
+    "<=": lambda v, t: v <= t,
+}
+
+RULE_KINDS = ("threshold", "sustained", "burn_rate")
+
+
+class Rule:
+    """One declarative SLO rule (immutable after construction)."""
+
+    def __init__(self, name: str, metric: str, op: str = ">",
+                 threshold: float = 0.0, kind: str = "threshold",
+                 for_ticks: int = 1, window: int = 16,
+                 labels: Optional[Dict[str, str]] = None):
+        if op not in _OPS:
+            raise ValueError("alert rule %r: unknown op %r" % (name, op))
+        if kind not in RULE_KINDS:
+            raise ValueError("alert rule %r: unknown kind %r" % (name, kind))
+        self.name = str(name)
+        self.metric = str(metric)
+        self.op = op
+        self.threshold = float(threshold)
+        self.kind = kind
+        self.for_ticks = max(1, int(for_ticks))
+        self.window = max(2, int(window))
+        self.labels = {k: str(v) for k, v in (labels or {}).items()}
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Rule":
+        return cls(name=d["name"], metric=d["metric"],
+                   op=d.get("op", ">"),
+                   threshold=d.get("threshold", 0.0),
+                   kind=d.get("kind", "threshold"),
+                   for_ticks=d.get("for", d.get("for_ticks", 1)),
+                   window=d.get("window", 16),
+                   labels=d.get("labels"))
+
+    def to_dict(self) -> Dict:
+        return {"name": self.name, "metric": self.metric, "op": self.op,
+                "threshold": self.threshold, "kind": self.kind,
+                "for": self.for_ticks, "window": self.window,
+                "labels": dict(self.labels)}
+
+
+class _RuleState:
+    __slots__ = ("active", "streak", "samples", "last_value",
+                 "fired_ticks", "cleared_ticks")
+
+    def __init__(self, window: int):
+        self.active = False
+        self.streak = 0
+        # (tick, family total) ring for burn-rate slopes
+        self.samples: deque = deque(maxlen=window + 1)
+        self.last_value: Optional[float] = None
+        self.fired_ticks: List[int] = []
+        self.cleared_ticks: List[int] = []
+
+
+def default_rules(config=None) -> List[Rule]:
+    """Built-in rule set covering the incidents the ISSUE names.
+
+    Thresholds come from the tpu_alert_* config knobs when a Config is
+    given; bare defaults otherwise (so a serving process with default
+    params still gets sensible rules)."""
+    sustain = int(getattr(config, "tpu_alert_sustain_rounds", 3) or 3)
+    window = int(getattr(config, "tpu_alert_burn_window", 16) or 16)
+    wait_share = float(getattr(config, "tpu_alert_comm_wait_share", 0.5)
+                       or 0.5)
+    shed_rate = float(getattr(config, "tpu_alert_shed_rate", 5.0) or 5.0)
+    return [
+        # a host the straggler policy flagged slow, `for` rounds in a row
+        Rule("straggler_host", "lgbm_hybrid_host_slow", ">=", 1.0,
+             "sustained", for_ticks=sustain, window=window),
+        # a host blocked on peers for most of the round, sustained
+        Rule("comm_wait_share", "lgbm_cluster_host_comm_wait_share", ">",
+             wait_share, "sustained", for_ticks=sustain, window=window),
+        # consecutive missed heartbeat probes on any peer
+        Rule("heartbeat_miss", "lgbm_comm_heartbeat_miss_streak", ">=",
+             2.0, "sustained", for_ticks=1, window=window),
+        # circuit breaker opening repeatedly (flapping device/model)
+        Rule("breaker_flap", "lgbm_serve_breaker_open_total", ">", 0.25,
+             "burn_rate", window=window),
+        # admission / tenant-quota shed slope
+        Rule("shed_rate", "lgbm_serve_shed_total", ">", shed_rate,
+             "burn_rate", window=window),
+        Rule("quota_shed_rate", "lgbm_serve_quota_shed_total", ">",
+             shed_rate, "burn_rate", window=window),
+        # any fleet promote failure or supervisor rollback in the window
+        Rule("promotion_failures", "lgbm_fleet_promote_failures_total",
+             ">", 0.0, "burn_rate", window=window),
+        Rule("supervisor_rollbacks", "lgbm_supervisor_rollbacks_total",
+             ">", 0.0, "burn_rate", window=window),
+    ]
+
+
+def load_rules(path: str) -> List[Rule]:
+    """Parse a JSON rule file (list of rule objects)."""
+    with open(path) as f:
+        raw = json.load(f)
+    if not isinstance(raw, list):
+        raise ValueError("alert rule file %s: expected a JSON list" % path)
+    return [Rule.from_dict(d) for d in raw]
+
+
+class AlertEngine:
+    """Evaluates a rule list against one MetricsRegistry, tick by tick."""
+
+    def __init__(self, registry: MetricsRegistry,
+                 rules: Optional[List[Rule]] = None, config=None):
+        self.registry = registry
+        self.config = config
+        self.rules = list(rules) if rules is not None \
+            else default_rules(config)
+        self.tick = 0
+        self._state = {r.name: _RuleState(r.window) for r in self.rules}
+        self._gauges = {
+            r.name: registry.gauge(
+                "lgbm_alerts_active",
+                help="1 while the named alert rule is firing",
+                rule=r.name)
+            for r in self.rules}
+        for g in self._gauges.values():
+            g.set(0.0)
+
+    @classmethod
+    def from_config(cls, config, registry: MetricsRegistry) -> "AlertEngine":
+        rules = None
+        path = str(getattr(config, "tpu_alert_rules", "") or "")
+        if path:
+            rules = load_rules(path)
+        return cls(registry, rules=rules, config=config)
+
+    # -- evaluation ---------------------------------------------------- #
+    def _family_value(self, rule: Rule) -> Optional[float]:
+        """Worst (max) matching child value, or the matching-children
+        SUM for burn-rate rules (a slope over a cumulative family)."""
+        snap = self.registry.collect().get(rule.metric)
+        if snap is None or snap["kind"] == "histogram":
+            return None
+        vals = [v for labels, v in snap["values"]
+                if all(labels.get(k) == want
+                       for k, want in rule.labels.items())]
+        if not vals:
+            return None
+        return float(sum(vals)) if rule.kind == "burn_rate" \
+            else float(max(vals))
+
+    def _breaching(self, rule: Rule, state: _RuleState) -> bool:
+        value = self._family_value(rule)
+        if rule.kind == "burn_rate":
+            if value is None:
+                return False
+            state.samples.append((self.tick, value))
+            if len(state.samples) < 2:
+                state.last_value = 0.0
+                return False
+            t0, v0 = state.samples[0]
+            rate = (value - v0) / max(self.tick - t0, 1)
+            state.last_value = rate
+            return _OPS[rule.op](rate, rule.threshold)
+        state.last_value = value
+        if value is None:
+            return False
+        return _OPS[rule.op](value, rule.threshold)
+
+    def evaluate(self) -> List[Dict]:
+        """One tick: evaluate every rule, emit transitions.  Returns the
+        transition list ([{rule, state, value, ...}]).  Any per-rule
+        failure degrades to a warning and skips that rule."""
+        self.tick += 1
+        transitions: List[Dict] = []
+        for rule in self.rules:
+            state = self._state[rule.name]
+            try:
+                breach = self._breaching(rule, state)
+            except Exception as exc:  # noqa: BLE001 — alerts never raise
+                log.warning("alerts: rule %s evaluation failed: %s",
+                            rule.name, exc)
+                continue
+            state.streak = state.streak + 1 if breach else 0
+            need = rule.for_ticks if rule.kind == "sustained" else 1
+            should_fire = breach and state.streak >= need
+            if should_fire and not state.active:
+                state.active = True
+                state.fired_ticks.append(self.tick)
+                self._gauges[rule.name].set(1.0)
+                transitions.append(self._transition(rule, state, "firing"))
+            elif state.active and not breach:
+                state.active = False
+                state.cleared_ticks.append(self.tick)
+                self._gauges[rule.name].set(0.0)
+                transitions.append(self._transition(rule, state, "cleared"))
+        if transitions and self.config is not None:
+            from .recorder import alert_event
+            for t in transitions:
+                alert_event(self.config, **t)
+        return transitions
+
+    def _transition(self, rule: Rule, state: _RuleState,
+                    what: str) -> Dict:
+        return {"rule": rule.name, "state": what,
+                "metric": rule.metric, "kind": rule.kind,
+                "value": (round(state.last_value, 6)
+                          if state.last_value is not None else None),
+                "threshold": rule.threshold, "tick": self.tick}
+
+    # -- read side ----------------------------------------------------- #
+    def active(self) -> List[str]:
+        return [r.name for r in self.rules if self._state[r.name].active]
+
+    def snapshot(self) -> Dict:
+        """The `/alerts` endpoint payload."""
+        return {
+            "tick": self.tick,
+            "active": self.active(),
+            "rules": [{
+                "name": r.name, "metric": r.metric, "kind": r.kind,
+                "op": r.op, "threshold": r.threshold,
+                "active": self._state[r.name].active,
+                "value": self._state[r.name].last_value,
+                "streak": self._state[r.name].streak,
+                "fired": list(self._state[r.name].fired_ticks),
+                "cleared": list(self._state[r.name].cleared_ticks),
+            } for r in self.rules],
+        }

@@ -1,0 +1,118 @@
+"""Per-round critical-path ledger over federated telemetry digests.
+
+Decomposes the hub's round wall time into the four legs a mesh/hybrid
+round can stall on — compute, in-host mesh psum, leader wire, waiting
+for a straggling peer — and names the critical (host, phase) for the
+round, so MULTICHIP-style efficiency questions ("which host, which
+phase, which wire leg made round 17 slow?") are answered by reading one
+JSONL line instead of re-running with print statements.
+
+Inputs are the per-rank digests the federation exchange already
+gathered (obs/federation.py) plus the hub's per-peer blocking-recv
+maxima for the round (SocketComm.take_peer_waits) — everything here is
+pure arithmetic over dicts: no comm, no device access, no training
+state.  tools/round_report.py renders the resulting `round_ledger`
+events as a table.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+# phases that measure waiting on other ranks, not local work — they are
+# reported as wire/straggler legs, not as compute candidates
+_WAIT_PHASES = frozenset((
+    "comm/allgather", "comm/federation", "comm/hybrid_wire",
+    "comm/mesh_psum",
+))
+
+
+def _span_ms(digest: Dict, kind: str) -> float:
+    spans = digest.get("spans") or {}
+    entry = spans.get(kind) or {}
+    return float(entry.get("ms", 0.0) or 0.0)
+
+
+def _top_phases(digest: Dict, n: int = 3) -> List[Dict]:
+    """[{phase, ms}] of the digest's n largest LOCAL phases."""
+    phases = digest.get("phases") or {}
+    items = [{"phase": name, "ms": float(entry.get("ms", 0.0) or 0.0)}
+             for name, entry in phases.items()
+             if name not in _WAIT_PHASES]
+    items.sort(key=lambda d: -d["ms"])
+    return items[:n]
+
+
+def build_ledger(round_idx: int, digests: List[Dict],
+                 peer_waits_ms: Optional[Dict[int, float]] = None,
+                 hub_rank: int = 0) -> Dict:
+    """One round ledger from the gathered digests.
+
+    ``digests``: per-rank digest dicts (rank order) as assembled by
+    Federation._build_digest; ``peer_waits_ms`` maps ORIGINAL rank ->
+    the hub's max blocking-recv milliseconds against that peer this
+    round (the signal that exposes a straggler BEFORE the slow-host
+    policy convicts it: the lag shows up as hub wait on the sync
+    allgather)."""
+    peer_waits_ms = peer_waits_ms or {}
+    hub = next((d for d in digests if d.get("rank") == hub_rank),
+               digests[0] if digests else {})
+    wall_ms = float(hub.get("wall_ms", 0.0) or 0.0)
+    mesh_psum_ms = _span_ms(hub, "comm/mesh_psum")
+    wire_ms = float(hub.get("wire_ms", 0.0) or 0.0)
+    comm_wait_ms = float(hub.get("comm_wait_ms", 0.0) or 0.0)
+    straggler_wait_ms = max(peer_waits_ms.values(), default=0.0)
+    compute_ms = max(0.0, wall_ms - max(comm_wait_ms, wire_ms)
+                     - mesh_psum_ms)
+
+    # critical attribution: the single largest leg across every host —
+    # each digest's top local phase competes with each peer's hub-side
+    # wait, so a lagged host wins via the wait it inflicts even while
+    # its own phase profile looks ordinary
+    candidates: List[Dict] = []
+    for d in digests:
+        host = int(d.get("orig", d.get("rank", 0)) or 0)
+        for item in _top_phases(d, 1):
+            candidates.append({"host": host, "phase": item["phase"],
+                               "ms": item["ms"]})
+    for orig, wait in peer_waits_ms.items():
+        candidates.append({"host": int(orig), "phase": "straggler_wait",
+                           "ms": float(wait)})
+    critical = max(candidates, key=lambda c: c["ms"], default=None)
+
+    hosts = [{
+        "host": int(d.get("orig", d.get("rank", 0)) or 0),
+        "wall_ms": round(float(d.get("wall_ms", 0.0) or 0.0), 3),
+        "comm_wait_share": round(
+            float(d.get("comm_wait_share", 0.0) or 0.0), 4),
+        "rtt_ms": round(float(d.get("rtt_ms", 0.0) or 0.0), 3),
+        "hub_wait_ms": round(
+            float(peer_waits_ms.get(
+                int(d.get("orig", d.get("rank", 0)) or 0), 0.0)), 3),
+        "top_phases": _top_phases(d, 3),
+    } for d in digests]
+
+    return {
+        "round": int(round_idx),
+        "wall_ms": round(wall_ms, 3),
+        "compute_ms": round(compute_ms, 3),
+        "mesh_psum_ms": round(mesh_psum_ms, 3),
+        "leader_wire_ms": round(max(wire_ms, comm_wait_ms), 3),
+        "straggler_wait_ms": round(straggler_wait_ms, 3),
+        "critical_host": (int(critical["host"])
+                          if critical is not None else None),
+        "critical_phase": (critical["phase"]
+                           if critical is not None else None),
+        "critical_ms": (round(critical["ms"], 3)
+                        if critical is not None else None),
+        "hosts": hosts,
+    }
+
+
+def critical_counts(ledgers: List[Dict]) -> Dict[int, int]:
+    """host -> number of rounds it was the critical rank (report helper)."""
+    out: Dict[int, int] = {}
+    for led in ledgers:
+        host = led.get("critical_host")
+        if host is not None:
+            out[int(host)] = out.get(int(host), 0) + 1
+    return out

@@ -1,0 +1,352 @@
+"""Per-round telemetry federation: rank digests -> hub aggregation.
+
+Every per-rank signal needed to explain a slow round already exists —
+Profiler phase totals, comm-wait counters, heartbeat RTT, HBM live
+bytes, span rollups — but it is siloed per process.  This module ships
+a compact per-round DIGEST from every rank to the hub, piggybacked on
+the wire that already carries the per-round elastic sync (socket /
+hybrid backends: one extra small allgather per federated round; mesh
+and serial: the "cluster" is one process, gathered in place), where it
+becomes:
+
+- ``lgbm_cluster_*`` gauges with per-host labels (scraped via
+  /metrics, /cluster);
+- a ``cluster`` JSONL telemetry event per federated round;
+- a ``round_ledger`` event decomposing hub wall time into compute /
+  mesh-psum / leader-wire / straggler-wait legs and naming the
+  critical (host, phase) (obs/critical_path.py, tools/round_report.py);
+- alert-engine ticks (obs/alerts.py) when ``tpu_alert`` is on.
+
+Contract (same as the recorder): STRICTLY read-only on training state.
+Digest assembly failures degrade to a minimal digest so the exchange
+stays collectively symmetric; exchange failures degrade to a warning
+and disable federation (a WorldChangedError re-raises — the elastic
+supervisor owns re-formation).  Models train bitwise-identically with
+federation on or off (tests/test_federation.py, test_hybrid_collective
+assert this).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional
+
+from ..utils import log
+from . import device, tracing
+from .registry import MetricsRegistry
+
+# gauge families the hub publishes per host; cluster_snapshot() reads
+# them back for the /cluster endpoints
+CLUSTER_GAUGES = (
+    ("lgbm_cluster_host_wall_ms", "Last federated round wall ms per host"),
+    ("lgbm_cluster_host_comm_wait_share",
+     "Share of round wall spent blocked on peers, per host"),
+    ("lgbm_cluster_host_rtt_ms", "Hub clock-sync round-trip ms per host"),
+    ("lgbm_cluster_host_hbm_bytes", "Live device bytes per host"),
+    ("lgbm_cluster_host_wire_ms", "Leader-wire ms this round per host"),
+)
+
+
+class Federation:
+    """Per-booster federation endpoint (one per GBDT, like the recorder).
+
+    ``on_round`` is called by GBDT.train_one_iter after every round;
+    whether this process is a digest SOURCE, the aggregating HUB, or
+    both (serial / mesh: the process is the whole cluster) is resolved
+    per round from the live collective, so elastic re-formation needs
+    no federation-side bookkeeping."""
+
+    def __init__(self, config, registry: Optional[MetricsRegistry] = None):
+        from . import default_registry
+        self.config = config
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.every = max(1, int(getattr(config, "tpu_federation_every", 1)))
+        self.top_phases = max(1, int(getattr(config,
+                                             "tpu_federation_top_phases", 6)))
+        self.exchange = bool(getattr(config, "tpu_federation", False))
+        self.engine = None
+        if getattr(config, "tpu_alert", False):
+            from .alerts import AlertEngine
+            self.engine = AlertEngine.from_config(config, self.registry)
+        # per-round delta baselines (this rank)
+        self._last_phases: Dict[str, Dict[str, float]] = {}
+        self._last_spans: Dict[str, Dict[str, float]] = {}
+        self._last_comm_wait_s = 0.0
+        self._last_wire_s = 0.0
+        # hub state
+        self._latest: Dict = {}
+        self._ledgers: List[Dict] = []
+        self._http = None
+        self._closed = False
+
+    # -- driver hook ---------------------------------------------------- #
+    def on_round(self, gbdt, iteration: int, wall_s: float) -> None:
+        """Assemble, exchange and (on the hub) aggregate this round's
+        digests.  Called outside the train span; read-only on `gbdt`."""
+        if self._closed or iteration % self.every:
+            return
+        grower = getattr(gbdt, "_grower", None)
+        coll = getattr(grower, "collective", None)
+        backend = getattr(coll, "backend", "none")
+        on_wire = (self.exchange and coll is not None
+                   and backend in ("socket", "hybrid") and coll.world > 1)
+        try:
+            digest = self._build_digest(gbdt, coll, backend, iteration,
+                                        wall_s)
+        except Exception as exc:  # noqa: BLE001 — keep the wire symmetric
+            log.warning("federation: digest assembly failed (%s); "
+                        "sending minimal digest", exc)
+            digest = {"rank": int(getattr(coll, "rank", 0) or 0),
+                      "orig": self._orig_rank(coll),
+                      "round": int(iteration),
+                      "wall_ms": round(wall_s * 1e3, 3)}
+        if on_wire:
+            with tracing.span("comm/federation", "comm", round=iteration):
+                digests = [d for d in coll.allgather(digest)
+                           if isinstance(d, dict)]
+        else:
+            digests = [digest]
+        is_hub = not on_wire or coll.rank == 0
+        if not is_hub:
+            return
+        comm = getattr(coll, "comm", None) if on_wire else None
+        self._aggregate(iteration, digests, comm)
+        if self.engine is not None:
+            self.engine.evaluate()
+        self._ensure_http()
+
+    def close(self) -> None:
+        self._closed = True
+        http, self._http = self._http, None
+        if http:
+            try:
+                http.shutdown()
+                http.server_close()
+            except Exception as exc:  # noqa: BLE001 — teardown never raises
+                log.debug("federation: hub http close failed: %s", exc)
+
+    # -- digest --------------------------------------------------------- #
+    def _orig_rank(self, coll) -> int:
+        comm = getattr(coll, "comm", None)
+        if comm is not None:
+            return int(getattr(comm, "orig_rank", getattr(comm, "rank", 0)))
+        return int(getattr(coll, "rank", 0) or 0)
+
+    def _build_digest(self, gbdt, coll, backend: str, iteration: int,
+                      wall_s: float) -> Dict:
+        wall_ms = wall_s * 1e3
+        digest: Dict = {
+            "rank": int(getattr(coll, "rank", 0) or 0),
+            "orig": self._orig_rank(coll),
+            "round": int(iteration),
+            "backend": backend,
+            "wall_ms": round(wall_ms, 3),
+            "phases": self._phase_deltas(gbdt.profiler),
+        }
+        spans = self._span_deltas()
+        if spans:
+            digest["spans"] = spans
+        wait_s = self.registry.family_sum("lgbm_comm_sync_wait_seconds_total")
+        if wait_s is not None:
+            d_wait = max(0.0, wait_s - self._last_comm_wait_s)
+            self._last_comm_wait_s = wait_s
+            digest["comm_wait_ms"] = round(d_wait * 1e3, 3)
+            digest["comm_wait_share"] = round(
+                min(1.0, d_wait * 1e3 / wall_ms) if wall_ms > 0 else 0.0, 4)
+        comm = getattr(coll, "comm", None)
+        if comm is not None:
+            digest["rtt_ms"] = round(
+                float(getattr(comm, "_clock_rtt_s", 0.0)) * 1e3, 3)
+        axis = getattr(coll, "_axis", None)
+        wire_s = float(getattr(axis, "_wire_wait_s", 0.0) or 0.0)
+        if wire_s:
+            digest["wire_ms"] = round(
+                max(0.0, wire_s - self._last_wire_s) * 1e3, 3)
+            self._last_wire_s = wire_s
+        if getattr(self.config, "tpu_telemetry_device_stats", True):
+            try:
+                digest["hbm_bytes"] = int(
+                    device.device_stats().get("live_bytes", 0))
+            except Exception as exc:  # noqa: BLE001 — probe is best-effort
+                log.debug("federation: device stats probe failed: %s", exc)
+        return digest
+
+    def _phase_deltas(self, profiler) -> Dict[str, Dict[str, float]]:
+        """Top-N per-phase (ms, calls) deltas since the last digest —
+        the recorder's _phase_deltas shape, but bounded for the wire and
+        with its own baseline (the two must not steal each other's
+        deltas)."""
+        snap = profiler.snapshot()
+        out: Dict[str, Dict[str, float]] = {}
+        for name, cur in snap.items():
+            prev = self._last_phases.get(name, {"total_s": 0.0, "calls": 0})
+            d_total = cur["total_s"] - prev["total_s"]
+            d_calls = cur["calls"] - prev["calls"]
+            if d_calls > 0 or d_total > 1e-9:
+                out[name] = {"ms": round(d_total * 1e3, 3),
+                             "calls": d_calls}
+        self._last_phases = snap
+        top = sorted(out.items(), key=lambda kv: -kv[1]["ms"])
+        return dict(top[:self.top_phases])
+
+    def _span_deltas(self) -> Dict[str, Dict[str, float]]:
+        tracer = tracing.get_tracer()
+        if not tracer.enabled:
+            return {}
+        snap = tracer.kind_snapshot()
+        out: Dict[str, Dict[str, float]] = {}
+        for kind, cur in snap.items():
+            prev = self._last_spans.get(kind, {"ms": 0.0, "count": 0})
+            d_count = cur["count"] - prev["count"]
+            if d_count > 0:
+                out[kind] = {"ms": round(cur["ms"] - prev["ms"], 3),
+                             "count": d_count}
+        self._last_spans = snap
+        return out
+
+    # -- hub ------------------------------------------------------------ #
+    def _aggregate(self, iteration: int, digests: List[Dict],
+                   comm) -> None:
+        from .critical_path import build_ledger
+        from .recorder import cluster_event, round_ledger_event
+        reg = self.registry
+        for name, help_text in CLUSTER_GAUGES:
+            # touch the families so /cluster renders a stable schema
+            # (names audited in the CLUSTER_GAUGES table)
+            reg.gauge(name, help=help_text, host="0")  # tpulint: ok=metrics-dynamic-name
+        for d in digests:
+            host = str(d.get("orig", d.get("rank", 0)))
+            reg.gauge("lgbm_cluster_host_wall_ms", host=host).set(
+                float(d.get("wall_ms", 0.0) or 0.0))
+            reg.gauge("lgbm_cluster_host_comm_wait_share", host=host).set(
+                float(d.get("comm_wait_share", 0.0) or 0.0))
+            reg.gauge("lgbm_cluster_host_rtt_ms", host=host).set(
+                float(d.get("rtt_ms", 0.0) or 0.0))
+            reg.gauge("lgbm_cluster_host_hbm_bytes", host=host).set(
+                float(d.get("hbm_bytes", 0) or 0))
+            reg.gauge("lgbm_cluster_host_wire_ms", host=host).set(
+                float(d.get("wire_ms", 0.0) or 0.0))
+        reg.gauge("lgbm_cluster_hosts",
+                  help="Hosts in the last federated round").set(len(digests))
+        reg.gauge("lgbm_cluster_round",
+                  help="Last federated round index").set(iteration)
+        peer_waits_ms: Dict[int, float] = {}
+        if comm is not None and hasattr(comm, "take_peer_waits"):
+            try:
+                peer_waits_ms = {int(r): dt * 1e3 for r, dt
+                                 in comm.take_peer_waits().items()}
+            except Exception as exc:  # noqa: BLE001
+                log.debug("federation: take_peer_waits failed: %s", exc)
+        ledger = build_ledger(iteration, digests, peer_waits_ms)
+        self._ledgers.append(ledger)
+        if len(self._ledgers) > 256:
+            del self._ledgers[:len(self._ledgers) - 256]
+        reg.gauge("lgbm_cluster_straggler_wait_ms",
+                  help="Hub wait on the slowest peer, last round").set(
+            ledger["straggler_wait_ms"])
+        self._latest = {
+            "round": iteration,
+            "hosts": {str(d.get("orig", d.get("rank", 0))): d
+                      for d in digests},
+            "ledger": ledger,
+        }
+        cluster_event(self.config, round=iteration, hosts=digests)
+        round_ledger_event(self.config, **ledger)
+
+    # -- hub http endpoint ---------------------------------------------- #
+    def _ensure_http(self) -> None:
+        port = int(getattr(self.config, "tpu_federation_port", 0) or 0)
+        if port <= 0 or self._http is not None or self._closed:
+            return
+        try:
+            self._http = _serve_hub(self, port)
+            log.info("federation: hub endpoint on :%d (/cluster /alerts "
+                     "/metrics)", self._http.server_address[1])
+        except Exception as exc:  # noqa: BLE001 — degrade to warning
+            log.warning("federation: hub http endpoint failed to start "
+                        "on port %d: %s", port, exc)
+            self._http = False  # don't retry every round
+
+    def cluster_payload(self) -> Dict:
+        return dict(self._latest, ledgers=self._ledgers[-32:])
+
+    def alerts_payload(self) -> Optional[Dict]:
+        return self.engine.snapshot() if self.engine is not None else None
+
+
+def cluster_snapshot(registry: MetricsRegistry) -> Dict:
+    """Per-host cluster view assembled from the lgbm_cluster_* /
+    lgbm_hybrid_host_* gauge families — the `/cluster` payload for
+    processes that hold no Federation object (the serving server)."""
+    snap = registry.collect()
+    hosts: Dict[str, Dict] = {}
+    field_by_family = {name: name[len("lgbm_cluster_host_"):]
+                       for name, _ in CLUSTER_GAUGES}
+    field_by_family["lgbm_hybrid_host_up"] = "up"
+    field_by_family["lgbm_hybrid_host_slow"] = "slow"
+    for family, field in field_by_family.items():
+        fam = snap.get(family)
+        if fam is None:
+            continue
+        for labels, value in fam["values"]:
+            host = labels.get("host")
+            if host is None:
+                continue
+            hosts.setdefault(host, {"host": host})[field] = value
+    out: Dict = {"hosts": [hosts[h] for h in sorted(hosts, key=_host_key)]}
+    rnd = snap.get("lgbm_cluster_round")
+    if rnd is not None and rnd["values"]:
+        out["round"] = rnd["values"][0][1]
+    return out
+
+
+def _host_key(h: str):
+    return (0, int(h)) if h.isdigit() else (1, h)
+
+
+def _serve_hub(fed: Federation, port: int):
+    """Tiny read-only HTTP endpoint on the training hub (daemon thread):
+    GET /cluster, /alerts, /metrics.  Mirrors the serving server's
+    endpoints so one dashboard config scrapes both."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # quiet by design
+            log.debug("federation http: " + fmt, *args)
+
+        def _reply(self, code: int, body: bytes, ctype: str) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            try:
+                if self.path == "/cluster":
+                    body = json.dumps(fed.cluster_payload()).encode()
+                    self._reply(200, body, "application/json")
+                elif self.path == "/alerts":
+                    payload = fed.alerts_payload()
+                    if payload is None:
+                        self._reply(404, b'{"error":"alerting disabled"}',
+                                    "application/json")
+                    else:
+                        self._reply(200, json.dumps(payload).encode(),
+                                    "application/json")
+                elif self.path == "/metrics":
+                    self._reply(200,
+                                fed.registry.render_prometheus().encode(),
+                                "text/plain; version=0.0.4")
+                else:
+                    self._reply(404, b'{"error":"not found"}',
+                                "application/json")
+            except Exception as exc:  # noqa: BLE001 — scrape never raises
+                log.debug("federation http handler failed: %s", exc)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever,
+                     name="lgbm-federation-http", daemon=True).start()
+    return httpd

@@ -400,6 +400,65 @@ def comm_backend_event(config, backend: str, **fields) -> None:
                     path, exc)
 
 
+def cluster_event(config, **fields) -> None:
+    """Append one federated-telemetry aggregate ({"event": "cluster",
+    "round": ..., "hosts": [...]}) to Config.tpu_telemetry_path.  The
+    federation hub aggregates EVERY rank's digest, so like the elastic
+    and fleet events it appends directly rather than through one
+    booster's TrainingRecorder — same JSONL contract, best-effort;
+    tools/round_report.py and tools/telemetry_report.py render these."""
+    path = getattr(config, "tpu_telemetry_path", "")
+    if not path:
+        return
+    event = {"event": "cluster"}
+    event.update(fields)
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(event, default=_json_default,
+                               separators=(",", ":")) + "\n")
+    except Exception as exc:  # noqa: BLE001 — telemetry never raises
+        log.warning("telemetry: cluster event write to %s failed: %s",
+                    path, exc)
+
+
+def round_ledger_event(config, **fields) -> None:
+    """Append one critical-path ledger line ({"event": "round_ledger",
+    "round": ..., "critical_host": ..., ...}, see
+    obs/critical_path.build_ledger) to Config.tpu_telemetry_path —
+    same JSONL contract, best-effort."""
+    path = getattr(config, "tpu_telemetry_path", "")
+    if not path:
+        return
+    event = {"event": "round_ledger"}
+    event.update(fields)
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(event, default=_json_default,
+                               separators=(",", ":")) + "\n")
+    except Exception as exc:  # noqa: BLE001 — telemetry never raises
+        log.warning("telemetry: round_ledger event write to %s failed: %s",
+                    path, exc)
+
+
+def alert_event(config, **fields) -> None:
+    """Append one alert transition ({"event": "alert", "rule": ...,
+    "state": "firing"|"cleared", ...}) to Config.tpu_telemetry_path —
+    same JSONL contract, best-effort; the slow_host chaos drill greps
+    these lines for the fire-then-clear observable."""
+    path = getattr(config, "tpu_telemetry_path", "")
+    if not path:
+        return
+    event = {"event": "alert"}
+    event.update(fields)
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(event, default=_json_default,
+                               separators=(",", ":")) + "\n")
+    except Exception as exc:  # noqa: BLE001 — telemetry never raises
+        log.warning("telemetry: alert event write to %s failed: %s",
+                    path, exc)
+
+
 def fleet_event(config, what: str, **fields) -> None:
     """Append one fleet-residency event ({"event": "fleet", "what":
     "admit"|"spill"|"promote"|"demote"|"degrade"|"spill_corrupt"|

@@ -401,8 +401,12 @@ class SocketComm:
         self._clock_offset_s = 0.0
         self._clock_rtt_s = 0.0
         # hub-side straggler signal: per-peer blocking-recv seconds from
-        # the most recent allgather (slow_hosts reads it)
+        # the most recent allgather (slow_hosts reads it), plus the
+        # per-peer MAX since take_peer_waits last drained it — a round
+        # runs many allgathers and the straggler shows in the worst one,
+        # which last-wins _peer_waits would overwrite
         self._peer_waits: Dict[int, float] = {}
+        self._peer_waits_max: Dict[int, float] = {}
 
     @classmethod
     def from_config(cls, rank: int, world: int, machines: List[str],
@@ -511,6 +515,18 @@ class SocketComm:
                 out.append(int(membership[i]) if membership else i)
         return sorted(out)
 
+    def take_peer_waits(self) -> Dict[int, float]:
+        """Per-peer MAX blocking-recv seconds since the last call, keyed
+        by ORIGINAL rank when membership is known (ElasticComm), else by
+        current rank — then reset.  The federation hub reads this once
+        per round to charge straggler wait in the round ledger; unlike
+        slow_hosts it reports the worst wait of the whole round, not
+        just the last allgather's.  Hub only (spokes see no waits)."""
+        waits, self._peer_waits_max = self._peer_waits_max, {}
+        membership = getattr(self, "membership", None)
+        return {(int(membership[i]) if membership else i): dt
+                for i, dt in waits.items()}
+
     # -- span-trace correlation ----------------------------------------
     def _publish_trace_identity(self) -> None:
         """Hand the process tracer this rank's comm coordinates: session
@@ -566,6 +582,9 @@ class SocketComm:
                     waits[i] = time.monotonic() - t0
                 out[i] = None if got is _DROPPED else got
             self._peer_waits = waits
+            for i, dt in waits.items():
+                if dt > self._peer_waits_max.get(i, 0.0):
+                    self._peer_waits_max[i] = dt
             blob = _encode(out)
             for i, conn in enumerate(self._peers, start=1):
                 with _maybe_span(tr, "comm/send", peer=i, trace_id=cid,

@@ -245,12 +245,21 @@ class Heartbeat:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._gauge = None
+        self._miss_gauge = None
         if registry is not None:
             self._gauge = registry.gauge(
                 "lgbm_comm_alive_ranks",
                 help="Ranks the heartbeat currently considers alive",
                 rank=str(rank), world=str(world))
             self._gauge.set(world)
+            # worst consecutive-miss streak across peers: the alert
+            # engine's heartbeat_miss rule watches this — it climbs
+            # BEFORE conviction flips alive_ranks
+            self._miss_gauge = registry.gauge(
+                "lgbm_comm_heartbeat_miss_streak",
+                help="Max consecutive missed heartbeat probes over peers",
+                rank=str(rank), world=str(world))
+            self._miss_gauge.set(0)
 
     def start(self) -> "Heartbeat":
         if self._thread is None:
@@ -300,6 +309,8 @@ class Heartbeat:
         self._dead = dead
         if self._gauge is not None:
             self._gauge.set(self.world - len(dead))
+        if self._miss_gauge is not None:
+            self._miss_gauge.set(max(self._misses.values(), default=0))
         if changed and self.on_change is not None:
             try:
                 self.on_change(set(dead))

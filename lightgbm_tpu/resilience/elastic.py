@@ -46,9 +46,10 @@ Chaos hooks: ``LGBM_TPU_CHAOS=kill:<orig_rank>:<round>`` (also
 ``exit:``/``slow:<orig>:<round>:<secs>``/``partition:<orig>:<round>``)
 makes that rank injure itself at the start of that round of generation
 0 — tools/chaos_run.py drives real multi-process scenarios with it.
-``lag:<orig>:<round>:<secs>`` is the straggler drill: it sleeps in the
-TRAIN thread every round from ``<round>`` on while the control thread
-keeps answering pings, so the host is marked slow but never convicted.
+``lag:<orig>:<round>:<secs>[:<until>]`` is the straggler drill: it
+sleeps in the TRAIN thread every round from ``<round>`` on (stopping at
+``<until>`` when given) while the control thread keeps answering pings,
+so the host is marked slow but never convicted.
 """
 from __future__ import annotations
 
@@ -420,8 +421,12 @@ class ElasticSupervisor:
             # spoke's control thread keeps answering pings, so the host
             # is marked *slow* by the hub's leader-phase timer but never
             # convicted.  Fires every round from `at` on (no
-            # _chaos_fired), unlike the one-shot kinds.
+            # _chaos_fired), unlike the one-shot kinds.  An optional 5th
+            # field bounds it — lag:<orig>:<at>:<secs>:<until> stops at
+            # round `until` so alert-clear drills can watch recovery.
             secs = float(parts[3]) if len(parts) > 3 else 0.5
+            if len(parts) > 4 and round_idx >= int(parts[4]):
+                return
             log.warning("chaos: lag %.2fs on rank %d at round %d",
                         secs, comm.orig_rank, round_idx)
             time.sleep(secs)

@@ -85,6 +85,17 @@ class Server:
         obs_adapters.ensure_comm_metrics(self.metrics)
         if self.fleet is not None:
             publish_fleet_metrics(self.metrics, self.fleet)
+        # SLO alerting (obs/alerts.py): the rule engine ticks on every
+        # stats snapshot and serves GET /alerts; init failure degrades
+        # to a warning, never a dead server
+        self.alerts = None
+        if getattr(cfg, "tpu_alert", False):
+            try:
+                from ..obs.alerts import AlertEngine
+                self.alerts = AlertEngine.from_config(cfg, self.metrics)
+            except Exception as exc:  # noqa: BLE001 — alerting is optional
+                log.warning("serving alerts disabled: engine init "
+                            "failed (%s)", exc)
         # span timeline for the request lifecycle (enqueue -> micro-batch
         # -> device -> respond) when tpu_trace_path is set; flushed on
         # shutdown and harmless to leave armed
@@ -289,6 +300,16 @@ class Server:
             stats = dict(self._stats)
             batchers = dict(self._batchers)
             breakers = {n: b.snapshot() for n, b in self._breakers.items()}
+        if self.alerts is not None:
+            try:
+                # each stats tick is an alert-engine tick: sustained and
+                # burn-rate rules need a steady cadence to converge
+                self.alerts.evaluate()
+            except Exception as exc:  # noqa: BLE001 — never break /stats
+                log.warning("alert evaluation failed (%s); disabling "
+                            "serving alerts", exc)
+                with self._lock:
+                    self.alerts = None
         return {
             "uptime_s": round(time.time() - self._start_t, 3),
             "draining": self._draining,
@@ -304,6 +325,8 @@ class Server:
             "quota": (self._quota.snapshot()
                       if self._quota is not None else None),
             "phases": self.profiler.snapshot(),
+            "alerts": (self.alerts.active()
+                       if self.alerts is not None else None),
         }
 
     def metrics_text(self) -> str:
@@ -499,6 +522,16 @@ def _make_handler(server: Server):
                                       "(set tpu_fleet_hbm_budget_mb)"})
                 else:
                     self._reply(200, server.fleet.snapshot())
+            elif path == "/alerts":
+                if server.alerts is None:
+                    self._reply(404, {"error": "alerting disabled "
+                                      "(set tpu_alert)"})
+                else:
+                    self._reply(200, server.alerts.snapshot())
+            elif path == "/cluster":
+                from ..obs import federation as _federation
+                self._reply(200,
+                            _federation.cluster_snapshot(server.metrics))
             elif path == "/readyz":
                 # readiness: route traffic here?  503 while draining or
                 # model-less so load balancers rotate this replica out

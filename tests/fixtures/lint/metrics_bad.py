@@ -1,0 +1,37 @@
+"""Deliberate metrics-hygiene violations (never scanned by the repo
+gate — tests/ is outside DEFAULT_ROOTS)."""
+
+
+class _Registry:
+    def counter(self, name, help="", **labels):
+        return self
+
+    def gauge(self, name, help="", **labels):
+        return self
+
+    def histogram(self, name, bounds=(), help="", **labels):
+        return self
+
+
+registry = _Registry()
+
+
+def bad_prefix():
+    # name escapes the lgbm_ namespace: invisible to every dashboard glob
+    registry.counter("serve_requests_total", help="oops")
+    registry.gauge("up", help="oops")
+
+
+def bad_labels(request_id, row):
+    # per-request label values: unbounded cardinality
+    registry.counter("lgbm_serve_requests_total",
+                     request=f"req-{request_id}")
+    registry.gauge("lgbm_serve_queue_depth_rows",
+                   row="row-%d" % row)
+    registry.histogram("lgbm_serve_latency_ms",
+                       shard="{}".format(row))
+
+
+def bad_dynamic(name):
+    # name unauditable by the prefix check
+    registry.gauge(name, help="who knows")

@@ -1,0 +1,341 @@
+"""Cluster observability plane (obs/federation.py + critical_path.py +
+alerts.py): alert rule semantics (fire / sustain / clear / burn-rate),
+critical-path ledger attribution, globally-synced init scores
+(boost_from_average parity), bitwise model identity with the plane on
+vs off, the round_report tool, and the serving /alerts + /cluster
+endpoints — all on the fast tier (JAX_PLATFORMS=cpu, conftest)."""
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.obs import MetricsRegistry
+from lightgbm_tpu.obs.alerts import AlertEngine, Rule, load_rules
+from lightgbm_tpu.obs.critical_path import build_ledger, critical_counts
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+def _train_data(n=300, nf=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, nf)
+    y = 2.0 * X[:, 0] - X[:, 1] + 0.05 * rng.randn(n)
+    return X, y
+
+
+# ---------------------------------------------------------------- alerts
+
+def test_threshold_rule_fires_and_clears():
+    reg = MetricsRegistry()
+    g = reg.gauge("lgbm_test_depth")
+    eng = AlertEngine(reg, rules=[Rule("deep", "lgbm_test_depth", ">", 5.0)])
+    g.set(3)
+    assert eng.evaluate() == [] and eng.active() == []
+    g.set(9)
+    (t,) = eng.evaluate()
+    assert (t["rule"], t["state"], t["value"]) == ("deep", "firing", 9.0)
+    assert eng.active() == ["deep"]
+    assert reg.gauge("lgbm_alerts_active", rule="deep").value == 1.0
+    g.set(2)
+    (t,) = eng.evaluate()
+    assert t["state"] == "cleared" and eng.active() == []
+    assert reg.gauge("lgbm_alerts_active", rule="deep").value == 0.0
+
+
+def test_sustained_rule_needs_consecutive_breaches():
+    reg = MetricsRegistry()
+    g = reg.gauge("lgbm_hybrid_host_slow", host="1")
+    eng = AlertEngine(reg, rules=[Rule(
+        "straggler", "lgbm_hybrid_host_slow", ">=", 1.0, "sustained",
+        for_ticks=3)])
+    # two breaches, a clean tick, two more: never fires (streak resets)
+    for v in (1, 1, 0, 1, 1):
+        g.set(v)
+        assert eng.evaluate() == []
+    # the third CONSECUTIVE breach fires; first clean tick clears
+    g.set(2)
+    (t,) = eng.evaluate()
+    assert t["state"] == "firing" and eng.active() == ["straggler"]
+    g.set(0)
+    (t,) = eng.evaluate()
+    assert t["state"] == "cleared"
+
+
+def test_burn_rate_rule_watches_slope_not_level():
+    reg = MetricsRegistry()
+    c = reg.counter("lgbm_serve_shed_total", model="m")
+    eng = AlertEngine(reg, rules=[Rule(
+        "shed", "lgbm_serve_shed_total", ">", 1.0, "burn_rate", window=4)])
+    eng.evaluate()                       # tick 1: baseline sample
+    c.inc(50)                            # a 50/tick burst
+    (t,) = eng.evaluate()
+    assert t["state"] == "firing" and t["value"] > 1.0
+    # the counter stays HIGH but stops growing: the rule must clear
+    # once the burst slides out of the window
+    for _ in range(8):
+        transitions = eng.evaluate()
+        if transitions:
+            break
+    assert transitions and transitions[0]["state"] == "cleared"
+    assert eng.active() == []
+
+
+def test_rule_label_subset_match():
+    reg = MetricsRegistry()
+    reg.gauge("lgbm_hybrid_host_slow", host="0").set(0)
+    reg.gauge("lgbm_hybrid_host_slow", host="1").set(5)
+    pinned = AlertEngine(reg, rules=[Rule(
+        "h0", "lgbm_hybrid_host_slow", ">=", 1.0, labels={"host": "0"})])
+    anyhost = AlertEngine(reg, rules=[Rule(
+        "any", "lgbm_hybrid_host_slow", ">=", 1.0)])
+    assert pinned.evaluate() == []           # host 0 is fine
+    assert anyhost.evaluate()[0]["state"] == "firing"   # worst child
+
+
+def test_rule_file_and_alert_events(tmp_path):
+    rules_path = tmp_path / "rules.json"
+    rules_path.write_text(json.dumps([
+        {"name": "hot", "metric": "lgbm_test_temp", "op": ">",
+         "threshold": 1.5, "kind": "sustained", "for": 2}]))
+    (rule,) = load_rules(str(rules_path))
+    assert (rule.name, rule.kind, rule.for_ticks) == ("hot", "sustained", 2)
+
+    tele = tmp_path / "t.jsonl"
+    cfg = Config({"tpu_telemetry_path": str(tele), "verbose": "-1"})
+    reg = MetricsRegistry()
+    reg.gauge("lgbm_test_temp").set(9)
+    eng = AlertEngine(reg, rules=[rule], config=cfg)
+    eng.evaluate()
+    eng.evaluate()
+    events = [json.loads(l) for l in open(tele)]
+    assert [(e["event"], e["rule"], e["state"]) for e in events] == \
+        [("alert", "hot", "firing")]
+
+
+def test_engine_snapshot_schema():
+    reg = MetricsRegistry()
+    eng = AlertEngine(reg)      # the built-in default rule set
+    eng.evaluate()
+    snap = eng.snapshot()
+    assert snap["tick"] == 1 and snap["active"] == []
+    names = {r["name"] for r in snap["rules"]}
+    assert {"straggler_host", "comm_wait_share", "heartbeat_miss",
+            "breaker_flap", "shed_rate"} <= names
+
+
+# ---------------------------------------------------------- critical path
+
+def _hub_digest():
+    return {"rank": 0, "orig": 0, "wall_ms": 100.0, "comm_wait_ms": 40.0,
+            "comm_wait_share": 0.4,
+            "phases": {"tree_grow": {"ms": 50.0, "calls": 1},
+                       "comm/allgather": {"ms": 40.0, "calls": 2}},
+            "spans": {"comm/mesh_psum": {"ms": 10.0, "count": 4}}}
+
+
+def test_ledger_attributes_lag_to_the_straggling_host():
+    peer = {"rank": 1, "orig": 3, "wall_ms": 95.0,
+            "phases": {"hist_build": {"ms": 20.0, "calls": 1}}}
+    led = build_ledger(7, [_hub_digest(), peer], peer_waits_ms={3: 60.0})
+    # the lagged host wins the critical slot via the wait it inflicts
+    # on the hub even though its own phase profile looks ordinary
+    assert (led["critical_host"], led["critical_phase"]) == \
+        (3, "straggler_wait")
+    assert led["straggler_wait_ms"] == 60.0
+    assert led["round"] == 7 and led["wall_ms"] == 100.0
+    assert led["leader_wire_ms"] == 40.0
+    assert led["compute_ms"] == pytest.approx(100.0 - 40.0 - 10.0)
+    host3 = next(h for h in led["hosts"] if h["host"] == 3)
+    assert host3["hub_wait_ms"] == 60.0
+    # wait phases never compete as local compute
+    assert all(p["phase"] != "comm/allgather"
+               for h in led["hosts"] for p in h["top_phases"])
+
+
+def test_ledger_local_phase_wins_without_stragglers():
+    led = build_ledger(0, [_hub_digest()])
+    assert (led["critical_host"], led["critical_phase"]) == (0, "tree_grow")
+    assert led["straggler_wait_ms"] == 0.0
+    assert critical_counts([led, led]) == {0: 2}
+
+
+# ------------------------------------------------- init-score global sync
+
+@pytest.mark.parametrize("objective,params,n_class", [
+    ("regression", {}, 1),
+    ("binary", {}, 1),
+    ("poisson", {}, 1),
+    ("xentropy", {}, 1),
+    ("multiclass", {"num_class": 3}, 3),
+    ("multiclassova", {"num_class": 3}, 3),
+])
+def test_boost_stats_parity_with_local_score(objective, params, n_class):
+    """boost_from_stats(sum of per-shard boost_stats) must equal the
+    serial boost_from_score on the concatenated data — the contract the
+    distributed allreduce in GBDT._global_init_score relies on."""
+    from lightgbm_tpu.io.metadata import Metadata
+    from lightgbm_tpu.objective import create_objective
+
+    rng = np.random.RandomState(5)
+    n = 120
+    if objective in ("binary", "xentropy"):
+        y = (rng.rand(n) > 0.4).astype(np.float32)
+    elif n_class > 1:
+        y = rng.randint(0, n_class, size=n).astype(np.float32)
+    else:
+        y = (rng.rand(n) * 3 + 0.1).astype(np.float32)
+
+    def _make(label):
+        obj = create_objective(objective, dict(params, verbose=-1))
+        md = Metadata(len(label))
+        md.label = np.asarray(label, np.float32)
+        obj.init(md, len(label))
+        return obj
+
+    full = _make(y)
+    shards = [_make(y[:50]), _make(y[50:])]
+    for cid in range(n_class):
+        parts = [s.boost_stats(cid) for s in shards]
+        assert all(p is not None and p.dtype == np.float64 for p in parts)
+        total = np.sum(parts, axis=0)
+        assert full.boost_from_stats(total, cid) == \
+            pytest.approx(full.boost_from_score(cid), rel=1e-6, abs=1e-9)
+
+
+def test_percentile_objectives_have_no_sufficient_stats():
+    # L1/quantile/MAPE init from a percentile, fair from 0 — a global
+    # MEAN would silently diverge from the serial init, so they must
+    # opt out of the stats sync (gbdt falls back to local + warning)
+    from lightgbm_tpu.io.metadata import Metadata
+    from lightgbm_tpu.objective import create_objective
+    y = np.abs(np.random.RandomState(0).randn(40)).astype(np.float32) + 0.1
+    for name in ("regression_l1", "quantile", "mape", "fair"):
+        obj = create_objective(name, {"verbose": -1})
+        md = Metadata(len(y))
+        md.label = y
+        obj.init(md, len(y))
+        assert obj.boost_stats() is None
+
+
+# ------------------------------------------------------- bitwise identity
+
+def test_federation_bitwise_identical_model(tmp_path):
+    X, y = _train_data(seed=3)
+    params = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5, "boost_from_average": True}
+    path = str(tmp_path / "tele.jsonl")
+    b_on = lgb.train(dict(params, tpu_federation=True, tpu_alert=True,
+                          tpu_telemetry_path=path),
+                     lgb.Dataset(X, label=y), num_boost_round=5)
+    b_off = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                      num_boost_round=5)
+    assert b_on.model_to_string() == b_off.model_to_string()
+    events = [json.loads(l) for l in open(path)]
+    kinds = {e["event"] for e in events}
+    assert {"cluster", "round_ledger"} <= kinds
+    ledgers = [e for e in events if e["event"] == "round_ledger"]
+    assert len(ledgers) == 5
+    assert all(e["critical_host"] is not None for e in ledgers)
+    # world=1 run: the hub digest is this process
+    (digest,) = [e for e in events if e["event"] == "cluster"][0]["hosts"]
+    assert digest["rank"] == 0 and digest["wall_ms"] > 0
+
+
+# ----------------------------------------------------------------- tools
+
+def test_round_report_tool(tmp_path):
+    path = tmp_path / "t.jsonl"
+    lines = [
+        {"event": "round_ledger", "round": 0, "wall_ms": 100.0,
+         "compute_ms": 50.0, "mesh_psum_ms": 10.0, "leader_wire_ms": 40.0,
+         "straggler_wait_ms": 60.0, "critical_host": 3,
+         "critical_phase": "straggler_wait", "critical_ms": 60.0,
+         "hosts": []},
+        {"event": "alert", "rule": "straggler_host", "state": "firing",
+         "metric": "lgbm_hybrid_host_slow", "kind": "sustained",
+         "value": 3.0, "threshold": 1.0, "tick": 4},
+    ]
+    path.write_text("".join(json.dumps(e) + "\n" for e in lines))
+    sys.path.insert(0, TOOLS)
+    try:
+        import round_report
+        out = round_report.render(round_report.load_events(str(path)))
+    finally:
+        sys.path.remove(TOOLS)
+    assert "host 3 straggler_wait" in out
+    assert "straggler_host" in out and "firing" in out
+
+
+def test_telemetry_report_renders_cluster_sections(tmp_path):
+    X, y = _train_data(n=150)
+    path = str(tmp_path / "tele.jsonl")
+    lgb.train({"objective": "regression", "num_leaves": 7, "verbose": -1,
+               "min_data_in_leaf": 5, "tpu_federation": True,
+               "tpu_telemetry_path": path},
+              lgb.Dataset(X, label=y), num_boost_round=3)
+    sys.path.insert(0, TOOLS)
+    try:
+        import telemetry_report
+        out = telemetry_report.render(telemetry_report.load_events(path))
+    finally:
+        sys.path.remove(TOOLS)
+    assert "cluster: 3 federated rounds, 1 hosts" in out
+    assert "critical path:" in out
+
+
+# ------------------------------------------------------ serving endpoints
+
+def _get_json(port, route):
+    resp = urllib.request.urlopen(
+        "http://127.0.0.1:%d%s" % (port, route), timeout=30)
+    return json.loads(resp.read().decode())
+
+
+def test_serving_alerts_and_cluster_endpoints():
+    from lightgbm_tpu.serving import Server
+
+    X, y = _train_data()
+    bst = lgb.Booster(params={"objective": "regression", "num_leaves": 7,
+                              "verbose": -1, "min_data_in_leaf": 5},
+                      train_set=lgb.Dataset(X, label=y))
+    bst.update()
+
+    srv = Server(Config({"verbose": "-1", "tpu_alert": "true"}))
+    assert srv.alerts is not None
+    srv.load_model("m1", model_str=bst.model_to_string())
+    httpd = srv.serve_http(port=0, block=False)
+    try:
+        port = httpd.server_address[1]
+        stats = _get_json(port, "/stats")
+        assert stats["alerts"] == []        # the tick ran, nothing firing
+        alerts = _get_json(port, "/alerts")
+        assert alerts["active"] == [] and alerts["tick"] >= 1
+        assert {r["name"] for r in alerts["rules"]} >= {"shed_rate"}
+        cluster = _get_json(port, "/cluster")
+        assert "hosts" in cluster
+    finally:
+        httpd.shutdown()
+        srv.shutdown()
+
+
+def test_serving_alerts_endpoint_404_when_disabled():
+    from lightgbm_tpu.serving import Server
+
+    srv = Server(Config({"verbose": "-1"}))
+    assert srv.alerts is None
+    httpd = srv.serve_http(port=0, block=False)
+    try:
+        port = httpd.server_address[1]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get_json(port, "/alerts")
+        assert ei.value.code == 404
+    finally:
+        httpd.shutdown()
+        srv.shutdown()

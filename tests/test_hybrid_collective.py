@@ -364,14 +364,18 @@ def _params(quantized):
     return p
 
 
-def _train_serial(X, y, quantized, rounds=N_ROUNDS):
-    params = dict(_params(quantized), tree_learner="serial")
+def _train_serial(X, y, quantized, rounds=N_ROUNDS, extra=None,
+                  use_fobj=True):
+    params = dict(_params(quantized), tree_learner="serial",
+                  **(extra or {}))
     b = lgb.train(params, lgb.Dataset(X, label=y),
-                  num_boost_round=rounds, fobj=_dyadic_fobj)
+                  num_boost_round=rounds,
+                  fobj=_dyadic_fobj if use_fobj else None)
     return b.model_to_string()
 
 
-def _hybrid_worker(rank, world, machines, X, y, quantized, resume, q):
+def _hybrid_worker(rank, world, machines, X, y, quantized, resume, q,
+                   extra=None, use_fobj=True, rounds=N_ROUNDS):
     """One HOST of the hybrid world (spawned process; module-level).
     The inherited XLA_FLAGS (conftest) provides 8 CPU devices; the
     hybrid backend takes 2 of them for the inner mesh.  With
@@ -393,16 +397,18 @@ def _hybrid_worker(rank, world, machines, X, y, quantized, resume, q):
             params = dict(_params(quantized), tree_learner="data",
                           num_machines=world, machine_rank=rank,
                           tpu_comm_backend="hybrid",
-                          tpu_hybrid_local_devices=2)
+                          tpu_hybrid_local_devices=2,
+                          **(extra or {}))
             cfg = Config(dict(params))
             shard = construct_rank_shard(X, cfg, rank, world, comm,
                                          label=y, pre_partition=True)
 
-            def train(extra=None, rounds=N_ROUNDS, **kw):
+            def train(extra=None, rounds=rounds, **kw):
                 ds = Dataset(X[shard.dist_row_ids], params=dict(params))
                 ds._binned = shard
                 b = lgb.train(dict(params, **(extra or {})), ds,
-                              num_boost_round=rounds, fobj=_dyadic_fobj,
+                              num_boost_round=rounds,
+                              fobj=_dyadic_fobj if use_fobj else None,
                               **kw)
                 g = b._gbdt._grower
                 assert g is not None and g.collective.backend == "hybrid"
@@ -432,14 +438,15 @@ def _hybrid_worker(rank, world, machines, X, y, quantized, resume, q):
         q.put((rank, "fail", traceback.format_exc()))
 
 
-def _train_hybrid(X, y, quantized, world=2, resume=None):
+def _train_hybrid(X, y, quantized, world=2, resume=None, extra=None,
+                  use_fobj=True, rounds=N_ROUNDS):
     port = _free_port()
     machines = ["127.0.0.1:%d" % port] * world
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     procs = [ctx.Process(target=_hybrid_worker,
                          args=(r, world, machines, X, y, quantized,
-                               resume, q))
+                               resume, q, extra, use_fobj, rounds))
              for r in range(world)]
     for p in procs:
         p.start()
@@ -472,6 +479,44 @@ def test_hybrid_two_hosts_bitwise_vs_serial(quantized):
     hybrid = _train_hybrid(X, y, quantized)
     assert hybrid[0]["full"] == serial, \
         "hybrid 2x2 diverged from serial"
+
+
+@pytest.mark.slow
+def test_hybrid_boost_from_average_bitwise_vs_serial():
+    """boost_from_average with a REAL objective: the init score is now
+    computed from globally-allreduced sufficient stats, so serial and
+    hybrid seed from the same global mean (it used to be the one
+    per-rank divergence; the chaos drills had to disable it).  One round
+    with dyadic labels and n a power of two keeps every partial sum and
+    the mean itself exact in f32, so the comparison is bitwise."""
+    X, y = _make_data(n=512)
+    extra = {"objective": "regression", "boost_from_average": True}
+    serial = _train_serial(X, y, quantized=False, rounds=1, extra=extra,
+                           use_fobj=False)
+    hybrid = _train_hybrid(X, y, quantized=False, extra=extra,
+                           use_fobj=False, rounds=1)
+    assert hybrid[0]["full"] == serial, \
+        "hybrid boost_from_average diverged from serial"
+
+
+@pytest.mark.slow
+def test_hybrid_federation_bitwise(tmp_path):
+    """Telemetry federation + alerting are strictly read-only: a hybrid
+    run with both enabled produces a bitwise-identical model to a run
+    with both disabled (and to serial)."""
+    X, y = _make_data()
+    plain = _train_hybrid(X, y, quantized=False)
+    federated = _train_hybrid(
+        X, y, quantized=False,
+        extra={"tpu_federation": True, "tpu_alert": True,
+               "tpu_telemetry_path": str(tmp_path / "telemetry.jsonl")})
+    assert federated[0]["full"] == plain[0]["full"], \
+        "federation/alerting changed the trained model"
+    events = [json.loads(line) for line in
+              (tmp_path / "telemetry.jsonl").read_text().splitlines()]
+    kinds = {e.get("event") for e in events}
+    assert "round_ledger" in kinds, \
+        "federated hybrid run emitted no round_ledger events"
 
 
 @pytest.mark.slow

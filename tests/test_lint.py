@@ -209,6 +209,25 @@ def test_donation_ok_fixture_is_clean():
                 if f.check.startswith("donation-")]
 
 
+# -- metrics hygiene ------------------------------------------------------
+
+def test_metrics_bad_fixture_fires():
+    fs = [f for f in _run("metrics_bad.py")
+          if f.check.startswith("metrics-")]
+    assert {"metrics-name-prefix", "metrics-unbounded-label",
+            "metrics-dynamic-name"} == _checks(fs)
+    prefix = [f for f in fs if f.check == "metrics-name-prefix"]
+    assert len(prefix) == 2 and all(f.severity == "HIGH" for f in prefix)
+    # all three formatted-string shapes are caught: f-string, %, .format
+    labels = [f for f in fs if f.check == "metrics-unbounded-label"]
+    assert len(labels) == 3 and all(f.severity == "MEDIUM" for f in labels)
+
+
+def test_metrics_ok_fixture_is_clean():
+    assert not [f for f in _run("metrics_ok.py")
+                if f.check.startswith("metrics-")]
+
+
 # -- seeded-bug regression: the checkers catch real-code mutations --------
 
 def _real(src):

@@ -275,11 +275,15 @@ def run_hybrid_scenario(scenario: str, hosts: int = 3, local: int = 2,
     hosts-1, resume from the newest checkpoint and finish with
     bitwise-identical models (same model digest on every survivor).
 
-    slow_host: delay one host's leader phase every round (the `lag`
-    chaos kind sleeps only in the train thread, so heartbeats keep
-    flowing).  The hub must mark the host slow (hybrid_slow telemetry
-    event, policy=observe) WITHOUT convicting it: every host finishes
-    at full world with identical models and zero re-forms."""
+    slow_host: delay one host's leader phase for a bounded window of
+    rounds (the `lag` chaos kind sleeps only in the train thread, so
+    heartbeats keep flowing).  The hub must mark the host slow
+    (hybrid_slow telemetry event, policy=observe) WITHOUT convicting
+    it: every host finishes at full world with identical models and
+    zero re-forms.  Federation + alerting run alongside: the round
+    ledger must name the victim as the critical host (straggler_wait)
+    while it lags, and the straggler_host alert must fire during the
+    lag and clear after recovery — all bitwise-invisible to training."""
     assert scenario in HYBRID_SCENARIOS, scenario
     victim = hosts - 1
     tmp = tempfile.mkdtemp(prefix="lgbm_chaos_hyb_")
@@ -288,11 +292,10 @@ def run_hybrid_scenario(scenario: str, hosts: int = 3, local: int = 2,
     params = {
         "objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
         "verbosity": -1,
-        # boost_from_average is computed from rank-LOCAL labels (no
-        # global sync yet — see ROADMAP), so it is the one per-rank
-        # divergence; off, the collectively-built trees must be
-        # identical on every host and the drill asserts ONE digest
-        "boost_from_average": False,
+        # boost_from_average stays ON: the init score is now computed
+        # from globally-allreduced sufficient stats, so the one-digest
+        # assertion must hold with it enabled
+        "boost_from_average": True,
         "num_machines": hosts, "machines": machines,
         "tree_learner": "data", "pre_partition": True,
         "tpu_comm_backend": "hybrid", "tpu_hybrid_local_devices": local,
@@ -304,13 +307,22 @@ def run_hybrid_scenario(scenario: str, hosts: int = 3, local: int = 2,
         "tpu_checkpoint_interval": 1,
         "tpu_telemetry_path": telemetry,
     }
+    lag_until = None
     if scenario == "slow_host":
+        # federation + alerting ride the drill: the hub must NAME the
+        # lagged host in the round ledger and fire/clear the straggler
+        # alert, all while staying read-only on training
+        lag_until = rounds - 1      # recover before the end: clear must fire
         params.update({
             "tpu_hybrid_slow_ms": 50.0,
             "tpu_hybrid_slow_rounds": 2,
             "tpu_hybrid_slow_policy": "observe",
+            "tpu_federation": True,
+            "tpu_alert": True,
+            "tpu_alert_sustain_rounds": 2,
         })
-        env_chaos = "lag:%d:%d:%.1f" % (victim, chaos_round, 0.4)
+        env_chaos = "lag:%d:%d:%.1f:%d" % (victim, chaos_round, 0.4,
+                                           lag_until)
         expect_world = hosts
     else:
         env_chaos = "kill:%d:%d" % (victim, chaos_round)
@@ -352,6 +364,8 @@ def run_hybrid_scenario(scenario: str, hosts: int = 3, local: int = 2,
         for o in completed.values()) and len(digests) == 1)
     slow_events = []
     backend_events = []
+    ledger_events = []
+    alert_events = []
     try:
         with open(telemetry) as f:
             for line in f:
@@ -361,6 +375,10 @@ def run_hybrid_scenario(scenario: str, hosts: int = 3, local: int = 2,
                     slow_events.append(ev)
                 elif ev.get("event") == "comm_backend":
                     backend_events.append(ev)
+                elif ev.get("event") == "round_ledger":
+                    ledger_events.append(ev)
+                elif ev.get("event") == "alert":
+                    alert_events.append(ev)
     except (OSError, ValueError):
         pass
     hybrid_backends = [e for e in backend_events
@@ -377,6 +395,18 @@ def run_hybrid_scenario(scenario: str, hosts: int = 3, local: int = 2,
               and any(e.get("slow_host") == victim
                       and e.get("policy") == "observe"
                       for e in slow_events))
+        # the ledger must attribute the lag to the victim — via the
+        # hub-side straggler wait, BEFORE the slow policy could convict
+        ok = ok and any(
+            e.get("critical_host") == victim
+            and e.get("critical_phase") == "straggler_wait"
+            for e in ledger_events
+            if chaos_round <= e.get("round", -1) < (lag_until or rounds))
+        # and the straggler alert must fire during the lag and clear
+        # after recovery
+        straggler = [e.get("state") for e in alert_events
+                     if e.get("rule") == "straggler_host"]
+        ok = ok and straggler == ["firing", "cleared"]
     recovery = max((o.get("recovery_s", 0.0)
                     for o in completed.values()), default=None)
     return {
@@ -386,6 +416,11 @@ def run_hybrid_scenario(scenario: str, hosts: int = 3, local: int = 2,
         "completed_ranks": sorted(completed),
         "model_digests": digests,
         "hybrid_slow_events": len(slow_events),
+        "round_ledger_events": len(ledger_events),
+        "ledger_critical_hosts": sorted({e.get("critical_host")
+                                         for e in ledger_events}),
+        "alert_transitions": [(e.get("rule"), e.get("state"))
+                              for e in alert_events],
         "comm_backend_events": hybrid_backends[:2],
         "recovery_s": recovery,
         "total_s": round(total_s, 3),

@@ -1,0 +1,126 @@
+"""Render `round_ledger` telemetry events as a critical-path report.
+
+The offline reader for the per-round ledgers the federation hub writes
+(obs/critical_path.py) when ``tpu_federation`` is on: a per-round table
+decomposing hub wall time into its compute / mesh-psum / leader-wire /
+straggler-wait legs plus the named critical (host, phase), and a
+summary of which hosts dominated the run — the "which host made round
+17 slow?" question answered from the event log after the fact.
+
+Usage:
+    python tools/round_report.py train.telemetry.jsonl
+    python tools/round_report.py --last 20 train.telemetry.jsonl
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, List
+
+# shared JSONL loader — one parser for every telemetry reader
+from telemetry_report import load_events  # noqa: E402
+
+_LEGS = ("compute_ms", "mesh_psum_ms", "leader_wire_ms",
+         "straggler_wait_ms")
+
+
+def _critical_counts(ledgers: List[dict]) -> Dict[int, int]:
+    out: Dict[int, int] = {}
+    for led in ledgers:
+        host = led.get("critical_host")
+        if host is not None:
+            out[int(host)] = out.get(int(host), 0) + 1
+    return out
+
+
+def render(events: List[dict], last: int = 0) -> str:
+    ledgers = [e for e in events if e.get("event") == "round_ledger"]
+    alerts = [e for e in events if e.get("event") == "alert"]
+    if not ledgers:
+        return ("no round_ledger events (run training with "
+                "tpu_federation=true and tpu_telemetry_path set)")
+    shown = ledgers[-last:] if last else ledgers
+
+    lines: List[str] = []
+    wall = [float(led.get("wall_ms", 0.0) or 0.0) for led in ledgers]
+    lines.append("rounds: %d   wall %s ms/round avg (min %.1f, max %.1f)"
+                 % (len(ledgers), "%.1f" % (sum(wall) / len(wall)),
+                    min(wall), max(wall)))
+
+    # leg decomposition across the whole run
+    totals = {leg: sum(float(led.get(leg, 0.0) or 0.0)
+                       for led in ledgers) for leg in _LEGS}
+    denom = max(sum(totals.values()), 1e-9)
+    lines.append("legs:  " + "  ".join(
+        "%s %.0fms (%.0f%%)" % (leg[:-3], totals[leg],
+                                100.0 * totals[leg] / denom)
+        for leg in _LEGS))
+
+    counts = _critical_counts(ledgers)
+    if counts:
+        lines.append("critical hosts: " + "  ".join(
+            "host %d x%d" % (h, n)
+            for h, n in sorted(counts.items(), key=lambda kv: -kv[1])))
+
+    # top critical phases: what the slow rounds were actually doing
+    phase_ms: Dict[str, float] = {}
+    for led in ledgers:
+        phase = led.get("critical_phase")
+        if phase:
+            phase_ms[phase] = phase_ms.get(phase, 0.0) \
+                + float(led.get("critical_ms", 0.0) or 0.0)
+    if phase_ms:
+        top = sorted(phase_ms.items(), key=lambda kv: -kv[1])[:3]
+        lines.append("top critical phases: " + "  ".join(
+            "%s %.0fms" % (name, ms) for name, ms in top))
+
+    lines.append("")
+    lines.append("%6s %9s %9s %9s %9s %10s  %s"
+                 % ("round", "wall_ms", "compute", "psum", "wire",
+                    "straggler", "critical"))
+    for led in shown:
+        crit = "-"
+        if led.get("critical_host") is not None:
+            crit = "host %s %s (%.1fms)" % (
+                led["critical_host"], led.get("critical_phase", "?"),
+                float(led.get("critical_ms", 0.0) or 0.0))
+        lines.append("%6d %9.1f %9.1f %9.1f %9.1f %10.1f  %s"
+                     % (led.get("round", -1),
+                        float(led.get("wall_ms", 0.0) or 0.0),
+                        float(led.get("compute_ms", 0.0) or 0.0),
+                        float(led.get("mesh_psum_ms", 0.0) or 0.0),
+                        float(led.get("leader_wire_ms", 0.0) or 0.0),
+                        float(led.get("straggler_wait_ms", 0.0) or 0.0),
+                        crit))
+
+    if alerts:
+        lines.append("")
+        lines.append("alerts: %d transitions" % len(alerts))
+        for a in alerts:
+            lines.append("  tick %-4s %-8s %s (%s %s, value=%s)"
+                         % (a.get("tick", "?"), a.get("state", "?"),
+                            a.get("rule", "?"), a.get("metric", "?"),
+                            a.get("kind", "?"), a.get("value")))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    last = 0
+    if "--last" in argv:
+        i = argv.index("--last")
+        try:
+            last = int(argv[i + 1])
+        except (IndexError, ValueError):
+            sys.stderr.write("--last needs an integer\n")
+            return 2
+        del argv[i:i + 2]
+    if len(argv) != 1:
+        sys.stderr.write("usage: python tools/round_report.py "
+                         "[--last N] <telemetry.jsonl>\n")
+        return 2
+    print(render(load_events(argv[0]), last=last))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,71 @@ def _fmt_ms(v: float) -> str:
     return "%.1f" % v if v < 100 else "%.0f" % v
 
 
+def _render_cluster(events: List[dict]) -> List[str]:
+    """The federated-observability sections: per-host rollup from the
+    `cluster` digests, top critical phases from the `round_ledger`
+    decomposition, and the `alert` incident timeline (all three are
+    written by the hub when tpu_federation / tpu_alert are on —
+    tools/round_report.py has the per-round view)."""
+    clusters = [e for e in events if e.get("event") == "cluster"]
+    ledgers = [e for e in events if e.get("event") == "round_ledger"]
+    alerts = [e for e in events if e.get("event") == "alert"]
+    lines: List[str] = []
+
+    if clusters:
+        # per-host rollup across every digest each host shipped
+        hosts: Dict[int, Dict[str, float]] = {}
+        for ev in clusters:
+            for d in ev.get("hosts") or []:
+                host = int(d.get("orig", d.get("rank", 0)) or 0)
+                agg = hosts.setdefault(host, {"wall_ms": 0.0, "rounds": 0,
+                                              "wait_share": 0.0,
+                                              "rtt_ms": 0.0})
+                agg["wall_ms"] += float(d.get("wall_ms", 0.0) or 0.0)
+                agg["wait_share"] += float(
+                    d.get("comm_wait_share", 0.0) or 0.0)
+                agg["rtt_ms"] += float(d.get("rtt_ms", 0.0) or 0.0)
+                agg["rounds"] += 1
+        crit = {}
+        for led in ledgers:
+            h = led.get("critical_host")
+            if h is not None:
+                crit[int(h)] = crit.get(int(h), 0) + 1
+        lines.append("cluster: %d federated rounds, %d hosts"
+                     % (len(clusters), len(hosts)))
+        lines.append("  %4s %10s %11s %8s %9s"
+                     % ("host", "wall_ms", "wait_share", "rtt_ms",
+                        "critical"))
+        for host in sorted(hosts):
+            agg = hosts[host]
+            n = max(int(agg["rounds"]), 1)
+            lines.append("  %4d %10.1f %11.3f %8.2f %8dx"
+                         % (host, agg["wall_ms"], agg["wait_share"] / n,
+                            agg["rtt_ms"] / n, crit.get(host, 0)))
+
+    if ledgers:
+        phase_ms: Dict[str, float] = {}
+        for led in ledgers:
+            phase = led.get("critical_phase")
+            if phase:
+                phase_ms[phase] = phase_ms.get(phase, 0.0) \
+                    + float(led.get("critical_ms", 0.0) or 0.0)
+        top = sorted(phase_ms.items(), key=lambda kv: -kv[1])[:3]
+        if top:
+            lines.append("critical path: " + "  ".join(
+                "%s %.0fms" % (name, ms) for name, ms in top)
+                + "   (per-round: python tools/round_report.py)")
+
+    if alerts:
+        lines.append("alerts: %d transitions" % len(alerts))
+        for a in alerts:
+            lines.append("  tick %-4s %-8s %s (value=%s threshold=%s)"
+                         % (a.get("tick", "?"), a.get("state", "?"),
+                            a.get("rule", "?"), a.get("value"),
+                            a.get("threshold")))
+    return lines
+
+
 def render(events: List[dict], show_iterations: bool = False) -> str:
     start = next((e for e in events if e.get("event") == "start"), {})
     iters = [e for e in events if e.get("event") == "iteration"]
@@ -115,6 +180,8 @@ def render(events: List[dict], show_iterations: bool = False) -> str:
                      % (comm.get("allgather", 0), comm.get("bytes_sent", 0),
                         comm.get("bytes_received", 0),
                         comm.get("sync_wait_seconds", 0.0)))
+
+    lines.extend(_render_cluster(events))
 
     if show_iterations and iters:
         lines.append("")

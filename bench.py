@@ -347,6 +347,43 @@ def chaos_smoke():
             os.environ["JAX_PLATFORMS"] = prev
 
 
+def policy_smoke():
+    """Closed-loop control-plane drill (one line in `detail`).
+
+    Runs the policy_loop scenario from tools/chaos_run.py: a lagging
+    host trips the straggler_host alert, the policy engine demotes it,
+    the recovered host petitions back in through a formation epoch, and
+    the dry-run leg must be bitwise-identical to the policy-off control
+    leg.  Never fails the bench: any problem becomes the summary.
+    """
+    import os
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        import chaos_run
+    finally:
+        sys.path.pop(0)
+    prev = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"   # spawned hosts only
+    try:
+        s = chaos_run.run_policy_scenario("policy_loop", hosts=3,
+                                          local=2, rounds=12,
+                                          n_rows=240, chaos_round=2,
+                                          join_timeout_s=180.0)
+        return ("policy_loop: %d hosts, actions %s, dry_run_identical=%s, "
+                "ok=%s"
+                % (s["hosts"],
+                   [a[1] for a in s["live_policy_actions"]],
+                   s["dry_run_bitwise_identical"], s["ok"]))
+    except Exception as e:  # noqa: BLE001 — smoke only, never fatal
+        return "FAILED: %s" % e
+    finally:
+        if prev is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = prev
+
+
 def _hybrid_bench_worker(rank, world, machines, n_rows, rounds, q):
     """One HOST of the hybrid_smoke world (spawned process): 2 local
     CPU devices behind one wire rank.  Reports the timed train wall."""
@@ -839,6 +876,7 @@ def main():
             "cluster_smoke": cluster_smoke(),
             "trace_smoke": trace_smoke(lgb),
             "chaos_smoke": chaos_smoke(),
+            "policy_smoke": policy_smoke(),
             "supervisor_smoke": supervisor_smoke(),
             "fleet_smoke": fleet_smoke(),
             "lint_smoke": lint_smoke(),

@@ -386,6 +386,31 @@ _SCHEMA = [
     #   fraction of round wall a host may spend blocked on peers
     ("tpu_alert_shed_rate", float, 5.0),     # built-in shed-rate rule:
     #   shed (+ quota-shed) requests per evaluation tick
+    # --- closed-loop control plane (control/): the policy engine turns
+    #   alert transitions + round-ledger signals into recorded,
+    #   rate-limited actions through the process actuator.  See
+    #   docs/ControlPlane.md
+    ("tpu_policy", bool, False),             # evaluate policy rules each
+    #   federated round (hub) and dispatch actions through the actuator;
+    #   requires tpu_federation + tpu_alert for the training-side rules
+    ("tpu_policy_rules", str, ""),           # JSON policy rule file ("" =
+    #   built-in rules: straggler demote, scale-up admit, shed pre-spill,
+    #   promote-floor tighten); same spirit as tpu_alert_rules
+    ("tpu_policy_dry_run", bool, False),     # record every decision as a
+    #   policy_action event with status=dry_run but dispatch NOTHING —
+    #   training stays bitwise-identical to tpu_policy=false
+    ("tpu_policy_rate_limit", float, 4.0),   # global action token bucket:
+    #   actions allowed per tpu_policy_rate_window_s across ALL rules
+    ("tpu_policy_rate_window_s", float, 60.0),  # token bucket refill window
+    ("tpu_policy_cooldown_rounds", int, 8),  # default per-rule cooldown in
+    #   federated rounds between dispatches (rules may override)
+    ("tpu_elastic_scale_up", bool, False),   # keep the formation listener
+    #   open after formation: a fenced/fresh host petitions to rejoin and
+    #   is admitted at the next formation epoch boundary (hub re-forms the
+    #   full world, rows re-shard, training resumes from the newest
+    #   checkpoint via resume_mode="reshard")
+    ("tpu_elastic_scale_up_wait_s", float, 60.0),  # how long a petitioning
+    #   host waits for an epoch before giving up (ElasticFenced)
 ]
 
 # alias -> canonical name (src/io/config_auto.cpp:4-157)
@@ -527,6 +552,12 @@ ALIAS_TABLE: Dict[str, str] = {
     "alerting": "tpu_alert",
     "alert_rules": "tpu_alert_rules",
     "alert_sustain_rounds": "tpu_alert_sustain_rounds",
+    "policy": "tpu_policy",
+    "policy_engine": "tpu_policy",
+    "policy_rules": "tpu_policy_rules",
+    "policy_dry_run": "tpu_policy_dry_run",
+    "elastic_scale_up": "tpu_elastic_scale_up",
+    "scale_up": "tpu_elastic_scale_up",
 }
 
 PARAMETER_TYPES: Dict[str, Any] = {name: typ for name, typ, _ in _SCHEMA}
@@ -872,6 +903,18 @@ class Config:
         if self.tpu_alert_shed_rate < 0:
             log.fatal("tpu_alert_shed_rate must be >= 0, got %g"
                       % self.tpu_alert_shed_rate)
+        if self.tpu_policy_rate_limit <= 0:
+            log.fatal("tpu_policy_rate_limit must be > 0, got %g"
+                      % self.tpu_policy_rate_limit)
+        if self.tpu_policy_rate_window_s <= 0:
+            log.fatal("tpu_policy_rate_window_s must be > 0, got %g"
+                      % self.tpu_policy_rate_window_s)
+        if self.tpu_policy_cooldown_rounds < 0:
+            log.fatal("tpu_policy_cooldown_rounds must be >= 0, got %d"
+                      % self.tpu_policy_cooldown_rounds)
+        if self.tpu_elastic_scale_up_wait_s < 0:
+            log.fatal("tpu_elastic_scale_up_wait_s must be >= 0, got %g"
+                      % self.tpu_elastic_scale_up_wait_s)
 
     def is_single_machine(self) -> bool:
         return self.num_machines <= 1

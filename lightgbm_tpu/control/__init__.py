@@ -1,0 +1,33 @@
+"""lightgbm_tpu.control — the closed-loop control plane.
+
+The observability plane (obs/) senses; the resilience and serving
+layers (resilience/, serving/) have levers; this package connects
+them:
+
+- actuator:  the ONE dispatch surface for control actions — a
+             process-global named-binding registry plus the global
+             token-bucket action budget;
+- policy:    declarative policy rules (``tpu_policy_rules`` JSON, the
+             control twin of ``tpu_alert_rules``) with ``$ref`` arg
+             resolution from the round context;
+- engine:    the PolicyEngine the federation hub ticks once per round
+             — recorded, rate-limited, dry-runnable decisions.
+
+With ``tpu_policy=false`` (default) or ``tpu_policy_dry_run=true``
+nothing in this package mutates training state, and training output is
+bitwise identical to a build without the package — enforced by the
+``policy_loop`` chaos drill (tools/chaos_run.py).  See
+docs/ControlPlane.md for the policy syntax and the action catalog.
+"""
+from __future__ import annotations
+
+from .actuator import (Actuator, TokenBucket, default_actuator,
+                       global_token_bucket, reset_global_token_bucket)
+from .engine import PolicyEngine
+from .policy import (PolicyRule, default_policy_rules, load_policy_rules,
+                     resolve_args)
+
+__all__ = ["Actuator", "PolicyEngine", "PolicyRule", "TokenBucket",
+           "default_actuator", "default_policy_rules",
+           "global_token_bucket", "load_policy_rules",
+           "reset_global_token_bucket", "resolve_args"]

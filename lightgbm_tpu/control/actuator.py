@@ -1,0 +1,140 @@
+"""The single dispatch surface every control-plane action goes through.
+
+ROADMAP item 4's gap in one sentence: the sensors (obs/alerts.py
+transitions, the federated round ledger) and the actuators (elastic
+host fencing, fleet spill/boost, supervisor promote floor) never talk
+to each other.  This module is the coupling point — and deliberately
+the ONLY one: levers register a callable under a stable name
+(``demote_host``, ``expand_world``, ``fleet_pre_spill``,
+``fleet_boost``, ``tighten_promote_floor``) and the PolicyEngine
+dispatches by name, so control/ never imports the subsystems it steers
+and a lever that is not running in this process simply reports
+"unbound" instead of an ImportError.
+
+Bindings are process-global (the ``set_process_comm`` idiom from
+parallel/collective.py): the elastic supervisor re-binds the comm
+levers every incarnation (the comm object changes across
+re-formations), the serving fleet binds its residency levers for the
+life of the manager, and each owner unbinds in its teardown path.
+
+The ``TokenBucket`` here is the GLOBAL action budget
+(``tpu_policy_rate_limit`` actions per ``tpu_policy_rate_window_s``).
+It is process-global on purpose: a PolicyEngine lives for one
+federation incarnation, and a demote -> re-form -> demote loop must
+not get a fresh budget per incarnation.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..utils import log
+
+
+class TokenBucket:
+    """Classic token bucket: ``capacity`` tokens, refilled continuously
+    at ``capacity / window_s`` tokens per second.  ``take`` is the only
+    mutator and never blocks — a dry bucket is a policy decision
+    ("rate_limited"), not a wait."""
+
+    def __init__(self, capacity: float, window_s: float):
+        self.capacity = max(float(capacity), 1.0)
+        self.window_s = max(float(window_s), 1e-6)
+        self.rate = self.capacity / self.window_s
+        self._tokens = self.capacity
+        self._stamp = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self, n: float = 1.0) -> bool:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.capacity,
+                               self._tokens + (now - self._stamp) * self.rate)
+            self._stamp = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def available(self) -> float:
+        with self._lock:
+            now = time.monotonic()
+            return min(self.capacity,
+                       self._tokens + (now - self._stamp) * self.rate)
+
+
+class Actuator:
+    """Named-binding registry: ``bind`` a lever, ``dispatch`` by name.
+
+    ``dispatch`` raises ``KeyError`` for an unbound name (the engine
+    turns that into an "unbound" decision) and lets the lever's own
+    exceptions propagate (the engine records them as "error" — a failed
+    action must be auditable, never silent)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._bindings: Dict[str, Callable[[Dict], object]] = {}
+
+    def bind(self, name: str, fn: Callable[[Dict], object]) -> None:
+        with self._lock:
+            if name in self._bindings and self._bindings[name] is not fn:
+                log.debug("control: rebinding actuator %r", name)
+            self._bindings[name] = fn
+
+    def unbind(self, name: str,
+               fn: Optional[Callable[[Dict], object]] = None) -> None:
+        """Remove a binding; with ``fn`` given, only if it is still OURS
+        (a later incarnation may have re-bound the name already)."""
+        with self._lock:
+            cur = self._bindings.get(name)
+            if cur is None or (fn is not None and cur is not fn):
+                return
+            del self._bindings[name]
+
+    def is_bound(self, name: str) -> bool:
+        with self._lock:
+            return name in self._bindings
+
+    def bound(self) -> List[str]:
+        with self._lock:
+            return sorted(self._bindings)
+
+    def dispatch(self, name: str, args: Dict) -> object:
+        with self._lock:
+            fn = self._bindings.get(name)
+        if fn is None:
+            raise KeyError(name)
+        return fn(dict(args or {}))
+
+
+# -- process-global plumbing (the set_process_comm idiom) --------------- #
+_default_actuator = Actuator()
+_bucket: Optional[TokenBucket] = None
+_bucket_lock = threading.Lock()
+
+
+def default_actuator() -> Actuator:
+    """The process-wide actuator every lever binds into."""
+    return _default_actuator
+
+
+def global_token_bucket(config=None) -> TokenBucket:
+    """The process-wide action budget, created from the FIRST config
+    that asks for it; later capacity changes are ignored for the life
+    of the process so re-formed incarnations share one spend."""
+    global _bucket
+    with _bucket_lock:
+        if _bucket is None:
+            cap = float(getattr(config, "tpu_policy_rate_limit", 4.0) or 4.0)
+            win = float(getattr(config, "tpu_policy_rate_window_s", 60.0)
+                        or 60.0)
+            _bucket = TokenBucket(cap, win)
+        return _bucket
+
+
+def reset_global_token_bucket() -> None:
+    """Drop the shared bucket (test isolation only)."""
+    global _bucket
+    with _bucket_lock:
+        _bucket = None

@@ -1,0 +1,237 @@
+"""PolicyEngine: closes the loop from alerts to actuation.
+
+Evaluated once per federated round by the hub (obs/federation.py calls
+``on_round`` right after AlertEngine.evaluate), the engine folds the
+tick's alert transitions into a level-triggered active-alert view,
+matches that view plus the tick's control signals against the policy
+rules, resolves action args from the round context (newest round
+ledger + triggering transition), and pushes every decision through the
+shared ``Actuator`` — rate-limited by the process-global token bucket,
+debounced per rule by ``cooldown_rounds``, and fully dry-runnable.
+Guard misses do NOT start the cooldown, so a gated rule dispatches on
+the first round its guard condition actually holds.
+
+Every decision is recorded twice: a ``policy_action`` JSONL event
+(obs/recorder.policy_event — best-effort, never raises) and the
+``lgbm_policy_actions_total{action,status}`` counter family.  Statuses:
+
+- ``ok``           lever dispatched and returned
+- ``dry_run``      ``tpu_policy_dry_run=true`` — the full decision was
+                   made (guards, args, cooldown, token bucket) but the
+                   lever was NOT invoked; training stays bitwise
+                   identical to policy-off
+- ``rate_limited`` global token bucket dry
+- ``unbound``      no lever registered under the action name in this
+                   process
+- ``unresolved``   an ``$arg`` had no value this round
+- ``error``        the lever raised (the exception is recorded, never
+                   propagated — policy failures must not kill training)
+
+Guard mismatches and cooldown suppressions are counted
+(``lgbm_policy_suppressed_total{reason}``) but not written to the
+event log — they recur every round and would drown the audit trail.
+The engine itself follows the observability plane's failure contract:
+``on_round`` degrades to a warning, never raises into training.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import log
+from .actuator import Actuator, default_actuator, global_token_bucket
+from .policy import (PolicyRule, default_policy_rules, load_policy_rules,
+                     resolve_args)
+
+EMITTED_STATUSES = ("ok", "dry_run", "rate_limited", "unbound",
+                    "unresolved", "error")
+
+
+class PolicyEngine:
+    """Evaluates policy rules against one round's alert transitions,
+    signals and ledger; dispatches through the process actuator."""
+
+    def __init__(self, config, rules: Optional[List[PolicyRule]] = None,
+                 actuator: Optional[Actuator] = None, registry=None,
+                 bucket=None):
+        self.config = config
+        self.rules = (list(rules) if rules is not None
+                      else default_policy_rules(config))
+        self.dry_run = bool(getattr(config, "tpu_policy_dry_run", False))
+        self.cooldown_default = int(
+            getattr(config, "tpu_policy_cooldown_rounds", 8) or 0)
+        self.actuator = actuator if actuator is not None \
+            else default_actuator()
+        self.bucket = bucket if bucket is not None \
+            else global_token_bucket(config)
+        if registry is None:
+            from ..obs import default_registry
+            registry = default_registry()
+        self.registry = registry
+        self._last_round: Dict[str, int] = {}
+        # level-triggered alert view: rule name -> the transition that
+        # set it firing, folded from each tick's transition stream
+        self._active: Dict[str, Dict] = {}
+        self._decisions: List[Dict] = []
+        self._g_last = registry.gauge(
+            "lgbm_policy_last_action_round",
+            help="round of the newest recorded policy decision")
+        self._counters: Dict[Tuple[str, str], object] = {}
+
+    @classmethod
+    def from_config(cls, config, **kwargs) -> "PolicyEngine":
+        rules = None
+        path = str(getattr(config, "tpu_policy_rules", "") or "")
+        if path:
+            rules = load_policy_rules(path)
+        return cls(config, rules=rules, **kwargs)
+
+    # -- metrics --------------------------------------------------------- #
+    def _count_action(self, action: str, status: str) -> None:
+        key = (action, status)
+        c = self._counters.get(key)
+        if c is None:
+            c = self.registry.counter(
+                "lgbm_policy_actions_total",
+                help="policy decisions by action and outcome",
+                action=action, status=status)
+            self._counters[key] = c
+        c.inc()
+
+    def _count_suppressed(self, reason: str) -> None:
+        key = ("_suppressed", reason)
+        c = self._counters.get(key)
+        if c is None:
+            c = self.registry.counter(
+                "lgbm_policy_suppressed_total",
+                help="policy triggers suppressed before decision",
+                reason=reason)
+            self._counters[key] = c
+        c.inc()
+
+    # -- evaluation ------------------------------------------------------ #
+    def on_round(self, round_no: int, transitions=(), ledger=None,
+                 signals=()) -> List[Dict]:
+        """One federation tick.  Returns the recorded decision list;
+        any internal failure degrades to a warning (recorder contract)."""
+        try:
+            return self._on_round(int(round_no), transitions or (),
+                                  ledger, signals or ())
+        except Exception as exc:  # noqa: BLE001 — policy never raises
+            log.warning("policy: round %s evaluation failed: %s",
+                        round_no, exc)
+            return []
+
+    def _on_round(self, round_no, transitions, ledger, signals):
+        # fold this tick's transitions into the level-triggered view:
+        # "firing" rules keep matching every round until they clear, so
+        # a guard that fails on the transition tick (e.g. the round
+        # ledger names a different critical phase) retries next round
+        # instead of missing its one edge.  cooldown_rounds debounces
+        # the decisions; "cleared" rules stay edge-triggered.
+        for t in transitions:
+            name = t.get("rule")
+            if not name:
+                continue
+            if t.get("state") == "firing":
+                self._active[name] = dict(t)
+            else:
+                self._active.pop(name, None)
+        decisions: List[Dict] = []
+        for rule in self.rules:
+            alerts = (self._active.values() if rule.state == "firing"
+                      else transitions)
+            for t in alerts:
+                if rule.matches_alert(t):
+                    ctx = self._context(round_no, ledger, transition=t)
+                    d = self._consider(rule, ctx, round_no)
+                    if d:
+                        decisions.append(d)
+            for s in signals:
+                if rule.matches_signal(s):
+                    ctx = self._context(round_no, ledger, signal=s)
+                    d = self._consider(rule, ctx, round_no)
+                    if d:
+                        decisions.append(d)
+        return decisions
+
+    def _context(self, round_no, ledger, transition=None,
+                 signal=None) -> Dict:
+        ctx: Dict = {"round": round_no}
+        for key in ("critical_host", "critical_phase"):
+            ctx[key] = (ledger or {}).get(key)
+        for key in ("rule", "metric", "value", "threshold", "tick"):
+            ctx[key] = (transition or {}).get(key)
+        for k, v in (signal or {}).items():
+            ctx["signal.%s" % k] = v
+        return ctx
+
+    def _consider(self, rule: PolicyRule, ctx: Dict,
+                  round_no: int) -> Optional[Dict]:
+        for key, want in rule.guard.items():
+            if str(ctx.get(key)) != want:
+                self._count_suppressed("guard")
+                return None
+        cooldown = (rule.cooldown_rounds if rule.cooldown_rounds is not None
+                    else self.cooldown_default)
+        last = self._last_round.get(rule.name)
+        if last is not None and round_no - last < cooldown:
+            self._count_suppressed("cooldown")
+            return None
+
+        error = None
+        try:
+            args = resolve_args(rule.args, ctx)
+        except KeyError as exc:
+            args, status, error = dict(rule.args), "unresolved", str(exc)
+        else:
+            # the bucket is drained in dry-run too, so the recorded
+            # stream is exactly what a live run would have dispatched
+            if not self.bucket.take():
+                status = "rate_limited"
+            elif self.dry_run:
+                status = "dry_run"
+            else:
+                try:
+                    self.actuator.dispatch(rule.action, args)
+                    status = "ok"
+                except KeyError:
+                    status = "unbound"
+                except Exception as exc:  # noqa: BLE001 — record, don't kill
+                    status, error = "error", str(exc)
+                    log.warning("policy: action %s (rule %s) failed: %s",
+                                rule.action, rule.name, exc)
+        # every recorded decision starts the cooldown — the debounce
+        # applies to the DECISION stream, not only to successes
+        self._last_round[rule.name] = round_no
+        return self._record(rule, args, status, round_no, ctx, error)
+
+    def _record(self, rule, args, status, round_no, ctx, error):
+        decision = {"rule": rule.name, "action": rule.action,
+                    "status": status, "round": round_no,
+                    "args": args, "dry_run": self.dry_run}
+        if error is not None:
+            decision["error"] = error
+        trigger = rule.alert or rule.signal
+        if trigger is not None:
+            decision["trigger"] = trigger
+        if ctx.get("critical_host") is not None:
+            decision["critical_host"] = ctx["critical_host"]
+        self._count_action(rule.action, status)
+        self._g_last.set(float(round_no))
+        self._decisions.append(decision)
+        if len(self._decisions) > 256:
+            del self._decisions[:-256]
+        from ..obs.recorder import policy_event
+        policy_event(self.config, **decision)
+        log.info("policy: %s -> %s [%s] round %d %s",
+                 decision.get("trigger", "?"), rule.action, status,
+                 round_no, args)
+        return decision
+
+    # -- read side ------------------------------------------------------- #
+    def snapshot(self) -> Dict:
+        return {"dry_run": self.dry_run,
+                "rules": [r.to_dict() for r in self.rules],
+                "bound": self.actuator.bound(),
+                "tokens_available": round(self.bucket.available(), 3),
+                "decisions": list(self._decisions)}

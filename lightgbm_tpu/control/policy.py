@@ -1,0 +1,153 @@
+"""Declarative policy rules — the control-plane twin of
+``tpu_alert_rules`` (obs/alerts.py).
+
+``tpu_policy_rules`` is a JSON list of rule objects::
+
+    [{"name": "demote_straggler",
+      "when": {"alert": "straggler_host", "state": "firing"},
+      "guard": {"critical_phase": "straggler_wait"},
+      "action": "demote_host",
+      "args": {"orig": "$critical_host"},
+      "cooldown_rounds": 8}]
+
+``when`` triggers on one of two sources:
+
+- ``{"alert": <rule name>, "state": "firing"|"cleared"}`` — an
+  AlertEngine condition.  ``"firing"`` is LEVEL-triggered: the rule
+  keeps matching on every round the alert stays active (debounced by
+  ``cooldown_rounds``), so a ``guard`` that fails on the transition
+  tick retries until its condition materializes.  ``"cleared"`` is
+  edge-triggered on the clear transition itself.
+- ``{"signal": <name>}`` — a control signal synthesized by the runtime
+  (today: ``pending_join``, emitted by the federation hub when a
+  fenced/fresh host is knocking on the formation socket).
+
+``guard`` is an optional exact-match filter over the round context
+(see below) — the default demote rule uses it to require the round
+ledger to actually name the straggler phase before acting.
+
+``args`` values beginning with ``$`` are resolved from the round
+context at dispatch time.  Context keys: ``round``, the triggering
+transition's ``rule``/``value``/``threshold``/``metric``/``tick``, the
+newest round ledger's ``critical_host``/``critical_phase``, and for
+signal triggers every signal field flattened as ``signal.<key>``.  An
+unresolvable ``$ref`` (e.g. no ledger this round) downgrades the
+decision to status ``unresolved`` — recorded, never dispatched.
+
+``cooldown_rounds`` (default ``tpu_policy_cooldown_rounds``) is the
+per-rule debounce: after any recorded decision the rule stays silent
+for that many rounds.  The global token bucket
+(control/actuator.py) is the fleet-wide budget on top.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+ALERT_STATES = ("firing", "cleared")
+
+
+class PolicyRule:
+    """One declarative policy rule (immutable after construction)."""
+
+    def __init__(self, name: str, when: Dict, action: str,
+                 args: Optional[Dict] = None, guard: Optional[Dict] = None,
+                 cooldown_rounds: Optional[int] = None):
+        when = dict(when or {})
+        if bool(when.get("alert")) == bool(when.get("signal")):
+            raise ValueError(
+                "policy rule %r: `when` needs exactly one of "
+                "{'alert': ...} or {'signal': ...}" % name)
+        state = str(when.get("state", "firing"))
+        if when.get("alert") and state not in ALERT_STATES:
+            raise ValueError("policy rule %r: unknown alert state %r"
+                             % (name, state))
+        if not action:
+            raise ValueError("policy rule %r: missing action" % name)
+        self.name = str(name)
+        self.alert = str(when["alert"]) if when.get("alert") else None
+        self.state = state
+        self.signal = str(when["signal"]) if when.get("signal") else None
+        self.action = str(action)
+        self.args = dict(args or {})
+        self.guard = {k: str(v) for k, v in (guard or {}).items()}
+        self.cooldown_rounds = (None if cooldown_rounds is None
+                                else max(0, int(cooldown_rounds)))
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "PolicyRule":
+        return cls(name=d["name"], when=d.get("when") or {},
+                   action=d.get("action", ""), args=d.get("args"),
+                   guard=d.get("guard"),
+                   cooldown_rounds=d.get("cooldown_rounds",
+                                         d.get("cooldown")))
+
+    def to_dict(self) -> Dict:
+        when = ({"alert": self.alert, "state": self.state}
+                if self.alert else {"signal": self.signal})
+        return {"name": self.name, "when": when, "action": self.action,
+                "args": dict(self.args), "guard": dict(self.guard),
+                "cooldown_rounds": self.cooldown_rounds}
+
+    # -- trigger matching ----------------------------------------------- #
+    def matches_alert(self, transition: Dict) -> bool:
+        return (self.alert is not None
+                and transition.get("rule") == self.alert
+                and transition.get("state") == self.state)
+
+    def matches_signal(self, signal: Dict) -> bool:
+        return (self.signal is not None
+                and signal.get("signal") == self.signal)
+
+
+def resolve_args(args: Dict, context: Dict) -> Dict:
+    """Substitute ``$key`` arg values from the round context; raises
+    ``KeyError`` when a reference has no value this round (the engine
+    records the decision as "unresolved" instead of dispatching)."""
+    out: Dict = {}
+    for k, v in args.items():
+        if isinstance(v, str) and v.startswith("$"):
+            key = v[1:]
+            if context.get(key) is None:
+                raise KeyError(key)
+            out[k] = context[key]
+        else:
+            out[k] = v
+    return out
+
+
+def default_policy_rules(config=None) -> List[PolicyRule]:
+    """Built-in policy set binding the ISSUE's three closed loops:
+    straggler -> proactive demote, rejoin knock -> formation epoch
+    (scale-UP), shed burn -> fleet pre-spill, quality regression ->
+    tighter promote floor.  Alert names match obs/alerts.default_rules;
+    action names match the lever catalog in docs/ControlPlane.md."""
+    return [
+        PolicyRule("demote_straggler",
+                   when={"alert": "straggler_host", "state": "firing"},
+                   guard={"critical_phase": "straggler_wait"},
+                   action="demote_host", args={"orig": "$critical_host"}),
+        PolicyRule("expand_on_join",
+                   when={"signal": "pending_join"},
+                   action="expand_world",
+                   args={"readmit": "$signal.ranks"}),
+        PolicyRule("spill_on_shed",
+                   when={"alert": "shed_rate", "state": "firing"},
+                   action="fleet_pre_spill", args={"count": 1}),
+        PolicyRule("spill_on_quota_shed",
+                   when={"alert": "quota_shed_rate", "state": "firing"},
+                   action="fleet_pre_spill", args={"count": 1}),
+        PolicyRule("floor_on_rollback",
+                   when={"alert": "supervisor_rollbacks", "state": "firing"},
+                   action="tighten_promote_floor",
+                   args={"factor": 2.0, "min_delta": 1e-4}),
+    ]
+
+
+def load_policy_rules(path: str) -> List[PolicyRule]:
+    """Parse a JSON policy file (list of rule objects)."""
+    with open(path) as f:
+        raw = json.load(f)
+    if not isinstance(raw, list):
+        raise ValueError("policy rule file %s: expected a JSON list" % path)
+    return [PolicyRule.from_dict(d) for d in raw]

@@ -12,7 +12,11 @@ Three rule kinds:
   tick it stops breaching.
 - ``sustained``: fire after ``for`` CONSECUTIVE breaching ticks (the
   persistent-straggler / comm-wait-share shape: one slow round is
-  noise, five in a row is an incident), clear on the first clean tick.
+  noise, five in a row is an incident), clear after ``clear_for``
+  consecutive clean ticks (default 1 — first clean tick).  Raising
+  ``clear_for`` debounces a flapping metric: the clear-side hysteresis
+  is what keeps the policy engine (control/engine.py) from oscillating
+  demote/rejoin on a host that is slow every other round.
 - ``burn_rate``: for counters — fire when the per-tick increase rate
   over a sliding ``window`` of ticks exceeds the threshold (breaker
   flaps, shed rate, promotion failures: the level is meaningless, the
@@ -60,7 +64,8 @@ class Rule:
     def __init__(self, name: str, metric: str, op: str = ">",
                  threshold: float = 0.0, kind: str = "threshold",
                  for_ticks: int = 1, window: int = 16,
-                 labels: Optional[Dict[str, str]] = None):
+                 labels: Optional[Dict[str, str]] = None,
+                 clear_for: int = 1):
         if op not in _OPS:
             raise ValueError("alert rule %r: unknown op %r" % (name, op))
         if kind not in RULE_KINDS:
@@ -73,6 +78,7 @@ class Rule:
         self.for_ticks = max(1, int(for_ticks))
         self.window = max(2, int(window))
         self.labels = {k: str(v) for k, v in (labels or {}).items()}
+        self.clear_for = max(1, int(clear_for))
 
     @classmethod
     def from_dict(cls, d: Dict) -> "Rule":
@@ -82,22 +88,24 @@ class Rule:
                    kind=d.get("kind", "threshold"),
                    for_ticks=d.get("for", d.get("for_ticks", 1)),
                    window=d.get("window", 16),
-                   labels=d.get("labels"))
+                   labels=d.get("labels"),
+                   clear_for=d.get("clear_for", 1))
 
     def to_dict(self) -> Dict:
         return {"name": self.name, "metric": self.metric, "op": self.op,
                 "threshold": self.threshold, "kind": self.kind,
                 "for": self.for_ticks, "window": self.window,
-                "labels": dict(self.labels)}
+                "labels": dict(self.labels), "clear_for": self.clear_for}
 
 
 class _RuleState:
-    __slots__ = ("active", "streak", "samples", "last_value",
-                 "fired_ticks", "cleared_ticks")
+    __slots__ = ("active", "streak", "clean_streak", "samples",
+                 "last_value", "fired_ticks", "cleared_ticks")
 
     def __init__(self, window: int):
         self.active = False
         self.streak = 0
+        self.clean_streak = 0
         # (tick, family total) ring for burn-rate slopes
         self.samples: deque = deque(maxlen=window + 1)
         self.last_value: Optional[float] = None
@@ -227,6 +235,7 @@ class AlertEngine:
                             rule.name, exc)
                 continue
             state.streak = state.streak + 1 if breach else 0
+            state.clean_streak = 0 if breach else state.clean_streak + 1
             need = rule.for_ticks if rule.kind == "sustained" else 1
             should_fire = breach and state.streak >= need
             if should_fire and not state.active:
@@ -234,7 +243,8 @@ class AlertEngine:
                 state.fired_ticks.append(self.tick)
                 self._gauges[rule.name].set(1.0)
                 transitions.append(self._transition(rule, state, "firing"))
-            elif state.active and not breach:
+            elif (state.active and not breach
+                    and state.clean_streak >= rule.clear_for):
                 state.active = False
                 state.cleared_ticks.append(self.tick)
                 self._gauges[rule.name].set(0.0)

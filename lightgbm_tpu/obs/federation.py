@@ -17,13 +17,19 @@ becomes:
   critical (host, phase) (obs/critical_path.py, tools/round_report.py);
 - alert-engine ticks (obs/alerts.py) when ``tpu_alert`` is on.
 
-Contract (same as the recorder): STRICTLY read-only on training state.
-Digest assembly failures degrade to a minimal digest so the exchange
-stays collectively symmetric; exchange failures degrade to a warning
-and disable federation (a WorldChangedError re-raises — the elastic
-supervisor owns re-formation).  Models train bitwise-identically with
-federation on or off (tests/test_federation.py, test_hybrid_collective
-assert this).
+Contract (same as the recorder): STRICTLY read-only on training state
+— with one deliberate, opt-in exception: when ``tpu_policy=true`` the
+hub also ticks the control-plane PolicyEngine (lightgbm_tpu/control/)
+right after the alert engine, and its dispatched actions DO steer the
+cluster (demote, formation epoch, fleet pre-spill) through the
+actuator bindings.  With policy off or in ``tpu_policy_dry_run`` the
+read-only contract holds bit-for-bit.  Digest assembly failures
+degrade to a minimal digest so the exchange stays collectively
+symmetric; exchange failures degrade to a warning and disable
+federation (a WorldChangedError re-raises — the elastic supervisor
+owns re-formation).  Models train bitwise-identically with federation
+on or off (tests/test_federation.py, test_hybrid_collective assert
+this).
 """
 from __future__ import annotations
 
@@ -69,6 +75,11 @@ class Federation:
         if getattr(config, "tpu_alert", False):
             from .alerts import AlertEngine
             self.engine = AlertEngine.from_config(config, self.registry)
+        self.policy = None
+        if getattr(config, "tpu_policy", False):
+            from ..control import PolicyEngine
+            self.policy = PolicyEngine.from_config(config,
+                                                   registry=self.registry)
         # per-round delta baselines (this rank)
         self._last_phases: Dict[str, Dict[str, float]] = {}
         self._last_spans: Dict[str, Dict[str, float]] = {}
@@ -112,8 +123,23 @@ class Federation:
             return
         comm = getattr(coll, "comm", None) if on_wire else None
         self._aggregate(iteration, digests, comm)
-        if self.engine is not None:
-            self.engine.evaluate()
+        transitions = self.engine.evaluate() if self.engine is not None \
+            else []
+        if self.policy is not None:
+            # the control plane closes the loop HERE, on the hub, right
+            # after the sensors: alert transitions + the tick's control
+            # signals (a fenced/fresh host knocking to rejoin) feed the
+            # policy engine, whose levers were bound by the subsystems
+            # that own them (elastic supervisor, fleet, supervisor)
+            signals = []
+            pending = getattr(comm, "pending_joiners", None)
+            ranks = pending() if callable(pending) else ()
+            if ranks:
+                signals.append({"signal": "pending_join",
+                                "ranks": list(ranks)})
+            self.policy.on_round(iteration, transitions=transitions,
+                                 ledger=self._latest.get("ledger"),
+                                 signals=signals)
         self._ensure_http()
 
     def close(self) -> None:
@@ -274,6 +300,9 @@ class Federation:
     def alerts_payload(self) -> Optional[Dict]:
         return self.engine.snapshot() if self.engine is not None else None
 
+    def policy_payload(self) -> Optional[Dict]:
+        return self.policy.snapshot() if self.policy is not None else None
+
 
 def cluster_snapshot(registry: MetricsRegistry) -> Dict:
     """Per-host cluster view assembled from the lgbm_cluster_* /
@@ -331,6 +360,14 @@ def _serve_hub(fed: Federation, port: int):
                     payload = fed.alerts_payload()
                     if payload is None:
                         self._reply(404, b'{"error":"alerting disabled"}',
+                                    "application/json")
+                    else:
+                        self._reply(200, json.dumps(payload).encode(),
+                                    "application/json")
+                elif self.path == "/policy":
+                    payload = fed.policy_payload()
+                    if payload is None:
+                        self._reply(404, b'{"error":"policy disabled"}',
                                     "application/json")
                     else:
                         self._reply(200, json.dumps(payload).encode(),

@@ -459,6 +459,29 @@ def alert_event(config, **fields) -> None:
                     path, exc)
 
 
+def policy_event(config, **fields) -> None:
+    """Append one control-plane decision ({"event": "policy_action",
+    "rule": ..., "action": ..., "status": "ok"|"dry_run"|..., "round":
+    ..., "args": {...}}) to Config.tpu_telemetry_path.  The policy
+    engine runs on the federation hub and its decisions span hosts, so
+    like the cluster/alert events it appends directly — same JSONL
+    contract, best-effort; the policy_loop chaos drill and the report
+    tools grep these lines to audit each demote/expand next to the
+    alert that caused it."""
+    path = getattr(config, "tpu_telemetry_path", "")
+    if not path:
+        return
+    event = {"event": "policy_action"}
+    event.update(fields)
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(event, default=_json_default,
+                               separators=(",", ":")) + "\n")
+    except Exception as exc:  # noqa: BLE001 — telemetry never raises
+        log.warning("telemetry: policy event write to %s failed: %s",
+                    path, exc)
+
+
 def fleet_event(config, what: str, **fields) -> None:
     """Append one fleet-residency event ({"event": "fleet", "what":
     "admit"|"spill"|"promote"|"demote"|"degrade"|"spill_corrupt"|

@@ -659,6 +659,15 @@ class SocketComm:
         self._peer_ranks = []
 
 
+class FormationPending(ConnectionError):
+    """A JOIN knocked on a hub that is MID-INCARNATION (scale-up mode):
+    the hub recorded the petition and will admit the knocker at the
+    next formation epoch.  Deliberately a ConnectionError subclass so
+    callers that don't know about scale-up still treat it as a retryable
+    formation failure — but the elastic supervisor catches it FIRST and
+    retries without convicting anyone (the hub is alive and answered)."""
+
+
 class ElasticComm(SocketComm):
     """A SocketComm that survives rank death: generation-fenced world
     formation, an active ping/pong control channel, and poison-frame
@@ -701,6 +710,16 @@ class ElasticComm(SocketComm):
     wakes up finds its generation rejected and must rejoin at the next
     re-formation window.
 
+    Scale-UP (``scale_up=True`` / ``tpu_elastic_scale_up``): the hub
+    keeps the formation socket LISTENING for the whole incarnation; a
+    fenced or fresh rank that knocks mid-run gets ``wait`` (its
+    petition is recorded in ``pending_joiners()``) instead of a
+    rejection, and ``announce_epoch(readmit)`` — POISON's deliberate
+    twin, generation-stamped the same way — tears the world down with
+    ``WorldChangedError(epoch=True)`` so the supervisor re-forms one
+    generation up with the knockers admitted through the normal JOIN
+    window.  Today's shrink-only elasticity becomes shrink-and-grow.
+
     Split-brain caveat (documented, not solved — CAP is undefeated): a
     spoke whose alive-view is stale keeps sweeping candidates until
     ``timeout_s`` and then fails formation rather than electing a
@@ -716,13 +735,20 @@ class ElasticComm(SocketComm):
                  heartbeat_s: float = 0.2, suspect_s: float = 1.0,
                  retry: Optional[RetryPolicy] = None,
                  op_timeout_s: float = 0.0,
-                 injector: Optional[FaultInjector] = None):
+                 injector: Optional[FaultInjector] = None,
+                 scale_up: bool = False):
         self.orig_rank = int(orig_rank)
         self.machines = list(machines)
         self.rejoin_window_s = max(float(rejoin_window_s), 0.05)
         self.min_world = max(int(min_world), 1)
+        self.scale_up = bool(scale_up)
         self._hb_interval = max(float(heartbeat_s), 1e-3)
         self._suspect_s = max(float(suspect_s), self._hb_interval)
+        # scale-up: the hub keeps its formation socket listening for the
+        # whole incarnation so fenced/fresh hosts can KNOCK mid-run; the
+        # heartbeat probe drains the knocks into _pending_joins
+        self._join_srv: Optional[socket.socket] = None
+        self._pending_joins: Dict[int, float] = {}
         self._ctrl: Dict[int, dict] = {}      # hub: orig -> conn state
         self._ctrl_sock: Optional[socket.socket] = None   # spoke: to hub
         self._ctrl_thread: Optional[threading.Thread] = None
@@ -785,6 +811,8 @@ class ElasticComm(SocketComm):
                           float(getattr(config, "tpu_elastic_rejoin_s", 3.0)))
         kwargs.setdefault("min_world",
                           int(getattr(config, "tpu_elastic_min_world", 1)))
+        kwargs.setdefault("scale_up", bool(
+            getattr(config, "tpu_elastic_scale_up", False)))
         return cls(orig_rank, machines, generation=generation, alive=alive,
                    **kwargs)
 
@@ -816,11 +844,18 @@ class ElasticComm(SocketComm):
         # leaving early when every original rank is back
         window = timeout_s if gen == 0 else self.rejoin_window_s
         deadline = time.monotonic() + window
+        # under scale-up, world GROWTH is serialized at formation epoch
+        # boundaries: a re-formation admits only the ranks the supervisor
+        # already believes alive, and any other knocker (convicted,
+        # restarted, fresh) is parked as a rejoin petition for the next
+        # epoch.  Without scale-up a re-formation window welcomes every
+        # original rank back (the restart-rejoin path).
+        want = expected if (gen == 0 or self.scale_up) else everyone
         joins: Dict[int, tuple] = {}
         try:
             while True:
                 have = set(joins)
-                if have >= (expected if gen == 0 else everyone):
+                if have >= want:
                     break
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -839,6 +874,18 @@ class ElasticComm(SocketComm):
                 r = int(hello.get("orig_rank", -1))
                 if (hello.get("type") != "join"
                         or not 0 <= r < len(self.machines)):
+                    conn.close()
+                    continue
+                if self.scale_up and gen > 0 and r not in expected:
+                    # epoch-serialized growth: park the petition and keep
+                    # forming the expected world (see `want` above)
+                    with self._fence_lock:
+                        self._pending_joins[r] = time.monotonic()
+                    try:
+                        _send_msg(conn, {"type": "wait",
+                                         "generation": gen}, gen)
+                    except OSError:
+                        pass
                     conn.close()
                     continue
                 if r in joins:
@@ -901,11 +948,19 @@ class ElasticComm(SocketComm):
                     conn.close()
                     continue
                 if hello.get("type") == "join":
-                    # a rank that missed the rejoin window: reject it
-                    # explicitly so it fails fast instead of timing out
+                    # a rank that missed the rejoin window: under
+                    # scale-up it becomes a petition for the next
+                    # formation epoch; otherwise reject it explicitly
+                    # so it fails fast instead of timing out
+                    jr = int(hello.get("orig_rank", -1))
+                    if self.scale_up and 0 <= jr < len(self.machines):
+                        with self._fence_lock:
+                            self._pending_joins[jr] = time.monotonic()
+                        reply = {"type": "wait", "generation": gen}
+                    else:
+                        reply = {"type": "reject", "generation": gen}
                     try:
-                        _send_msg(conn, {"type": "reject",
-                                         "generation": gen}, gen)
+                        _send_msg(conn, reply, gen)
                     except OSError:
                         pass
                     conn.close()
@@ -915,8 +970,15 @@ class ElasticComm(SocketComm):
                     conn.close()
                     continue
                 ctrl[r] = conn
+            if self.scale_up:
+                # keep listening for the whole incarnation: late JOINs
+                # become rejoin petitions (_drain_join_knocks) instead
+                # of rejections, and the next formation epoch admits
+                # them.  close() owns the socket from here.
+                self._join_srv = srv
         finally:
-            srv.close()
+            if self._join_srv is not srv:
+                srv.close()
         return {"membership": membership, "generation": gen,
                 "session": session,
                 "data": {r: conn for r, (conn, _t1) in joins.items()},
@@ -926,41 +988,51 @@ class ElasticComm(SocketComm):
                     port_offset: int) -> dict:
         candidates = [c for c in self._alive if c < self.orig_rank]
         deadline = time.monotonic() + timeout_s
-        conn = hub = None
-        # round-robin sweep: a dead candidate refuses instantly (or
-        # times out in 1 s); the real hub is the first that accepts
-        while conn is None:
-            for c in candidates:
-                if time.monotonic() >= deadline:
-                    break
-                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)  # tpulint: ok=socket-no-with
-                s.settimeout(1.0)
-                try:
-                    s.connect(self._addr(c, port_offset))
-                    conn, hub = s, c
-                    break
-                except OSError:
-                    s.close()
-            if conn is None:
+        while True:
+            conn = hub = None
+            # round-robin sweep: a dead candidate refuses instantly (or
+            # times out in 1 s); the real hub is the first that accepts
+            while conn is None:
+                for c in candidates:
+                    if time.monotonic() >= deadline:
+                        break
+                    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)  # tpulint: ok=socket-no-with
+                    s.settimeout(1.0)
+                    try:
+                        s.connect(self._addr(c, port_offset))
+                        conn, hub = s, c
+                        break
+                    except OSError:
+                        s.close()
+                if conn is None:
+                    if time.monotonic() >= deadline:
+                        raise ConnectionError(
+                            "no elastic hub among candidate rank(s) %s "
+                            "within %.1fs" % (candidates, timeout_s))
+                    time.sleep(0.1)
+            conn.settimeout(timeout_s + self.rejoin_window_s)
+            wall_t0 = time.time()
+            try:
+                _send_msg(conn, {"type": "join",
+                                 "orig_rank": self.orig_rank,
+                                 "generation": gen, "wall": wall_t0}, gen)
+                # the generation is still being negotiated here; the
+                # hub's JSON assign payload carries it, formation
+                # adopts it (stray control frames are dropped by kind)
+                assign, _ag = _recv_formation_msg(conn)
+                break
+            except (OSError, ConnectionError, ValueError) as e:
+                # a drop mid-exchange is usually the hub's PREVIOUS
+                # incarnation tearing down its listener right as we
+                # knocked (the new window rebinds the same port
+                # moments later) — a transient, not a conviction:
+                # keep sweeping until the deadline says otherwise
+                conn.close()
                 if time.monotonic() >= deadline:
                     raise ConnectionError(
-                        "no elastic hub among candidate rank(s) %s "
-                        "within %.1fs" % (candidates, timeout_s))
+                        "hub candidate %d dropped the formation "
+                        "exchange: %s" % (hub, e))
                 time.sleep(0.1)
-        conn.settimeout(timeout_s + self.rejoin_window_s)
-        wall_t0 = time.time()
-        try:
-            _send_msg(conn, {"type": "join", "orig_rank": self.orig_rank,
-                             "generation": gen, "wall": wall_t0}, gen)
-            # the generation is still being negotiated here; the
-            # hub's JSON assign payload carries it, formation adopts it
-            # (stray control frames are dropped by kind)
-            assign, _ag = _recv_formation_msg(conn)
-        except (OSError, ConnectionError, ValueError) as e:
-            conn.close()
-            raise ConnectionError(
-                "hub candidate %d dropped the formation exchange: %s"
-                % (hub, e))
         wall_t3 = time.time()
         if assign.get("type") == "reject":
             conn.close()
@@ -968,6 +1040,15 @@ class ElasticComm(SocketComm):
                 "rejoin window missed: the world re-formed without "
                 "this rank", dead_ranks=[self.orig_rank],
                 generation=int(assign.get("generation", gen)), fenced=True)
+        if assign.get("type") == "wait":
+            # the hub is mid-incarnation with scale-up on: our petition
+            # is recorded; retry the sweep until the next epoch's
+            # formation window opens
+            conn.close()
+            raise FormationPending(
+                "hub %d is mid-incarnation at generation %s; rejoin "
+                "petition recorded, awaiting a formation epoch"
+                % (hub, assign.get("generation", "?")))
         if assign.get("type") != "assign":
             conn.close()
             raise ConnectionError("unexpected formation reply %r"
@@ -1019,10 +1100,55 @@ class ElasticComm(SocketComm):
                 daemon=True)
             self._ctrl_thread.start()
 
+    def _drain_join_knocks(self) -> None:
+        """Scale-up only (hub): accept any connection waiting on the
+        formation socket, record a JOIN hello as a rejoin petition and
+        answer ``wait`` — the knocker's supervisor sleeps on
+        FormationPending and re-knocks until a formation epoch admits
+        it.  Non-JOIN garbage is dropped; nothing here blocks the probe
+        for more than the 1 s hello timeout per knock."""
+        srv = self._join_srv
+        if srv is None:
+            return
+        while True:
+            try:
+                readable, _, _ = select.select([srv], [], [], 0)
+            except (OSError, ValueError):
+                return
+            if not readable:
+                return
+            try:
+                conn, _addr_ = srv.accept()
+            except OSError:
+                return
+            try:
+                conn.settimeout(1.0)
+                hello, _hg = _recv_formation_msg(conn)
+                r = int(hello.get("orig_rank", -1))
+                if (hello.get("type") == "join"
+                        and 0 <= r < len(self.machines)):
+                    first = r not in self._pending_joins
+                    with self._fence_lock:
+                        self._pending_joins[r] = time.monotonic()
+                    if first:
+                        log.info("elastic: rank %d is knocking to rejoin "
+                                 "(generation %d); pending a formation "
+                                 "epoch", r, self.generation)
+                    _send_msg(conn, {"type": "wait",
+                                     "generation": self.generation},
+                              self.generation)
+            except (OSError, ConnectionError, ValueError):
+                pass
+            finally:
+                conn.close()
+
     def _ctrl_probe(self) -> List[int]:
         """Hub liveness probe (one Heartbeat round): PING every control
         channel, drain PONGs, report ranks (ORIGINAL numbering) that are
-        closed or silent past the staleness bound."""
+        closed or silent past the staleness bound.  Under scale-up the
+        same cadence also drains rejoin knocks off the formation
+        socket."""
+        self._drain_join_knocks()
         now = time.monotonic()
         for orig, st in self._ctrl.items():
             if st["eof"]:
@@ -1076,10 +1202,15 @@ class ElasticComm(SocketComm):
             self._world_changed = WorldChangedError(
                 "peer rank(s) fenced by liveness monitor",
                 dead_ranks=all_dead, generation=self.generation)
-        # 2. poison every surviving spoke so nobody blocks past this
+        # 2. poison every spoke so nobody blocks past this — INCLUDING
+        # the freshly fenced ranks: the verdict frame is how a demoted-
+        # but-alive host learns it was fenced (fenced=True in its
+        # WorldChangedError) rather than mistaking the closed control
+        # channel for hub death and convicting the hub right back.  A
+        # genuinely dead rank just fails the send.
         poison = _encode({"dead": all_dead, "generation": self.generation})
         for orig, st in self._ctrl.items():
-            if orig in all_dead or st["eof"]:
+            if st["eof"] or (orig in all_dead and orig not in fresh):
                 continue
             try:
                 _send_blob(st["sock"], poison,
@@ -1096,6 +1227,43 @@ class ElasticComm(SocketComm):
                 idx = self.membership.index(orig)
                 if 1 <= idx <= len(self._peers):
                     _shutdown(self._peers[idx - 1])
+
+    def announce_epoch(self, readmit=()) -> None:
+        """Hub only: declare a FORMATION EPOCH — the deliberate,
+        scale-UP twin of ``_fence``.  Nobody is convicted; the world
+        tears down so the supervisor can re-form it one generation up
+        with the ``readmit`` ranks back in the alive view (they are
+        knocking on the formation socket and will join the new window).
+        Generation-stamped like POISON: an EPOCH frame from a stale
+        incarnation is ignored by the formation transport's kind/
+        generation fencing."""
+        readmit = sorted({int(r) for r in readmit})
+        with self._fence_lock:
+            if self._world_changed is not None:
+                return
+            self._world_changed = WorldChangedError(
+                "formation epoch: re-forming to admit rank(s) %s"
+                % readmit, dead_ranks=[], generation=self.generation,
+                epoch=True, readmit=readmit)
+        log.info("elastic: formation epoch at generation %d "
+                 "(readmit=%s)", self.generation, readmit)
+        payload = _encode({"readmit": readmit,
+                           "generation": self.generation})
+        for orig, st in self._ctrl.items():
+            if st["eof"]:
+                continue
+            try:
+                _send_blob(st["sock"], payload,
+                           generation=self.generation, kind=FRAME_EPOCH)
+            except OSError:
+                st["eof"] = True
+
+    def pending_joiners(self) -> List[int]:
+        """Original ranks whose rejoin petitions the hub has recorded
+        this incarnation (scale-up) and that are not already members."""
+        with self._fence_lock:
+            return sorted(r for r in self._pending_joins
+                          if r not in self.membership)
 
     def _ctrl_loop(self) -> None:
         """Spoke control thread: answer hub PINGs, treat POISON as a
@@ -1148,6 +1316,23 @@ class ElasticComm(SocketComm):
                 for s in self._peers:
                     _shutdown(s)
                 break
+            elif kind == FRAME_EPOCH:
+                # the POISON twin for scale-UP: nobody died — tear down
+                # and let the supervisor rejoin the next formation
+                try:
+                    info = json.loads(blob.decode("utf-8"))
+                except ValueError:
+                    info = {}
+                with self._fence_lock:
+                    self._world_changed = WorldChangedError(
+                        "formation epoch announced by hub",
+                        dead_ranks=[],
+                        generation=int(info.get("generation", g)),
+                        epoch=True,
+                        readmit=[int(r) for r in info.get("readmit", [])])
+                for s in self._peers:
+                    _shutdown(s)
+                break
 
     # -- supervisor surface ---------------------------------------------
     def world_changed(self) -> Optional[WorldChangedError]:
@@ -1164,6 +1349,12 @@ class ElasticComm(SocketComm):
 
     def close(self) -> None:
         self._ctrl_stop.set()
+        if self._join_srv is not None:
+            try:
+                self._join_srv.close()
+            except OSError:
+                pass
+            self._join_srv = None  # tpulint: ok=lock-shared-write
         if self._heartbeat is not None:
             self._heartbeat.stop()
             # close() runs after the heartbeat/control threads are
@@ -1268,7 +1459,7 @@ def _recv_msg(sock: socket.socket):
     return json.loads(_recv_frame(sock)[0].decode("utf-8"))
 
 
-_FRAME_NAMES = {0: "data", 1: "poison", 2: "ping", 3: "pong"}
+_FRAME_NAMES = {0: "data", 1: "poison", 2: "ping", 3: "pong", 4: "epoch"}
 
 
 def _recv_formation_msg(sock: socket.socket,
@@ -1310,8 +1501,12 @@ _ZERO_TRACE = b"\x00" * 16
 # frame kinds: DATA carries an allgather payload; POISON tells the
 # receiver the world membership changed (blob = {"dead": [...],
 # "generation": g}); PING/PONG are the ElasticComm control-channel
-# liveness probes (empty blobs)
+# liveness probes (empty blobs); EPOCH is the scale-UP twin of POISON —
+# a DELIBERATE formation boundary (blob = {"readmit": [...],
+# "generation": g}): nobody died, the world tears down to re-form one
+# generation up with the readmitted ranks back in
 FRAME_DATA = 0
 FRAME_POISON = 1
 FRAME_PING = 2
 FRAME_PONG = 3
+FRAME_EPOCH = 4

@@ -44,6 +44,13 @@ surviving hosts) then device-second (the grower's local padding /
 shard_map split), and recovery resumes from the newest checkpoint via
 ``resume_mode="reshard"`` — see docs/Distributed.md (hybrid topology)
 and docs/Elasticity.md (host fencing).
+
+The same holds in reverse for elastic scale-UP
+(``tpu_elastic_scale_up``): a formation epoch re-forms the host set
+one host LARGER, and because this collective is built fresh from
+``get_process_comm()`` each incarnation — the world size is never
+baked into the mesh stage — the readmitted host simply appears as one
+more leader on the wire at the next generation.
 """
 from __future__ import annotations
 

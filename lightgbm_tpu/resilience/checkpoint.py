@@ -82,6 +82,10 @@ CONFIG_HASH_EXCLUDE = frozenset({
     "tpu_elastic", "tpu_elastic_heartbeat_ms", "tpu_elastic_suspect_ms",
     "tpu_elastic_rejoin_s", "tpu_elastic_min_world",
     "tpu_elastic_max_reforms", "tpu_elastic_sync_every",
+    "tpu_elastic_scale_up", "tpu_elastic_scale_up_wait_s",
+    "tpu_policy", "tpu_policy_rules", "tpu_policy_dry_run",
+    "tpu_policy_rate_limit", "tpu_policy_rate_window_s",
+    "tpu_policy_cooldown_rounds",
     "tpu_serve_shed_queue_rows", "tpu_serve_shed_retry_after_s",
     "tpu_serve_breaker_failures", "tpu_serve_breaker_reset_s",
     "tpu_serve_drain_timeout_s",

@@ -46,16 +46,27 @@ class WorldChangedError(ConnectionError):
     that).  ``dead_ranks`` names the ranks believed gone, ``generation``
     the generation the error was observed under, and ``fenced`` is True
     when THIS rank is the one the survivors cut off.
+
+    The same exception also carries the scale-UP boundary: ``epoch`` is
+    True for a DELIBERATE formation epoch (ElasticComm.announce_epoch —
+    nobody died, the world is re-forming to ADMIT hosts) and
+    ``readmit`` names the ranks the supervisor should put back in its
+    alive view before re-forming.
     """
 
     def __init__(self, message: str, dead_ranks: Iterable[int] = (),
-                 generation: int = 0, fenced: bool = False):
+                 generation: int = 0, fenced: bool = False,
+                 epoch: bool = False, readmit: Iterable[int] = ()):
         self.dead_ranks = sorted(int(r) for r in dead_ranks)
         self.generation = int(generation)
         self.fenced = bool(fenced)
-        super().__init__("%s (dead=%s, generation=%d%s)"
+        self.epoch = bool(epoch)
+        self.readmit = sorted(int(r) for r in readmit)
+        super().__init__("%s (dead=%s, generation=%d%s%s)"
                          % (message, self.dead_ranks, self.generation,
-                            ", self-fenced" if fenced else ""))
+                            ", self-fenced" if fenced else "",
+                            ", epoch readmit=%s" % self.readmit
+                            if epoch else ""))
 
 
 class CommFailure(ConnectionError):

@@ -32,6 +32,26 @@ checkpoint, same re-shard draw, same mappers.  It is NOT byte-identical
 to an undisturbed run — the row partition changed — which is the
 documented degraded-world promise (docs/Elasticity.md).
 
+Scale-UP (``tpu_elastic_scale_up``): the world can also GROW back.  A
+fenced rank does not exit — it petitions the live hub's formation
+listener, which records the knock and answers ``wait`` (the
+``FormationPending`` path: caught BEFORE the generic comm-failure
+handler so a petitioner never convicts the live hub).  The hub's
+policy engine — or ``ElasticComm.announce_epoch`` directly — declares
+a formation epoch: every survivor raises ``WorldChangedError`` with
+``epoch=True``, the supervisor shrinks ``known_dead`` by the readmit
+set and re-forms at generation+1 WITHOUT burning a reform budget slot,
+rows re-shard host-first back up to the full world, and training
+resumes from the newest checkpoint via ``resume_mode="reshard"`` —
+the same bitwise-deterministic recovery path as shrink, run in
+reverse.  A petition that outlives ``tpu_elastic_scale_up_wait_s``
+gives up with ``ElasticFenced``.
+
+When ``tpu_policy`` is on, the hub incarnation additionally binds the
+control-plane levers (``demote_host``, ``expand_world``) on the
+process actuator for the policy engine (control/engine.py) — see
+docs/ControlPlane.md for the action catalog.
+
 Under the hybrid collective backend (parallel/hybrid.py) a wire rank
 is a whole HOST, so everything above is host-granular: conviction
 fences the host and every device behind it, ``min_world`` counts
@@ -155,10 +175,15 @@ class ElasticSupervisor:
         Raises ElasticFenced when THIS rank is voted out, ElasticAborted
         when the world cannot recover (too small / too many reforms /
         formation failure past the budget)."""
-        from ..parallel.distributed import ElasticComm, WorldChangedError
+        from ..parallel.distributed import (ElasticComm, FormationPending,
+                                            WorldChangedError)
         cfg = self.cfg
         max_reforms = max(0, int(getattr(cfg, "tpu_elastic_max_reforms", 3)))
         min_world = max(1, int(getattr(cfg, "tpu_elastic_min_world", 1)))
+        scale_up = bool(getattr(cfg, "tpu_elastic_scale_up", False))
+        petition_wait = float(
+            getattr(cfg, "tpu_elastic_scale_up_wait_s", 60.0) or 60.0)
+        petition_deadline: Optional[float] = None
         known_dead: set = set()
         generation = 0
         reforms = 0
@@ -184,6 +209,7 @@ class ElasticSupervisor:
                     port_offset=self.port_offset,
                     injector=self.injector)
                 generation = comm.generation
+                petition_deadline = None
                 if t_failure is not None:
                     dt = time.monotonic() - t_failure
                     recovery_s += dt
@@ -208,12 +234,69 @@ class ElasticSupervisor:
                 return result
             except WorldChangedError as exc:
                 dead = set(int(r) for r in exc.dead_ranks)
+                if getattr(exc, "epoch", False):
+                    # deliberate scale-UP boundary (announce_epoch):
+                    # nobody died — put the readmitted ranks back in the
+                    # alive view and re-form one generation up.  Not a
+                    # failure: no reform burned, no recovery clock.
+                    readmit = set(int(r)
+                                  for r in getattr(exc, "readmit", ()) or ())
+                    known_dead -= readmit
+                    if comm is not None:
+                        try:
+                            comm.close()
+                        except OSError:
+                            pass
+                    log.warning("elastic: formation epoch at generation %d;"
+                                " re-forming to admit rank(s) %s",
+                                generation, sorted(readmit))
+                    self._record(cfg, "epoch", generation,
+                                 len(alive) + len(readmit - set(alive)),
+                                 reforms, recovery_s,
+                                 dead=sorted(known_dead))
+                    generation += 1
+                    continue
                 if exc.fenced or self.orig_rank in dead:
                     if comm is not None:
                         comm.close()
+                    if not scale_up:
+                        raise ElasticFenced(
+                            "rank %d fenced at generation %d: %s"
+                            % (self.orig_rank, generation, exc)) from exc
+                    # scale-up: instead of exiting, petition the
+                    # surviving world to readmit us at the next
+                    # formation epoch.  Drop our (stale) conviction
+                    # view — the hub's ASSIGN is authoritative.
+                    if petition_deadline is None:
+                        petition_deadline = time.monotonic() + petition_wait
+                    log.warning("elastic: rank %d fenced at generation %d; "
+                                "petitioning to rejoin (scale-up)",
+                                self.orig_rank, generation)
+                    self._record(cfg, "petition", generation, 0,
+                                 reforms, recovery_s)
+                    known_dead = set()
+                    if t_failure is None:
+                        t_failure = time.monotonic()
+                    generation += 1
+                    continue
+            except FormationPending as exc:
+                # the hub is alive and mid-incarnation: our petition is
+                # recorded.  No conviction, no reform burn — sleep and
+                # re-knock until the next epoch's window (or the wait
+                # budget) runs out.
+                if petition_deadline is None:
+                    petition_deadline = time.monotonic() + petition_wait
+                if time.monotonic() >= petition_deadline:
                     raise ElasticFenced(
-                        "rank %d fenced at generation %d: %s"
-                        % (self.orig_rank, generation, exc)) from exc
+                        "rank %d rejoin petition expired after %.1fs "
+                        "(tpu_elastic_scale_up_wait_s)"
+                        % (self.orig_rank, petition_wait)) from exc
+                log.debug("elastic: rejoin pending (%s); re-knocking",
+                          str(exc).split("\n")[0][:120])
+                if t_failure is None:
+                    t_failure = time.monotonic()
+                time.sleep(0.2)
+                continue
             except (CommFailure, ConnectionError, OSError) as exc:
                 # wire failure without a membership verdict.  For a spoke
                 # that exhausted its hub sweep, the candidates it could
@@ -325,6 +408,7 @@ class ElasticSupervisor:
         # training collectives inherit its retry/heartbeat/generation
         # fencing), and a torn-down world never leaks into the next one
         from ..parallel import collective as coll_mod
+        levers = self._bind_policy_levers(comm)
         coll_mod.set_process_comm(comm)
         try:
             return engine_train(params, ds,
@@ -334,6 +418,59 @@ class ElasticSupervisor:
                                 callbacks=cbs)
         finally:
             coll_mod.set_process_comm(None)
+            if levers:
+                from ..control import default_actuator
+                act = default_actuator()
+                for name, fn in levers:
+                    act.unbind(name, fn)
+
+    def _bind_policy_levers(self, comm):
+        """Hub-side control-plane levers for THIS incarnation: the
+        policy engine (ticked by the federation hub, obs/federation.py)
+        dispatches by name through the process actuator; the comm
+        object changes every re-formation, so the bindings are made
+        here and dropped in ``_train_once``'s finally.  Returns the
+        (name, fn) pairs to unbind, or None when policy is off or this
+        rank is not the hub."""
+        if not bool(getattr(self.cfg, "tpu_policy", False)) \
+                or comm.rank != 0 or comm.world <= 1:
+            return None
+        from ..control import default_actuator
+        min_world = max(1, int(getattr(self.cfg,
+                                       "tpu_elastic_min_world", 1)))
+
+        def demote_host(args):
+            orig = int(args["orig"])
+            if orig == comm.membership[0]:
+                raise ValueError("refusing to demote the hub (orig %d)"
+                                 % orig)
+            if orig not in comm.membership:
+                raise ValueError("orig %d is not in the current formation"
+                                 % orig)
+            if comm.world - 1 < min_world:
+                raise ValueError(
+                    "demote would shrink the world below "
+                    "tpu_elastic_min_world=%d" % min_world)
+            comm._fence({orig})
+            return "fenced %d" % orig
+
+        def expand_world(args):
+            if not getattr(comm, "scale_up", False):
+                raise ValueError("tpu_elastic_scale_up is off")
+            pend = set(comm.pending_joiners())
+            want = [int(r) for r in (args.get("readmit") or [])]
+            readmit = sorted(set(want) & pend) or sorted(pend)
+            if not readmit:
+                raise ValueError("no pending joiners to admit")
+            comm.announce_epoch(readmit)
+            return "epoch admit %s" % readmit
+
+        act = default_actuator()
+        levers = [("demote_host", demote_host),
+                  ("expand_world", expand_world)]
+        for name, fn in levers:
+            act.bind(name, fn)
+        return levers
 
     def _sync_callback(self, comm, cfg):
         """The failure-propagation seam: a tiny allgather every
@@ -429,7 +566,14 @@ class ElasticSupervisor:
                 return
             log.warning("chaos: lag %.2fs on rank %d at round %d",
                         secs, comm.orig_rank, round_idx)
-            time.sleep(secs)
+            # yield as soon as the world moved on without us: a fenced
+            # host has nothing left to be slow AT, and the scale-up
+            # petition timing should be bounded by the heartbeat, not
+            # by the injected lag
+            deadline = time.monotonic() + secs
+            while (time.monotonic() < deadline
+                    and comm.world_changed() is None):
+                time.sleep(0.05)
             return
         self._chaos_fired = True
         log.warning("chaos: %s on rank %d at round %d", kind,

@@ -388,6 +388,33 @@ class ContinuousLearningSupervisor:
             model=self.name)
         self._restore()
         server.attach_supervisor(self)
+        self._policy_levers = self._bind_policy_levers()
+
+    def _bind_policy_levers(self):
+        """Control-plane lever: the policy engine reacts to a
+        ``supervisor_rollbacks`` burn-rate alert by tightening the
+        promote floor, so a regressing refit stream has to clear a
+        higher quality bar before the next promote.  Mutates
+        ``self.config.tpu_promote_min_delta``, which ``_tick_shadow``
+        reads fresh every tick.  Returns the (name, fn) pairs so
+        ``stop()`` can unbind them."""
+        if not bool(getattr(self.config, "tpu_policy", False)):
+            return None
+        from ..control import default_actuator
+
+        def tighten_promote_floor(args):
+            factor = float(args.get("factor", 2.0))
+            floor = float(args.get("min_delta", 0.0))
+            old = float(self.config.tpu_promote_min_delta)
+            new = max(old * factor, floor)
+            self.config.tpu_promote_min_delta = new
+            return "promote floor %.6g -> %.6g" % (old, new)
+
+        act = default_actuator()
+        levers = [("tighten_promote_floor", tighten_promote_floor)]
+        for name, fn in levers:
+            act.bind(name, fn)
+        return levers
 
     # -- ingest (HTTP + in-process edge) -------------------------------- #
     def ingest(self, rows, labels=None, weights=None):
@@ -433,10 +460,16 @@ class ContinuousLearningSupervisor:
         with self._state_lock:
             thread, self._thread = self._thread, None
             mirror, self._mirror = self._mirror, None
+            levers, self._policy_levers = self._policy_levers, None
         if thread is not None:
             thread.join(timeout=timeout_s)
         if mirror is not None:
             self.server.detach_shadow(self.name)
+        if levers:
+            from ..control import default_actuator
+            act = default_actuator()
+            for name, fn in levers:
+                act.unbind(name, fn)
 
     # -- the state machine ---------------------------------------------- #
     def tick(self, now: Optional[float] = None) -> str:

@@ -233,6 +233,7 @@ class HbmResidencyManager:
         self.spill_corruptions = 0
         self.device_hits = 0
         self.host_serves = 0
+        self._policy_levers = self._bind_policy_levers()
 
     @classmethod
     def from_config(cls, config, **kwargs) -> "HbmResidencyManager":
@@ -366,9 +367,64 @@ class HbmResidencyManager:
         with self._lock:
             self._stopped = True
             worker, self._worker = self._worker, None
+            levers, self._policy_levers = self._policy_levers, None
         self._queue.put(None)
         if worker is not None:
             worker.join(timeout=5.0)
+        if levers:
+            from ..control import default_actuator
+            act = default_actuator()
+            for name, fn in levers:
+                act.unbind(name, fn)
+
+    # -- control-plane levers ------------------------------------------- #
+    def pre_spill(self, count: int = 1) -> List[str]:
+        """Proactively spill the ``count`` coldest device-resident
+        tenants to the host tier, returning their names.  This is the
+        shed-burn-rate lever: when admission is 429ing, freeing HBM
+        headroom BEFORE the next admit avoids the synchronous
+        make-room eviction on the serving path.  Same spill mechanics
+        as watermark eviction (accounting drops under the lock, the
+        model-text snapshot is written outside it)."""
+        count = max(1, int(count))
+        victims: List[Tuple] = []
+        with self._lock:
+            cands = sorted(
+                (r for r in self._records.values() if r.state == RESIDENT),
+                key=lambda r: r.last_access)
+            for r in cands[:count]:
+                self.resident_bytes -= r.bytes  # tpulint: ok=lock-unguarded-write
+                self.evictions += 1  # tpulint: ok=lock-unguarded-write
+                victims.append((r, r.entry, r.ens))
+                r.bytes = 0
+                r.ens = None
+                r.state = SPILLED
+        self._finish_spills(victims)
+        names = [rec.name for rec, _e, _s in victims]
+        if names:
+            self._event("pre_spill", models=names)
+        return names
+
+    def _bind_policy_levers(self):
+        """Expose the residency levers to the policy engine
+        (control/engine.py) through the process actuator; unbound again
+        in :meth:`stop`.  Returns the (name, fn) pairs, or None when
+        ``tpu_policy`` is off."""
+        if not bool(getattr(self.config, "tpu_policy", False)):
+            return None
+        from ..control import default_actuator
+
+        def fleet_pre_spill(args):
+            names = self.pre_spill(int(args.get("count", 1)))
+            if not names:
+                raise ValueError("no device-resident tenants to pre-spill")
+            return "spilled %s" % names
+
+        act = default_actuator()
+        levers = [("fleet_pre_spill", fleet_pre_spill)]
+        for name, fn in levers:
+            act.bind(name, fn)
+        return levers
 
     # -- promotion ------------------------------------------------------ #
     def _enqueue(self, name: str) -> None:

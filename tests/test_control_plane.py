@@ -1,0 +1,346 @@
+"""Closed-loop control plane (control/): policy rule parsing and
+matching, $arg resolution, the token bucket, the actuator registry,
+PolicyEngine decision statuses (ok / dry_run / rate_limited / unbound /
+unresolved / error), level-triggered alert matching, the policy_action
+telemetry stream, and the federation hub wiring — all on the fast tier
+(JAX_PLATFORMS=cpu, conftest)."""
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.control import (Actuator, PolicyEngine, PolicyRule,
+                                  TokenBucket, default_actuator,
+                                  load_policy_rules)
+from lightgbm_tpu.control.policy import default_policy_rules, resolve_args
+from lightgbm_tpu.obs import MetricsRegistry
+
+
+def _cfg(**over):
+    params = {"objective": "regression", "verbosity": -1,
+              "tpu_policy": True}
+    params.update(over)
+    return Config(params)
+
+
+def _engine(rules, registry=None, **cfg_over):
+    """An isolated engine: private actuator + fresh bucket, so tests
+    never touch the process-global bindings or budget."""
+    cfg = _cfg(**cfg_over)
+    return PolicyEngine(
+        cfg, rules=rules, actuator=Actuator(),
+        registry=registry or MetricsRegistry(),
+        bucket=TokenBucket(cfg.tpu_policy_rate_limit,
+                           cfg.tpu_policy_rate_window_s))
+
+
+def _firing(rule="straggler_host", **over):
+    t = {"rule": rule, "state": "firing", "metric": "lgbm_hybrid_host_slow",
+         "kind": "sustained", "value": 2.0, "threshold": 1.0, "tick": 4}
+    t.update(over)
+    return t
+
+
+# ------------------------------------------------------------ PolicyRule
+
+def test_rule_when_needs_exactly_one_trigger():
+    with pytest.raises(ValueError):
+        PolicyRule("r", when={}, action="demote_host")
+    with pytest.raises(ValueError):
+        PolicyRule("r", when={"alert": "a", "signal": "s"},
+                   action="demote_host")
+    with pytest.raises(ValueError):
+        PolicyRule("r", when={"alert": "a", "state": "sideways"},
+                   action="demote_host")
+    with pytest.raises(ValueError):
+        PolicyRule("r", when={"alert": "a"}, action="")
+
+
+def test_rule_matching_and_roundtrip():
+    r = PolicyRule("demote", when={"alert": "straggler_host"},
+                   action="demote_host", args={"orig": "$critical_host"},
+                   guard={"critical_phase": "straggler_wait"},
+                   cooldown_rounds=3)
+    assert r.matches_alert(_firing())
+    assert not r.matches_alert(_firing(state="cleared"))
+    assert not r.matches_alert(_firing(rule="shed_rate"))
+    assert not r.matches_signal({"signal": "pending_join"})
+    r2 = PolicyRule.from_dict(r.to_dict())
+    assert r2.to_dict() == r.to_dict()
+
+    s = PolicyRule("join", when={"signal": "pending_join"},
+                   action="expand_world")
+    assert s.matches_signal({"signal": "pending_join", "ranks": [2]})
+    assert not s.matches_alert(_firing())
+
+
+def test_resolve_args_substitutes_and_raises():
+    ctx = {"critical_host": 2, "signal.ranks": [3], "round": 7}
+    out = resolve_args({"orig": "$critical_host", "readmit": "$signal.ranks",
+                        "count": 1}, ctx)
+    assert out == {"orig": 2, "readmit": [3], "count": 1}
+    with pytest.raises(KeyError):
+        resolve_args({"orig": "$critical_host"}, {"critical_host": None})
+
+
+def test_load_policy_rules_file(tmp_path):
+    path = tmp_path / "policy.json"
+    path.write_text(json.dumps([
+        {"name": "demote", "when": {"alert": "straggler_host"},
+         "action": "demote_host", "args": {"orig": "$critical_host"},
+         "cooldown": 2}]))
+    (r,) = load_policy_rules(str(path))
+    assert r.name == "demote" and r.cooldown_rounds == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"not": "a list"}))
+    with pytest.raises(ValueError):
+        load_policy_rules(str(bad))
+
+
+def test_default_policy_rules_cover_the_three_loops():
+    actions = {r.action for r in default_policy_rules(_cfg())}
+    assert {"demote_host", "expand_world", "fleet_pre_spill",
+            "tighten_promote_floor"} <= actions
+
+
+# ------------------------------------------------------------ TokenBucket
+
+def test_token_bucket_spends_and_refills():
+    b = TokenBucket(capacity=2.0, window_s=1000.0)
+    assert b.take() and b.take()
+    assert not b.take()                      # dry: never blocks
+    assert b.available() < 1.0
+    fast = TokenBucket(capacity=100.0, window_s=0.1)
+    for _ in range(100):
+        fast.take()
+    import time
+    time.sleep(0.05)
+    assert fast.take()                       # continuous refill
+
+
+# --------------------------------------------------------------- Actuator
+
+def test_actuator_bind_dispatch_unbind():
+    act = Actuator()
+    calls = []
+    fn = lambda args: calls.append(args) or "done"   # noqa: E731
+    act.bind("demote_host", fn)
+    assert act.is_bound("demote_host") and act.bound() == ["demote_host"]
+    assert act.dispatch("demote_host", {"orig": 2}) == "done"
+    assert calls == [{"orig": 2}]
+    with pytest.raises(KeyError):
+        act.dispatch("missing", {})
+    # fn-guarded unbind: a later incarnation's binding survives ours
+    other = lambda args: "other"                     # noqa: E731
+    act.bind("demote_host", other)
+    act.unbind("demote_host", fn)
+    assert act.is_bound("demote_host")
+    act.unbind("demote_host", other)
+    assert not act.is_bound("demote_host")
+
+
+# ------------------------------------------------------------ PolicyEngine
+
+def test_engine_dispatches_ok_with_resolved_args():
+    rules = [PolicyRule("demote", when={"alert": "straggler_host"},
+                        guard={"critical_phase": "straggler_wait"},
+                        action="demote_host",
+                        args={"orig": "$critical_host"})]
+    eng = _engine(rules)
+    seen = []
+    eng.actuator.bind("demote_host", lambda a: seen.append(a))
+    (d,) = eng.on_round(4, transitions=[_firing()],
+                        ledger={"critical_host": 2,
+                                "critical_phase": "straggler_wait"})
+    assert d["status"] == "ok" and d["args"] == {"orig": 2}
+    assert d["trigger"] == "straggler_host" and seen == [{"orig": 2}]
+
+
+def test_engine_alert_matching_is_level_triggered_past_guard_miss():
+    """The firing transition lands on a round whose ledger names a
+    different critical phase; the guard must retry on later rounds
+    while the alert stays active (the flaky-edge bug the policy_loop
+    drill caught)."""
+    rules = [PolicyRule("demote", when={"alert": "straggler_host"},
+                        guard={"critical_phase": "straggler_wait"},
+                        action="demote_host",
+                        args={"orig": "$critical_host"})]
+    eng = _engine(rules)
+    eng.actuator.bind("demote_host", lambda a: None)
+    # transition tick: guard fails (critical phase is tree_grow)
+    assert eng.on_round(4, transitions=[_firing()],
+                        ledger={"critical_host": 1,
+                                "critical_phase": "tree_grow"}) == []
+    # no new transition, alert still active, guard now holds -> dispatch
+    (d,) = eng.on_round(5, transitions=[],
+                        ledger={"critical_host": 2,
+                                "critical_phase": "straggler_wait"})
+    assert d["status"] == "ok" and d["args"] == {"orig": 2}
+    # a clear transition drops the rule out of the active view
+    eng.on_round(6, transitions=[_firing(state="cleared")],
+                 ledger={"critical_host": 2,
+                         "critical_phase": "straggler_wait"})
+    assert eng.on_round(20, transitions=[],
+                        ledger={"critical_host": 2,
+                                "critical_phase": "straggler_wait"}) == []
+
+
+def test_engine_cooldown_debounces_decisions():
+    rules = [PolicyRule("demote", when={"alert": "straggler_host"},
+                        action="demote_host", args={},
+                        cooldown_rounds=4)]
+    eng = _engine(rules)
+    eng.actuator.bind("demote_host", lambda a: None)
+    assert eng.on_round(1, transitions=[_firing()])[0]["status"] == "ok"
+    # level-triggered but debounced: silent until the cooldown lapses
+    assert eng.on_round(2, transitions=[]) == []
+    assert eng.on_round(4, transitions=[]) == []
+    assert eng.on_round(5, transitions=[])[0]["status"] == "ok"
+
+
+def test_engine_statuses_dry_run_unbound_unresolved_error():
+    reg = MetricsRegistry()
+    demote = PolicyRule("demote", when={"alert": "straggler_host"},
+                        action="demote_host",
+                        args={"orig": "$critical_host"}, cooldown_rounds=0)
+
+    # dry_run: full decision, lever NOT invoked
+    eng = _engine([demote], registry=reg, tpu_policy_dry_run=True)
+    calls = []
+    eng.actuator.bind("demote_host", lambda a: calls.append(a))
+    (d,) = eng.on_round(1, transitions=[_firing()],
+                        ledger={"critical_host": 2})
+    assert d["status"] == "dry_run" and d["dry_run"] and calls == []
+
+    # unbound: no lever in this process
+    eng = _engine([demote], registry=reg)
+    (d,) = eng.on_round(1, transitions=[_firing()],
+                        ledger={"critical_host": 2})
+    assert d["status"] == "unbound"
+
+    # unresolved: $critical_host has no value this round (no ledger)
+    eng = _engine([demote], registry=reg)
+    eng.actuator.bind("demote_host", lambda a: None)
+    (d,) = eng.on_round(1, transitions=[_firing()], ledger=None)
+    assert d["status"] == "unresolved" and "critical_host" in d["error"]
+
+    # error: the lever raised — recorded, never propagated
+    eng = _engine([demote], registry=reg)
+    def _boom(args):
+        raise RuntimeError("lever exploded")
+    eng.actuator.bind("demote_host", _boom)
+    (d,) = eng.on_round(1, transitions=[_firing()],
+                        ledger={"critical_host": 2})
+    assert d["status"] == "error" and "lever exploded" in d["error"]
+
+
+def test_engine_rate_limited_when_bucket_dry():
+    rules = [PolicyRule("demote", when={"alert": "straggler_host"},
+                        action="demote_host", args={}, cooldown_rounds=0)]
+    cfg = _cfg()
+    eng = PolicyEngine(cfg, rules=rules, actuator=Actuator(),
+                       registry=MetricsRegistry(),
+                       bucket=TokenBucket(1.0, 1000.0))
+    eng.actuator.bind("demote_host", lambda a: None)
+    assert eng.on_round(1, transitions=[_firing()])[0]["status"] == "ok"
+    assert eng.on_round(2, transitions=[])[0]["status"] == "rate_limited"
+
+
+def test_engine_signal_trigger_resolves_signal_args():
+    rules = [PolicyRule("join", when={"signal": "pending_join"},
+                        action="expand_world",
+                        args={"readmit": "$signal.ranks"})]
+    eng = _engine(rules)
+    seen = []
+    eng.actuator.bind("expand_world", lambda a: seen.append(a))
+    (d,) = eng.on_round(3, signals=[{"signal": "pending_join",
+                                     "ranks": [2]}])
+    assert d["status"] == "ok" and seen == [{"readmit": [2]}]
+
+
+def test_engine_records_metrics_and_snapshot():
+    reg = MetricsRegistry()
+    rules = [PolicyRule("demote", when={"alert": "straggler_host"},
+                        guard={"critical_phase": "straggler_wait"},
+                        action="demote_host", args={})]
+    eng = _engine(rules, registry=reg)
+    eng.actuator.bind("demote_host", lambda a: None)
+    eng.on_round(1, transitions=[_firing()],
+                 ledger={"critical_phase": "tree_grow"})   # guard miss
+    eng.on_round(2, transitions=[],
+                 ledger={"critical_phase": "straggler_wait"})
+    assert reg.counter("lgbm_policy_actions_total", action="demote_host",
+                       status="ok").value == 1.0
+    assert reg.counter("lgbm_policy_suppressed_total",
+                       reason="guard").value == 1.0
+    assert reg.gauge("lgbm_policy_last_action_round").value == 2.0
+    snap = eng.snapshot()
+    assert snap["bound"] == ["demote_host"] and not snap["dry_run"]
+    assert [d["status"] for d in snap["decisions"]] == ["ok"]
+
+
+def test_engine_emits_policy_action_events(tmp_path):
+    path = str(tmp_path / "t.jsonl")
+    rules = [PolicyRule("demote", when={"alert": "straggler_host"},
+                        action="demote_host", args={})]
+    eng = _engine(rules, tpu_telemetry_path=path)
+    eng.actuator.bind("demote_host", lambda a: None)
+    eng.on_round(4, transitions=[_firing()])
+    (ev,) = [json.loads(line) for line in open(path)]
+    assert ev["event"] == "policy_action" and ev["status"] == "ok"
+    assert ev["rule"] == "demote" and ev["round"] == 4
+
+
+def test_engine_on_round_never_raises():
+    rules = [PolicyRule("demote", when={"alert": "straggler_host"},
+                        action="demote_host", args={})]
+    eng = _engine(rules)
+    # transitions that are not even dicts: degrade to warning, not raise
+    assert eng.on_round(1, transitions=[None, 42]) == []
+
+
+# ------------------------------------------------- federation hub wiring
+
+def test_federation_hub_runs_policy_engine(tmp_path):
+    """tpu_policy=true on a world-1 training run: the hub builds a
+    PolicyEngine and every round flows through it (no alerts fire, so
+    the stream stays empty — but the engine must exist and the run
+    must complete unchanged)."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(120, 5)
+    y = 2.0 * X[:, 0] - X[:, 1] + 0.05 * rng.randn(120)
+    path = str(tmp_path / "tele.jsonl")
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbose": -1, "min_data_in_leaf": 5,
+                     "tpu_federation": True, "tpu_alert": True,
+                     "tpu_policy": True, "tpu_policy_dry_run": True,
+                     "tpu_telemetry_path": path},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    assert bst.num_trees() == 3
+    events = [json.loads(line) for line in open(path)]
+    assert [e for e in events if e.get("event") == "round_ledger"]
+
+
+def test_policy_config_validation():
+    with pytest.raises(Exception):
+        _cfg(tpu_policy_rate_limit=0.0)
+    with pytest.raises(Exception):
+        _cfg(tpu_policy_rate_window_s=-1.0)
+    with pytest.raises(Exception):
+        _cfg(tpu_policy_cooldown_rounds=-1)
+    cfg = _cfg(tpu_policy_rate_limit=2.0)
+    assert cfg.tpu_policy is True and cfg.tpu_policy_rate_limit == 2.0
+
+
+def test_default_actuator_is_process_global():
+    a = default_actuator()
+    assert a is default_actuator()
+    fn = lambda args: None                           # noqa: E731
+    a.bind("_test_lever", fn)
+    try:
+        assert "_test_lever" in a.bound()
+    finally:
+        a.unbind("_test_lever", fn)
+    assert "_test_lever" not in a.bound()

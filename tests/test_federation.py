@@ -68,6 +68,54 @@ def test_sustained_rule_needs_consecutive_breaches():
     assert t["state"] == "cleared"
 
 
+def test_clear_hysteresis_rides_through_flapping_metric():
+    """clear_for=N keeps a firing rule firing through N-1 clean ticks,
+    so a metric flapping 1/0/1/0 emits ONE firing transition instead of
+    a fire/clear pair per flap; the default clear_for=1 clears (and
+    re-fires) on every flap."""
+    def _engine(clear_for):
+        reg = MetricsRegistry()
+        g = reg.gauge("lgbm_hybrid_host_slow", host="1")
+        eng = AlertEngine(reg, rules=[Rule(
+            "straggler", "lgbm_hybrid_host_slow", ">=", 1.0,
+            clear_for=clear_for)])
+        return g, eng
+
+    flaps = (1, 0, 1, 0, 1, 0)
+
+    # default clear_for=1: every clean tick clears, every breach
+    # re-fires — six transitions for six flaps
+    g, eng = _engine(1)
+    states = []
+    for v in flaps:
+        g.set(v)
+        states.extend(t["state"] for t in eng.evaluate())
+    assert states == ["firing", "cleared"] * 3
+
+    # clear_for=2: one clean tick is not enough to clear, so the rule
+    # stays latched across the whole flap train (one firing transition);
+    # the train ends on a breach so the clean streak is 0 below
+    g, eng = _engine(2)
+    states = []
+    for v in flaps + (1,):
+        g.set(v)
+        states.extend(t["state"] for t in eng.evaluate())
+        assert eng.active() == ["straggler"]
+    assert states == ["firing"]
+
+    # ...and clears only after clear_for CONSECUTIVE clean ticks; a
+    # breach mid-countdown resets the clean streak
+    g.set(0)
+    assert eng.evaluate() == []          # clean streak 1 of 2
+    g.set(1)
+    assert eng.evaluate() == []          # breach: streak resets, stays firing
+    g.set(0)
+    assert eng.evaluate() == []          # clean streak 1 of 2 (again)
+    g.set(0)
+    (t,) = eng.evaluate()                # clean streak 2 of 2: clears
+    assert t["state"] == "cleared" and eng.active() == []
+
+
 def test_burn_rate_rule_watches_slope_not_level():
     reg = MetricsRegistry()
     c = reg.counter("lgbm_serve_shed_total", model="m")

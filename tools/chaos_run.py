@@ -39,6 +39,13 @@ survivor.  slow_host delays one host's leader phase every round; the
 hub must mark it *slow* (a hybrid_slow telemetry event) without ever
 convicting it — all hosts finish at full world, models identical.
 
+One closed-loop control-plane drill exercises the policy engine
+(lightgbm_tpu/control/) end to end — alert-driven demote, rejoin
+petition, elastic scale-UP back to full world, plus the dry-run
+bitwise-identity contract against a policy-off control leg:
+
+    python tools/chaos_run.py --scenario policy_loop
+
 Exit code 0 iff the scenario's expectations held (survivors completed
 at the expected world size with a usable model).  The injury rides the
 LGBM_TPU_CHAOS env hook (kind:orig_rank:round[:secs]) the supervisor's
@@ -109,6 +116,8 @@ HYBRID_SCENARIOS = ("kill_host", "slow_host")
 SUPERVISOR_SCENARIOS = ("kill_refit", "bad_promote")
 # fleet-residency drill (serving/fleet.py)
 FLEET_SCENARIOS = ("tenant_storm",)
+# closed-loop control-plane drill (control/ + elastic scale-up)
+POLICY_SCENARIOS = ("policy_loop",)
 
 
 def run_scenario(scenario: str, world: int = 3, rounds: int = 8,
@@ -425,6 +434,196 @@ def run_hybrid_scenario(scenario: str, hosts: int = 3, local: int = 2,
         "recovery_s": recovery,
         "total_s": round(total_s, 3),
         "results": results,
+    }
+
+
+def _run_policy_leg(hosts, local, rounds, n_rows, chaos_round, lag_s,
+                    lag_until, policy, dry_run, join_timeout_s):
+    """One training run for the policy_loop drill: a hybrid world with
+    a lagging victim host, federation + alerting on, and the policy
+    engine in the requested mode.  Returns (results, events) where
+    events is the parsed telemetry JSONL."""
+    victim = hosts - 1
+    tmp = tempfile.mkdtemp(prefix="lgbm_chaos_pol_")
+    telemetry = os.path.join(tmp, "telemetry.jsonl")
+    machines = ",".join("127.0.0.1:%d" % _free_port() for _ in range(hosts))
+    params = {
+        "objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+        "verbosity": -1, "boost_from_average": True,
+        "num_machines": hosts, "machines": machines,
+        "tree_learner": "data", "pre_partition": True,
+        "tpu_comm_backend": "hybrid", "tpu_hybrid_local_devices": local,
+        "tpu_elastic": True,
+        "tpu_elastic_heartbeat_ms": 100.0, "tpu_elastic_suspect_ms": 500.0,
+        "tpu_elastic_rejoin_s": 1.0,
+        "tpu_elastic_min_world": max(1, min(2, hosts - 1)),
+        # the scale-up listener stays open in EVERY leg so the dry-run
+        # and policy-off runs share the live leg's config shape
+        "tpu_elastic_scale_up": True,
+        "tpu_elastic_scale_up_wait_s": 60.0,
+        "tpu_checkpoint_path": os.path.join(tmp, "ckpts"),
+        "tpu_checkpoint_interval": 1,
+        "tpu_telemetry_path": telemetry,
+        # slow_policy=observe: the straggler DEMOTE must come from the
+        # policy engine reacting to the straggler_host alert, not from
+        # the in-loop slow-host policy
+        "tpu_hybrid_slow_ms": 50.0, "tpu_hybrid_slow_rounds": 2,
+        "tpu_hybrid_slow_policy": "observe",
+        "tpu_federation": True, "tpu_alert": True,
+        "tpu_alert_sustain_rounds": 2,
+        "tpu_policy": policy, "tpu_policy_dry_run": dry_run,
+    }
+    os.environ["LGBM_TPU_CHAOS"] = "lag:%d:%d:%.2f:%d" % (
+        victim, chaos_round, lag_s, lag_until)
+    try:
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        mlist = machines.split(",")
+        procs = [ctx.Process(target=_hybrid_worker,
+                             args=(r, mlist, params, n_rows, rounds,
+                                   local, q))
+                 for r in range(hosts)]
+        for p in procs:
+            p.start()
+        results = {}
+        deadline = time.monotonic() + join_timeout_s
+        while len(results) < hosts and time.monotonic() < deadline:
+            try:
+                rank, out = q.get(timeout=1.0)
+                results[rank] = out
+            except Exception:   # noqa: BLE001 — queue.Empty
+                if not any(p.is_alive() for p in procs):
+                    break
+        for p in procs:
+            p.join(timeout=5.0)
+            if p.is_alive():
+                p.terminate()
+    finally:
+        os.environ.pop("LGBM_TPU_CHAOS", None)
+    events = []
+    try:
+        with open(telemetry) as f:
+            for line in f:
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    pass
+    except OSError:
+        pass
+    return results, events
+
+
+def run_policy_scenario(scenario: str, hosts: int = 3, local: int = 2,
+                        rounds: int = 12, n_rows: int = 240,
+                        chaos_round: int = 2,
+                        join_timeout_s: float = 180.0) -> dict:
+    """policy_loop: the closed-loop control-plane drill, three legs.
+
+    LIVE (tpu_policy=true): a lagging host trips the straggler_host
+    alert; the policy engine demotes it (proactive fence + re-shard at
+    hosts-1), the now-healthy victim petitions to rejoin, the
+    pending_join signal triggers expand_world, and a formation epoch
+    re-admits it — every host finishes at FULL world with one shared
+    model digest, with recorded policy_action events for both the
+    demote and the expansion.
+
+    DRY RUN (tpu_policy_dry_run=true): the same incident is decided
+    but nothing is dispatched — no fence, zero re-forms, and the final
+    model must be BITWISE identical to the policy-off leg.
+
+    OFF (tpu_policy=false): the control leg the dry run is compared
+    against; no policy_action events at all."""
+    assert scenario in POLICY_SCENARIOS, scenario
+    victim = hosts - 1
+    t0 = time.monotonic()
+    # live leg: keep lagging until demoted (the lag only fires at
+    # generation 0, so the readmitted victim is healthy)
+    live_res, live_ev = _run_policy_leg(
+        hosts, local, rounds, n_rows, chaos_round, 0.6, rounds,
+        policy=True, dry_run=False, join_timeout_s=join_timeout_s)
+    # dry-run + off legs: a bounded lag window (the alert must clear),
+    # identical in everything except the policy switch
+    lag_until = max(chaos_round + 4, rounds - 4)
+    dry_res, dry_ev = _run_policy_leg(
+        hosts, local, rounds, n_rows, chaos_round, 0.6, lag_until,
+        policy=True, dry_run=True, join_timeout_s=join_timeout_s)
+    off_res, off_ev = _run_policy_leg(
+        hosts, local, rounds, n_rows, chaos_round, 0.6, lag_until,
+        policy=False, dry_run=False, join_timeout_s=join_timeout_s)
+
+    def _complete(results):
+        return {r: o for r, o in results.items()
+                if o.get("outcome") == "complete"}
+
+    def _digests(results):
+        return sorted({o.get("model_digest")
+                       for o in _complete(results).values()})
+
+    def _policy_actions(events):
+        return [e for e in events if e.get("event") == "policy_action"]
+
+    def _alert_states(events, rule):
+        return [e.get("state") for e in events
+                if e.get("event") == "alert" and e.get("rule") == rule]
+
+    live_c, dry_c, off_c = (_complete(r)
+                            for r in (live_res, dry_res, off_res))
+    live_actions = _policy_actions(live_ev)
+    dry_actions = _policy_actions(dry_ev)
+    off_actions = _policy_actions(off_ev)
+    elastic_whats = [e.get("what") for e in live_ev
+                     if e.get("event") == "elastic"]
+    # LIVE: full-world finish through demote -> petition -> epoch, with
+    # both actions recorded as dispatched ("ok")
+    ok_live = (len(live_c) == hosts and len(_digests(live_res)) == 1
+               and all(o["world"] == hosts and o["num_trees"] >= rounds
+                       for o in live_c.values())
+               and any(a.get("action") == "demote_host"
+                       and a.get("status") == "ok"
+                       and a.get("args", {}).get("orig") == victim
+                       for a in live_actions)
+               and any(a.get("action") == "expand_world"
+                       and a.get("status") == "ok"
+                       for a in live_actions)
+               and "petition" in elastic_whats
+               and "epoch" in elastic_whats
+               and "firing" in _alert_states(live_ev, "straggler_host"))
+    # DRY RUN: decisions recorded, nothing dispatched, zero re-forms,
+    # and the incident plays out exactly like policy-off
+    ok_dry = (len(dry_c) == hosts and len(_digests(dry_res)) == 1
+              and all(o["reforms"] == 0 for o in dry_c.values())
+              and bool(dry_actions)
+              and all(a.get("status") == "dry_run" for a in dry_actions)
+              and any(a.get("action") == "demote_host"
+                      for a in dry_actions)
+              and _alert_states(dry_ev, "straggler_host")
+              == ["firing", "cleared"])
+    # OFF: the control leg — and the dry run is bitwise-identical to it
+    ok_off = (len(off_c) == hosts and len(_digests(off_res)) == 1
+              and not off_actions
+              and _digests(dry_res) == _digests(off_res))
+    ok = ok_live and ok_dry and ok_off
+    return {
+        "scenario": scenario, "hosts": hosts, "local_devices": local,
+        "victim": victim, "rounds": rounds, "ok": ok,
+        "ok_live": ok_live, "ok_dry_run": ok_dry, "ok_off": ok_off,
+        "final_world": hosts,
+        "live_digests": _digests(live_res),
+        "dry_run_digests": _digests(dry_res),
+        "off_digests": _digests(off_res),
+        "dry_run_bitwise_identical":
+            _digests(dry_res) == _digests(off_res),
+        "live_policy_actions": [
+            (a.get("rule"), a.get("action"), a.get("status"))
+            for a in live_actions],
+        "dry_run_policy_actions": [
+            (a.get("rule"), a.get("action"), a.get("status"))
+            for a in dry_actions],
+        "live_elastic_events": elastic_whats,
+        "live_alerts": _alert_states(live_ev, "straggler_host"),
+        "dry_run_alerts": _alert_states(dry_ev, "straggler_host"),
+        "total_s": round(time.monotonic() - t0, 3),
+        "results": {"live": live_res, "dry_run": dry_res, "off": off_res},
     }
 
 
@@ -759,7 +958,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--scenario",
                     choices=SCENARIOS + SUPERVISOR_SCENARIOS
-                    + FLEET_SCENARIOS + HYBRID_SCENARIOS,
+                    + FLEET_SCENARIOS + HYBRID_SCENARIOS
+                    + POLICY_SCENARIOS,
                     default="kill_rank")
     ap.add_argument("--world", type=int, default=3)
     ap.add_argument("--rounds", type=int, default=8)
@@ -783,6 +983,12 @@ def main(argv=None) -> int:
         summary = run_supervisor_scenario(args.scenario,
                                           n_rows=max(args.rows, 400),
                                           join_timeout_s=args.timeout)
+    elif args.scenario in POLICY_SCENARIOS:
+        summary = run_policy_scenario(
+            args.scenario,
+            rounds=8 if args.fast else 12,
+            n_rows=args.rows, chaos_round=args.chaos_round,
+            join_timeout_s=max(args.timeout, 180.0))
     elif args.scenario in HYBRID_SCENARIOS:
         # kill_host keeps 3 hosts even in --fast so two survivors can
         # re-form a quorum; slow_host convicts nobody, so 2 suffice

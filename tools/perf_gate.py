@@ -36,6 +36,12 @@ bandwidth-utilization minimums per kernel.  CPU-backend bench results
 skip the throughput gate (the ledger tracks the TPU numbers; a CPU
 smoke run proving 1000x slower is noise, not a regression).
 
+Latency metrics (``CEILING_METRICS``, e.g. the serving plane's
+``serve_open_loop_p99_ms`` from ``tools/serve_bench.py --open-loop``)
+invert the gate: they breach ABOVE ``baseline * (1 + tolerance)`` and
+are enforced on every backend, since open-loop serving latency is a
+host-side number either way.
+
 Usage:
     python tools/perf_gate.py                      # newest BENCH_r*.json
     python tools/perf_gate.py --bench FILE [--roofline FILE]
@@ -93,6 +99,15 @@ def extract_metrics(bench: Dict) -> Dict:
     detail = parsed.get("detail") or {}
     out: Dict = {"backend": detail.get("backend", "unknown"),
                  "round": bench.get("n")}
+    if parsed.get("metric") == "serve_open_loop_p99_ms":
+        # tools/serve_bench.py --open-loop result: a LATENCY ceiling
+        # (lower is better), gated on every backend — the open-loop
+        # serving path is host-side either way
+        if parsed.get("value") is not None:
+            # bench-JSON metric key, not a config param
+            val = float(parsed["value"])
+            out["serve_open_loop_p99_ms"] = val  # tpulint: ok=config-phantom-param
+        return out
     higgs = (detail.get("higgs") or {}).get("throughput_mrows_iter_s")
     if higgs is None:
         higgs = parsed.get("value")   # pre-detail bench format (r01/r02)
@@ -132,7 +147,8 @@ def check(metrics: Dict, roofline: Optional[Dict[str, float]],
     breaches: List[str] = []
     enforce_throughput = metrics.get("backend") == "tpu"
     for name, spec in (baseline.get("metrics") or {}).items():
-        if not enforce_throughput:
+        ceiling = name in CEILING_METRICS
+        if not enforce_throughput and not ceiling:
             continue
         got = metrics.get(name)
         base = float(spec.get("baseline", 0.0))
@@ -140,6 +156,15 @@ def check(metrics: Dict, roofline: Optional[Dict[str, float]],
             continue
         tol = (float(tolerance) if tolerance is not None
                else float(spec.get("tolerance", DEFAULT_TOLERANCE)))
+        if ceiling:
+            # latency: lower is better, breach ABOVE baseline + tolerance
+            cap = base * (1.0 + tol)
+            if float(got) > cap:
+                breaches.append(
+                    "%s %.3f > ceiling %.3f (baseline %.3f + %d%% "
+                    "tolerance)" % (name, float(got), cap, base,
+                                    round(tol * 100)))
+            continue
         floor = base * (1.0 - tol)
         if float(got) < floor:
             breaches.append(
@@ -165,7 +190,14 @@ TRACKED_METRICS = {"higgs_mrows_iter_s": "higgs",
                    "mslr_mrows_iter_s": "mslr",
                    "higgs_quantized_mrows_iter_s": "higgs_quantized",
                    "higgs_mesh8_mrows_iter_s": "higgs_mesh8",
-                   "higgs_hybrid_mrows_iter_s": "higgs_hybrid"}
+                   "higgs_hybrid_mrows_iter_s": "higgs_hybrid",
+                   "serve_open_loop_p99_ms": "serve_p99"}
+
+# LATENCY metrics: gated as a CEILING (breach above baseline+tolerance)
+# on EVERY backend — unlike the throughput floors, which only the TPU
+# numbers enforce.  Commit their baselines with a generous --margin
+# (shared CI machines jitter tail latency far more than throughput).
+CEILING_METRICS = frozenset({"serve_open_loop_p99_ms"})
 
 
 def make_baseline(metrics: Dict, roofline: Optional[Dict[str, float]],
@@ -270,9 +302,17 @@ def main(argv=None) -> int:
         for b in breaches:
             print("BREACH: %s" % b, file=sys.stderr)
         return 1
+    ceilings = [n for n in (baseline.get("metrics") or {})
+                if n in CEILING_METRICS and metrics.get(n) is not None]
     if metrics.get("backend") != "tpu":
-        print("ledger %s: skipped (backend=%s; throughput floors track "
-              "the TPU numbers)" % (args.baseline, metrics.get("backend")))
+        if ceilings:
+            print("ledger %s: OK (%d latency ceiling(s) enforced; "
+                  "throughput floors track the TPU numbers)"
+                  % (args.baseline, len(ceilings)))
+        else:
+            print("ledger %s: skipped (backend=%s; throughput floors "
+                  "track the TPU numbers)"
+                  % (args.baseline, metrics.get("backend")))
     else:
         print("ledger %s: OK (%d metric floors enforced)"
               % (args.baseline, len(baseline.get("metrics") or {})))

@@ -92,14 +92,32 @@ def render(events: List[dict], last: int = 0) -> str:
                         float(led.get("straggler_wait_ms", 0.0) or 0.0),
                         crit))
 
-    if alerts:
+    # incident timeline: alert transitions interleaved with the policy
+    # actions they triggered (control/engine.py) — the alert tick and
+    # the policy round are the same federation-round clock, so sorting
+    # on it shows each demote/expand next to the transition it answered
+    policies = [e for e in events if e.get("event") == "policy_action"]
+    if alerts or policies:
         lines.append("")
-        lines.append("alerts: %d transitions" % len(alerts))
-        for a in alerts:
-            lines.append("  tick %-4s %-8s %s (%s %s, value=%s)"
-                         % (a.get("tick", "?"), a.get("state", "?"),
-                            a.get("rule", "?"), a.get("metric", "?"),
-                            a.get("kind", "?"), a.get("value")))
+        head = "alerts: %d transitions" % len(alerts)
+        if policies:
+            head += "   policy: %d actions" % len(policies)
+        lines.append(head)
+        timeline = ([(int(a.get("tick", 0) or 0), 0, a) for a in alerts]
+                    + [(int(p.get("round", 0) or 0), 1, p)
+                       for p in policies])
+        for _, _, e in sorted(timeline, key=lambda kv: (kv[0], kv[1])):
+            if e.get("event") == "policy_action":
+                lines.append("  tick %-4s %-8s policy %s -> %s %s%s"
+                             % (e.get("round", "?"), e.get("status", "?"),
+                                e.get("rule", "?"), e.get("action", "?"),
+                                e.get("args") or {},
+                                " [dry-run]" if e.get("dry_run") else ""))
+            else:
+                lines.append("  tick %-4s %-8s %s (%s %s, value=%s)"
+                             % (e.get("tick", "?"), e.get("state", "?"),
+                                e.get("rule", "?"), e.get("metric", "?"),
+                                e.get("kind", "?"), e.get("value")))
     return "\n".join(lines)
 
 

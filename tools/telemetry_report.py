@@ -94,13 +94,30 @@ def _render_cluster(events: List[dict]) -> List[str]:
                 "%s %.0fms" % (name, ms) for name, ms in top)
                 + "   (per-round: python tools/round_report.py)")
 
-    if alerts:
-        lines.append("alerts: %d transitions" % len(alerts))
-        for a in alerts:
-            lines.append("  tick %-4s %-8s %s (value=%s threshold=%s)"
-                         % (a.get("tick", "?"), a.get("state", "?"),
-                            a.get("rule", "?"), a.get("value"),
-                            a.get("threshold")))
+    # alert transitions interleaved with the policy actions they drove
+    # (control/engine.py records one policy_action per decision; tick
+    # and round share the federation-round clock)
+    policies = [e for e in events if e.get("event") == "policy_action"]
+    if alerts or policies:
+        head = "alerts: %d transitions" % len(alerts)
+        if policies:
+            head += "   policy: %d actions" % len(policies)
+        lines.append(head)
+        timeline = ([(int(a.get("tick", 0) or 0), 0, a) for a in alerts]
+                    + [(int(p.get("round", 0) or 0), 1, p)
+                       for p in policies])
+        for _, _, e in sorted(timeline, key=lambda kv: (kv[0], kv[1])):
+            if e.get("event") == "policy_action":
+                lines.append("  tick %-4s %-8s policy %s -> %s %s%s"
+                             % (e.get("round", "?"), e.get("status", "?"),
+                                e.get("rule", "?"), e.get("action", "?"),
+                                e.get("args") or {},
+                                " [dry-run]" if e.get("dry_run") else ""))
+            else:
+                lines.append("  tick %-4s %-8s %s (value=%s threshold=%s)"
+                             % (e.get("tick", "?"), e.get("state", "?"),
+                                e.get("rule", "?"), e.get("value"),
+                                e.get("threshold")))
     return lines
 
 

@@ -767,6 +767,74 @@ def fleet_smoke():
         return "FAILED: %s" % e
 
 
+def trend_smoke():
+    """Trend-observatory drill (one line in `detail`).
+
+    Synthetic straggler-share ramp through the real pipeline: a
+    SeriesStore sampled from a MetricsRegistry gauge each "round", a
+    trend AlertEngine rule that must FIRE on the ramp and CLEAR on the
+    plateau, a RUNHIST artifact written from the store, and a
+    tools/run_diff.py self-compare in a subprocess that must exit 0 —
+    the same machinery the federation hub, recorder and CI diff gate
+    run.  Never fails the bench: any problem becomes the summary.
+    """
+    import json
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    try:
+        from lightgbm_tpu.obs import MetricsRegistry, SeriesStore, \
+            write_runhist
+        from lightgbm_tpu.obs.alerts import AlertEngine, Rule
+        from lightgbm_tpu.obs.timeseries import PHASE_PREFIX
+        reg = MetricsRegistry()
+        share = reg.gauge("lgbm_cluster_straggler_share")
+        store = SeriesStore()
+        engine = AlertEngine(reg, rules=[Rule(
+            "share_trend", "lgbm_cluster_straggler_share", ">", 0.01,
+            "trend", stat="slope", window=8, min_points=3,
+            clear_for=3)])
+        fired = cleared = 0
+        rounds = 24
+        for rnd in range(1, rounds + 1):
+            # 12 ramping rounds (0.03/round, never past a 0.5 level
+            # threshold), then a flat plateau that must clear the rule
+            share.set(0.05 + 0.03 * min(rnd, 12))
+            store.sample_registry(reg, rnd,
+                                  include=["lgbm_cluster_*"])
+            store.observe(PHASE_PREFIX + "tree_grow", rnd,
+                          10.0 + 0.1 * rnd)
+            for t in engine.evaluate(tick=rnd):
+                if t["rule"] != "share_trend":
+                    continue
+                if t["state"] == "firing":
+                    fired += 1
+                else:
+                    cleared += 1
+        path = os.path.join(
+            tempfile.mkdtemp(prefix="lgbm_trend_smoke_"),
+            "smoke.runhist.json")
+        wrote = write_runhist(path, {"kind": "trend_smoke",
+                                     "rounds": rounds}, store)
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "run_diff.py"), path, path, "--json"],
+            capture_output=True, text=True, timeout=120)
+        compared = 0
+        if proc.returncode == 0:
+            compared = json.loads(proc.stdout).get("compared", 0)
+        ok = (fired >= 1 and cleared >= 1 and wrote
+              and proc.returncode == 0 and compared > 0)
+        return ("%s: ramp fired=%d cleared=%d over %d rounds, "
+                "%d series, run_diff self-compare rc=%d (%d compared)"
+                % ("OK" if ok else "FAILED", fired, cleared, rounds,
+                   len(store.all_series()), proc.returncode, compared))
+    except Exception as e:  # noqa: BLE001 — smoke only, never fatal
+        return "FAILED: %s" % e
+
+
 def lint_smoke():
     """tpulint over the shipped tree (one line in `detail`).
 
@@ -879,6 +947,7 @@ def main():
             "policy_smoke": policy_smoke(),
             "supervisor_smoke": supervisor_smoke(),
             "fleet_smoke": fleet_smoke(),
+            "trend_smoke": trend_smoke(),
             "lint_smoke": lint_smoke(),
         },
     }

@@ -411,6 +411,36 @@ _SCHEMA = [
     #   checkpoint via resume_mode="reshard")
     ("tpu_elastic_scale_up_wait_s", float, 60.0),  # how long a petitioning
     #   host waits for an epoch before giving up (ElasticFenced)
+    ("tpu_elastic_petition_poll_s", float, 2.0),  # how long a parked
+    #   petitioner blocks on the hub socket per poll, waiting for the
+    #   epoch wake the hub pushes when expand_world admits it — bounds
+    #   rejoin latency to ~one poll instead of a blind sleep/re-knock
+    # --- trend observatory (obs/timeseries.py): bounded per-metric
+    #   time-series sampled each federated round / serving stats tick,
+    #   feeding `trend` alert rules, policy trend guards, per-leg ledger
+    #   trends and the end-of-run RUNHIST artifact.  Strictly read-only —
+    #   training is bitwise-identical with it on or off.  See
+    #   docs/TrendObservatory.md
+    ("tpu_trend", bool, False),              # keep ring-buffer series on
+    #   the hub (training) / server (serving), annotate the round ledger
+    #   and /cluster with slope/EWMA per leg, and arm the built-in
+    #   straggler_share_trend alert rule
+    ("tpu_trend_window", int, 64),           # ring capacity per series and
+    #   the default analytics window, in ticks (federated rounds /
+    #   serving stats ticks)
+    ("tpu_trend_metrics", str, ""),          # comma-separated glob list
+    #   restricting which registry families are sampled ("" = all)
+    ("tpu_alert_trend_slope", float, 0.01),  # built-in trend rule: fires
+    #   when the round's straggler-wait share grows faster than this
+    #   per round over the trend window
+    ("tpu_policy_trend_guard", bool, False),  # arm the trend guard on the
+    #   built-in demote_straggler policy rule: demote only when the
+    #   straggler-wait share is GROWING over the trend window, not on
+    #   any single sustained breach
+    ("tpu_runhist_path", str, ""),           # write the end-of-run RUNHIST
+    #   JSON artifact (per-phase + per-metric windowed summaries and
+    #   series tails) here; tools/run_diff.py diffs two artifacts with
+    #   tolerance bands and a nonzero exit on regression
 ]
 
 # alias -> canonical name (src/io/config_auto.cpp:4-157)
@@ -558,6 +588,12 @@ ALIAS_TABLE: Dict[str, str] = {
     "policy_dry_run": "tpu_policy_dry_run",
     "elastic_scale_up": "tpu_elastic_scale_up",
     "scale_up": "tpu_elastic_scale_up",
+    "trend": "tpu_trend",
+    "trends": "tpu_trend",
+    "trend_window": "tpu_trend_window",
+    "trend_guard": "tpu_policy_trend_guard",
+    "runhist": "tpu_runhist_path",
+    "runhist_path": "tpu_runhist_path",
 }
 
 PARAMETER_TYPES: Dict[str, Any] = {name: typ for name, typ, _ in _SCHEMA}
@@ -915,6 +951,15 @@ class Config:
         if self.tpu_elastic_scale_up_wait_s < 0:
             log.fatal("tpu_elastic_scale_up_wait_s must be >= 0, got %g"
                       % self.tpu_elastic_scale_up_wait_s)
+        if self.tpu_elastic_petition_poll_s <= 0:
+            log.fatal("tpu_elastic_petition_poll_s must be > 0, got %g"
+                      % self.tpu_elastic_petition_poll_s)
+        if self.tpu_trend_window < 4:
+            log.fatal("tpu_trend_window must be >= 4, got %d"
+                      % self.tpu_trend_window)
+        if self.tpu_alert_trend_slope <= 0:
+            log.fatal("tpu_alert_trend_slope must be > 0, got %g"
+                      % self.tpu_alert_trend_slope)
 
     def is_single_machine(self) -> bool:
         return self.num_machines <= 1

@@ -40,7 +40,7 @@ from typing import Dict, List, Optional, Tuple
 from ..utils import log
 from .actuator import Actuator, default_actuator, global_token_bucket
 from .policy import (PolicyRule, default_policy_rules, load_policy_rules,
-                     resolve_args)
+                     resolve_args, trend_guard_ok)
 
 EMITTED_STATUSES = ("ok", "dry_run", "rate_limited", "unbound",
                     "unresolved", "error")
@@ -52,8 +52,13 @@ class PolicyEngine:
 
     def __init__(self, config, rules: Optional[List[PolicyRule]] = None,
                  actuator: Optional[Actuator] = None, registry=None,
-                 bucket=None):
+                 bucket=None, series=None):
         self.config = config
+        # the federation hub's SeriesStore (obs/timeseries.py), backing
+        # per-rule `trend` guards; None when the observatory is off —
+        # trend-guarded rules then fail closed (suppressed), plain
+        # rules are unaffected
+        self.series = series
         self.rules = (list(rules) if rules is not None
                       else default_policy_rules(config))
         self.dry_run = bool(getattr(config, "tpu_policy_dry_run", False))
@@ -171,6 +176,13 @@ class PolicyEngine:
             if str(ctx.get(key)) != want:
                 self._count_suppressed("guard")
                 return None
+        if rule.trend is not None \
+                and not trend_guard_ok(rule.trend, self.series, ctx):
+            # like guard misses, a trend miss does not start the
+            # cooldown: the rule dispatches on the first round the
+            # trajectory actually breaches
+            self._count_suppressed("trend_guard")
+            return None
         cooldown = (rule.cooldown_rounds if rule.cooldown_rounds is not None
                     else self.cooldown_default)
         last = self._last_round.get(rule.name)

@@ -26,6 +26,22 @@
 (see below) — the default demote rule uses it to require the round
 ledger to actually name the straggler phase before acting.
 
+``trend`` is an optional TREND guard evaluated against the federation
+hub's time-series store (obs/timeseries.py)::
+
+    "trend": {"metric": "ledger/straggler_wait_share", "stat": "slope",
+              "op": ">", "threshold": 0.0, "window": 16,
+              "min_points": 3, "labels": {"host": "$critical_host"}}
+
+The rule only dispatches when SOME series matching ``metric`` +
+``labels`` (label values may be ``$refs`` into the round context) has
+its windowed statistic (``slope`` or ``ewma``) breaching — "demote only
+if the straggler-wait share is GROWING", not on any single sustained
+breach.  No store, no matching series, or fewer than ``min_points``
+samples all fail CLOSED (suppressed as ``trend_guard``), so a trend
+rule never actuates on insufficient evidence.  Like ``guard`` misses,
+trend-guard misses do not start the cooldown.
+
 ``args`` values beginning with ``$`` are resolved from the round
 context at dispatch time.  Context keys: ``round``, the triggering
 transition's ``rule``/``value``/``threshold``/``metric``/``tick``, the
@@ -47,12 +63,67 @@ from typing import Dict, List, Optional
 ALERT_STATES = ("firing", "cleared")
 
 
+def _normalize_trend(name: str, trend: Optional[Dict]) -> Optional[Dict]:
+    """Validate + default-fill a rule's trend-guard spec."""
+    if not trend:
+        return None
+    from ..obs.alerts import TREND_STATS, _OPS
+    spec = dict(trend)
+    metric = spec.get("metric") or spec.get("series")
+    if not metric:
+        raise ValueError("policy rule %r: trend guard needs a metric"
+                         % name)
+    stat = str(spec.get("stat", "slope"))
+    if stat not in TREND_STATS:
+        raise ValueError("policy rule %r: unknown trend stat %r"
+                         % (name, stat))
+    op = str(spec.get("op", ">"))
+    if op not in _OPS:
+        raise ValueError("policy rule %r: unknown trend op %r"
+                         % (name, op))
+    return {"metric": str(metric), "stat": stat, "op": op,
+            "threshold": float(spec.get("threshold", 0.0)),
+            "window": max(2, int(spec.get("window", 16))),
+            "min_points": max(2, int(spec.get("min_points", 3))),
+            "labels": dict(spec.get("labels") or {})}
+
+
+def trend_guard_ok(spec: Dict, series, context: Dict) -> bool:
+    """Evaluate one trend-guard spec against a SeriesStore.  ANY
+    matching series whose windowed statistic breaches satisfies the
+    guard; everything else — no store, unresolvable ``$label``, no
+    matching series, too few points — fails CLOSED."""
+    if series is None:
+        return False
+    from ..obs.alerts import _OPS
+    from ..obs.timeseries import ewma, least_squares_slope
+    labels: Dict[str, str] = {}
+    for k, v in spec["labels"].items():
+        if isinstance(v, str) and v.startswith("$"):
+            rv = context.get(v[1:])
+            if rv is None:
+                return False
+            labels[k] = str(rv)
+        else:
+            labels[k] = str(v)
+    for s in series.match(spec["metric"], labels):
+        pts = s.window(spec["window"])
+        if len(pts) < spec["min_points"]:
+            continue
+        stat = least_squares_slope(pts) if spec["stat"] == "slope" \
+            else ewma(pts)
+        if stat is not None and _OPS[spec["op"]](stat, spec["threshold"]):
+            return True
+    return False
+
+
 class PolicyRule:
     """One declarative policy rule (immutable after construction)."""
 
     def __init__(self, name: str, when: Dict, action: str,
                  args: Optional[Dict] = None, guard: Optional[Dict] = None,
-                 cooldown_rounds: Optional[int] = None):
+                 cooldown_rounds: Optional[int] = None,
+                 trend: Optional[Dict] = None):
         when = dict(when or {})
         if bool(when.get("alert")) == bool(when.get("signal")):
             raise ValueError(
@@ -73,6 +144,7 @@ class PolicyRule:
         self.guard = {k: str(v) for k, v in (guard or {}).items()}
         self.cooldown_rounds = (None if cooldown_rounds is None
                                 else max(0, int(cooldown_rounds)))
+        self.trend = _normalize_trend(name, trend)
 
     @classmethod
     def from_dict(cls, d: Dict) -> "PolicyRule":
@@ -80,14 +152,18 @@ class PolicyRule:
                    action=d.get("action", ""), args=d.get("args"),
                    guard=d.get("guard"),
                    cooldown_rounds=d.get("cooldown_rounds",
-                                         d.get("cooldown")))
+                                         d.get("cooldown")),
+                   trend=d.get("trend"))
 
     def to_dict(self) -> Dict:
         when = ({"alert": self.alert, "state": self.state}
                 if self.alert else {"signal": self.signal})
-        return {"name": self.name, "when": when, "action": self.action,
-                "args": dict(self.args), "guard": dict(self.guard),
-                "cooldown_rounds": self.cooldown_rounds}
+        out = {"name": self.name, "when": when, "action": self.action,
+               "args": dict(self.args), "guard": dict(self.guard),
+               "cooldown_rounds": self.cooldown_rounds}
+        if self.trend is not None:
+            out["trend"] = dict(self.trend)
+        return out
 
     # -- trigger matching ----------------------------------------------- #
     def matches_alert(self, transition: Dict) -> bool:
@@ -121,12 +197,24 @@ def default_policy_rules(config=None) -> List[PolicyRule]:
     straggler -> proactive demote, rejoin knock -> formation epoch
     (scale-UP), shed burn -> fleet pre-spill, quality regression ->
     tighter promote floor.  Alert names match obs/alerts.default_rules;
-    action names match the lever catalog in docs/ControlPlane.md."""
+    action names match the lever catalog in docs/ControlPlane.md.
+
+    With ``tpu_policy_trend_guard`` (and the trend store, ``tpu_trend``)
+    the built-in demote rule additionally requires the straggler-wait
+    share of the round wall to be GROWING over the trend window — a
+    host that is slow-but-stable no longer gets demoted."""
+    trend = None
+    if bool(getattr(config, "tpu_policy_trend_guard", False)):
+        window = int(getattr(config, "tpu_trend_window", 0) or 16)
+        trend = {"metric": "ledger/straggler_wait_share", "stat": "slope",
+                 "op": ">", "threshold": 0.0,
+                 "window": min(window, 16), "min_points": 3}
     return [
         PolicyRule("demote_straggler",
                    when={"alert": "straggler_host", "state": "firing"},
                    guard={"critical_phase": "straggler_wait"},
-                   action="demote_host", args={"orig": "$critical_host"}),
+                   action="demote_host", args={"orig": "$critical_host"},
+                   trend=trend),
         PolicyRule("expand_on_join",
                    when={"signal": "pending_join"},
                    action="expand_world",

@@ -169,10 +169,12 @@ class GBDT:
         # telemetry contract is a bitwise-identical model).
         from ..utils.profiling import Profiler, TraceSession
         telemetry_path = getattr(config, "tpu_telemetry_path", "")
+        runhist_path = getattr(config, "tpu_runhist_path", "")
         federated = bool(getattr(config, "tpu_federation", False)
                          or getattr(config, "tpu_alert", False))
         self.profiler = Profiler(
-            enabled=config.tpu_profile or bool(telemetry_path) or federated,
+            enabled=(config.tpu_profile or bool(telemetry_path)
+                     or bool(runhist_path) or federated),
             sync_fn=self._profile_sync if config.tpu_profile else None)
         self._trace = TraceSession(config.tpu_profile_trace_dir)
         # span timeline (obs/tracing.py): arming the process tracer makes
@@ -189,7 +191,10 @@ class GBDT:
         # never fail a training run
         self.recorder = None
         self._bag_count: Optional[int] = None
-        if telemetry_path:
+        if telemetry_path or getattr(config, "tpu_runhist_path", ""):
+            # a RUNHIST artifact alone also needs the recorder (it owns
+            # the per-run series store); with no telemetry_path the
+            # JSONL stream is simply skipped
             try:
                 from ..obs.recorder import TrainingRecorder
                 self.recorder = TrainingRecorder(telemetry_path, config)

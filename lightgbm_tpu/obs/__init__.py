@@ -12,7 +12,11 @@ One subsystem for every number the framework emits:
              probe into the registry;
 - tracing:   SpanTracer — nested-span timeline emitted as Chrome
              trace-event JSON (Config.tpu_trace_path), with cross-rank
-             correlation ids carried in the SocketComm frame header.
+             correlation ids carried in the SocketComm frame header;
+- timeseries: SeriesStore — bounded per-metric ring-buffer series with
+             windowed trend analytics (slope / EWMA / quantiles) and
+             the end-of-run RUNHIST artifact (Config.tpu_runhist_path),
+             feeding trend alert rules and policy trend guards.
 
 The process-wide default registry is what `GET /metrics` on the serving
 server and the CLI end-of-training dump render.
@@ -21,6 +25,7 @@ from __future__ import annotations
 
 from .registry import Counter, Gauge, Histogram, MetricsRegistry
 from .recorder import TrainingRecorder
+from .timeseries import Series, SeriesStore, write_runhist
 from .tracing import SpanTracer, get_tracer
 
 _default_registry = MetricsRegistry()
@@ -39,5 +44,6 @@ def reset_default_registry() -> MetricsRegistry:
 
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "SpanTracer", "TrainingRecorder", "default_registry",
-           "get_tracer", "reset_default_registry"]
+           "Series", "SeriesStore", "SpanTracer", "TrainingRecorder",
+           "default_registry", "get_tracer", "reset_default_registry",
+           "write_runhist"]

@@ -6,21 +6,34 @@ training round (by the hub) and once per serving stats tick, watching
 the SAME registry every subsystem already reports into — no new
 instrumentation, just continuous evaluation of what is already there.
 
-Three rule kinds:
+Four rule kinds:
 
 - ``threshold``: fire the tick the watched value breaches, clear the
   tick it stops breaching.
-- ``sustained``: fire after ``for`` CONSECUTIVE breaching ticks (the
+- ``sustained``: fire after ``for`` breaching ticks (the
   persistent-straggler / comm-wait-share shape: one slow round is
   noise, five in a row is an incident), clear after ``clear_for``
-  consecutive clean ticks (default 1 — first clean tick).  Raising
-  ``clear_for`` debounces a flapping metric: the clear-side hysteresis
-  is what keeps the policy engine (control/engine.py) from oscillating
-  demote/rejoin on a host that is slow every other round.
+  clean ticks (default 1 — first clean tick).  Raising ``clear_for``
+  debounces a flapping metric: the clear-side hysteresis is what keeps
+  the policy engine (control/engine.py) from oscillating demote/rejoin
+  on a host that is slow every other round.
 - ``burn_rate``: for counters — fire when the per-tick increase rate
   over a sliding ``window`` of ticks exceeds the threshold (breaker
   flaps, shed rate, promotion failures: the level is meaningless, the
   slope is the signal), clear when the rate falls back under.
+- ``trend``: fire when a windowed statistic (``stat``: least-squares
+  ``slope`` or ``ewma``, obs/timeseries.py) of the watched value over
+  ``window`` ticks breaches — the trajectory shape: straggler-wait
+  share *growing* 2%/round fires long before any level threshold
+  would, and a high-but-flat value never does.  ``min_points`` samples
+  are required before the statistic is judged at all.
+
+Window accounting is pinned to ROUND INDICES (the engine tick — the
+hub passes the federated round, serving auto-increments), not sample
+counts: a metric that skips ticks (rank desync, serving-only metrics on
+a round tick) is NEUTRAL for that tick — an absent sample neither
+extends the clean run, resets the breach run, nor stretches a burn/trend
+window.  Only a PRESENT clean sample resets a breach run.
 
 Every state transition appends an ``alert`` JSONL event (recorder
 idiom: best-effort, never raises) and flips the
@@ -34,10 +47,14 @@ Rule files (``tpu_alert_rules``) are a JSON list of objects::
 
     [{"name": "hot_host", "metric": "lgbm_cluster_host_comm_wait_share",
       "op": ">", "threshold": 0.5, "kind": "sustained", "for": 3,
-      "labels": {"host": "2"}}]
+      "labels": {"host": "2"}},
+     {"name": "wait_growing", "metric": "lgbm_cluster_straggler_share",
+      "op": ">", "threshold": 0.01, "kind": "trend", "stat": "slope",
+      "window": 8, "min_points": 3, "clear_for": 2}]
 
 ``labels`` is an optional subset match; omitted -> the rule watches
-the worst (max) child of the family.  See docs/ClusterObservability.md.
+the worst (max) child of the family.  See docs/ClusterObservability.md
+and docs/TrendObservatory.md.
 """
 from __future__ import annotations
 
@@ -47,6 +64,8 @@ from typing import Callable, Dict, List, Optional
 
 from ..utils import log
 from .registry import MetricsRegistry
+from .timeseries import ewma as _ts_ewma
+from .timeseries import least_squares_slope
 
 _OPS: Dict[str, Callable[[float, float], bool]] = {
     ">": lambda v, t: v > t,
@@ -55,7 +74,8 @@ _OPS: Dict[str, Callable[[float, float], bool]] = {
     "<=": lambda v, t: v <= t,
 }
 
-RULE_KINDS = ("threshold", "sustained", "burn_rate")
+RULE_KINDS = ("threshold", "sustained", "burn_rate", "trend")
+TREND_STATS = ("slope", "ewma")
 
 
 class Rule:
@@ -65,11 +85,15 @@ class Rule:
                  threshold: float = 0.0, kind: str = "threshold",
                  for_ticks: int = 1, window: int = 16,
                  labels: Optional[Dict[str, str]] = None,
-                 clear_for: int = 1):
+                 clear_for: int = 1, stat: str = "slope",
+                 min_points: int = 3):
         if op not in _OPS:
             raise ValueError("alert rule %r: unknown op %r" % (name, op))
         if kind not in RULE_KINDS:
             raise ValueError("alert rule %r: unknown kind %r" % (name, kind))
+        if stat not in TREND_STATS:
+            raise ValueError("alert rule %r: unknown trend stat %r"
+                             % (name, stat))
         self.name = str(name)
         self.metric = str(metric)
         self.op = op
@@ -79,6 +103,8 @@ class Rule:
         self.window = max(2, int(window))
         self.labels = {k: str(v) for k, v in (labels or {}).items()}
         self.clear_for = max(1, int(clear_for))
+        self.stat = str(stat)
+        self.min_points = max(2, int(min_points))
 
     @classmethod
     def from_dict(cls, d: Dict) -> "Rule":
@@ -89,25 +115,35 @@ class Rule:
                    for_ticks=d.get("for", d.get("for_ticks", 1)),
                    window=d.get("window", 16),
                    labels=d.get("labels"),
-                   clear_for=d.get("clear_for", 1))
+                   clear_for=d.get("clear_for", 1),
+                   stat=d.get("stat", "slope"),
+                   min_points=d.get("min_points", 3))
 
     def to_dict(self) -> Dict:
-        return {"name": self.name, "metric": self.metric, "op": self.op,
-                "threshold": self.threshold, "kind": self.kind,
-                "for": self.for_ticks, "window": self.window,
-                "labels": dict(self.labels), "clear_for": self.clear_for}
+        out = {"name": self.name, "metric": self.metric, "op": self.op,
+               "threshold": self.threshold, "kind": self.kind,
+               "for": self.for_ticks, "window": self.window,
+               "labels": dict(self.labels), "clear_for": self.clear_for}
+        if self.kind == "trend":
+            out["stat"] = self.stat
+            out["min_points"] = self.min_points
+        return out
 
 
 class _RuleState:
-    __slots__ = ("active", "streak", "clean_streak", "samples",
+    __slots__ = ("active", "breach_since", "clean_since", "samples",
                  "last_value", "fired_ticks", "cleared_ticks")
 
     def __init__(self, window: int):
         self.active = False
-        self.streak = 0
-        self.clean_streak = 0
-        # (tick, family total) ring for burn-rate slopes
-        self.samples: deque = deque(maxlen=window + 1)
+        # tick the current breach / clean run started (None = no run):
+        # runs span ticks, not sample counts, so a skipped sample
+        # neither resets nor extends them
+        self.breach_since: Optional[int] = None
+        self.clean_since: Optional[int] = None
+        # (tick, value) ring for burn-rate / trend windows — evicted by
+        # tick age, the maxlen is only a safety bound
+        self.samples: deque = deque(maxlen=max(4 * window, 64))
         self.last_value: Optional[float] = None
         self.fired_ticks: List[int] = []
         self.cleared_ticks: List[int] = []
@@ -124,7 +160,7 @@ def default_rules(config=None) -> List[Rule]:
     wait_share = float(getattr(config, "tpu_alert_comm_wait_share", 0.5)
                        or 0.5)
     shed_rate = float(getattr(config, "tpu_alert_shed_rate", 5.0) or 5.0)
-    return [
+    rules = [
         # a host the straggler policy flagged slow, `for` rounds in a row
         Rule("straggler_host", "lgbm_hybrid_host_slow", ">=", 1.0,
              "sustained", for_ticks=sustain, window=window),
@@ -148,6 +184,17 @@ def default_rules(config=None) -> List[Rule]:
         Rule("supervisor_rollbacks", "lgbm_supervisor_rollbacks_total",
              ">", 0.0, "burn_rate", window=window),
     ]
+    if bool(getattr(config, "tpu_trend", False)):
+        twin = int(getattr(config, "tpu_trend_window", 0) or 16)
+        tslope = float(getattr(config, "tpu_alert_trend_slope", 0.01)
+                       or 0.01)
+        rules.append(
+            # the round's straggler-wait share of hub wall time GROWING
+            # — fires on a gradual ramp no level threshold would catch
+            Rule("straggler_share_trend", "lgbm_cluster_straggler_share",
+                 ">", tslope, "trend", stat="slope",
+                 window=min(twin, window), min_points=3, clear_for=2))
+    return rules
 
 
 def load_rules(path: str) -> List[Rule]:
@@ -202,29 +249,70 @@ class AlertEngine:
         return float(sum(vals)) if rule.kind == "burn_rate" \
             else float(max(vals))
 
-    def _breaching(self, rule: Rule, state: _RuleState) -> bool:
+    def _evict(self, state: _RuleState, window: int) -> None:
+        """Age the sample ring by TICK distance (not count): the window
+        a burn/trend rule is judged over stays `window` rounds wide no
+        matter how many ticks the metric skipped."""
+        while state.samples and state.samples[0][0] <= self.tick - window:
+            state.samples.popleft()
+
+    def _breaching(self, rule: Rule,
+                   state: _RuleState) -> Optional[bool]:
+        """Tri-state: True breach / False present-and-clean / None
+        absent (neutral — the tick leaves the rule's runs untouched)."""
         value = self._family_value(rule)
         if rule.kind == "burn_rate":
+            if value is not None:
+                if state.samples and state.samples[-1][0] == self.tick:
+                    state.samples[-1] = (self.tick, value)
+                else:
+                    state.samples.append((self.tick, value))
+            self._evict(state, rule.window + 1)
             if value is None:
-                return False
-            state.samples.append((self.tick, value))
+                state.last_value = None
+                return None
             if len(state.samples) < 2:
                 state.last_value = 0.0
                 return False
             t0, v0 = state.samples[0]
-            rate = (value - v0) / max(self.tick - t0, 1)
+            tn, vn = state.samples[-1]
+            rate = (vn - v0) / max(tn - t0, 1)
             state.last_value = rate
             return _OPS[rule.op](rate, rule.threshold)
+        if rule.kind == "trend":
+            if value is not None:
+                if state.samples and state.samples[-1][0] == self.tick:
+                    state.samples[-1] = (self.tick, value)
+                else:
+                    state.samples.append((self.tick, value))
+            self._evict(state, rule.window)
+            pts = list(state.samples)
+            if len(pts) < rule.min_points:
+                state.last_value = None
+                return None
+            stat = least_squares_slope(pts) if rule.stat == "slope" \
+                else _ts_ewma(pts)
+            state.last_value = stat
+            if stat is None:
+                return None
+            return _OPS[rule.op](stat, rule.threshold)
         state.last_value = value
         if value is None:
-            return False
+            return None
         return _OPS[rule.op](value, rule.threshold)
 
-    def evaluate(self) -> List[Dict]:
+    def evaluate(self, tick: Optional[int] = None) -> List[Dict]:
         """One tick: evaluate every rule, emit transitions.  Returns the
-        transition list ([{rule, state, value, ...}]).  Any per-rule
-        failure degrades to a warning and skips that rule."""
-        self.tick += 1
+        transition list ([{rule, state, value, ...}]).  `tick` pins the
+        engine clock to an external round index (the federation hub
+        passes the federated round, so window math is in rounds even
+        when evaluation skips some); None auto-increments (serving stats
+        ticks).  Any per-rule failure degrades to a warning and skips
+        that rule."""
+        if tick is not None and int(tick) > self.tick:
+            self.tick = int(tick)
+        else:
+            self.tick += 1
         transitions: List[Dict] = []
         for rule in self.rules:
             state = self._state[rule.name]
@@ -234,17 +322,27 @@ class AlertEngine:
                 log.warning("alerts: rule %s evaluation failed: %s",
                             rule.name, exc)
                 continue
-            state.streak = state.streak + 1 if breach else 0
-            state.clean_streak = 0 if breach else state.clean_streak + 1
-            need = rule.for_ticks if rule.kind == "sustained" else 1
-            should_fire = breach and state.streak >= need
+            if breach is None:
+                continue        # absent sample: neutral, runs untouched
+            if breach:
+                if state.breach_since is None:
+                    state.breach_since = self.tick
+                state.clean_since = None
+            else:
+                state.breach_since = None
+                if state.clean_since is None:
+                    state.clean_since = self.tick
+            need = rule.for_ticks if rule.kind in ("sustained", "trend") \
+                else 1
+            should_fire = (breach
+                           and self.tick - state.breach_since + 1 >= need)
             if should_fire and not state.active:
                 state.active = True
                 state.fired_ticks.append(self.tick)
                 self._gauges[rule.name].set(1.0)
                 transitions.append(self._transition(rule, state, "firing"))
             elif (state.active and not breach
-                    and state.clean_streak >= rule.clear_for):
+                    and self.tick - state.clean_since + 1 >= rule.clear_for):
                 state.active = False
                 state.cleared_ticks.append(self.tick)
                 self._gauges[rule.name].set(0.0)
@@ -267,6 +365,11 @@ class AlertEngine:
     def active(self) -> List[str]:
         return [r.name for r in self.rules if self._state[r.name].active]
 
+    def _streak(self, state: _RuleState) -> int:
+        if state.breach_since is None:
+            return 0
+        return self.tick - state.breach_since + 1
+
     def snapshot(self) -> Dict:
         """The `/alerts` endpoint payload."""
         return {
@@ -277,7 +380,7 @@ class AlertEngine:
                 "op": r.op, "threshold": r.threshold,
                 "active": self._state[r.name].active,
                 "value": self._state[r.name].last_value,
-                "streak": self._state[r.name].streak,
+                "streak": self._streak(self._state[r.name]),
                 "fired": list(self._state[r.name].fired_ticks),
                 "cleared": list(self._state[r.name].cleared_ticks),
             } for r in self.rules],

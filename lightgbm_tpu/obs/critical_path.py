@@ -108,6 +108,21 @@ def build_ledger(round_idx: int, digests: List[Dict],
     }
 
 
+# the four wall-time legs of a ledger, in ledger-key form
+LEG_KEYS = ("compute_ms", "mesh_psum_ms", "leader_wire_ms",
+            "straggler_wait_ms")
+
+
+def leg_shares(ledger: Dict) -> Dict[str, float]:
+    """Each leg's share of the decomposed round wall ({leg: share} with
+    the `_ms` suffix stripped) — the normalized shape the trend
+    observatory tracks round over round: a straggler-wait share
+    GROWING is a degrading host even while absolute wall times jitter."""
+    from .timeseries import share_of_total
+    return share_of_total({k[:-3]: float(ledger.get(k, 0.0) or 0.0)
+                           for k in LEG_KEYS})
+
+
 def critical_counts(ledgers: List[Dict]) -> Dict[int, int]:
     """host -> number of rounds it was the critical rank (report helper)."""
     out: Dict[int, int] = {}

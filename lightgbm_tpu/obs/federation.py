@@ -75,11 +75,22 @@ class Federation:
         if getattr(config, "tpu_alert", False):
             from .alerts import AlertEngine
             self.engine = AlertEngine.from_config(config, self.registry)
+        self.series = None
+        self._trend_include = None
+        self.trend_window = max(4, int(getattr(config, "tpu_trend_window",
+                                               64) or 64))
+        if bool(getattr(config, "tpu_trend", False)):
+            from .timeseries import SeriesStore
+            self.series = SeriesStore(capacity=self.trend_window)
+            pats = str(getattr(config, "tpu_trend_metrics", "") or "")
+            self._trend_include = [p.strip() for p in pats.split(",")
+                                   if p.strip()] or None
         self.policy = None
         if getattr(config, "tpu_policy", False):
             from ..control import PolicyEngine
             self.policy = PolicyEngine.from_config(config,
-                                                   registry=self.registry)
+                                                   registry=self.registry,
+                                                   series=self.series)
         # per-round delta baselines (this rank)
         self._last_phases: Dict[str, Dict[str, float]] = {}
         self._last_spans: Dict[str, Dict[str, float]] = {}
@@ -123,8 +134,11 @@ class Federation:
             return
         comm = getattr(coll, "comm", None) if on_wire else None
         self._aggregate(iteration, digests, comm)
-        transitions = self.engine.evaluate() if self.engine is not None \
-            else []
+        # the engine clock is pinned to the ROUND index, so sustained /
+        # burn / trend windows stay round-denominated even when
+        # federation skips rounds (tpu_federation_every > 1)
+        transitions = self.engine.evaluate(tick=iteration + 1) \
+            if self.engine is not None else []
         if self.policy is not None:
             # the control plane closes the loop HERE, on the hub, right
             # after the sensors: alert transitions + the tick's control
@@ -265,19 +279,45 @@ class Federation:
             except Exception as exc:  # noqa: BLE001
                 log.debug("federation: take_peer_waits failed: %s", exc)
         ledger = build_ledger(iteration, digests, peer_waits_ms)
-        self._ledgers.append(ledger)
-        if len(self._ledgers) > 256:
-            del self._ledgers[:len(self._ledgers) - 256]
+        from .critical_path import leg_shares
+        shares = leg_shares(ledger)
         reg.gauge("lgbm_cluster_straggler_wait_ms",
                   help="Hub wait on the slowest peer, last round").set(
             ledger["straggler_wait_ms"])
+        reg.gauge("lgbm_cluster_straggler_share",
+                  help="Straggler-wait share of the decomposed round "
+                       "wall, last round").set(shares["straggler_wait"])
+        if self.series is not None:
+            # the observatory's sampling point: one sweep over the
+            # registry (the gauges set above included) plus the
+            # normalized ledger-leg shares, all at tick = round + 1
+            tick = iteration + 1
+            for leg, share in shares.items():
+                self.series.observe("ledger/%s_share" % leg, tick, share)
+            self.series.sample_registry(reg, tick,
+                                        include=self._trend_include)
+            ledger["trends"] = self.leg_trends()
+        self._ledgers.append(ledger)
+        if len(self._ledgers) > 256:
+            del self._ledgers[:len(self._ledgers) - 256]
         self._latest = {
             "round": iteration,
             "hosts": {str(d.get("orig", d.get("rank", 0))): d
                       for d in digests},
             "ledger": ledger,
         }
-        cluster_event(self.config, round=iteration, hosts=digests)
+        if self.series is not None:
+            # mirror the /cluster endpoint: the JSONL stream gets the
+            # same trends block so offline report tools see the slopes
+            cluster_event(self.config, round=iteration, hosts=digests,
+                          trends={
+                              "legs": ledger.get("trends", {}),
+                              "hosts": self.series.snapshot(
+                                  self.trend_window,
+                                  prefix="lgbm_cluster_host_"),
+                          })
+        else:
+            cluster_event(self.config, round=iteration, hosts=digests)
         round_ledger_event(self.config, **ledger)
 
     # -- hub http endpoint ---------------------------------------------- #
@@ -294,8 +334,36 @@ class Federation:
                         "on port %d: %s", port, exc)
             self._http = False  # don't retry every round
 
+    def leg_trends(self) -> Dict:
+        """Slope / EWMA of each ledger-leg share over the trend window
+        — the `trends` block annotated onto every round ledger."""
+        if self.series is None:
+            return {}
+        out: Dict = {}
+        for leg in ("compute", "mesh_psum", "leader_wire",
+                    "straggler_wait"):
+            s = self.series.get("ledger/%s_share" % leg)
+            if s is None or not s.points:
+                continue
+            w = self.trend_window
+            out[leg] = {
+                "share": round(s.last(), 4),
+                "slope": (round(s.slope(w), 6)
+                          if s.slope(w) is not None else None),
+                "ewma": (round(s.ewma(window=w), 4)
+                         if s.ewma(window=w) is not None else None),
+            }
+        return out
+
     def cluster_payload(self) -> Dict:
-        return dict(self._latest, ledgers=self._ledgers[-32:])
+        out = dict(self._latest, ledgers=self._ledgers[-32:])
+        if self.series is not None:
+            out["trends"] = {
+                "legs": self.leg_trends(),
+                "hosts": self.series.snapshot(
+                    self.trend_window, prefix="lgbm_cluster_host_"),
+            }
+        return out
 
     def alerts_payload(self) -> Optional[Dict]:
         return self.engine.snapshot() if self.engine is not None else None

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -90,6 +91,22 @@ class TrainingRecorder:
             getattr(config, "tpu_perf_roofline", True))
         self._budget: Optional[Dict] = None
         self._roof = None
+        # trend observatory: a per-run series store feeding the RUNHIST
+        # artifact (obs/timeseries.py) — phase deltas, eval metrics and
+        # a registry sweep per round.  Only built when a RUNHIST was
+        # asked for; read-only, so bitwise identity is untouched
+        self.runhist_path = str(getattr(config, "tpu_runhist_path", "")
+                                or "")
+        self.series = None
+        self._trend_include = None
+        self._trend_window = max(4, int(getattr(config, "tpu_trend_window",
+                                                64) or 64))
+        if self.runhist_path:
+            from .timeseries import SeriesStore
+            self.series = SeriesStore(capacity=self._trend_window)
+            pats = str(getattr(config, "tpu_trend_metrics", "") or "")
+            self._trend_include = [p.strip() for p in pats.split(",")
+                                   if p.strip()] or None
         adapters.ensure_device_metrics(self.registry)
         self._m_iters = self.registry.counter(
             "lgbm_train_iterations_total", help="Boosting rounds completed")
@@ -147,6 +164,15 @@ class TrainingRecorder:
         self._m_seconds.inc(wall_s)
         if not finished:
             self._m_trees.inc(len(slot))
+        if self.series is not None:
+            from .timeseries import PHASE_PREFIX
+            tick = iteration + 1
+            self.series.observe("train/wall_ms", tick, event["wall_ms"])
+            for name, entry in event["phases"].items():
+                self.series.observe(PHASE_PREFIX + name, tick,
+                                    entry["ms"])
+            self.series.sample_registry(self.registry, tick,
+                                        include=self._trend_include)
         self._pending = event
 
     def record_eval(self, iteration: int, results) -> None:
@@ -157,6 +183,9 @@ class TrainingRecorder:
         metrics = self._pending["metrics"]
         for v in results or ():
             metrics.setdefault(str(v[0]), {})[str(v[1])] = float(v[2])
+            if self.series is not None:
+                self.series.observe("eval/%s/%s" % (v[0], v[1]),
+                                    int(iteration) + 1, float(v[2]))
 
     def record_checkpoint(self, round_idx: int, path: str,
                           wall_s: float) -> None:
@@ -194,6 +223,20 @@ class TrainingRecorder:
         if comm is not None:
             summary["comm"] = comm
         self._write(summary)
+        if self.series is not None and self.runhist_path:
+            from .timeseries import write_runhist
+            write_runhist(self.runhist_path, {
+                "schema": SCHEMA_VERSION,
+                "kind": "train",
+                "iterations": int(gbdt.iter),
+                "num_trees": len(gbdt.models),
+                "objective": str(getattr(self.config, "objective", "")),
+                "boosting": str(getattr(self.config, "boosting", "")),
+                "rank": max(int(getattr(self.config, "machine_rank", -1)),
+                            0),
+                "world": max(int(getattr(self.config, "num_machines", 1)),
+                             1),
+            }, self.series, window=self._trend_window)
         self._closed = True
         if self._file is not None:
             try:
@@ -306,7 +349,7 @@ class TrainingRecorder:
         """Append one event line.  A failing write (disk full, path
         yanked) degrades to ONE warning and stops the stream — prior
         lines stay intact, training never sees the exception."""
-        if self._closed or self._write_failed:
+        if self._closed or self._write_failed or not self.path:
             return
         try:
             if self._file is None:
@@ -347,7 +390,11 @@ def elastic_event(config, what: str, **fields) -> None:
     path = getattr(config, "tpu_telemetry_path", "")
     if not path:
         return
-    event = {"event": "elastic", "what": str(what)}
+    # wall-clock stamp: elastic events come from SEVERAL processes
+    # appending to one file, so ordering/latency questions (petition ->
+    # epoch -> wake, asserted by the chaos drills) need a shared clock
+    event = {"event": "elastic", "what": str(what),
+             "ts": round(time.time(), 6)}
     event.update(fields)
     try:
         with open(path, "a") as f:

@@ -1,0 +1,373 @@
+"""Bounded metric time-series store + windowed trend analytics.
+
+The observatory's memory: every other leg of the observability plane is
+point-in-time — gauges show the current value, the round ledger names
+THIS round's critical host, alerts fire on the latest tick.  This module
+retains a bounded ring of (tick, value) points per metric child so the
+layers above can ask *trajectory* questions: is the straggler-wait share
+growing?  is p99 drifting up across the run?  did this run regress
+against the last one?
+
+Three consumers ride on it:
+
+- ``AlertEngine`` ``trend`` rules (obs/alerts.py): fire when the
+  least-squares slope / EWMA of a metric over an N-round window
+  breaches, with the same hysteresis machinery as sustained rules.
+- ``PolicyEngine`` trend *guards* (control/policy.py): an action such as
+  ``demote_host`` can require "wait share growing over the window", not
+  just a single sustained breach — a transient blip no longer actuates.
+- The end-of-run RUNHIST artifact (``write_runhist``): per-phase and
+  per-metric windowed summaries + final series tails, diffable across
+  runs by tools/run_diff.py.
+
+Design contract (mirrors the recorder/federation contract):
+- strictly read-only on training state; sampling failures degrade to a
+  skipped sample, never an exception into the training loop;
+- zero-cost when disabled — no store is constructed unless
+  ``tpu_trend`` / ``tpu_runhist_path`` ask for one, and training output
+  is bitwise-identical with the store on or off;
+- window accounting is pinned to ROUND INDICES (ticks), not sample
+  counts: a metric that skips rounds (rank desync, serving-only ticks)
+  ages out of the window by tick distance, so a gap neither stretches
+  nor shrinks the window it is judged over.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+from collections import deque
+from fnmatch import fnmatch
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils import log
+
+# -- windowed statistics over (tick, value) point lists ----------------- #
+
+
+def least_squares_slope(points: Sequence[Tuple[float, float]]
+                        ) -> Optional[float]:
+    """Per-tick least-squares slope of value over tick.
+
+    None with fewer than two points or a degenerate (single-tick) x
+    span.  The x axis is the tick itself, so the answer reads "units
+    per round" no matter how the samples are spaced."""
+    if len(points) < 2:
+        return None
+    n = float(len(points))
+    mx = sum(t for t, _ in points) / n
+    my = sum(v for _, v in points) / n
+    sxx = sum((t - mx) * (t - mx) for t, _ in points)
+    if sxx <= 0.0:
+        return None
+    sxy = sum((t - mx) * (v - my) for t, v in points)
+    return sxy / sxx
+
+
+def ewma(points: Sequence[Tuple[float, float]],
+         alpha: float = 0.3) -> Optional[float]:
+    """Exponentially weighted moving average of the values, oldest
+    first.  Gap-aware: the decay is applied per TICK of distance, so a
+    metric that skipped rounds is smoothed over the same horizon as one
+    sampled every round."""
+    if not points:
+        return None
+    a = min(max(float(alpha), 1e-6), 1.0)
+    acc = float(points[0][1])
+    prev_t = points[0][0]
+    for t, v in points[1:]:
+        # decay once per tick of distance: w = (1-a)^(t - prev_t)
+        w = (1.0 - a) ** max(1, int(t - prev_t))
+        acc = acc * w + float(v) * (1.0 - w)
+        prev_t = t
+    return acc
+
+
+def window_quantile(points: Sequence[Tuple[float, float]],
+                    q: float) -> Optional[float]:
+    """q-th percentile (q in [0, 100]) of the point values, linearly
+    interpolated; None when empty."""
+    if not points:
+        return None
+    vals = sorted(float(v) for _, v in points)
+    if len(vals) == 1:
+        return vals[0]
+    rank = (q / 100.0) * (len(vals) - 1)
+    lo = int(math.floor(rank))
+    hi = min(lo + 1, len(vals) - 1)
+    frac = rank - lo
+    return vals[lo] * (1.0 - frac) + vals[hi] * frac
+
+
+def share_of_total(parts: Dict[str, float]) -> Dict[str, float]:
+    """Each part's share of the (non-negative) total; zeros when the
+    total is empty — the ledger-leg normalization (straggler_wait_ms /
+    wall_ms and friends)."""
+    total = sum(v for v in parts.values() if v and v > 0.0)
+    if total <= 0.0:
+        return {k: 0.0 for k in parts}
+    return {k: (max(float(v), 0.0) / total) for k, v in parts.items()}
+
+
+# -- the store ---------------------------------------------------------- #
+
+
+def series_key(name: str, labels: Optional[Dict[str, str]] = None) -> str:
+    """Canonical flat key: ``name`` or ``name{k=v,...}`` (sorted)."""
+    if not labels:
+        return str(name)
+    inner = ",".join("%s=%s" % (k, labels[k]) for k in sorted(labels))
+    return "%s{%s}" % (name, inner)
+
+
+class Series:
+    """One bounded ring of (tick, value) points for a metric child."""
+
+    __slots__ = ("name", "labels", "points")
+
+    def __init__(self, name: str, labels: Dict[str, str], capacity: int):
+        self.name = name
+        self.labels = dict(labels)
+        self.points: deque = deque(maxlen=max(2, int(capacity)))
+
+    def observe(self, tick: int, value: float) -> None:
+        """Append one point; a re-observation of the newest tick
+        replaces it (the hub may re-publish within one round)."""
+        t, v = int(tick), float(value)
+        if self.points and self.points[-1][0] == t:
+            self.points[-1] = (t, v)
+        else:
+            self.points.append((t, v))
+
+    # -- reads --------------------------------------------------------- #
+    def window(self, window: Optional[int] = None
+               ) -> List[Tuple[int, float]]:
+        """Points inside the trailing tick window (by ROUND INDEX, not
+        sample count): ticks > last_tick - window.  None -> all."""
+        pts = list(self.points)
+        if not pts or window is None:
+            return pts
+        lo = pts[-1][0] - max(1, int(window))
+        return [(t, v) for t, v in pts if t > lo]
+
+    def last(self) -> Optional[float]:
+        return self.points[-1][1] if self.points else None
+
+    def slope(self, window: Optional[int] = None) -> Optional[float]:
+        return least_squares_slope(self.window(window))
+
+    def ewma(self, alpha: float = 0.3,
+             window: Optional[int] = None) -> Optional[float]:
+        return ewma(self.window(window), alpha=alpha)
+
+    def quantile(self, q: float,
+                 window: Optional[int] = None) -> Optional[float]:
+        return window_quantile(self.window(window), q)
+
+    def summary(self, window: Optional[int] = None) -> Dict:
+        """The RUNHIST / endpoint summary block for this series."""
+        pts = self.window(window)
+        vals = [v for _, v in pts]
+        out: Dict = {"n": len(pts)}
+        if not pts:
+            return out
+        out.update({
+            "last": round(vals[-1], 6),
+            "mean": round(sum(vals) / len(vals), 6),
+            "min": round(min(vals), 6),
+            "max": round(max(vals), 6),
+            "p50": _round6(window_quantile(pts, 50)),
+            "p90": _round6(window_quantile(pts, 90)),
+            "slope": _round6(least_squares_slope(pts)),
+            "ewma": _round6(ewma(pts)),
+        })
+        return out
+
+    def tail(self, n: int = 32) -> List[List[float]]:
+        return [[t, round(v, 6)] for t, v in list(self.points)[-max(1, n):]]
+
+
+def _round6(v: Optional[float]) -> Optional[float]:
+    return None if v is None else round(float(v), 6)
+
+
+class SeriesStore:
+    """Thread-safe (name, labels) -> Series map with bounded rings.
+
+    ``capacity`` bounds every ring (points per series);
+    ``max_series`` bounds the map itself so a label-exploding family
+    cannot grow the store without limit — past the cap new keys are
+    dropped (counted, warned once)."""
+
+    def __init__(self, capacity: int = 128, max_series: int = 512):
+        self.capacity = max(2, int(capacity))
+        self.max_series = max(1, int(max_series))
+        self._series: Dict[Tuple[str, Tuple[Tuple[str, str], ...]],
+                           Series] = {}
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    def series(self, name: str, **labels) -> Optional[Series]:
+        """Get-or-create; None when the store is at max_series."""
+        key = (str(name), tuple(sorted((k, str(v))
+                                       for k, v in labels.items())))
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                if len(self._series) >= self.max_series:
+                    self.dropped += 1
+                    if self.dropped == 1:
+                        log.warning(
+                            "timeseries: store full (%d series) — new "
+                            "series are dropped", self.max_series)
+                    return None
+                s = Series(str(name), dict(key[1]), self.capacity)
+                self._series[key] = s
+            return s
+
+    def observe(self, name: str, tick: int, value, **labels) -> None:
+        if value is None:
+            return
+        s = self.series(name, **labels)
+        if s is not None:
+            s.observe(tick, value)
+
+    def get(self, name: str, **labels) -> Optional[Series]:
+        key = (str(name), tuple(sorted((k, str(v))
+                                       for k, v in labels.items())))
+        with self._lock:
+            return self._series.get(key)
+
+    def match(self, name: str,
+              labels: Optional[Dict[str, str]] = None) -> List[Series]:
+        """Every series of ``name`` whose labels superset-match
+        ``labels`` (the alert-rule matching contract)."""
+        want = {k: str(v) for k, v in (labels or {}).items()}
+        with self._lock:
+            out = [s for (n, _), s in self._series.items()
+                   if n == str(name)
+                   and all(s.labels.get(k) == v for k, v in want.items())]
+        return out
+
+    def all_series(self) -> List[Series]:
+        with self._lock:
+            return list(self._series.values())
+
+    # -- sampling ------------------------------------------------------ #
+    def sample_registry(self, registry, tick: int,
+                        include: Optional[Sequence[str]] = None) -> int:
+        """One sweep over ``registry.collect()``: counters and gauges
+        record their value, histograms record ``:p50`` / ``:p99``
+        estimate series.  ``include`` is an optional list of glob
+        patterns over family names (None -> everything).  Returns the
+        number of points recorded; any failure degrades to a warning."""
+        recorded = 0
+        try:
+            snap = registry.collect()
+        except Exception as exc:  # noqa: BLE001 — sampling never raises
+            log.warning("timeseries: registry sample failed: %s", exc)
+            return 0
+        for name, fam in snap.items():
+            if include and not any(fnmatch(name, pat) for pat in include):
+                continue
+            for labels, value in fam["values"]:
+                try:
+                    if fam["kind"] == "histogram":
+                        for q in ("p50", "p99"):
+                            v = value.get(q)
+                            if v is not None:
+                                self.observe("%s:%s" % (name, q), tick,
+                                             v, **labels)
+                                recorded += 1
+                    else:
+                        self.observe(name, tick, value, **labels)
+                        recorded += 1
+                except Exception as exc:  # noqa: BLE001
+                    log.warning("timeseries: sample %s failed: %s",
+                                name, exc)
+        return recorded
+
+    # -- snapshots ------------------------------------------------------ #
+    def snapshot(self, window: Optional[int] = None,
+                 prefix: Optional[str] = None) -> Dict[str, Dict]:
+        """{flat_key: summary} for every series (optionally name-prefix
+        filtered) — the /cluster ``trends`` block and RUNHIST body."""
+        out: Dict[str, Dict] = {}
+        for s in self.all_series():
+            if prefix and not s.name.startswith(prefix):
+                continue
+            out[series_key(s.name, s.labels)] = s.summary(window)
+        return dict(sorted(out.items()))
+
+    def tails(self, n: int = 32,
+              prefix: Optional[str] = None) -> Dict[str, List]:
+        out: Dict[str, List] = {}
+        for s in self.all_series():
+            if prefix and not s.name.startswith(prefix):
+                continue
+            out[series_key(s.name, s.labels)] = s.tail(n)
+        return dict(sorted(out.items()))
+
+
+# -- RUNHIST artifact --------------------------------------------------- #
+
+RUNHIST_VERSION = 1
+PHASE_PREFIX = "phase/"
+
+
+def write_runhist(path: str, meta: Dict, store: Optional[SeriesStore],
+                  histograms: Optional[Dict] = None,
+                  window: Optional[int] = None, tail: int = 32) -> bool:
+    """Write the end-of-run RUNHIST JSON artifact.
+
+    Series named ``phase/<name>`` land in the ``phases`` section (the
+    per-round phase-delta trajectories the recorder samples); everything
+    else lands in ``metrics``.  ``histograms`` carries full
+    bucket-resolution snapshots (serve_bench latency shapes) so
+    tools/run_diff.py can compare tails, not just scalars.  Best-effort:
+    returns False (and warns) instead of raising."""
+    doc: Dict = {
+        "runhist": RUNHIST_VERSION,
+        "meta": dict(meta or {}),
+        "phases": {},
+        "metrics": {},
+        "histograms": dict(histograms or {}),
+    }
+    if store is not None:
+        for s in store.all_series():
+            block = s.summary(window)
+            block["tail"] = s.tail(tail)
+            if s.name.startswith(PHASE_PREFIX) and not s.labels:
+                doc["phases"][s.name[len(PHASE_PREFIX):]] = block
+            else:
+                doc["metrics"][series_key(s.name, s.labels)] = block
+        doc["phases"] = dict(sorted(doc["phases"].items()))
+        doc["metrics"] = dict(sorted(doc["metrics"].items()))
+    try:
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+        with open(tmp, "w") as f:
+            json.dump(doc, f, sort_keys=True)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return True
+    except OSError as exc:
+        log.warning("timeseries: RUNHIST write to %s failed: %s",
+                    path, exc)
+        return False
+
+
+def read_runhist(path: str) -> Dict:
+    """Parse a RUNHIST artifact; raises ValueError on a non-RUNHIST
+    document (run_diff's unreadable contract)."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "runhist" not in doc:
+        raise ValueError("%s is not a RUNHIST artifact" % path)
+    return doc

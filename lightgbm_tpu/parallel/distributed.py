@@ -665,7 +665,17 @@ class FormationPending(ConnectionError):
     next formation epoch.  Deliberately a ConnectionError subclass so
     callers that don't know about scale-up still treat it as a retryable
     formation failure — but the elastic supervisor catches it FIRST and
-    retries without convicting anyone (the hub is alive and answered)."""
+    retries without convicting anyone (the hub is alive and answered).
+
+    ``woken=True`` means the petitioner was parked on the hub's
+    formation socket and the hub pushed the epoch announcement down the
+    parked connection: the join window is opening NOW, so the
+    supervisor should re-knock immediately instead of sleeping out its
+    poll cadence."""
+
+    def __init__(self, msg: str, woken: bool = False):
+        super().__init__(msg)
+        self.woken = bool(woken)
 
 
 class ElasticComm(SocketComm):
@@ -736,12 +746,14 @@ class ElasticComm(SocketComm):
                  retry: Optional[RetryPolicy] = None,
                  op_timeout_s: float = 0.0,
                  injector: Optional[FaultInjector] = None,
-                 scale_up: bool = False):
+                 scale_up: bool = False,
+                 petition_poll_s: float = 2.0):
         self.orig_rank = int(orig_rank)
         self.machines = list(machines)
         self.rejoin_window_s = max(float(rejoin_window_s), 0.05)
         self.min_world = max(int(min_world), 1)
         self.scale_up = bool(scale_up)
+        self.petition_poll_s = max(float(petition_poll_s), 0.0)
         self._hb_interval = max(float(heartbeat_s), 1e-3)
         self._suspect_s = max(float(suspect_s), self._hb_interval)
         # scale-up: the hub keeps its formation socket listening for the
@@ -749,6 +761,10 @@ class ElasticComm(SocketComm):
         # heartbeat probe drains the knocks into _pending_joins
         self._join_srv: Optional[socket.socket] = None
         self._pending_joins: Dict[int, float] = {}
+        # scale-up hub: petition connections PARKED open (orig rank ->
+        # socket) so announce_epoch can wake the petitioner the moment
+        # the join window opens instead of waiting out its poll cadence
+        self._parked_petitions: Dict[int, socket.socket] = {}
         self._ctrl: Dict[int, dict] = {}      # hub: orig -> conn state
         self._ctrl_sock: Optional[socket.socket] = None   # spoke: to hub
         self._ctrl_thread: Optional[threading.Thread] = None
@@ -813,6 +829,8 @@ class ElasticComm(SocketComm):
                           int(getattr(config, "tpu_elastic_min_world", 1)))
         kwargs.setdefault("scale_up", bool(
             getattr(config, "tpu_elastic_scale_up", False)))
+        kwargs.setdefault("petition_poll_s", float(
+            getattr(config, "tpu_elastic_petition_poll_s", 2.0)))
         return cls(orig_rank, machines, generation=generation, alive=alive,
                    **kwargs)
 
@@ -1042,13 +1060,27 @@ class ElasticComm(SocketComm):
                 generation=int(assign.get("generation", gen)), fenced=True)
         if assign.get("type") == "wait":
             # the hub is mid-incarnation with scale-up on: our petition
-            # is recorded; retry the sweep until the next epoch's
-            # formation window opens
+            # is recorded and the hub PARKS this connection.  Block in
+            # recv (up to petition_poll_s) for the epoch wake the hub
+            # pushes from announce_epoch — when it lands, the join
+            # window is opening and the supervisor should re-knock
+            # immediately (woken=True) instead of sleeping first.
+            woken = False
+            poll_s = getattr(self, "petition_poll_s", 2.0)
+            if poll_s > 0:
+                try:
+                    conn.settimeout(poll_s)
+                    wake, _wg = _recv_formation_msg(conn)
+                    woken = wake.get("type") == "epoch"
+                except (OSError, ConnectionError, ValueError):
+                    pass
             conn.close()
             raise FormationPending(
                 "hub %d is mid-incarnation at generation %s; rejoin "
-                "petition recorded, awaiting a formation epoch"
-                % (hub, assign.get("generation", "?")))
+                "petition recorded, %s"
+                % (hub, assign.get("generation", "?"),
+                   "formation epoch announced — re-knocking now" if woken
+                   else "awaiting a formation epoch"), woken=woken)
         if assign.get("type") != "assign":
             conn.close()
             raise ConnectionError("unexpected formation reply %r"
@@ -1103,10 +1135,12 @@ class ElasticComm(SocketComm):
     def _drain_join_knocks(self) -> None:
         """Scale-up only (hub): accept any connection waiting on the
         formation socket, record a JOIN hello as a rejoin petition and
-        answer ``wait`` — the knocker's supervisor sleeps on
-        FormationPending and re-knocks until a formation epoch admits
-        it.  Non-JOIN garbage is dropped; nothing here blocks the probe
-        for more than the 1 s hello timeout per knock."""
+        answer ``wait`` — then PARK the connection open (keyed by
+        original rank, a re-knock supersedes its predecessor) so
+        ``announce_epoch`` can push the epoch announcement straight to
+        the petitioner, which is blocked in recv waiting for exactly
+        that wake.  Non-JOIN garbage is dropped; nothing here blocks
+        the probe for more than the 1 s hello timeout per knock."""
         srv = self._join_srv
         if srv is None:
             return
@@ -1121,6 +1155,7 @@ class ElasticComm(SocketComm):
                 conn, _addr_ = srv.accept()
             except OSError:
                 return
+            parked = False
             try:
                 conn.settimeout(1.0)
                 hello, _hg = _recv_formation_msg(conn)
@@ -1130,6 +1165,9 @@ class ElasticComm(SocketComm):
                     first = r not in self._pending_joins
                     with self._fence_lock:
                         self._pending_joins[r] = time.monotonic()
+                        stale = self._parked_petitions.pop(r, None)
+                    if stale is not None:
+                        stale.close()
                     if first:
                         log.info("elastic: rank %d is knocking to rejoin "
                                  "(generation %d); pending a formation "
@@ -1137,10 +1175,14 @@ class ElasticComm(SocketComm):
                     _send_msg(conn, {"type": "wait",
                                      "generation": self.generation},
                               self.generation)
+                    with self._fence_lock:
+                        self._parked_petitions[r] = conn
+                    parked = True
             except (OSError, ConnectionError, ValueError):
                 pass
             finally:
-                conn.close()
+                if not parked:
+                    conn.close()
 
     def _ctrl_probe(self) -> List[int]:
         """Hub liveness probe (one Heartbeat round): PING every control
@@ -1257,6 +1299,23 @@ class ElasticComm(SocketComm):
                            generation=self.generation, kind=FRAME_EPOCH)
             except OSError:
                 st["eof"] = True
+        # wake the parked petitioners: each is blocked in recv on its
+        # petition connection (petition_poll_s) and will re-knock the
+        # moment this lands — the rejoin latency is bounded by the
+        # epoch, not the petitioner's poll cadence.  A petitioner whose
+        # poll already expired just fails the send; it re-knocks on its
+        # own schedule and the next window admits it anyway.
+        with self._fence_lock:
+            parked = dict(self._parked_petitions)
+            self._parked_petitions.clear()
+        for r, conn in parked.items():
+            try:
+                _send_msg(conn, {"type": "epoch", "readmit": readmit,
+                                 "generation": self.generation},
+                          self.generation)
+            except OSError:
+                pass
+            conn.close()
 
     def pending_joiners(self) -> List[int]:
         """Original ranks whose rejoin petitions the hub has recorded
@@ -1355,6 +1414,14 @@ class ElasticComm(SocketComm):
             except OSError:
                 pass
             self._join_srv = None  # tpulint: ok=lock-shared-write
+        with self._fence_lock:
+            parked = dict(self._parked_petitions)
+            self._parked_petitions.clear()
+        for conn in parked.values():
+            try:
+                conn.close()
+            except OSError:
+                pass
         if self._heartbeat is not None:
             self._heartbeat.stop()
             # close() runs after the heartbeat/control threads are

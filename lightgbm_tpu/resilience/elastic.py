@@ -217,6 +217,10 @@ class ElasticSupervisor:
                     log.warning("elastic: world re-formed at generation %d "
                                 "(world %d) %.2fs after failure",
                                 generation, comm.world, dt)
+                    # recovery observable: the chaos drills bound the
+                    # epoch -> rejoined gap with this event's timestamp
+                    self._record(cfg, "rejoined", generation, comm.world,
+                                 reforms, recovery_s)
                 self._publish(generation, comm.world, reforms, recovery_s,
                               membership=getattr(comm, "membership", None))
                 booster = self._train_once(comm)
@@ -295,7 +299,18 @@ class ElasticSupervisor:
                           str(exc).split("\n")[0][:120])
                 if t_failure is None:
                     t_failure = time.monotonic()
-                time.sleep(0.2)
+                if getattr(exc, "woken", False):
+                    # the hub pushed the epoch announcement down our
+                    # parked petition connection: the join window is
+                    # opening NOW — re-knock without sleeping.  The
+                    # chaos drill asserts the epoch->wake gap this
+                    # push keeps tight.
+                    self._record(cfg, "petition_wake", generation, 0,
+                                 reforms, recovery_s)
+                else:
+                    # no epoch wake within the petition poll — back off
+                    # briefly before re-knocking
+                    time.sleep(0.2)
                 continue
             except (CommFailure, ConnectionError, OSError) as exc:
                 # wire failure without a membership verdict.  For a spoke

@@ -96,6 +96,19 @@ class Server:
             except Exception as exc:  # noqa: BLE001 — alerting is optional
                 log.warning("serving alerts disabled: engine init "
                             "failed (%s)", exc)
+        # trend observatory (obs/timeseries.py): each stats tick also
+        # samples the registry into a bounded series store, so /trends
+        # answers trajectory questions (is p99 drifting? shed growing?)
+        self.series = None
+        self._trend_tick = 0
+        self._trend_window = max(4, int(getattr(cfg, "tpu_trend_window",
+                                                64) or 64))
+        if getattr(cfg, "tpu_trend", False):
+            from ..obs.timeseries import SeriesStore
+            self.series = SeriesStore(capacity=self._trend_window)
+            pats = str(getattr(cfg, "tpu_trend_metrics", "") or "")
+            self._trend_include = [p.strip() for p in pats.split(",")
+                                   if p.strip()] or None
         # span timeline for the request lifecycle (enqueue -> micro-batch
         # -> device -> respond) when tpu_trace_path is set; flushed on
         # shutdown and harmless to leave armed
@@ -300,6 +313,13 @@ class Server:
             stats = dict(self._stats)
             batchers = dict(self._batchers)
             breakers = {n: b.snapshot() for n, b in self._breakers.items()}
+            tick = self._trend_tick = self._trend_tick + 1
+        if self.series is not None:
+            # sample BEFORE the alert tick so a trend rule evaluating
+            # this tick sees the newest point (the store has its own
+            # lock; only the tick counter needs ours)
+            self.series.sample_registry(self.metrics, tick,
+                                        include=self._trend_include)
         if self.alerts is not None:
             try:
                 # each stats tick is an alert-engine tick: sustained and
@@ -333,6 +353,15 @@ class Server:
         """The registry in Prometheus text exposition format 0.0.4
         (GET /metrics)."""
         return self.metrics.render_prometheus()
+
+    def trends_snapshot(self) -> Dict:
+        """GET /trends: windowed summaries (slope / EWMA / quantiles)
+        of every sampled series (obs/timeseries.py)."""
+        if self.series is None:
+            return {}
+        return {"tick": self._trend_tick,
+                "window": self._trend_window,
+                "series": self.series.snapshot(self._trend_window)}
 
     # -- HTTP frontend ------------------------------------------------- #
     def serve_http(self, host: Optional[str] = None,
@@ -528,6 +557,12 @@ def _make_handler(server: Server):
                                       "(set tpu_alert)"})
                 else:
                     self._reply(200, server.alerts.snapshot())
+            elif path == "/trends":
+                if server.series is None:
+                    self._reply(404, {"error": "trend store disabled "
+                                      "(set tpu_trend)"})
+                else:
+                    self._reply(200, server.trends_snapshot())
             elif path == "/cluster":
                 from ..obs import federation as _federation
                 self._reply(200,

@@ -116,6 +116,121 @@ def test_clear_hysteresis_rides_through_flapping_metric():
     assert t["state"] == "cleared" and eng.active() == []
 
 
+class _GappyRegistry(MetricsRegistry):
+    """A registry whose collect() can HIDE families — simulating a
+    metric that skips rounds (rank desync, serving-only families on a
+    training tick, a family published only after its first incident)."""
+
+    def __init__(self):
+        super().__init__()
+        self.hidden = set()
+
+    def collect(self):
+        snap = super().collect()
+        for fam in self.hidden:
+            snap.pop(fam, None)
+        return snap
+
+
+def test_sustained_window_counts_round_indices_across_gaps():
+    """Gap regression: window accounting is pinned to ROUND INDICES.
+    A sustained breach run spans the rounds it covers even when the
+    metric skips a round in the middle — the absent tick is NEUTRAL
+    (it neither resets the run like a clean sample would, nor counts
+    as an extra breach observation)."""
+    reg = _GappyRegistry()
+    g = reg.gauge("lgbm_hybrid_host_slow", host="1")
+    eng = AlertEngine(reg, rules=[Rule(
+        "straggler", "lgbm_hybrid_host_slow", ">=", 1.0, "sustained",
+        for_ticks=3)])
+    g.set(1)
+    assert eng.evaluate(tick=1) == []        # breach run starts round 1
+    reg.hidden = {"lgbm_hybrid_host_slow"}
+    assert eng.evaluate(tick=2) == []        # skipped round: neutral
+    reg.hidden = set()
+    (t,) = eng.evaluate(tick=3)              # rounds 1..3 span >= for=3
+    assert t["state"] == "firing" and eng.active() == ["straggler"]
+
+    # contrast: a PRESENT clean sample mid-run resets it
+    reg2 = _GappyRegistry()
+    g2 = reg2.gauge("lgbm_hybrid_host_slow", host="1")
+    eng2 = AlertEngine(reg2, rules=[Rule(
+        "straggler", "lgbm_hybrid_host_slow", ">=", 1.0, "sustained",
+        for_ticks=3)])
+    g2.set(1)
+    assert eng2.evaluate(tick=1) == []
+    g2.set(0)
+    assert eng2.evaluate(tick=2) == []       # clean: run resets
+    g2.set(1)
+    assert eng2.evaluate(tick=3) == []       # new run, only round 3
+    assert eng2.active() == []
+
+
+def test_active_alert_rides_through_metric_absence():
+    """Gap regression: an ACTIVE alert is not cleared by the metric
+    going absent — only a present clean sample clears.  (A family that
+    disappears for good therefore never auto-clears; that is the
+    documented trade for gap robustness.)"""
+    reg = _GappyRegistry()
+    g = reg.gauge("lgbm_test_depth")
+    eng = AlertEngine(reg, rules=[Rule("deep", "lgbm_test_depth", ">", 5.0)])
+    g.set(9)
+    assert eng.evaluate()[0]["state"] == "firing"
+    reg.hidden = {"lgbm_test_depth"}
+    for _ in range(5):
+        assert eng.evaluate() == []          # absent: stays firing
+    assert eng.active() == ["deep"]
+    reg.hidden = set()
+    g.set(1)
+    (t,) = eng.evaluate()                    # present clean: clears
+    assert t["state"] == "cleared" and eng.active() == []
+
+
+def test_burn_rate_window_ages_by_tick_not_sample_count():
+    """Gap regression: the burn window is `window` ROUNDS wide, not
+    `window` samples.  A burst observed long ago (in rounds) slides out
+    of the window even when few samples arrived since — a sample-count
+    ring would keep the stale burst in the rate forever."""
+    reg = MetricsRegistry()
+    c = reg.counter("lgbm_serve_shed_total", model="m")
+    eng = AlertEngine(reg, rules=[Rule(
+        "shed", "lgbm_serve_shed_total", ">", 1.0, "burn_rate", window=4)])
+    eng.evaluate(tick=1)                     # baseline sample
+    c.inc(50)
+    (t,) = eng.evaluate(tick=2)              # 50/round burst fires
+    assert t["state"] == "firing"
+    # next evaluation lands 20 rounds later (the engine skipped rounds);
+    # the burst is far outside the 4-round window, so the stale samples
+    # must be evicted by TICK AGE and the rule must clear
+    (t,) = eng.evaluate(tick=22)
+    assert t["state"] == "cleared" and eng.active() == []
+
+
+def test_trend_window_pinned_to_round_indices():
+    """Gap regression: a trend window does not stretch across a long
+    gap — samples older than `window` rounds are evicted, so the
+    statistic is judged over fresh points only (and stays neutral until
+    min_points fresh samples exist again)."""
+    reg = MetricsRegistry()
+    g = reg.gauge("lgbm_cluster_straggler_share")
+    eng = AlertEngine(reg, rules=[Rule(
+        "ramp", "lgbm_cluster_straggler_share", ">", 0.01, "trend",
+        stat="slope", window=4, min_points=3)])
+    for tick, v in ((1, 0.1), (2, 0.2), (3, 0.3)):
+        g.set(v)
+        out = eng.evaluate(tick=tick)
+    assert out[0]["state"] == "firing"       # 0.1/round ramp
+    # 20 rounds of silence, then a flat value: the old ramp points are
+    # outside the window, one fresh point < min_points -> neutral
+    g.set(0.3)
+    assert eng.evaluate(tick=23) == [] and eng.active() == ["ramp"]
+    g.set(0.3)
+    assert eng.evaluate(tick=24) == []
+    g.set(0.3)
+    (t,) = eng.evaluate(tick=25)             # 3 fresh flat points: slope 0
+    assert t["state"] == "cleared" and eng.active() == []
+
+
 def test_burn_rate_rule_watches_slope_not_level():
     reg = MetricsRegistry()
     c = reg.counter("lgbm_serve_shed_total", model="m")

@@ -303,6 +303,194 @@ def test_form_spoke_rejects_stale_generation_assign():
         _run_formation(assign_gen=3, poison_first=False, spoke_gen=4)
 
 
+def test_form_spoke_parked_petition_woken_by_epoch():
+    """Scale-up rejoin latency: a petitioner answered ``wait`` stays
+    blocked on the parked connection and the hub's epoch push wakes it
+    WELL before the petition poll timeout — FormationPending carries
+    woken=True so the supervisor re-knocks without sleeping."""
+    port = _free_port()
+    machines = ["127.0.0.1:%d" % port, "127.0.0.1:%d" % _free_port()]
+    srv = socket.socket()  # tpulint: ok=socket-no-with — closed in finally
+    out = {}
+
+    def hub():
+        try:
+            conn, _ = srv.accept()
+            conn.settimeout(5.0)
+            out["join"] = dist._recv_msg(conn)
+            dist._send_msg(conn, {"type": "wait", "generation": 3}, 3)
+            time.sleep(0.25)          # petition parked; epoch comes later
+            dist._send_msg(conn, {"type": "epoch", "generation": 3,
+                                  "readmit": [1]}, 3)
+            out["conn"] = conn
+        except Exception as exc:  # noqa: BLE001 — surfaced by the test
+            out["error"] = exc
+
+    try:
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(2)
+        t = threading.Thread(target=hub, daemon=True)
+        t.start()
+        spoke = _bare_spoke(machines)
+        spoke.petition_poll_s = 5.0   # the wake must beat this by a mile
+        t0 = time.monotonic()
+        with pytest.raises(dist.FormationPending) as ei:
+            spoke._form_spoke(3, timeout_s=5.0, port_offset=0)
+        elapsed = time.monotonic() - t0
+        t.join(timeout=5.0)
+        assert "error" not in out, out.get("error")
+        assert ei.value.woken is True
+        # woken by the push at ~0.25 s, nowhere near the 5 s poll
+        assert elapsed < 2.0, elapsed
+    finally:
+        if "conn" in out:
+            out["conn"].close()
+        srv.close()
+
+
+def test_form_spoke_unwoken_petition_times_out_at_poll():
+    """No epoch within the petition poll: the petitioner gives up the
+    parked wait at petition_poll_s and FormationPending says
+    woken=False (the supervisor backs off before re-knocking)."""
+    port = _free_port()
+    machines = ["127.0.0.1:%d" % port, "127.0.0.1:%d" % _free_port()]
+    srv = socket.socket()  # tpulint: ok=socket-no-with — closed in finally
+    out = {}
+
+    def hub():
+        try:
+            conn, _ = srv.accept()
+            conn.settimeout(5.0)
+            dist._recv_msg(conn)
+            dist._send_msg(conn, {"type": "wait", "generation": 3}, 3)
+            out["conn"] = conn        # parked, but no epoch ever comes
+        except Exception as exc:  # noqa: BLE001
+            out["error"] = exc
+
+    try:
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(2)
+        t = threading.Thread(target=hub, daemon=True)
+        t.start()
+        spoke = _bare_spoke(machines)
+        spoke.petition_poll_s = 0.3
+        t0 = time.monotonic()
+        with pytest.raises(dist.FormationPending) as ei:
+            spoke._form_spoke(3, timeout_s=5.0, port_offset=0)
+        elapsed = time.monotonic() - t0
+        t.join(timeout=5.0)
+        assert "error" not in out, out.get("error")
+        assert ei.value.woken is False
+        assert elapsed >= 0.3, elapsed
+    finally:
+        if "conn" in out:
+            out["conn"].close()
+        srv.close()
+
+
+def _bare_hub(machines, generation=3):
+    """An ElasticComm shell with only the attributes the scale-up hub
+    surface (_drain_join_knocks / announce_epoch / close parking) reads."""
+    c = object.__new__(dist.ElasticComm)
+    c.machines = list(machines)
+    c.membership = [0]
+    c.generation = generation
+    c._fence_lock = threading.Lock()
+    c._pending_joins = {}
+    c._parked_petitions = {}
+    c._world_changed = None
+    c._ctrl = {}
+    return c
+
+
+def test_drain_join_knocks_parks_and_announce_epoch_wakes():
+    """Hub side of the parked-petition path: a knock is answered
+    ``wait`` with the connection PARKED, and announce_epoch pushes the
+    epoch announcement straight down it — the petitioner's blocked recv
+    returns immediately instead of waiting out its poll."""
+    port = _free_port()
+    machines = ["127.0.0.1:%d" % port, "127.0.0.1:%d" % _free_port()]
+    hub = _bare_hub(machines)
+    srv = socket.socket()  # tpulint: ok=socket-no-with — closed in finally
+    knock = None
+    try:
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(2)
+        hub._join_srv = srv
+        knock = socket.create_connection(("127.0.0.1", port), timeout=5.0)
+        knock.settimeout(5.0)
+        dist._send_msg(knock, {"type": "join", "orig_rank": 1,
+                               "generation": 3}, 3)
+        hub._drain_join_knocks()
+        wait_msg, _g = dist._recv_formation_msg(knock)
+        assert wait_msg["type"] == "wait"
+        assert hub.pending_joiners() == [1] or 1 in hub._pending_joins
+        assert 1 in hub._parked_petitions
+
+        t0 = time.monotonic()
+        hub.announce_epoch([1])
+        wake, _g = dist._recv_formation_msg(knock)
+        elapsed = time.monotonic() - t0
+        assert wake["type"] == "epoch" and wake["readmit"] == [1]
+        assert elapsed < 1.0, elapsed
+        assert hub._parked_petitions == {}
+        assert hub._world_changed is not None
+        assert hub._world_changed.epoch and hub._world_changed.readmit == [1]
+    finally:
+        if knock is not None:
+            knock.close()
+        hub._join_srv = None
+        srv.close()
+
+
+def test_drain_join_knocks_reknock_supersedes_parked_connection():
+    """A re-knock from the same rank replaces its stale parked
+    connection (the old one is closed), so a petitioner that timed out
+    and knocked again still gets the wake on its LIVE connection."""
+    port = _free_port()
+    machines = ["127.0.0.1:%d" % port, "127.0.0.1:%d" % _free_port()]
+    hub = _bare_hub(machines)
+    srv = socket.socket()  # tpulint: ok=socket-no-with — closed in finally
+    first = second = None
+    try:
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(2)
+        hub._join_srv = srv
+        for i in range(2):
+            conn = socket.create_connection(("127.0.0.1", port),
+                                            timeout=5.0)
+            conn.settimeout(5.0)
+            dist._send_msg(conn, {"type": "join", "orig_rank": 1,
+                                  "generation": 3}, 3)
+            hub._drain_join_knocks()
+            msg, _g = dist._recv_formation_msg(conn)
+            assert msg["type"] == "wait"
+            if i == 0:
+                first = conn
+            else:
+                second = conn
+        parked = hub._parked_petitions[1]
+        assert parked is not first
+        # the superseded connection was closed by the hub: its next recv
+        # sees EOF, not a hung wait
+        first.settimeout(1.0)
+        with pytest.raises((ConnectionError, OSError, ValueError)):
+            dist._recv_formation_msg(first)
+        hub.announce_epoch([1])
+        wake, _g = dist._recv_formation_msg(second)
+        assert wake["type"] == "epoch"
+    finally:
+        for c in (first, second):
+            if c is not None:
+                c.close()
+        hub._join_srv = None
+        srv.close()
+
+
 # --------------------------------------------------------------------- #
 # Distributed find-bin sampling
 # --------------------------------------------------------------------- #

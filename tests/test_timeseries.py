@@ -1,0 +1,523 @@
+"""Trend observatory (obs/timeseries.py + consumers): windowed series
+math (slope / EWMA / quantiles / shares), the bounded SeriesStore and
+its registry sampling, trend alert rules on a synthetic ramp, policy
+trend guards (fail-closed, $label resolution), the RUNHIST artifact and
+tools/run_diff.py regression diffing, federation ledger/endpoint trend
+annotation, and the bitwise-identity guarantees (store + RUNHIST on vs
+off) — all on the fast tier (JAX_PLATFORMS=cpu, conftest)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.control import Actuator, PolicyEngine, TokenBucket
+from lightgbm_tpu.control.policy import PolicyRule, trend_guard_ok
+from lightgbm_tpu.obs import MetricsRegistry, SeriesStore, write_runhist
+from lightgbm_tpu.obs.alerts import AlertEngine, Rule
+from lightgbm_tpu.obs.timeseries import (PHASE_PREFIX, Series, ewma,
+                                         least_squares_slope, read_runhist,
+                                         series_key, share_of_total,
+                                         window_quantile)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUN_DIFF = os.path.join(ROOT, "tools", "run_diff.py")
+
+
+# ------------------------------------------------------- windowed stats
+
+def test_least_squares_slope_reads_units_per_round():
+    assert least_squares_slope([(1, 1.0), (2, 2.0), (3, 3.0)]) \
+        == pytest.approx(1.0)
+    # gap-tolerant: the x axis is the tick, so sparse samples of the
+    # same line report the same per-round slope
+    assert least_squares_slope([(1, 1.0), (5, 5.0), (9, 9.0)]) \
+        == pytest.approx(1.0)
+    assert least_squares_slope([(4, 7.0)]) is None
+    assert least_squares_slope([]) is None
+    # degenerate single-tick span (same-tick duplicates)
+    assert least_squares_slope([(3, 1.0), (3, 2.0)]) is None
+
+
+def test_ewma_decays_per_tick_of_distance():
+    assert ewma([]) is None
+    assert ewma([(1, 4.0)]) == pytest.approx(4.0)
+    assert ewma([(t, 2.0) for t in range(1, 9)]) == pytest.approx(2.0)
+    # gap-aware: a jump observed after an 8-tick gap has decayed the
+    # old level further than the same jump one tick later
+    gapped = ewma([(1, 0.0), (2, 0.0), (10, 1.0)])
+    adjacent = ewma([(1, 0.0), (2, 0.0), (3, 1.0)])
+    assert gapped > adjacent
+
+
+def test_window_quantile_interpolates():
+    pts = [(t, float(v)) for t, v in enumerate([1, 2, 3, 4])]
+    assert window_quantile(pts, 0) == 1.0
+    assert window_quantile(pts, 100) == 4.0
+    assert window_quantile(pts, 50) == pytest.approx(2.5)
+    assert window_quantile([(1, 9.0)], 99) == 9.0
+    assert window_quantile([], 50) is None
+
+
+def test_share_of_total_normalizes_and_handles_empty():
+    shares = share_of_total({"a": 3.0, "b": 1.0, "c": 0.0})
+    assert shares["a"] == pytest.approx(0.75)
+    assert shares["b"] == pytest.approx(0.25)
+    assert shares["c"] == 0.0
+    assert share_of_total({"a": 0.0, "b": 0.0}) == {"a": 0.0, "b": 0.0}
+
+
+# --------------------------------------------------------- Series rings
+
+def test_series_ring_bounds_and_same_tick_replace():
+    s = Series("m", {}, capacity=4)
+    for t in range(1, 9):
+        s.observe(t, float(t))
+    assert [t for t, _ in s.points] == [5, 6, 7, 8]   # ring bound
+    s.observe(8, 99.0)                                # same tick replaces
+    assert s.last() == 99.0 and len(s.points) == 4
+    assert series_key("m", {"b": "2", "a": "1"}) == "m{a=1,b=2}"
+    assert series_key("m") == "m"
+
+
+def test_series_window_is_tick_span_not_sample_count():
+    s = Series("m", {}, capacity=32)
+    for t in (1, 2, 3, 20, 21):
+        s.observe(t, float(t))
+    # a 4-round window ends at tick 21: only ticks > 17 qualify, the
+    # early burst is out no matter how few samples arrived since
+    assert [t for t, _ in s.window(4)] == [20, 21]
+    assert [t for t, _ in s.window(None)] == [1, 2, 3, 20, 21]
+    summ = s.summary(4)
+    assert summ["n"] == 2 and summ["last"] == 21.0
+
+
+def test_store_caps_series_count_and_matches_labels():
+    store = SeriesStore(capacity=8, max_series=2)
+    assert store.series("a", host="0") is not None
+    assert store.series("a", host="1") is not None
+    assert store.series("b") is None                  # at max_series
+    assert store.dropped == 1
+    store.observe("a", 1, 0.5, host="0")
+    store.observe("a", 1, 0.9, host="1")
+    assert len(store.match("a", None)) == 2
+    (only,) = store.match("a", {"host": "1"})
+    assert only.last() == 0.9
+    assert store.match("a", {"host": "7"}) == []
+    assert store.get("a", host="0").last() == 0.5
+
+
+def test_sample_registry_globs_counters_gauges_histograms():
+    reg = MetricsRegistry()
+    reg.counter("lgbm_serve_shed_total", model="m").inc(3)
+    reg.gauge("lgbm_cluster_straggler_share").set(0.4)
+    h = reg.histogram("lgbm_serve_latency_ms", bounds=[1, 10, 100])
+    for v in (2.0, 3.0, 50.0):
+        h.observe(v)
+    store = SeriesStore()
+    n = store.sample_registry(reg, tick=1)
+    assert n >= 4        # counter + gauge + histogram p50/p99
+    assert store.get("lgbm_serve_shed_total", model="m").last() == 3.0
+    assert store.get("lgbm_cluster_straggler_share").last() == 0.4
+    assert store.get("lgbm_serve_latency_ms:p50") is not None
+    assert store.get("lgbm_serve_latency_ms:p99") is not None
+    # include globs: only the matching family is sampled
+    only = SeriesStore()
+    only.sample_registry(reg, tick=1, include=["lgbm_cluster_*"])
+    assert only.get("lgbm_cluster_straggler_share") is not None
+    assert only.get("lgbm_serve_shed_total", model="m") is None
+
+
+# ------------------------------------------------------ RUNHIST artifact
+
+def _ramp_store(slope=1.0, base=10.0, rounds=8):
+    store = SeriesStore()
+    for t in range(1, rounds + 1):
+        store.observe(PHASE_PREFIX + "tree_grow", t, base + slope * t)
+        store.observe("train/wall_ms", t, 2 * base + slope * t)
+        store.observe("eval/valid_0/rmse", t, 1.0 / t)
+    return store
+
+
+def test_runhist_write_read_roundtrip(tmp_path):
+    path = str(tmp_path / "run.runhist.json")
+    store = _ramp_store()
+    assert write_runhist(path, {"kind": "train", "iterations": 8}, store,
+                         histograms={"lat": {"p50": 1.0, "p99": 2.0}})
+    doc = read_runhist(path)
+    assert doc["runhist"] == 1
+    assert doc["meta"]["kind"] == "train"
+    # phase/ series land in phases (prefix stripped), the rest in metrics
+    assert "tree_grow" in doc["phases"]
+    assert doc["phases"]["tree_grow"]["n"] == 8
+    assert doc["phases"]["tree_grow"]["slope"] == pytest.approx(1.0)
+    assert "train/wall_ms" in doc["metrics"]
+    assert "eval/valid_0/rmse" in doc["metrics"]
+    assert doc["histograms"]["lat"]["p99"] == 2.0
+    assert doc["phases"]["tree_grow"]["tail"][-1][0] == 8
+
+    bad = tmp_path / "not_runhist.json"
+    bad.write_text("{}")
+    with pytest.raises(ValueError):
+        read_runhist(str(bad))
+
+
+# -------------------------------------------------- tools/run_diff.py
+
+def _diff(base, new, *extra):
+    proc = subprocess.run(
+        [sys.executable, RUN_DIFF, str(base), str(new), *extra],
+        capture_output=True, text=True, timeout=120)
+    return proc.returncode, proc.stdout, proc.stderr
+
+
+def _write(tmp_path, name, store, histograms=None):
+    path = str(tmp_path / name)
+    assert write_runhist(path, {"kind": "train"}, store,
+                         histograms=histograms)
+    return path
+
+
+class TestRunDiff:
+    def test_self_compare_exits_zero(self, tmp_path):
+        p = _write(tmp_path, "a.json", _ramp_store())
+        rc, out, err = _diff(p, p)
+        assert rc == 0, err
+        assert "within bands" in out and "REGRESSION" not in err
+
+    def test_seeded_phase_regression_exits_one(self, tmp_path):
+        base = _write(tmp_path, "base.json", _ramp_store(base=10.0))
+        # 50% slower per round with the same shape: outside the 15% band
+        slow = _write(tmp_path, "slow.json", _ramp_store(base=15.0))
+        rc, out, err = _diff(base, slow)
+        assert rc == 1
+        assert "REGRESSION" in err and "tree_grow" in err
+
+    def test_improvement_is_not_a_failure(self, tmp_path):
+        base = _write(tmp_path, "base.json", _ramp_store(base=15.0))
+        fast = _write(tmp_path, "fast.json", _ramp_store(base=10.0))
+        rc, out, err = _diff(base, fast)
+        assert rc == 0 and "better:" in out
+
+    def test_eval_loss_regresses_up(self, tmp_path):
+        s_good, s_bad = SeriesStore(), SeriesStore()
+        for t in range(1, 6):
+            s_good.observe("eval/valid_0/rmse", t, 0.10)
+            s_bad.observe("eval/valid_0/rmse", t, 0.20)
+        base = _write(tmp_path, "good.json", s_good)
+        new = _write(tmp_path, "bad.json", s_bad)
+        rc, _out, err = _diff(base, new)
+        assert rc == 1 and "rmse" in err
+        # the reverse direction is an improvement, not a regression
+        assert _diff(new, base)[0] == 0
+
+    def test_histogram_tail_fattening_is_caught(self, tmp_path):
+        flat = {"lat_ms": {"p50": 5.0, "p90": 8.0, "p99": 10.0,
+                           "max": 12.0}}
+        fat = {"lat_ms": {"p50": 5.0, "p90": 8.0, "p99": 30.0,
+                          "max": 55.0}}
+        base = _write(tmp_path, "flat.json", None, histograms=flat)
+        new = _write(tmp_path, "fat.json", None, histograms=fat)
+        rc, _out, err = _diff(base, new)
+        assert rc == 1 and "p99" in err   # median identical, tail caught
+
+    def test_tolerance_band_is_respected(self, tmp_path):
+        base = _write(tmp_path, "b.json", _ramp_store(base=10.0))
+        worse = _write(tmp_path, "w.json", _ramp_store(base=13.0))
+        assert _diff(base, worse)[0] == 1                  # ~20% > 15%
+        assert _diff(base, worse, "--tolerance", "0.5")[0] == 0
+
+    def test_unreadable_inputs_exit_two(self, tmp_path):
+        good = _write(tmp_path, "g.json", _ramp_store())
+        missing = str(tmp_path / "nope.json")
+        rc, _out, err = _diff(good, missing)
+        assert rc == 2 and "cannot read" in err
+        not_runhist = tmp_path / "n.json"
+        not_runhist.write_text(json.dumps({"hello": 1}))
+        assert _diff(str(not_runhist), good)[0] == 2
+        garbage = tmp_path / "garbage.json"
+        garbage.write_text("{[")
+        assert _diff(good, str(garbage))[0] == 2
+
+    def test_json_output_mode(self, tmp_path):
+        p = _write(tmp_path, "a.json", _ramp_store())
+        rc, out, _err = _diff(p, p, "--json")
+        assert rc == 0
+        doc = json.loads(out)
+        assert doc["regressions"] == [] and doc["compared"] > 0
+
+
+# ------------------------------------------------- policy trend guards
+
+def _guarded_engine(series, window=8, threshold=0.01, labels=None):
+    cfg = Config({"objective": "regression", "verbosity": -1,
+                  "tpu_policy": True})
+    rules = [PolicyRule(
+        "demote", when={"alert": "straggler_host"}, action="demote_host",
+        args={"orig": "$critical_host"}, cooldown_rounds=0,
+        trend={"metric": "ledger/straggler_wait_share", "stat": "slope",
+               "op": ">", "threshold": threshold, "window": window,
+               "min_points": 3, "labels": labels or {}})]
+    return PolicyEngine(cfg, rules=rules, actuator=Actuator(),
+                        registry=MetricsRegistry(),
+                        bucket=TokenBucket(10, 60.0), series=series)
+
+
+def _firing(rule="straggler_host"):
+    return {"rule": rule, "state": "firing",
+            "metric": "lgbm_hybrid_host_slow", "kind": "sustained",
+            "value": 2.0, "threshold": 1.0, "tick": 4}
+
+
+def test_trend_guard_fails_closed():
+    spec = {"metric": "m", "stat": "slope", "op": ">", "threshold": 0.0,
+            "window": 8, "min_points": 3, "labels": {}}
+    assert trend_guard_ok(spec, None, {}) is False      # no store
+    store = SeriesStore()
+    assert trend_guard_ok(spec, store, {}) is False     # no series
+    store.observe("m", 1, 1.0)
+    store.observe("m", 2, 2.0)
+    assert trend_guard_ok(spec, store, {}) is False     # < min_points
+    store.observe("m", 3, 3.0)
+    assert trend_guard_ok(spec, store, {}) is True      # growing
+
+    # $label resolution: unresolvable context fails closed
+    pinned = dict(spec, labels={"host": "$critical_host"})
+    labeled = SeriesStore()
+    for t in range(1, 4):
+        labeled.observe("m", t, float(t), host="2")
+    assert trend_guard_ok(pinned, labeled, {}) is False
+    assert trend_guard_ok(pinned, labeled, {"critical_host": 2}) is True
+    assert trend_guard_ok(pinned, labeled, {"critical_host": 0}) is False
+
+
+def test_trend_guard_ewma_stat():
+    spec = {"metric": "m", "stat": "ewma", "op": ">", "threshold": 0.5,
+            "window": 8, "min_points": 3, "labels": {}}
+    store = SeriesStore()
+    for t in range(1, 6):
+        store.observe("m", t, 0.9)
+    assert trend_guard_ok(spec, store, {}) is True
+    low = SeriesStore()
+    for t in range(1, 6):
+        low.observe("m", t, 0.1)
+    assert trend_guard_ok(spec, low, {}) is False
+
+
+def test_trend_guarded_rule_suppressed_without_store_no_cooldown():
+    """A trend-guarded rule with no SeriesStore NEVER dispatches (fail
+    closed) and the suppression does not start the cooldown — the rule
+    dispatches on the first round the guard actually holds."""
+    eng = _guarded_engine(series=None)
+    seen = []
+    eng.actuator.bind("demote_host", lambda a: seen.append(a))
+    assert eng.on_round(1, transitions=[_firing()],
+                        ledger={"critical_host": 2}) == []
+    assert seen == []
+    fam = eng.registry.collect().get("lgbm_policy_suppressed_total", {})
+    sup = {labels.get("reason"): v
+           for labels, v in fam.get("values", [])}
+    assert sup.get("trend_guard", 0) >= 1
+
+    # same engine shape WITH a store showing growth: dispatches
+    store = SeriesStore()
+    for t in range(1, 5):
+        store.observe("ledger/straggler_wait_share", t, 0.1 * t)
+    eng2 = _guarded_engine(series=store)
+    seen2 = []
+    eng2.actuator.bind("demote_host", lambda a: seen2.append(a))
+    (d,) = eng2.on_round(5, transitions=[_firing()],
+                         ledger={"critical_host": 2})
+    assert d["status"] == "ok" and seen2 == [{"orig": 2}]
+
+
+# ------------------------------------- acceptance: trend vs sustained
+
+def test_gradual_ramp_fires_trend_not_sustained_threshold():
+    """The tentpole's acceptance shape: straggler-wait share ramps
+    GRADUALLY (never crossing the sustained level threshold), so the
+    sustained rule stays silent — but the trend rule sees the slope and
+    fires, and the trend-guarded demote dispatches on the stub
+    actuator.  A high-but-FLAT share must not fire the trend rule."""
+    reg = MetricsRegistry()
+    share = reg.gauge("lgbm_cluster_straggler_share")
+    rules = [
+        Rule("share_level", "lgbm_cluster_straggler_share", ">", 0.5,
+             "sustained", for_ticks=3),
+        Rule("share_trend", "lgbm_cluster_straggler_share", ">", 0.01,
+             "trend", stat="slope", window=8, min_points=3),
+    ]
+    alerts = AlertEngine(reg, rules=rules)
+    store = SeriesStore()
+    eng = PolicyEngine(
+        Config({"objective": "regression", "verbosity": -1,
+                "tpu_policy": True}),
+        rules=[PolicyRule(
+            "demote", when={"alert": "share_trend"}, action="demote_host",
+            args={"orig": 2}, cooldown_rounds=100,
+            trend={"metric": "lgbm_cluster_straggler_share",
+                   "stat": "slope", "op": ">", "threshold": 0.01,
+                   "window": 8, "min_points": 3})],
+        actuator=Actuator(), registry=MetricsRegistry(),
+        bucket=TokenBucket(10, 60.0), series=store)
+    dispatched = []
+    eng.actuator.bind("demote_host", lambda a: dispatched.append(a))
+
+    fired = []
+    # share climbs 0.03/round: 0.05 -> 0.41, never past the 0.5 level
+    for rnd in range(1, 13):
+        share.set(0.05 + 0.03 * rnd)
+        store.observe("lgbm_cluster_straggler_share", rnd,
+                      share.value)
+        transitions = alerts.evaluate(tick=rnd)
+        fired.extend(t["rule"] for t in transitions
+                     if t["state"] == "firing")
+        eng.on_round(rnd, transitions=transitions, ledger={})
+    assert "share_trend" in fired
+    assert "share_level" not in fired          # sustained never fired
+    assert dispatched == [{"orig": 2}]
+
+    # control: high but FLAT share — level fires, trend stays silent
+    reg2 = MetricsRegistry()
+    flat = reg2.gauge("lgbm_cluster_straggler_share")
+    alerts2 = AlertEngine(reg2, rules=[
+        Rule("share_level", "lgbm_cluster_straggler_share", ">", 0.5,
+             "sustained", for_ticks=3),
+        Rule("share_trend", "lgbm_cluster_straggler_share", ">", 0.01,
+             "trend", stat="slope", window=8, min_points=3)])
+    fired2 = []
+    for rnd in range(1, 9):
+        flat.set(0.8)
+        fired2.extend(t["rule"] for t in alerts2.evaluate(tick=rnd)
+                      if t["state"] == "firing")
+    assert fired2 == ["share_level"]
+
+
+# ------------------------------------------- federation + training
+
+def _train_data(n=300, nf=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, nf)
+    y = 2.0 * X[:, 0] - X[:, 1] + 0.05 * rng.randn(n)
+    return X, y
+
+
+def test_federation_annotates_ledger_and_cluster_with_trends(tmp_path):
+    X, y = _train_data(seed=5)
+    tele = str(tmp_path / "tele.jsonl")
+    params = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5, "tpu_federation": True,
+              "tpu_trend": True, "tpu_telemetry_path": tele}
+    lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=6)
+    events = [json.loads(l) for l in open(tele)]
+    ledgers = [e for e in events if e["event"] == "round_ledger"]
+    assert len(ledgers) == 6
+    # trends ride the ledger once enough points exist
+    trended = [e for e in ledgers if e.get("trends")]
+    assert trended, "no ledger carried a trends block"
+    legs = trended[-1]["trends"]
+    assert "straggler_wait" in legs and "compute" in legs
+    for leg in legs.values():
+        assert set(leg) >= {"share", "slope", "ewma"}
+    cluster = [e for e in events if e["event"] == "cluster"][-1]
+    assert "trends" in cluster
+    assert set(cluster["trends"]) == {"legs", "hosts"}
+
+
+def test_training_bitwise_identical_with_store_and_runhist(tmp_path):
+    """The tentpole's non-perturbation guarantee: trend store + RUNHIST
+    enabled changes NOTHING about the model."""
+    X, y = _train_data(seed=7)
+    params = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5, "boost_from_average": True}
+    runhist = str(tmp_path / "run.runhist.json")
+    b_on = lgb.train(dict(params, tpu_federation=True, tpu_trend=True,
+                          tpu_runhist_path=runhist),
+                     lgb.Dataset(X, label=y), num_boost_round=5)
+    b_off = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                      num_boost_round=5)
+    assert b_on.model_to_string() == b_off.model_to_string()
+    doc = read_runhist(runhist)
+    assert doc["meta"]["kind"] == "train"
+    assert doc["meta"]["iterations"] == 5
+    assert doc["phases"], "no phase series reached the RUNHIST"
+    assert "train/wall_ms" in doc["metrics"]
+
+
+def test_policy_dry_run_with_trends_bitwise_identical(tmp_path):
+    """The full sensor+policy stack in dry-run — federation, alerts,
+    trend store, trend-guarded policy — must not move a single bit of
+    the model vs everything off."""
+    X, y = _train_data(seed=11)
+    params = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+              "min_data_in_leaf": 5}
+    b_on = lgb.train(dict(params, tpu_federation=True, tpu_alert=True,
+                          tpu_trend=True, tpu_policy=True,
+                          tpu_policy_dry_run=True,
+                          tpu_policy_trend_guard=True,
+                          tpu_telemetry_path=str(tmp_path / "t.jsonl")),
+                     lgb.Dataset(X, label=y), num_boost_round=5)
+    b_off = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                      num_boost_round=5)
+    assert b_on.model_to_string() == b_off.model_to_string()
+
+
+def test_runhist_written_without_telemetry_stream(tmp_path):
+    """tpu_runhist_path alone (no tpu_telemetry_path) still builds the
+    recorder + store and writes the artifact — and no JSONL stream
+    appears anywhere."""
+    X, y = _train_data(seed=9)
+    runhist = str(tmp_path / "solo.runhist.json")
+    lgb.train({"objective": "regression", "num_leaves": 15, "verbose": -1,
+               "min_data_in_leaf": 5, "tpu_runhist_path": runhist},
+              lgb.Dataset(X, label=y), num_boost_round=4)
+    doc = read_runhist(runhist)
+    assert doc["meta"]["iterations"] == 4
+    assert doc["phases"]
+    assert os.listdir(str(tmp_path)) == ["solo.runhist.json"]
+
+
+def test_serving_trends_endpoint(tmp_path):
+    import urllib.error
+    import urllib.request
+    from lightgbm_tpu.serving import Server
+
+    X, y = _train_data()
+    bst = lgb.Booster(params={"objective": "regression", "num_leaves": 7,
+                              "verbose": -1, "min_data_in_leaf": 5},
+                      train_set=lgb.Dataset(X, label=y))
+    bst.update()
+
+    def get(port, route):
+        resp = urllib.request.urlopen(
+            "http://127.0.0.1:%d%s" % (port, route), timeout=30)
+        return json.loads(resp.read().decode())
+
+    srv = Server(Config({"verbose": "-1", "tpu_trend": "true"}))
+    srv.load_model("m", model_str=bst.model_to_string())
+    httpd = srv.serve_http(port=0, block=False)
+    try:
+        port = httpd.server_address[1]
+        srv.predict(X[:4], model="m")
+        srv.stats_snapshot()          # stats tick samples the store
+        doc = get(port, "/trends")
+        assert doc["tick"] >= 1 and isinstance(doc["series"], dict)
+        assert any(k.startswith("lgbm_serve_requests_total")
+                   for k in doc["series"])
+    finally:
+        srv.shutdown()
+
+    # disabled -> 404, mirroring the other optional planes
+    srv2 = Server(Config({"verbose": "-1"}))
+    srv2.load_model("m", model_str=bst.model_to_string())
+    httpd2 = srv2.serve_http(port=0, block=False)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get(httpd2.server_address[1], "/trends")
+        assert ei.value.code == 404
+    finally:
+        srv2.shutdown()

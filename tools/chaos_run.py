@@ -573,6 +573,29 @@ def run_policy_scenario(scenario: str, hosts: int = 3, local: int = 2,
     off_actions = _policy_actions(off_ev)
     elastic_whats = [e.get("what") for e in live_ev
                      if e.get("event") == "elastic"]
+
+    def _elastic_ts(events, what, orig=None):
+        return [float(e["ts"]) for e in events
+                if e.get("event") == "elastic" and e.get("what") == what
+                and e.get("ts") is not None
+                and (orig is None or e.get("orig_rank") == orig)]
+
+    # rejoin-latency bound: once the epoch is announced the victim must
+    # be back in the world fast — its parked petition connection gets
+    # the announcement PUSHED (petition_wake) or its next knock lands
+    # straight in the new formation window; either way the victim's
+    # "rejoined" event must land within 1.5 s of the first epoch, well
+    # under a petition-poll timeout plus back-off.  (petition_wake is
+    # reported when the parked path was exercised; the unit tests pin
+    # its sub-second push bound deterministically.)
+    epoch_ts = _elastic_ts(live_ev, "epoch")
+    rejoin_ts = _elastic_ts(live_ev, "rejoined", orig=victim)
+    wake_ts = _elastic_ts(live_ev, "petition_wake", orig=victim)
+    rejoin_latency = (min(t - min(epoch_ts) for t in rejoin_ts
+                          if t >= min(epoch_ts))
+                      if epoch_ts and any(t >= min(epoch_ts)
+                                          for t in rejoin_ts) else None)
+    ok_wake = rejoin_latency is not None and rejoin_latency <= 1.5
     # LIVE: full-world finish through demote -> petition -> epoch, with
     # both actions recorded as dispatched ("ok")
     ok_live = (len(live_c) == hosts and len(_digests(live_res)) == 1
@@ -587,6 +610,7 @@ def run_policy_scenario(scenario: str, hosts: int = 3, local: int = 2,
                        for a in live_actions)
                and "petition" in elastic_whats
                and "epoch" in elastic_whats
+               and ok_wake
                and "firing" in _alert_states(live_ev, "straggler_host"))
     # DRY RUN: decisions recorded, nothing dispatched, zero re-forms,
     # and the incident plays out exactly like policy-off
@@ -620,6 +644,10 @@ def run_policy_scenario(scenario: str, hosts: int = 3, local: int = 2,
             (a.get("rule"), a.get("action"), a.get("status"))
             for a in dry_actions],
         "live_elastic_events": elastic_whats,
+        "rejoin_latency_s": (round(rejoin_latency, 4)
+                             if rejoin_latency is not None else None),
+        "rejoin_latency_ok": ok_wake,
+        "petition_wakes": len(wake_ts),
         "live_alerts": _alert_states(live_ev, "straggler_host"),
         "dry_run_alerts": _alert_states(dry_ev, "straggler_host"),
         "total_s": round(time.monotonic() - t0, 3),

@@ -73,6 +73,28 @@ def render(events: List[dict], last: int = 0) -> str:
         lines.append("top critical phases: " + "  ".join(
             "%s %.0fms" % (name, ms) for name, ms in top))
 
+    # trend observatory (obs/timeseries.py): the hub annotates each
+    # ledger with per-leg share / slope / EWMA once the window has
+    # enough points — the LAST annotated ledger is the run's verdict
+    # ("straggler_wait share 0.31 and growing" beats a raw table)
+    trended = [led for led in ledgers if led.get("trends")]
+    if trended:
+        legs = trended[-1]["trends"]
+        cells = []
+        for leg in ("compute", "mesh_psum", "leader_wire",
+                    "straggler_wait"):
+            t = legs.get(leg)
+            if not t:
+                continue
+            slope = t.get("slope")
+            arrow = ("flat" if slope is None or abs(slope) < 1e-6
+                     else ("growing" if slope > 0 else "shrinking"))
+            cells.append("%s %.0f%% %s" % (
+                leg, 100.0 * float(t.get("share", 0.0) or 0.0), arrow))
+        if cells:
+            lines.append("trends (round %s): " % trended[-1].get("round")
+                         + "  ".join(cells))
+
     lines.append("")
     lines.append("%6s %9s %9s %9s %9s %10s  %s"
                  % ("round", "wall_ms", "compute", "psum", "wire",

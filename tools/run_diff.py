@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Trajectory-aware regression diff between two RUNHIST artifacts.
+
+Where tools/trace_check.py enforces a static single-floor baseline,
+run_diff compares two END-OF-RUN histories (the RUNHIST JSON the
+recorder writes at ``tpu_runhist_path``, or tools/serve_bench.py
+``--runhist``) phase by phase and metric by metric, with tolerance
+bands — "this PR made tree_grow 12% slower per round" or "p99 grew a
+fat tail above the old p99" fails CI with the exact numbers, instead of
+landing as an anecdote.
+
+What is compared (only sections present in BOTH artifacts):
+
+- ``phases``: per-phase mean/p50 round milliseconds.  A phase is a
+  REGRESSION when the new mean exceeds the base mean by more than
+  ``--tolerance`` (relative) AND ``--min-ms`` (absolute floor — noise
+  on a 0.1 ms phase is not a finding).
+- ``metrics``: per-metric windowed means.  Direction is inferred from
+  the name: time/wait/shed/failure-shaped metrics regress UP, eval
+  losses regress UP, score-shaped metrics (auc, ndcg, map) regress
+  DOWN; anything unrecognized is informational only.
+- ``histograms``: full-resolution latency shapes (serve_bench).  p50 /
+  p90 / p99 / max regress UP like phases, so a fattened tail is caught
+  even when the median moved nowhere.
+
+Exit codes (trace_check contract): 0 = within bands, 1 = regression,
+2 = unreadable input.
+
+Usage:
+    python tools/run_diff.py BASE.runhist.json NEW.runhist.json \
+        [--tolerance 0.15] [--min-ms 1.0] [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+# name fragments -> regression direction for the metrics section
+_UP_BAD = ("ms", "seconds", "wait", "shed", "fail", "miss", "drop",
+           "error", "rollback", "retrace", "evict", "spill", "slow",
+           "l1", "l2", "rmse", "mse", "mae", "logloss", "error_rate",
+           "quantile_loss", "huber")
+_DOWN_BAD = ("auc", "ndcg", "map", "accuracy", "efficiency")
+
+
+def _key_parts(key: str) -> List[str]:
+    name = key.split("{", 1)[0].lower()
+    return name.replace(":", "/").replace("_", "/").split("/")
+
+
+def metric_direction(key: str) -> Optional[str]:
+    """'up_bad' | 'down_bad' | None (informational) for a series key."""
+    parts = _key_parts(key)
+    if any(p in _DOWN_BAD for p in parts):
+        return "down_bad"
+    if any(p in _UP_BAD for p in parts):
+        return "up_bad"
+    return None
+
+
+def _worse(base: float, new: float, direction: str, tolerance: float,
+           min_abs: float) -> bool:
+    if direction == "down_bad":
+        return new < base * (1.0 - tolerance) - min_abs
+    return new > base * (1.0 + tolerance) + min_abs
+
+
+def _block_value(block: Dict, field: str = "mean") -> Optional[float]:
+    v = block.get(field)
+    if v is None:
+        v = block.get("mean")
+    return None if v is None else float(v)
+
+
+def diff(base: Dict, new: Dict, tolerance: float = 0.15,
+         min_ms: float = 1.0) -> Dict:
+    """Compare two RUNHIST documents -> {regressions, improvements,
+    info, compared} finding lists (each entry is a printable dict)."""
+    out: Dict[str, List[Dict]] = {"regressions": [], "improvements": [],
+                                  "info": []}
+    compared = 0
+
+    def judge(section: str, key: str, field: str, b: float, n: float,
+              direction: Optional[str], min_abs: float) -> None:
+        nonlocal compared
+        compared += 1
+        entry = {"section": section, "key": key, "field": field,
+                 "base": round(b, 4), "new": round(n, 4),
+                 "delta": round(n - b, 4),
+                 "ratio": round(n / b, 4) if b else None}
+        if direction is None:
+            out["info"].append(entry)
+        elif _worse(b, n, direction, tolerance, min_abs):
+            out["regressions"].append(entry)
+        elif _worse(n, b, direction, tolerance, min_abs):
+            out["improvements"].append(entry)
+
+    bp, np_ = base.get("phases") or {}, new.get("phases") or {}
+    for name in sorted(set(bp) & set(np_)):
+        for field in ("mean", "p50"):
+            b = _block_value(bp[name], field)
+            n = _block_value(np_[name], field)
+            if b is not None and n is not None:
+                judge("phase", name, field, b, n, "up_bad", min_ms)
+    bm, nm = base.get("metrics") or {}, new.get("metrics") or {}
+    for key in sorted(set(bm) & set(nm)):
+        b = _block_value(bm[key])
+        n = _block_value(nm[key])
+        if b is None or n is None:
+            continue
+        direction = metric_direction(key)
+        # token match, not substring: "rmse" must not inherit the
+        # milliseconds noise floor
+        parts = _key_parts(key)
+        min_abs = min_ms if direction == "up_bad" \
+            and ("ms" in parts or "seconds" in parts) else 0.0
+        judge("metric", key, "mean", b, n, direction, min_abs)
+    bh, nh = base.get("histograms") or {}, new.get("histograms") or {}
+    for key in sorted(set(bh) & set(nh)):
+        for field in ("p50", "p90", "p99", "max"):
+            b, n = bh[key].get(field), nh[key].get(field)
+            if b is not None and n is not None:
+                judge("histogram", key, field, float(b), float(n),
+                      "up_bad", min_ms)
+    out["compared"] = compared
+    return out
+
+
+def _fmt(entry: Dict) -> str:
+    ratio = ("%+.1f%%" % ((entry["ratio"] - 1.0) * 100)
+             if entry.get("ratio") else "n/a")
+    return "%s %r %s: %.4f -> %.4f (%s)" % (
+        entry["section"], entry["key"], entry["field"],
+        entry["base"], entry["new"], ratio)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff two RUNHIST artifacts with tolerance bands")
+    ap.add_argument("base", help="baseline RUNHIST JSON")
+    ap.add_argument("new", help="candidate RUNHIST JSON")
+    ap.add_argument("--tolerance", type=float, default=0.15,
+                    help="relative band before a change is a finding "
+                         "(default 0.15 = 15%%)")
+    ap.add_argument("--min-ms", type=float, default=1.0,
+                    help="absolute floor for time-shaped findings "
+                         "(default 1.0 ms)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the findings object as JSON")
+    args = ap.parse_args(argv)
+
+    docs = []
+    for path in (args.base, args.new):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            if not isinstance(doc, dict) or "runhist" not in doc:
+                raise ValueError("no runhist key — not a RUNHIST artifact")
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            print("run_diff: cannot read %s: %s" % (path, exc),
+                  file=sys.stderr)
+            return 2
+        docs.append(doc)
+
+    findings = diff(docs[0], docs[1], tolerance=args.tolerance,
+                    min_ms=args.min_ms)
+    if args.json:
+        print(json.dumps(findings, indent=1, sort_keys=True))
+    else:
+        print("run_diff %s -> %s: %d comparisons, %d regressions, "
+              "%d improvements"
+              % (args.base, args.new, findings["compared"],
+                 len(findings["regressions"]),
+                 len(findings["improvements"])))
+        for entry in findings["improvements"]:
+            print("  better: %s" % _fmt(entry))
+    if findings["regressions"]:
+        for entry in findings["regressions"]:
+            print("REGRESSION: %s" % _fmt(entry), file=sys.stderr)
+        return 1
+    if not args.json:
+        print("within bands (tolerance %.0f%%, min %.1f ms)"
+              % (args.tolerance * 100, args.min_ms))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

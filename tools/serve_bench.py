@@ -119,7 +119,23 @@ def _run_open_loop(server, rows, offered_qps, duration_s, rng):
     return {"offered_qps": round(offered_qps, 1),
             "achieved_qps": round(done / wall, 1),
             "sent": len(sched), "completed": done, "errors": errors[0],
-            "p50_ms": round(p50, 3), "p99_ms": round(p99, 3)}
+            "p50_ms": round(p50, 3), "p99_ms": round(p99, 3),
+            "histogram": _lat_histogram(lat)}
+
+
+# log-spaced millisecond bounds wide enough for an overloaded level —
+# the FULL bucket-resolution shape rides into the RUNHIST artifact so
+# tools/run_diff.py compares tails, not just the p50/p99 scalars
+_LAT_BOUNDS_MS = (0.25, 0.5, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512,
+                  1024, 2048, 4096)
+
+
+def _lat_histogram(lat_ms):
+    from lightgbm_tpu.obs.registry import Histogram
+    h = Histogram(_LAT_BOUNDS_MS)
+    for v in lat_ms:
+        h.observe(v)
+    return h.snapshot()
 
 
 def _open_loop_main(args):
@@ -138,15 +154,33 @@ def _open_loop_main(args):
 
     qps_levels = [float(q) for q in args.qps.split(",")]
     arrivals = np.random.RandomState(7)
-    levels = {}
+    levels, histograms = {}, {}
     for q in qps_levels:
         r = _run_open_loop(server, rows, q, args.duration_s, arrivals)
+        histograms["latency_ms@%gqps" % q] = r.pop("histogram")
         levels["%g" % q] = r
         print("offered %8.1f qps: achieved %8.1f qps  p50=%.2f ms  "
               "p99=%.2f ms  errors=%d"
               % (q, r["achieved_qps"], r["p50_ms"], r["p99_ms"],
                  r["errors"]))
     server.shutdown()
+
+    if args.runhist:
+        from lightgbm_tpu.obs.timeseries import SeriesStore, write_runhist
+        store = SeriesStore()
+        for i, q in enumerate(qps_levels):
+            r = levels["%g" % q]
+            for field in ("achieved_qps", "p50_ms", "p99_ms", "errors"):
+                store.observe("serve/%s" % field, i + 1, r[field],
+                              qps="%g" % q)
+        ok = write_runhist(args.runhist, {
+            "kind": "serve_bench", "mode": "open_loop_poisson",
+            "duration_s": args.duration_s, "trees": args.trees,
+            "qps_levels": [("%g" % q) for q in qps_levels],
+        }, store, histograms=histograms)
+        if ok:
+            print("RUNHIST written to %s (%d latency histograms)"
+                  % (args.runhist, len(histograms)))
 
     # headline: tail latency at the highest offered level the server
     # actually sustained (achieved within 10% of offered)
@@ -186,6 +220,10 @@ def _parse_args(argv):
                     help="comma-separated offered QPS levels")
     ap.add_argument("--duration-s", type=float, default=5.0,
                     help="seconds per offered-QPS level")
+    ap.add_argument("--runhist", metavar="PATH", default="",
+                    help="open-loop mode: write a RUNHIST artifact with "
+                         "the FULL latency histogram per QPS level "
+                         "(diffable by tools/run_diff.py)")
     args = ap.parse_args(argv)
     if args.trees_pos is not None:
         args.trees = args.trees_pos

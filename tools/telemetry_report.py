@@ -93,6 +93,18 @@ def _render_cluster(events: List[dict]) -> List[str]:
             lines.append("critical path: " + "  ".join(
                 "%s %.0fms" % (name, ms) for name, ms in top)
                 + "   (per-round: python tools/round_report.py)")
+        # trend observatory: last annotated ledger's per-leg trajectory
+        trended = [led for led in ledgers if led.get("trends")]
+        if trended:
+            cells = []
+            for leg, t in sorted(trended[-1]["trends"].items()):
+                slope = t.get("slope")
+                arrow = ("flat" if slope is None or abs(slope) < 1e-6
+                         else ("growing" if slope > 0 else "shrinking"))
+                cells.append("%s %.0f%% %s" % (
+                    leg, 100.0 * float(t.get("share", 0.0) or 0.0),
+                    arrow))
+            lines.append("leg trends: " + "  ".join(cells))
 
     # alert transitions interleaved with the policy actions they drove
     # (control/engine.py records one policy_action per decision; tick

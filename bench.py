@@ -666,6 +666,67 @@ def mesh_smoke(on_tpu):
         return {"error": "FAILED: %s" % e}
 
 
+def scaling_smoke(on_tpu):
+    """Scaling-forensics drill (dict in `detail`).
+
+    Runs tools/scaling_report.py --json in a subprocess over a 2-world
+    CPU mesh (virtual devices off-TPU) and checks the tentpole
+    invariants: every world produced a non-empty step decomposition,
+    the clean round path tripped zero sentinel sync events, and the
+    waterfall legs sum to the measured round wall within tolerance
+    (residual share <= 10%).  The w=2 host share feeds the perf ledger
+    as a ceiling metric (mesh2_host_share).  Never fails the bench: any
+    problem becomes an `error` entry.
+    """
+    import os
+    import subprocess
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    if not on_tpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(here, "tools", "scaling_report.py"),
+             "--worlds", "1,2", "--rows", "1024", "--features", "12",
+             "--iters", "2", "--json"],
+            capture_output=True, text=True, timeout=2400, env=env)
+        if proc.returncode not in (0, 1):
+            return {"error": "rc=%d %s" % (
+                proc.returncode, (proc.stderr or "").strip()[-400:])}
+        rep = json.loads(proc.stdout.strip().splitlines()[-1])
+        wf = rep.get("waterfall", {})
+        entries = [e for kind in wf.values() for e in kind.values()]
+        sync_events = sum(int(r.get("sync_events", 0))
+                          for r in rep.get("runs", {}).values())
+        w2 = [e for kind in wf.values() for w, e in kind.items()
+              if int(w) == 2]
+        out = {
+            "gate_rc": proc.returncode,
+            "worlds": rep.get("worlds"),
+            "decomp_nonempty": bool(entries) and all(
+                e.get("measured_ms", 0) > 0 for e in entries),
+            "sync_events_clean": sync_events,
+            "legs_sum_ok": bool(entries) and all(
+                e.get("residual_share", 1.0) <= 0.10 for e in entries),
+            "mesh2_host_share": (max(e["host_share"] for e in w2)
+                                 if w2 else None),
+            "dominant_loss": {
+                kind: {w: e["dominant_loss"] for w, e in sorted(
+                    wf[kind].items(), key=lambda kv: int(kv[0]))}
+                for kind in sorted(wf)},
+            "breaches": rep.get("breaches", []),
+        }
+        out["ok"] = (out["decomp_nonempty"] and out["legs_sum_ok"]
+                     and sync_events == 0)
+        return out
+    except Exception as e:  # noqa: BLE001 — smoke only, never fatal
+        return {"error": "FAILED: %s" % e}
+
+
 def supervisor_smoke():
     """Continuous-learning loop drill (one line in `detail`).
 
@@ -940,6 +1001,7 @@ def main():
             },
             "quality_ok": ok,
             "mesh_scaling": mesh_smoke(on_tpu),
+            "scaling_smoke": scaling_smoke(on_tpu),
             "hybrid_smoke": hybrid_smoke(),
             "cluster_smoke": cluster_smoke(),
             "trace_smoke": trace_smoke(lgb),

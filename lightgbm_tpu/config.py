@@ -441,6 +441,23 @@ _SCHEMA = [
     #   JSON artifact (per-phase + per-metric windowed summaries and
     #   series tails) here; tools/run_diff.py diffs two artifacts with
     #   tolerance bands and a nonzero exit on regression
+    # --- scaling forensics (obs/scaling.py): per-round host/device step
+    #   decomposition, the runtime sync sentinel and the efficiency
+    #   waterfall (tools/scaling_report.py).  Strictly read-only —
+    #   training is bitwise-identical with it on or off.  See
+    #   docs/ScalingForensics.md
+    ("tpu_sync_guard", str, "off"),          # runtime sync sentinel mode:
+    #   "off" (default, zero overhead), "log" (count + stack-attribute
+    #   every implicit device->host scalar fetch inside the round as a
+    #   sync_event), or "fail" (raise at the first un-exempted sync)
+    ("tpu_scaling_decomp", bool, True),      # attach a step_decomp section
+    #   (host_sync / leader_wire / psum / dispatch legs) to each recorder
+    #   round event and the lgbm_scaling_* gauges
+    ("tpu_scaling_window", int, 8),          # rounds between the device
+    #   chain probes (one dependent scalar fetch each, obs/perf timing
+    #   discipline); larger amortizes the tunnel sync further
+    ("tpu_scaling_ici_gbps", float, 45.0),   # assumed per-link ICI
+    #   bandwidth for the analytic psum leg (bytes moved / this rate)
 ]
 
 # alias -> canonical name (src/io/config_auto.cpp:4-157)
@@ -594,6 +611,12 @@ ALIAS_TABLE: Dict[str, str] = {
     "trend_guard": "tpu_policy_trend_guard",
     "runhist": "tpu_runhist_path",
     "runhist_path": "tpu_runhist_path",
+    "sync_guard": "tpu_sync_guard",
+    "transfer_guard": "tpu_sync_guard",
+    "scaling_decomp": "tpu_scaling_decomp",
+    "step_decomp": "tpu_scaling_decomp",
+    "scaling_window": "tpu_scaling_window",
+    "scaling_ici_gbps": "tpu_scaling_ici_gbps",
 }
 
 PARAMETER_TYPES: Dict[str, Any] = {name: typ for name, typ, _ in _SCHEMA}
@@ -960,6 +983,15 @@ class Config:
         if self.tpu_alert_trend_slope <= 0:
             log.fatal("tpu_alert_trend_slope must be > 0, got %g"
                       % self.tpu_alert_trend_slope)
+        if self.tpu_sync_guard not in ("off", "log", "fail"):
+            log.fatal("tpu_sync_guard must be 'off', 'log' or 'fail', "
+                      "got %r" % self.tpu_sync_guard)
+        if self.tpu_scaling_window < 1:
+            log.fatal("tpu_scaling_window must be >= 1, got %d"
+                      % self.tpu_scaling_window)
+        if self.tpu_scaling_ici_gbps <= 0:
+            log.fatal("tpu_scaling_ici_gbps must be > 0, got %g"
+                      % self.tpu_scaling_ici_gbps)
 
     def is_single_machine(self) -> bool:
         return self.num_machines <= 1

@@ -181,6 +181,17 @@ def train(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100
             keep_last_n=cfg.tpu_checkpoint_keep,
             rank=max(cfg.machine_rank, 0))))
 
+    sentinel = getattr(booster._gbdt, "sync_sentinel", None)
+    if sentinel is not None and sentinel.mode == "fail" and cfg.tpu_profile:
+        # the profiler's per-phase sync is a KNOWN legitimate fetch; it
+        # runs under obs.scaling.exempt() (a scoped transfer_guard
+        # context, not a global opt-out), so fail mode stays usable —
+        # but say so once up front rather than surprising the operator
+        log.warning("tpu_sync_guard=fail with tpu_profile: the perf "
+                    "probe's per-phase float() sync is exempted via a "
+                    "scoped transfer-guard context and will not trip the "
+                    "sentinel")
+
     cb_before = {cb for cb in callbacks
                  if getattr(cb, "before_iteration", False)}
     cb_after = callbacks - cb_before

@@ -26,6 +26,7 @@ from ..objective import ObjectiveFunction
 from ..ops import grow as grow_ops
 from ..ops import predict as predict_ops
 from ..ops.split import SplitParams
+from ..obs import scaling as obs_scaling
 from ..obs import tracing as obs_tracing
 from ..utils import log
 from .tree import Tree
@@ -213,6 +214,11 @@ class GBDT:
             except Exception as exc:  # noqa: BLE001
                 log.warning("cluster federation disabled: init failed (%s)",
                             exc)
+        # runtime sync sentinel (obs/scaling.py): tpu_sync_guard=log|fail
+        # wraps each round's training impl so implicit device->host
+        # fetches become counted, stack-attributed sync_event telemetry;
+        # None in the default "off" mode (zero overhead)
+        self.sync_sentinel = obs_scaling.SyncSentinel.from_config(config)
 
         if train_set is not None:
             self._setup_train(train_set)
@@ -222,7 +228,10 @@ class GBDT:
         """Device sync for phase timing: a dependent scalar fetch (plain
         block_until_ready is unreliable through remote device tunnels)."""
         if self.train_state is not None:
-            float(jnp.sum(self.train_state.score[:, :1]))
+            # the ONE sanctioned per-phase sync; scoped exemption keeps
+            # the sentinel's fail mode usable alongside tpu_profile
+            with obs_scaling.exempt():
+                float(jnp.sum(self.train_state.score[:, :1]))
 
     def profile_report(self):
         return self.profiler.report(header="tpu_profile")
@@ -354,6 +363,12 @@ class GBDT:
         from ..parallel import learners as par_learners
         self._grower = par_learners.make_grower(self.config,
                                                 train_set.num_features)
+        if self._grower is not None:
+            # donation forensics ride the telemetry opt-in: the audit
+            # costs one extra lowering per partition build, so it arms
+            # only when an observer (recorder/tracer) will consume it
+            self._grower.audit_donation = (self.recorder is not None
+                                           or self._tracing)
         self._setup_tree_engine()
         # bagging state
         self._bag_mask: Optional[jnp.ndarray] = None
@@ -438,12 +453,24 @@ class GBDT:
         subclasses override): times the round and hands the recorder one
         event per iteration, for every boosting mode."""
         it = self.iter
+        # the sentinel wraps ONLY the training impl: telemetry's own
+        # bulk fetches (recorder/federation, below) run outside the
+        # guard, so a clean round reports zero sync events
+        sentinel = self.sync_sentinel
         if self.recorder is None and self.federation is None:
             with obs_tracing.span("train/iteration", "train", iter=it):
-                return self._train_one_iter_impl(gradients, hessians)
+                if sentinel is None:
+                    return self._train_one_iter_impl(gradients, hessians)
+                with sentinel.guard(it):
+                    return self._train_one_iter_impl(gradients, hessians)
         t0 = time.perf_counter()
         with obs_tracing.span("train/iteration", "train", iter=it):
-            finished = self._train_one_iter_impl(gradients, hessians)
+            if sentinel is None:
+                finished = self._train_one_iter_impl(gradients, hessians)
+            else:
+                with sentinel.guard(it):
+                    finished = self._train_one_iter_impl(gradients,
+                                                         hessians)
         wall = time.perf_counter() - t0
         if self.recorder is not None:
             try:
@@ -786,16 +813,22 @@ class GBDT:
             # Must run BEFORE the executing call — arena and score are
             # donated, so their buffers are dead afterwards.
             from ..obs import device as obs_device
+            # resident flattened leaves: bins_t (1), the dataset field
+            # planes (3..) and row_all_in — persistent across rounds, so
+            # un-donatable by design; arena (0) and score (2) ARE donated
+            n_field = len(jax.tree_util.tree_leaves(field_vals))
             obs_device.analyze_compiled(
                 self._fused_fn, args,
                 signature="leaves=%d depth=%d bin=%d cat=%d rows=%d" % (
-                    key + (self.num_data,)))
+                    key + (self.num_data,)),
+                donation_resident=(1, *range(3, 4 + n_field)))
         ivecs, fvecs, new_score, arena = self._fused_fn(*args)
         if not getattr(self, "_fused_validated", False):
             # force materialization once so a device runtime fault raises
             # HERE (inside the fallback guard) instead of at a later
             # async fetch
-            int(ivecs[0][-1])
+            with obs_scaling.exempt():   # one-shot fault-surfacing sync
+                int(ivecs[0][-1])
             self._fused_validated = True
         self._arena = arena
         self.train_state.score = new_score
@@ -972,7 +1005,8 @@ class GBDT:
             self.train_state.missing_types, self.split_params,
             self.monotone, self.penalty, sh, qkey)
         if not getattr(self, "_fused_validated", False):
-            int(ivec[-1])
+            with obs_scaling.exempt():   # one-shot fault-surfacing sync
+                int(ivec[-1])
             self._fused_validated = True
         self._arena = arena
         self._carry_parity = 1 - p
@@ -1377,7 +1411,8 @@ class GBDT:
                     # otherwise surface a device runtime fault later at
                     # device_get, OUTSIDE this try (one host round trip,
                     # first tree only)
-                    int(arrays.num_leaves)
+                    with obs_scaling.exempt():
+                        int(arrays.num_leaves)
                     self._partition_validated = True
                 return arrays, out
             except Exception as exc:

@@ -33,6 +33,11 @@ _counts = {
 # live-gauge view of XLA's own peak-HBM estimate (recorder dicts only
 # see the per-retrace values)
 _hbm = {"peak_hbm_bytes": 0, "analyses": 0}
+# donation audit tables, label -> table dict (see donation_audit); the
+# lgbm_xla_undonated_bytes{fn} gauges pull from here
+_donation: Dict[str, Dict] = {}
+# inputs smaller than this are noise, not donation candidates
+DONATION_MIN_BYTES = 1 << 16
 
 # event name fragments -> counter key; matched by substring so minor
 # renames across jax versions keep counting instead of silently zeroing
@@ -94,11 +99,15 @@ def install_count() -> int:
         return _install_count
 
 
-def analyze_compiled(fn, args, signature: str = "") -> Optional[Dict]:
+def analyze_compiled(fn, args, signature: str = "",
+                     donation_resident=()) -> Optional[Dict]:
     """XLA kernel attribution for one jitted callable at concrete args:
-    flops / bytes accessed from ``Lowered.cost_analysis`` and peak HBM
-    from ``Compiled.memory_analysis``, recorded as a "compile" span
-    tagged with the triggering shape signature.
+    flops / bytes accessed from ``Lowered.cost_analysis``, peak HBM
+    from ``Compiled.memory_analysis``, and the input-layout donation
+    walk (``donation_audit`` over the same lowering — un-donated large
+    buffers land in the per-executable audit table and the
+    ``lgbm_xla_undonated_bytes{fn}`` gauge), recorded as a "compile"
+    span tagged with the triggering shape signature.
 
     jax caches the executable, so the ``.lower().compile()`` here reuses
     the compilation the training step already paid for; still, callers
@@ -113,6 +122,10 @@ def analyze_compiled(fn, args, signature: str = "") -> Optional[Dict]:
         lowered = fn.lower(*args)
     except Exception:  # noqa: BLE001 — analysis is best-effort
         return None
+    table = donation_audit(fn, args, label=signature or "jit",
+                           resident=donation_resident, lowered=lowered)
+    if table is not None:
+        stats["undonated_bytes"] = table["undonated_bytes"]
     try:
         cost = lowered.cost_analysis()
         if isinstance(cost, (list, tuple)):
@@ -145,6 +158,124 @@ def analyze_compiled(fn, args, signature: str = "") -> Optional[Dict]:
     tracing.complete("compile", _time.perf_counter() - t0, cat="xla",
                      **stats)
     return stats
+
+
+def _donated_params(mlir_text: str) -> Optional[set]:
+    """Parameter indices of @main carrying a donation marker
+    (``tf.aliasing_output`` / ``jax.buffer_donor``) in the lowered
+    StableHLO text — jax records donation intent there on every backend,
+    including CPU where the runtime then ignores it.  None when the
+    signature cannot be located (renamed entry point)."""
+    start = mlir_text.find("@main(")
+    if start < 0:
+        return None
+    # the signature region ends at the arrow/body; params carry no
+    # parens so the first ')' closes the list
+    end = mlir_text.find(")", start)
+    if end < 0:
+        return None
+    sig = mlir_text[start:end]
+    donated = set()
+    idx = 0
+    while True:
+        cur = sig.find("%%arg%d:" % idx)
+        if cur < 0:
+            break
+        nxt = sig.find("%%arg%d:" % (idx + 1))
+        chunk = sig[cur:nxt if nxt > 0 else len(sig)]
+        if "tf.aliasing_output" in chunk or "jax.buffer_donor" in chunk:
+            donated.add(idx)
+        idx += 1
+    return donated if idx else None
+
+
+def _leaf_bytes(leaf) -> int:
+    try:
+        v = getattr(leaf, "nbytes", None)
+        if v is not None:
+            return int(v)
+    except Exception:  # noqa: BLE001 — donated/deleted arrays raise
+        return 0
+    try:
+        import numpy as np
+        shape = getattr(leaf, "shape", ())
+        dtype = getattr(leaf, "dtype", None)
+        size = 1
+        for s in shape:
+            size *= int(s)
+        return size * (np.dtype(dtype).itemsize if dtype is not None else 8)
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+def donation_audit(fn, args, label: str = "",
+                   min_bytes: int = DONATION_MIN_BYTES,
+                   resident=(), lowered=None) -> Optional[Dict]:
+    """Walk one jitted callable's input layout at concrete args and
+    table which large inputs the caller donated: un-donated large
+    buffers force XLA to keep input AND output alive across the
+    dispatch — double HBM residency plus a copy the aliasing would have
+    elided, one of ROADMAP item 1's four named scaling suspects.
+
+    ``resident`` lists the flattened-argument indices that are
+    semantically impossible to donate (buffers reused on later rounds,
+    e.g. the binned feature planes); they are excluded from
+    ``undonated_bytes`` but stay in the table flagged resident, so the
+    committed floor tracks real omissions only.  The table lands in the
+    process-wide store (``donation_stats``) and feeds the
+    ``lgbm_xla_undonated_bytes{fn}`` gauge.  Best-effort: returns None
+    when lowering or the donation markers are unavailable."""
+    try:
+        import jax
+        if lowered is None:
+            lowered = fn.lower(*args)
+        donated = _donated_params(lowered.as_text())
+        if donated is None:
+            return None
+        leaves = jax.tree_util.tree_leaves(args)
+    except Exception as exc:  # noqa: BLE001 — audit is best-effort
+        log.debug("donation audit unavailable for %s: %s", label, exc)
+        return None
+    resident = set(int(i) for i in resident)
+    rows = []
+    undonated = 0
+    donated_bytes = 0
+    for i, leaf in enumerate(leaves):
+        nbytes = _leaf_bytes(leaf)
+        if nbytes < min_bytes:
+            continue
+        is_donated = i in donated
+        row = {"arg": i, "bytes": nbytes,
+               "shape": list(getattr(leaf, "shape", ()) or ()),
+               "dtype": str(getattr(leaf, "dtype", "")),
+               "donated": is_donated}
+        if is_donated:
+            donated_bytes += nbytes
+        elif i in resident:
+            row["resident"] = True
+        else:
+            undonated += nbytes
+        rows.append(row)
+    table = {"fn": label, "undonated_bytes": int(undonated),
+             "donated_bytes": int(donated_bytes),
+             "donated_args": sorted(donated), "rows": rows}
+    with _lock:
+        _donation[label or ("fn%d" % len(_donation))] = table
+    try:
+        from . import default_registry
+        default_registry().gauge(
+            "lgbm_xla_undonated_bytes",
+            help="Large un-donated input bytes of this cached executable "
+                 "(resident buffers excluded)", fn=label).set(undonated)
+    except Exception as exc:  # noqa: BLE001 — registry is optional here
+        log.debug("donation audit: gauge publish failed: %s", exc)
+    return table
+
+
+def donation_stats() -> Dict[str, Dict]:
+    """Per-executable donation audit tables recorded so far (copies)."""
+    with _lock:
+        return {k: dict(v) for k, v in _donation.items()}
 
 
 def hbm_stats() -> Dict[str, int]:

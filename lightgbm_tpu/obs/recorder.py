@@ -107,6 +107,13 @@ class TrainingRecorder:
             pats = str(getattr(config, "tpu_trend_metrics", "") or "")
             self._trend_include = [p.strip() for p in pats.split(",")
                                    if p.strip()] or None
+        # scaling forensics: per-round host/device step decomposition
+        # (obs/scaling.py) — same lazy-init / disable-on-failure contract
+        # as the roofline section; read-only apart from one exempted
+        # scalar probe per tpu_scaling_window rounds
+        self.scaling_enabled = bool(
+            getattr(config, "tpu_scaling_decomp", True))
+        self._decomposer = None
         adapters.ensure_device_metrics(self.registry)
         self._m_iters = self.registry.counter(
             "lgbm_train_iterations_total", help="Boosting rounds completed")
@@ -160,6 +167,10 @@ class TrainingRecorder:
         roofline = self._roofline(gbdt, wall_s)
         if roofline is not None:
             event["roofline"] = roofline
+        decomp = self._step_decomp(gbdt, iteration, wall_s,
+                                   event["phases"])
+        if decomp is not None:
+            event["step_decomp"] = decomp
         self._m_iters.inc()
         self._m_seconds.inc(wall_s)
         if not finished:
@@ -321,6 +332,27 @@ class TrainingRecorder:
         except Exception as exc:  # noqa: BLE001 — telemetry never raises
             self.roofline_enabled = False
             log.warning("telemetry: roofline section disabled: %s", exc)
+            return None
+
+    def _step_decomp(self, gbdt, iteration: int, wall_s: float,
+                     phases: Dict) -> Optional[Dict]:
+        """Per-round scaling-forensics section (obs/scaling.py): the
+        wall split into host_sync / leader_wire / psum / dispatch legs
+        plus the windowed device probe and the sentinel's sync-event
+        delta.  Best-effort: any failure disables the section for the
+        run rather than touching training."""
+        if not self.scaling_enabled:
+            return None
+        try:
+            from . import scaling
+            if self._decomposer is None:
+                self._decomposer = scaling.StepDecomposer(self.config,
+                                                          self.registry)
+            return self._decomposer.on_round(gbdt, iteration, wall_s,
+                                             phases)
+        except Exception as exc:  # noqa: BLE001 — telemetry never raises
+            self.scaling_enabled = False
+            log.warning("telemetry: step_decomp section disabled: %s", exc)
             return None
 
     def _span_deltas(self) -> Optional[Dict[str, Dict[str, float]]]:
@@ -526,6 +558,29 @@ def policy_event(config, **fields) -> None:
                                separators=(",", ":")) + "\n")
     except Exception as exc:  # noqa: BLE001 — telemetry never raises
         log.warning("telemetry: policy event write to %s failed: %s",
+                    path, exc)
+
+
+def sync_event(config, **fields) -> None:
+    """Append one runtime-sync-sentinel observation ({"event":
+    "sync_event", "kind": "item"|"__float__"|..., "site": "file:line
+    (func)", ...}) to Config.tpu_telemetry_path.  The sentinel
+    (obs/scaling.SyncSentinel) fires from INSIDE a hooked jax array
+    conversion — routing through one booster's TrainingRecorder from
+    there would re-enter its buffering, so like the elastic/fleet events
+    it appends directly — same JSONL contract, best-effort;
+    tools/scaling_report.py and the tests grep these lines."""
+    path = getattr(config, "tpu_telemetry_path", "")
+    if not path:
+        return
+    event = {"event": "sync_event"}
+    event.update(fields)
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(event, default=_json_default,
+                               separators=(",", ":")) + "\n")
+    except Exception as exc:  # noqa: BLE001 — telemetry never raises
+        log.warning("telemetry: sync event write to %s failed: %s",
                     path, exc)
 
 

@@ -996,6 +996,14 @@ def grow_tree_partition_impl(
     return tree, leaf_ids, state.arena, state.truncated
 
 
+# donate_argnums=(0,): the arena is the only donatable input — every
+# other large operand (bins_t, g/h, row_leaf_init) is resident by the
+# driver's degrade contract: a failed partition call falls back to the
+# label engine REUSING those same buffers (models/gbdt._run_partition),
+# so donating them would hand the fallback deleted arrays on TPU.  The
+# donation audit (obs/device.donation_audit) marks them resident rather
+# than un-donated; lgbm_xla_undonated_bytes stays at the committed floor
+# of zero for this executable.
 grow_tree_partition = partial(jax.jit, static_argnames=(
     "max_leaves", "max_depth", "max_bin", "emit", "full_bag",
     "max_cat_threshold", "axis_name", "learner", "num_machines", "top_k",

@@ -105,6 +105,13 @@ class ParallelGrower:
         self._bins_t = None
         self._bins_key = None
         self.last_truncated = None
+        # donation forensics (obs/device.donation_audit): the GBDT driver
+        # flips audit_donation on when telemetry is armed; each partition
+        # executable is walked once per build, against the raw jitted fn
+        # kept in _praw (the bind/reshard wrappers cannot .lower())
+        self.audit_donation = False
+        self._praw = {}
+        self._audited = set()
 
     # ------------------------------------------------------------------ #
     def enable_partition(self, hist_slots: int = 0):
@@ -280,9 +287,18 @@ class ParallelGrower:
                 return jax.sharding.NamedSharding(self.mesh, spec)
             jit_kw = dict(in_shardings=tuple(_ns(s) for s in in_specs),
                           out_shardings=tuple(_ns(s) for s in out_specs))
+        # donate_argnums=(0,): the arena is the ONLY donatable input.
+        # bins_t / grad / hess / row_leaf_init look like candidates but
+        # are semantically resident: bins_t and the bag mask persist
+        # across rounds, and grad/hess are re-used by BOTH degrade paths
+        # after a failed call (the quantized retry in gbdt._grow_tree and
+        # the label-engine fallback in grow()) — donating them would
+        # hand those paths deleted buffers on a real TPU.  The donation
+        # audit marks them resident instead of un-donated.
         fn = jax.jit(_shard_mapped(shard_fn, self.mesh, in_specs,
                                    out_specs),
                      donate_argnums=(0,), **jit_kw)
+        self._praw[statics] = fn
         if jit_kw:
             # explicit in_shardings REFUSE already-committed args whose
             # sharding differs (e.g. a replicated grad plane rebuilt by
@@ -328,7 +344,9 @@ class ParallelGrower:
                 quant_scales=(qsc[0], qsc[1]) if quantized else None)
             return t, l, arena_out[None], trunc
 
+        # arena-only donation, same residency argument as _build_partition
         jitted = jax.jit(local_fn, donate_argnums=(0,))
+        self._praw[statics] = jitted
 
         def wrapped(*args):
             out = jitted(*args)
@@ -406,6 +424,12 @@ class ParallelGrower:
         statics = (max_leaves, max_depth, max_bin, max_cat_threshold, C,
                    cap, self._partition["hist_slots"], interpret,
                    bool(quantized))
+        # the builder returns a donating jit but does NOT donate
+        # `statics` (a hashable int tuple, the cache key); bind the
+        # audit key up front so nothing re-reads `statics` past the
+        # build, which the donation-use-after checker cannot tell apart
+        # from a donated-buffer read
+        audit_key = statics if self.audit_donation else None
         fn = (self._build_partition_socket(statics) if socket
               else self._build_partition(statics))
         if quantized:
@@ -413,10 +437,31 @@ class ParallelGrower:
                              jnp.asarray(quant_scales[1], jnp.float32)])
         else:
             qsc = jnp.zeros((2,), jnp.float32)
-        tree, leaf_ids, self._arena, self.last_truncated = fn(
-            self._arena, self._bins_t, grad, hess, row_leaf_init,
-            feature_mask, num_bins, default_bins, missing_types, params,
-            monotone, penalty, is_categorical, bundle, qsc)
+        call_args = (self._arena, self._bins_t, grad, hess, row_leaf_init,
+                     feature_mask, num_bins, default_bins, missing_types,
+                     params, monotone, penalty, is_categorical, bundle, qsc)
+        audit_raw = None
+        if audit_key is not None and audit_key not in self._audited:
+            self._audited.add(audit_key)
+            audit_raw = self._praw.get(audit_key)
+        tree, leaf_ids, self._arena, self.last_truncated = fn(*call_args)
+        if audit_raw is not None:
+            # AFTER the call: .lower() before the first execution would
+            # populate the jaxpr cache outside capture_traced and starve
+            # the collective byte accounting; post-call it is a cache hit
+            from ..obs import device as obs_device
+            # resident leaves 1-4: bins_t (dataset plane), grad/hess
+            # (reused by the quantized-retry and label-fallback degrade
+            # paths after a failed call), row_leaf_init (the bag mask,
+            # reused until the next bagging round) — donation is
+            # semantically impossible for all four.  call_args[0] was
+            # donated into the call just made; lower with the
+            # (identically-shaped) output arena instead
+            obs_device.donation_audit(
+                audit_raw, (self._arena,) + call_args[1:],
+                label="partition/%s_w%d%s" % (
+                    self.mode, self.d, "_q" if quantized else ""),
+                resident=(1, 2, 3, 4))
         if leaf_ids.shape[0] != n:
             leaf_ids = leaf_ids[:n]
         return tree, leaf_ids

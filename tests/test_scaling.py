@@ -1,0 +1,339 @@
+"""Scaling forensics (obs/scaling.py + friends): decomposition math,
+the runtime sync sentinel, the donation audit, the waterfall report's
+exit-code contract, and the read-only guarantee — forensics on/off
+trains bitwise-identical models.
+
+The sentinel tests exercise the REAL hook path (patched ArrayImpl
+conversion methods), so they also pin the restore discipline: after
+every guard exits, the class methods must be the originals again.
+"""
+import json
+import os
+import sys
+from functools import partial
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.obs import device as obs_device
+from lightgbm_tpu.obs import scaling
+from lightgbm_tpu.utils.log import LightGBMError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _registry():
+    from lightgbm_tpu.obs import default_registry
+    return default_registry()
+
+
+# --------------------------------------------------------------------- #
+# Step decomposition math
+# --------------------------------------------------------------------- #
+class TestDecomposition:
+    def _decomposer(self, **params):
+        cfg = Config(dict({"tpu_scaling_window": 10_000}, **params))
+        return scaling.StepDecomposer(cfg, _registry())
+
+    def test_legs_partition_wall_exactly(self):
+        d = self._decomposer()
+        phases = {"drain_inflight": {"ms": 5.0, "calls": 1},
+                  "histogram": {"ms": 9.0, "calls": 1}}
+        out = d.on_round(object(), 0, 0.020, phases)
+        assert out["wall_ms"] == pytest.approx(20.0)
+        assert out["host_sync_ms"] == pytest.approx(5.0)
+        total = (out["host_sync_ms"] + out["leader_wire_ms"]
+                 + out["psum_ms"] + out["dispatch_ms"])
+        assert total == pytest.approx(out["wall_ms"], abs=1e-2)
+
+    def test_sync_legs_clamped_to_wall(self):
+        d = self._decomposer()
+        phases = {"drain_inflight": {"ms": 50.0, "calls": 1},
+                  "tree_fetch": {"ms": 50.0, "calls": 1}}
+        out = d.on_round(object(), 0, 0.010, phases)   # 10ms wall
+        assert out["host_sync_ms"] == pytest.approx(10.0)
+        assert out["dispatch_ms"] == pytest.approx(0.0)
+        assert out["host_share"] == pytest.approx(1.0)
+
+    def test_mean_decomposition(self):
+        rounds = [{"wall_ms": 10.0, "host_sync_ms": 2.0,
+                   "leader_wire_ms": 0.0, "psum_ms": 1.0,
+                   "dispatch_ms": 7.0, "device_est_ms": 4.0},
+                  {"wall_ms": 20.0, "host_sync_ms": 4.0,
+                   "leader_wire_ms": 0.0, "psum_ms": 1.0,
+                   "dispatch_ms": 15.0, "device_est_ms": 6.0},
+                  {}]                       # skipped: no wall_ms
+        m = scaling.mean_decomposition(rounds)
+        assert m["wall_ms"] == pytest.approx(15.0)
+        assert m["host_sync_ms"] == pytest.approx(3.0)
+        assert m["device_est_ms"] == pytest.approx(5.0)
+        assert scaling.mean_decomposition([]) is None
+        assert scaling.mean_decomposition([{}]) is None
+
+
+class TestWaterfall:
+    BASE = {"wall_ms": 100.0, "host_sync_ms": 10.0, "leader_wire_ms": 0.0,
+            "psum_ms": 0.0, "dispatch_ms": 90.0}
+    W2 = {"wall_ms": 80.0, "host_sync_ms": 20.0, "leader_wire_ms": 5.0,
+          "psum_ms": 5.0, "dispatch_ms": 50.0}
+
+    def test_losses_and_identity(self):
+        wf = scaling.efficiency_waterfall({1: self.BASE, 2: self.W2})
+        e = wf[2]
+        legs = e["legs"]
+        assert legs["ideal"] == pytest.approx(50.0)
+        assert legs["host_sync"] == pytest.approx(15.0)   # 20 - 10/2
+        assert legs["leader_wire"] == pytest.approx(5.0)
+        assert legs["psum"] == pytest.approx(5.0)
+        assert legs["dispatch_gap"] == pytest.approx(5.0)  # 50 - 90/2
+        # the waterfall reconstructs the measured wall identically
+        assert sum(legs.values()) == pytest.approx(e["measured_ms"],
+                                                   abs=1e-6)
+        assert e["residual_share"] == pytest.approx(0.0, abs=1e-6)
+        assert e["dominant_loss"] == "host_sync"
+        assert e["efficiency"] == pytest.approx(100.0 / (2 * 80.0))
+        assert e["host_share"] == pytest.approx(25.0 / 80.0)
+
+    def test_world1_is_clean(self):
+        wf = scaling.efficiency_waterfall({1: self.BASE, 2: self.W2})
+        e = wf[1]
+        assert e["efficiency"] == pytest.approx(1.0)
+        assert e["dominant_loss"] == "none"
+        assert e["residual_share"] == pytest.approx(0.0, abs=1e-6)
+
+    def test_empty(self):
+        assert scaling.efficiency_waterfall({}) == {}
+
+
+# --------------------------------------------------------------------- #
+# Runtime sync sentinel
+# --------------------------------------------------------------------- #
+class TestSyncSentinel:
+    def setup_method(self):
+        scaling.reset_sync_stats()
+
+    def test_off_mode_builds_nothing(self):
+        assert scaling.SyncSentinel.from_config(Config()) is None
+        s = scaling.SyncSentinel.from_config(
+            Config({"tpu_sync_guard": "log"}))
+        assert s is not None and s.mode == "log"
+
+    def test_planted_sync_is_caught_and_attributed(self):
+        sent = scaling.SyncSentinel.from_config(
+            Config({"tpu_sync_guard": "log"}))
+        with sent.guard(round_idx=3):
+            x = jnp.arange(8.0)
+            x.sum().item()                 # planted implicit sync
+            float(jnp.sum(x))              # and another, distinct kind
+        stats = scaling.sync_stats()
+        assert stats["total"] == 2
+        assert stats["by_kind"] == {"item": 1, "__float__": 1}
+        sites = [e.get("site", "") for e in stats["events"]]
+        assert any("test_scaling" in s for s in sites)
+        assert all(e.get("iter") == 3 for e in stats["events"])
+
+    def test_clean_loop_is_silent(self):
+        sent = scaling.SyncSentinel.from_config(
+            Config({"tpu_sync_guard": "log"}))
+        with sent.guard(0):
+            x = jnp.arange(16.0)
+            y = jnp.sum(x * 2.0)
+            _ = jax.device_get(y)          # bulk fetch, not a hidden sync
+        assert scaling.sync_stats()["total"] == 0
+
+    def test_fail_mode_raises_but_exempt_allows(self):
+        sent = scaling.SyncSentinel.from_config(
+            Config({"tpu_sync_guard": "fail"}))
+        with sent.guard(0):
+            with scaling.exempt():
+                float(jnp.sum(jnp.arange(4.0)))   # the perf-probe shape
+            with pytest.raises(LightGBMError):
+                float(jnp.sum(jnp.arange(4.0)))
+        # the raise still recorded the event first
+        assert scaling.sync_stats()["total"] == 1
+
+    def test_hooks_fully_restored_after_guard(self):
+        cls = scaling._array_impl_class()
+        sent = scaling.SyncSentinel.from_config(
+            Config({"tpu_sync_guard": "log"}))
+        with sent.guard(0):
+            assert getattr(cls.item, "_lgbm_sync_hook", False)
+        for name in scaling._WATCHED_METHODS:
+            fn = getattr(cls, name, None)
+            assert not getattr(fn, "_lgbm_sync_hook", False), name
+        # and conversions work normally again, uncounted
+        scaling.reset_sync_stats()
+        assert float(jnp.asarray(2.5)) == 2.5
+        assert scaling.sync_stats()["total"] == 0
+
+
+# --------------------------------------------------------------------- #
+# Donation audit
+# --------------------------------------------------------------------- #
+class TestDonationAudit:
+    def test_table_matches_jit_signature(self):
+        @partial(jax.jit, donate_argnums=(0,))
+        def f(a, b):
+            return a + b, b * 2.0
+
+        a = jnp.zeros((256, 256), jnp.float32)     # 256 KiB
+        b = jnp.ones((256, 256), jnp.float32)
+        table = obs_device.donation_audit(f, (a, b), label="test/donated")
+        assert table is not None
+        assert table["donated_args"] == [0]
+        rows = {r["arg"]: r for r in table["rows"]}
+        assert rows[0]["donated"] and not rows[1]["donated"]
+        assert table["undonated_bytes"] == 256 * 256 * 4
+        assert table["donated_bytes"] == 256 * 256 * 4
+        assert "test/donated" in obs_device.donation_stats()
+
+    def test_resident_args_excluded_from_floor(self):
+        @partial(jax.jit, donate_argnums=(0,))
+        def g(a, b):
+            return a * 2.0 + b
+
+        a = jnp.zeros((256, 256), jnp.float32)
+        b = jnp.ones((256, 256), jnp.float32)
+        table = obs_device.donation_audit(g, (a, b), label="test/resident",
+                                          resident=(1,))
+        assert table["undonated_bytes"] == 0
+        rows = {r["arg"]: r for r in table["rows"]}
+        assert rows[1]["resident"] is True and not rows[1]["donated"]
+
+    def test_small_buffers_ignored(self):
+        @jax.jit
+        def h(a):
+            return a + 1.0
+
+        table = obs_device.donation_audit(h, (jnp.zeros(8),),
+                                          label="test/small")
+        assert table is not None and table["rows"] == []
+        assert table["undonated_bytes"] == 0
+
+
+# --------------------------------------------------------------------- #
+# Waterfall report gate (exit-code contract 0/1/2)
+# --------------------------------------------------------------------- #
+class TestScalingReportGate:
+    @staticmethod
+    def _report():
+        base = {"wall_ms": 100.0, "host_sync_ms": 10.0,
+                "leader_wire_ms": 0.0, "psum_ms": 0.0, "dispatch_ms": 90.0}
+        w2 = {"wall_ms": 80.0, "host_sync_ms": 20.0, "leader_wire_ms": 5.0,
+              "psum_ms": 5.0, "dispatch_ms": 50.0}
+        wf = scaling.efficiency_waterfall({1: base, 2: w2})
+        return {"n_devices": 8, "rows": 512, "timed_iters": 2,
+                "backend": "cpu", "worlds": [1, 2], "runs": {},
+                "waterfall": {"f32": {str(w): v for w, v in wf.items()}}}
+
+    @pytest.fixture()
+    def report_main(self, monkeypatch):
+        import tools.scaling_report as sr
+        monkeypatch.setattr(sr, "build_report",
+                            lambda *a, **k: self._report())
+        return sr
+
+    def test_exit_0_within_baseline(self, report_main, tmp_path, capsys):
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps({
+            "residual_share_max": 0.10,
+            "dtypes": {"f32": {"worlds": {
+                "2": {"efficiency_min": 0.625, "host_share_max": 0.9}}}},
+        }))
+        assert report_main.main(["--baseline", str(base)]) == 0
+        assert "dominant=host_sync" in capsys.readouterr().out
+
+    def test_exit_1_on_breach(self, report_main, tmp_path, capsys):
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps({
+            "residual_share_max": 0.10,
+            "dtypes": {"f32": {"worlds": {
+                "2": {"efficiency_min": 0.625, "host_share_max": 0.1}}}},
+        }))
+        assert report_main.main(["--baseline", str(base)]) == 1
+        assert "BREACH" in capsys.readouterr().out
+
+    def test_exit_2_unreadable_baseline(self, report_main, tmp_path,
+                                        capsys):
+        missing = tmp_path / "nope.json"
+        assert report_main.main(["--baseline", str(missing)]) == 2
+        capsys.readouterr()
+
+    def test_json_output_carries_breaches(self, report_main, tmp_path,
+                                          capsys):
+        base = tmp_path / "base.json"
+        base.write_text(json.dumps({"residual_share_max": 0.10,
+                                    "dtypes": {}}))
+        assert report_main.main(["--baseline", str(base), "--json"]) == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["breaches"] == []
+        assert out["waterfall"]["f32"]["2"]["dominant_loss"] == "host_sync"
+
+
+# --------------------------------------------------------------------- #
+# Read-only guarantee: forensics on/off, bit for bit
+# --------------------------------------------------------------------- #
+def _train_model(tmp_path, forensics: bool, mesh: bool) -> str:
+    params = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+              "learning_rate": 0.1, "verbose": -1, "seed": 11,
+              "deterministic": True}
+    if mesh:
+        params.update(tree_learner="data", num_machines=2,
+                      tpu_comm_backend="mesh", tpu_tree_engine="partition")
+    if forensics:
+        params.update(tpu_sync_guard="log", tpu_scaling_window=1,
+                      tpu_telemetry_path=str(tmp_path / "tel.jsonl"))
+    rng = np.random.RandomState(3)
+    X = rng.rand(256, 6).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 1.0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params=dict(params))
+    booster = lgb.train(params, ds, num_boost_round=3)
+    return booster.model_to_string()
+
+
+def test_forensics_bitwise_identity_serial(tmp_path):
+    off = _train_model(tmp_path / "off", False, mesh=False)
+    (tmp_path / "on").mkdir()
+    on = _train_model(tmp_path / "on", True, mesh=False)
+    assert on == off
+
+
+@pytest.mark.slow
+def test_forensics_bitwise_identity_mesh_w2(tmp_path):
+    off = _train_model(tmp_path / "off", False, mesh=True)
+    (tmp_path / "on").mkdir()
+    on = _train_model(tmp_path / "on", True, mesh=True)
+    assert on == off
+
+
+def test_forensics_emit_decomp_and_stay_clean(tmp_path):
+    """The 'on' run actually produced step_decomp sections with legs
+    summing to the wall, and the clean round path tripped zero sync
+    events — the bench smoke's invariants, pinned in-suite."""
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1,
+              "seed": 11, "tpu_sync_guard": "log", "tpu_scaling_window": 1,
+              "tpu_telemetry_path": str(tmp_path / "tel.jsonl")}
+    rng = np.random.RandomState(3)
+    X = rng.rand(256, 6).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params=dict(params))
+    lgb.train(params, ds, num_boost_round=3)
+    decs = []
+    with open(tmp_path / "tel.jsonl") as fh:
+        for line in fh:
+            ev = json.loads(line)
+            if ev.get("event") == "iteration" and "step_decomp" in ev:
+                decs.append(ev["step_decomp"])
+    assert len(decs) == 3
+    for d in decs:
+        legs = (d["host_sync_ms"] + d["leader_wire_ms"] + d["psum_ms"]
+                + d["dispatch_ms"])
+        assert legs == pytest.approx(d["wall_ms"], abs=1e-2)
+        assert d["sync_events"] == 0

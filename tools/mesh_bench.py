@@ -34,11 +34,31 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
+def _read_decomps(path):
+    """step_decomp sections from a telemetry JSONL, in round order."""
+    decs = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if ev.get("event") == "iteration" and "step_decomp" in ev:
+                    decs.append(ev["step_decomp"])
+    except OSError:
+        pass
+    return decs
+
+
 def run(worlds, n_rows, n_features, iters, num_leaves):
+    import tempfile
+
     import jax
     import numpy as np
 
     import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import scaling as obs_scaling
     from lightgbm_tpu.utils import log as lgb_log
 
     lgb_log.set_level(-1)
@@ -57,12 +77,24 @@ def run(worlds, n_rows, n_features, iters, num_leaves):
                       "learning_rate": 0.1, "max_bin": 255,
                       "min_data_in_leaf": 20, "verbose": -1,
                       "tpu_tree_engine": "partition",
-                      "tpu_quantized_grad": quant}
+                      "tpu_quantized_grad": quant,
+                      # runtime sync sentinel armed in log mode: a clean
+                      # round path reports sync_events == 0 per round
+                      "tpu_sync_guard": "log"}
             if world > 1:
                 params.update(tree_learner="data", num_machines=world,
                               tpu_comm_backend="mesh")
+            # per-run telemetry stream: the recorder's step_decomp
+            # sections (obs/scaling.py) supply the attribution columns
+            tel_fd, tel_path = tempfile.mkstemp(prefix="mesh_bench_",
+                                                suffix=".jsonl")
+            os.close(tel_fd)
+            params["tpu_telemetry_path"] = tel_path
             ds = lgb.Dataset(X, label=y, params=dict(params))
-            booster = lgb.train(params, ds, num_boost_round=1)  # compile
+            # direct Booster (not lgb.train): train's finally would
+            # close the telemetry stream before the timed update loop
+            booster = lgb.Booster(params=params, train_set=ds)
+            booster.update()                                    # compile
             g = booster._gbdt
             float(jax.numpy.sum(g.train_state.score))           # sync
             t0 = time.perf_counter()
@@ -70,6 +102,12 @@ def run(worlds, n_rows, n_features, iters, num_leaves):
                 booster.update()
             float(jax.numpy.sum(g.train_state.score))
             dt = time.perf_counter() - t0
+            g.finish_telemetry()
+            decs = _read_decomps(tel_path)[1:]  # drop the compile round
+            try:
+                os.remove(tel_path)
+            except OSError:
+                pass
             grower = g._grower
             engine_on = (grower._partition is not None if grower is not None
                          else g._use_partition_engine)
@@ -84,6 +122,26 @@ def run(worlds, n_rows, n_features, iters, num_leaves):
                 "comm_backend": (grower.collective.backend
                                  if grower is not None else "serial"),
             }
+            mean = obs_scaling.mean_decomposition(decs)
+            if mean is not None:
+                # attribution columns (mean per timed round): host-sync
+                # wall, device-compute estimate, psum wire model, and
+                # leader-wire callback wait (zero on pure-mesh worlds)
+                out["runs"][key].update(
+                    round_wall_ms=round(mean["wall_ms"], 3),
+                    host_ms=round(mean["host_sync_ms"], 3),
+                    device_ms=round(mean["device_est_ms"], 3),
+                    psum_ms=round(mean["psum_ms"], 4),
+                    callback_ms=round(mean["leader_wire_ms"], 3),
+                    host_share=round(
+                        mean["host_sync_ms"] / mean["wall_ms"], 4)
+                    if mean["wall_ms"] else 0.0,
+                    # raw mean legs: scaling_report feeds these into
+                    # obs.scaling.efficiency_waterfall unrounded-ish
+                    legs_ms={k: round(v, 4) for k, v in mean.items()},
+                    sync_events=sum(int(d.get("sync_events", 0))
+                                    for d in decs),
+                )
     # scaling efficiency against the world=1 run of the same dtype
     for kind in ("f32", "int8"):
         base = out["runs"].get("w1_%s" % kind)

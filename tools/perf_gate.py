@@ -129,6 +129,14 @@ def extract_metrics(bench: Dict) -> Dict:
         v = hyb.get("hybrid_mrows_iter_s")
         if v is not None:
             out["higgs_hybrid_mrows_iter_s"] = float(v)
+    scal = detail.get("scaling_smoke")
+    if isinstance(scal, dict):
+        v = scal.get("mesh2_host_share")
+        if v is not None:
+            # host-sync fraction of the w=2 round wall (obs/scaling.py
+            # step decomposition) — a CEILING: growth means a new
+            # implicit device->host sync crept into the round path
+            out["mesh2_host_share"] = float(v)
     return out
 
 
@@ -191,13 +199,20 @@ TRACKED_METRICS = {"higgs_mrows_iter_s": "higgs",
                    "higgs_quantized_mrows_iter_s": "higgs_quantized",
                    "higgs_mesh8_mrows_iter_s": "higgs_mesh8",
                    "higgs_hybrid_mrows_iter_s": "higgs_hybrid",
-                   "serve_open_loop_p99_ms": "serve_p99"}
+                   "serve_open_loop_p99_ms": "serve_p99",
+                   "mesh2_host_share": "mesh2_host_share"}
 
 # LATENCY metrics: gated as a CEILING (breach above baseline+tolerance)
 # on EVERY backend — unlike the throughput floors, which only the TPU
 # numbers enforce.  Commit their baselines with a generous --margin
 # (shared CI machines jitter tail latency far more than throughput).
-CEILING_METRICS = frozenset({"serve_open_loop_p99_ms"})
+CEILING_METRICS = frozenset({"serve_open_loop_p99_ms",
+                             "mesh2_host_share"})
+
+# a ceiling pinned from a near-zero smoke reading would be vacuous
+# (check() skips base <= 0) or hair-trigger; --write-baseline never
+# records these ceilings below their floor value
+CEILING_BASELINE_MIN = {"mesh2_host_share": 0.2}
 
 
 def make_baseline(metrics: Dict, roofline: Optional[Dict[str, float]],
@@ -221,7 +236,8 @@ def make_baseline(metrics: Dict, roofline: Optional[Dict[str, float]],
         if name in metrics:
             # 6 decimals: CPU-smoke mesh throughputs sit around 1e-4
             # Mrows·iter/s and must not round to a vacuous 0.0 floor
-            out["metrics"][name] = {"baseline": round(metrics[name], 6),
+            val = max(metrics[name], CEILING_BASELINE_MIN.get(name, 0.0))
+            out["metrics"][name] = {"baseline": round(val, 6),
                                     "tolerance": margin}
             entry[short] = round(metrics[name], 6)
     out["history"].append(entry)

@@ -803,6 +803,38 @@ def supervisor_smoke():
         shutil.rmtree(root, ignore_errors=True)
 
 
+def replica_smoke():
+    """Replicated-serving fault-domain drill (one line in `detail`).
+
+    Runs the tools/chaos_run.py kill_device scenario in-process at
+    smoke scale: a 3-replica tenant under steady threaded traffic has
+    one replica's dispatches forced to fail — zero failed predictions
+    tolerated, zero host-walk fallbacks while siblings are healthy,
+    degraded throughput held at >= (N-1)/N of baseline, and the victim
+    must be re-admitted by the half-open probe with no operator action.
+    Never fails the bench: any problem becomes the summary.
+    """
+    import os
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        import chaos_run
+    finally:
+        sys.path.pop(0)
+    try:
+        s = chaos_run.run_replica_scenario("kill_device", replicas=3,
+                                           duration_s=3.0)
+        return ("kill_device: %d preds (0 failed=%s), %d failovers off "
+                "device %d, host_fallbacks=%d, floor %d -> got %d, "
+                "readmitted=%s, ok=%s"
+                % (s["predictions"], s["predict_failures"] == 0,
+                   s["failovers"], s["victim_device"],
+                   s["host_fallbacks"], int(s["throughput_floor"]),
+                   s["degraded_preds"], s["readmitted"], s["ok"]))
+    except Exception as e:  # noqa: BLE001 — smoke only, never fatal
+        return "FAILED: %s" % e
+
+
 def fleet_smoke():
     """Multi-tenant fleet residency drill (one line in `detail`).
 
@@ -1009,6 +1041,7 @@ def main():
             "policy_smoke": policy_smoke(),
             "supervisor_smoke": supervisor_smoke(),
             "fleet_smoke": fleet_smoke(),
+            "replica_smoke": replica_smoke(),
             "trend_smoke": trend_smoke(),
             "lint_smoke": lint_smoke(),
         },

@@ -285,6 +285,30 @@ _SCHEMA = [
     #   cannot starve the fleet
     ("tpu_fleet_tenant_burst", float, 0.0),  # token-bucket burst depth
     #   (0 = 2x the qps quota, floor 1)
+    # --- replica serving parameters (no reference analogue)
+    # Device-fault-domain replica sets (serving/replicas.py): N copies of
+    # a tenant's frozen ensemble committed to distinct local devices,
+    # least-outstanding-rows routing, per-replica circuit breakers with
+    # liveness probes, loss-free failover.  See docs/Replicas.md.
+    ("tpu_replica_count", int, 1),           # per-device replicas per tenant;
+    #   1 keeps the exact single-device serving path (no ReplicaSet built,
+    #   byte-identical output), >1 places copies round-robin across the
+    #   local devices
+    ("tpu_replica_min", int, 1),             # lower bound for the
+    #   set_replica_count control-plane lever
+    ("tpu_replica_max", int, 8),             # upper bound for the
+    #   set_replica_count control-plane lever (the local-device fleet size
+    #   is the natural ceiling)
+    ("tpu_replica_probe_interval_s", float, 0.0),  # per-replica liveness probe
+    #   cadence (a tiny one-row dispatch per replica); 0 disables the probe
+    #   thread — recovery then rides the router's organic half-open probe
+    ("tpu_replica_probe_deadline_ms", float, 1000.0),  # a probe slower than
+    #   this counts as a failure (a stuck device must not pass its probe)
+    ("tpu_replica_breaker_failures", int, 3),  # consecutive dispatch/probe
+    #   failures that open ONE replica's breaker (the tenant keeps serving
+    #   on its sibling replicas — capacity degrades, availability doesn't)
+    ("tpu_replica_breaker_reset_s", float, 5.0),  # per-replica breaker
+    #   open -> half-open probe delay
     # --- perf / roofline parameters (no reference analogue)
     # Roofline performance observatory (obs/perf, tools/roofline_report,
     # tools/perf_gate): analytic HBM-byte/FLOP floors per hot kernel vs
@@ -591,6 +615,14 @@ ALIAS_TABLE: Dict[str, str] = {
     "hbm_budget_mb": "tpu_fleet_hbm_budget_mb",
     "fleet_tenant_qps": "tpu_fleet_tenant_qps",
     "tenant_qps": "tpu_fleet_tenant_qps",
+    "replica_count": "tpu_replica_count",
+    "replicas": "tpu_replica_count",
+    "replica_min": "tpu_replica_min",
+    "replica_max": "tpu_replica_max",
+    "replica_probe_interval_s": "tpu_replica_probe_interval_s",
+    "replica_probe_deadline_ms": "tpu_replica_probe_deadline_ms",
+    "replica_breaker_failures": "tpu_replica_breaker_failures",
+    "replica_breaker_reset_s": "tpu_replica_breaker_reset_s",
     "federation": "tpu_federation",
     "telemetry_federation": "tpu_federation",
     "federation_every": "tpu_federation_every",
@@ -898,6 +930,25 @@ class Config:
         if self.tpu_fleet_tenant_qps < 0 or self.tpu_fleet_tenant_burst < 0:
             log.fatal("tpu_fleet_tenant_qps / tpu_fleet_tenant_burst must "
                       "be >= 0")
+        if self.tpu_replica_count < 1:
+            log.fatal("tpu_replica_count must be >= 1, got %d"
+                      % self.tpu_replica_count)
+        if not 1 <= self.tpu_replica_min <= self.tpu_replica_max:
+            log.fatal("replica bounds must satisfy 1 <= min <= max, got "
+                      "min=%d max=%d" % (self.tpu_replica_min,
+                                         self.tpu_replica_max))
+        if self.tpu_replica_probe_interval_s < 0:
+            log.fatal("tpu_replica_probe_interval_s must be >= 0, got %g"
+                      % self.tpu_replica_probe_interval_s)
+        if self.tpu_replica_probe_deadline_ms <= 0:
+            log.fatal("tpu_replica_probe_deadline_ms must be > 0, got %g"
+                      % self.tpu_replica_probe_deadline_ms)
+        if self.tpu_replica_breaker_failures < 1:
+            log.fatal("tpu_replica_breaker_failures must be >= 1, got %d"
+                      % self.tpu_replica_breaker_failures)
+        if self.tpu_replica_breaker_reset_s < 0:
+            log.fatal("tpu_replica_breaker_reset_s must be >= 0, got %g"
+                      % self.tpu_replica_breaker_reset_s)
         if self.tpu_perf_hbm_gbps <= 0 or self.tpu_perf_peak_tflops <= 0:
             log.fatal("tpu_perf_hbm_gbps and tpu_perf_peak_tflops must be "
                       "> 0, got %g / %g" % (self.tpu_perf_hbm_gbps,

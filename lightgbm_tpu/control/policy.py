@@ -229,6 +229,17 @@ def default_policy_rules(config=None) -> List[PolicyRule]:
                    when={"alert": "supervisor_rollbacks", "state": "firing"},
                    action="tighten_promote_floor",
                    args={"factor": 2.0, "min_delta": 1e-4}),
+        # replica scaling (serving/replicas.py): sustained queue pressure
+        # adds a per-device copy of the busiest tenant; sustained
+        # residency pressure releases one (each replica refunds its
+        # device's byte ledger).  Both ride the same cooldown + global
+        # token bucket + dry-run plumbing as every other lever.
+        PolicyRule("replica_scale_up",
+                   when={"alert": "serve_queue_pressure", "state": "firing"},
+                   action="set_replica_count", args={"delta": 1}),
+        PolicyRule("replica_scale_down",
+                   when={"alert": "residency_pressure", "state": "firing"},
+                   action="set_replica_count", args={"delta": -1}),
     ]
 
 

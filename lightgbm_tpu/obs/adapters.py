@@ -202,7 +202,74 @@ def publish_quota_metrics(reg: MetricsRegistry, name: str, quota) -> None:
                 model=name).set_fn(lambda: quota.shed_count(name))
 
 
+def publish_replica_metrics(reg: MetricsRegistry, name: str,
+                            rset_fn: Callable[[], object]) -> None:
+    """Per-device replica exposition for one tenant (serving/replicas).
+
+    ``rset_fn`` resolves the LIVE ReplicaSet at scrape time (the set is
+    swapped on rollback and resized by the scale lever, so closures must
+    not capture one instance).  Per-replica children are labeled
+    {model, slot, device}; a slot that was scaled away reads 0.  The
+    ``lgbm_replica_healthy`` gauge is the kill_device drill's story:
+    1 -> 0 when the breaker opens, back to 1 on half-open re-admission."""
+    rset = rset_fn()
+    if rset is None:
+        return
+
+    def rep_pull(slot: int, field: str, healthy: bool = False):
+        def pull() -> float:
+            live = rset_fn()
+            if live is None:
+                return 0.0
+            for r in live.snapshot()["replicas"]:
+                if r["slot"] == slot:
+                    return float(r["healthy"] if healthy else r[field])
+            return 0.0
+        return pull
+
+    def set_pull(field: str):
+        def pull() -> float:
+            live = rset_fn()
+            return 0.0 if live is None \
+                else float(live.snapshot()[field])
+        return pull
+
+    for rep in rset.snapshot()["replicas"]:
+        labels = dict(model=name, slot=str(rep["slot"]),
+                      device=str(rep["device"]))
+        slot = int(rep["slot"])
+        reg.gauge("lgbm_replica_healthy",
+                  help="1 while this replica's breaker is closed",
+                  **labels).set_fn(rep_pull(slot, "healthy", healthy=True))
+        reg.gauge("lgbm_replica_outstanding_rows",
+                  help="In-flight rows routed to this replica",
+                  **labels).set_fn(rep_pull(slot, "outstanding_rows"))
+        reg.counter("lgbm_replica_dispatches_total",
+                    help="Batches served by this replica",
+                    **labels).set_fn(rep_pull(slot, "dispatches"))
+        reg.counter("lgbm_replica_failures_total",
+                    help="Dispatch/probe failures on this replica",
+                    **labels).set_fn(rep_pull(slot, "failures"))
+        reg.counter("lgbm_replica_probes_total",
+                    help="Liveness probes sent to this replica",
+                    **labels).set_fn(rep_pull(slot, "probes"))
+    reg.gauge("lgbm_replica_count",
+              help="Live replicas in the tenant's set",
+              model=name).set_fn(set_pull("count"))
+    reg.gauge("lgbm_replica_healthy_count",
+              help="Replicas currently routable (breaker closed)",
+              model=name).set_fn(set_pull("healthy"))
+    reg.counter("lgbm_replica_failovers_total",
+                help="Batches rerouted off a failed replica "
+                     "(loss-free: the same rows retried on a sibling)",
+                model=name).set_fn(set_pull("failovers"))
+    reg.counter("lgbm_replica_host_fallbacks_total",
+                help="Batches served on the host walk because ZERO "
+                     "replicas were healthy",
+                model=name).set_fn(set_pull("host_fallbacks"))
+
+
 def unpublish_model_stats(reg: MetricsRegistry, name: str) -> int:
     """Drop every child labeled model=<name> (model eviction) — serving
-    stats, breaker and quota children alike."""
+    stats, breaker, quota and replica children alike."""
     return reg.remove(model=name)

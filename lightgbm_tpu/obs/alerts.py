@@ -183,7 +183,23 @@ def default_rules(config=None) -> List[Rule]:
              ">", 0.0, "burn_rate", window=window),
         Rule("supervisor_rollbacks", "lgbm_supervisor_rollbacks_total",
              ">", 0.0, "burn_rate", window=window),
+        # a tenant's batcher queue sustained past half its capacity —
+        # the replica scale-UP trigger (control/policy.py binds this to
+        # the set_replica_count lever with delta +1)
+        Rule("serve_queue_pressure", "lgbm_serve_queue_depth_rows", ">",
+             0.5 * float(getattr(config, "serve_queue_rows", 0) or 1024),
+             "sustained", for_ticks=sustain, window=window),
     ]
+    budget_mb = float(getattr(config, "tpu_fleet_hbm_budget_mb", 0) or 0)
+    if budget_mb > 0:
+        hwm = float(getattr(config, "tpu_fleet_high_watermark", 0.9) or 0.9)
+        rules.append(
+            # accounted residency pinned at the eviction trigger — the
+            # replica scale-DOWN signal (each released replica refunds
+            # its device's ledger)
+            Rule("residency_pressure", "lgbm_fleet_resident_bytes", ">=",
+                 hwm * budget_mb * (1 << 20), "sustained",
+                 for_ticks=sustain, window=window))
     if bool(getattr(config, "tpu_trend", False)):
         twin = int(getattr(config, "tpu_trend_window", 0) or 16)
         tslope = float(getattr(config, "tpu_alert_trend_slope", 0.01)

@@ -137,13 +137,22 @@ def estimate_device_bytes(trees: List, num_classes: int,
 
 class DeviceEnsemble:
     """Stacked ensemble for device prediction; built once per model state
-    (callers cache on len(models))."""
+    (callers cache on len(models)).
 
-    def __init__(self, trees: List, num_classes: int):
+    `device`: commit the ensemble's arrays to that jax device
+    (``jax.device_put``).  Committed constants force every jit dispatch
+    onto that device (uncommitted row inputs follow), which is how the
+    serving replica sets (serving/replicas.py) pin one copy per fault
+    domain.  None keeps the historical uncommitted ``jnp.asarray``
+    placement — the default-device path, byte-identical to pre-replica
+    behavior."""
+
+    def __init__(self, trees: List, num_classes: int, device=None):
         lay = ensemble_layout(trees, num_classes)
         self.k = lay["k"]
         self.num_trees = len(trees)
         self.ok = lay["ok"]
+        self.device = device
         T, N, L, W = lay["T"], lay["N"], lay["L"], lay["W"]
         self.T, self.N, self.L, self.W = T, N, L, W
         if not self.ok:
@@ -198,8 +207,13 @@ class DeviceEnsemble:
 
         self.x64 = bool(jax.config.jax_enable_x64)
         fdt = jnp.float64 if self.x64 else jnp.float32
-        self.sf_flat = jnp.asarray(sf.reshape(-1).astype(np.int32))
-        self.thr_flat = jnp.asarray(thr.reshape(-1), fdt)
+
+        def _dev(a, dtype=None):
+            arr = jnp.asarray(a) if dtype is None else jnp.asarray(a, dtype)
+            return arr if device is None else jax.device_put(arr, device)
+
+        self.sf_flat = _dev(sf.reshape(-1).astype(np.int32))
+        self.thr_flat = _dev(thr.reshape(-1), fdt)
         if self.x64:
             self.thr_lo = None
         else:
@@ -207,16 +221,16 @@ class DeviceEnsemble:
             # thresholds stay ~2^-48-exact in f32 (the host walk compares
             # in f64; a plain f32 downcast would flip boundary rows)
             t_hi = thr.reshape(-1).astype(np.float32)
-            self.thr_lo = jnp.asarray(
+            self.thr_lo = _dev(
                 (thr.reshape(-1) - t_hi.astype(np.float64))
                 .astype(np.float32))
-        self.dl_flat = jnp.asarray(dl.reshape(-1))
-        self.mt_flat = jnp.asarray(mt.reshape(-1).astype(np.int32))
-        self.ic_flat = jnp.asarray(ic.reshape(-1)) if any_cat else None
-        self.cat = jnp.asarray(cat) if any_cat else None
-        self.sig = jnp.asarray(sig, jnp.bfloat16)          # +-1/0 exact
-        self.path_len = jnp.asarray(path_len.astype(np.float32))
-        self.lv = jnp.asarray(lv, fdt)
+        self.dl_flat = _dev(dl.reshape(-1))
+        self.mt_flat = _dev(mt.reshape(-1).astype(np.int32))
+        self.ic_flat = _dev(ic.reshape(-1)) if any_cat else None
+        self.cat = _dev(cat) if any_cat else None
+        self.sig = _dev(sig, jnp.bfloat16)                 # +-1/0 exact
+        self.path_len = _dev(path_len.astype(np.float32))
+        self.lv = _dev(lv, fdt)
 
     def predict_sum(self, X: np.ndarray, num_iteration: int) -> np.ndarray:
         """[k, n] summed raw scores over the first num_iteration*k trees."""

@@ -89,6 +89,9 @@ CONFIG_HASH_EXCLUDE = frozenset({
     "tpu_serve_shed_queue_rows", "tpu_serve_shed_retry_after_s",
     "tpu_serve_breaker_failures", "tpu_serve_breaker_reset_s",
     "tpu_serve_drain_timeout_s",
+    "tpu_replica_count", "tpu_replica_min", "tpu_replica_max",
+    "tpu_replica_probe_interval_s", "tpu_replica_probe_deadline_ms",
+    "tpu_replica_breaker_failures", "tpu_replica_breaker_reset_s",
     "tpu_continuous_learning", "tpu_refit_interval_s", "tpu_refit_min_rows",
     "tpu_refit_mode", "tpu_refit_rounds", "tpu_refit_buffer_rows",
     "tpu_refit_holdout_fraction", "tpu_promote_min_delta",

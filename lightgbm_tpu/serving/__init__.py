@@ -4,8 +4,10 @@ A model registry with versioned hot-swap (registry.py), an adaptive
 micro-batcher amortizing the ~100 ms device dispatch floor across
 concurrent requests (batcher.py), an in-process + stdlib-HTTP frontend
 (server.py, CLI task=serve), a byte-accounted HBM residency manager for
-multi-tenant fleets (fleet.py), request-path observability (metrics.py)
-and a small client (client.py).  See docs/Serving.md and docs/Fleet.md.
+multi-tenant fleets (fleet.py), per-device replica sets with
+health-probed routing and loss-free failover (replicas.py),
+request-path observability (metrics.py) and a small client (client.py).
+See docs/Serving.md, docs/Fleet.md and docs/Replicas.md.
 """
 from .admission import (CircuitBreaker, DrainingError,  # noqa: F401
                         ShedError, TenantQuota)
@@ -18,6 +20,7 @@ from .fleet import (FleetFaultInjector,  # noqa: F401
 from .metrics import Histogram, ModelStats  # noqa: F401
 from .registry import (ModelEntry, ModelNotFoundError,  # noqa: F401
                        ModelRegistry)
+from .replicas import Replica, ReplicaRouter, ReplicaSet  # noqa: F401
 from .server import Server  # noqa: F401
 from .shadow import ShadowMirror  # noqa: F401
 
@@ -29,4 +32,5 @@ __all__ = [
     "CircuitBreaker", "DrainingError", "ShedError", "ShadowMirror",
     "TenantQuota", "HbmResidencyManager", "ShapeBucketCache",
     "FleetFaultInjector", "publish_fleet_metrics",
+    "Replica", "ReplicaSet", "ReplicaRouter",
 ]

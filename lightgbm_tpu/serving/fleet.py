@@ -223,9 +223,20 @@ class HbmResidencyManager:
         self._queue: "queue.Queue[Optional[str]]" = queue.Queue()
         self._worker: Optional[threading.Thread] = None
         self._stopped = False
+        # per-device replica ledger (serving/replicas.py): device ordinal
+        # -> replica bytes parked there.  Device 0 is shared with the
+        # classic residency ledger above, so _make_room_locked treats its
+        # replica bytes as an immovable floor; devices 1..N-1 hold
+        # replicas only and are budget-checked independently — the
+        # admission invariant (resident + reserved <= budget) holds PER
+        # DEVICE, not just globally.
+        self._replica_bytes: Dict[Tuple[str, int], Tuple[int, int]] = {}
+        self._device_used: Dict[int, int] = {}
+        self._device_peak: Dict[int, int] = {}
         # counters (ints, bumped under the lock; scraped lock-free)
         self.resident_bytes = 0       # includes in-flight reservations
         self.peak_resident_bytes = 0
+        self.replica_reserve_failures = 0
         self.promotions = 0
         self.promote_retries = 0
         self.promote_failures = 0
@@ -350,8 +361,14 @@ class HbmResidencyManager:
 
     def release(self, name: str) -> None:
         """Forget a tenant (registry eviction): its accounted bytes
-        leave the budget and its record is dropped."""
+        leave the budget and its record is dropped.  Stray replica
+        reservations for the tenant (a ReplicaSet that was not stopped
+        first) are dropped from the per-device ledger too."""
         with self._lock:
+            for key in [k for k in self._replica_bytes if k[0] == name]:
+                dev_ord, b = self._replica_bytes.pop(key)
+                self._device_used[dev_ord] = max(
+                    self._device_used.get(dev_ord, 0) - b, 0)
             rec = self._records.pop(name, None)
             if rec is None:
                 return
@@ -361,6 +378,75 @@ class HbmResidencyManager:
             entry = rec.entry
         self._drop_device_state(entry)
         self._event("release", model=name)
+
+    # -- per-device replica ledger (serving/replicas.py) ---------------- #
+    def reserve_replica(self, name: str, slot: int, dev_ord: int,
+                        est: int) -> bool:
+        """Reserve `est` bytes for replica `slot` of tenant `name` on
+        device `dev_ord` (admission-before-allocation, same as
+        promotion).  Device 0 shares the budget with the classic
+        residency ledger — LRU residents are spilled to make room
+        exactly like a promotion would; devices 1..N-1 hold replicas
+        only, so the check is a plain per-device budget test.  Returns
+        False (counted) when the replica does not fit: the ReplicaSet
+        places fewer copies — capacity degrades, admission stays exact."""
+        est = int(est or 0)
+        dev_ord = int(dev_ord)
+        key = (str(name), int(slot))
+        victims: List[Tuple] = []
+        with self._lock:
+            if key in self._replica_bytes:
+                return True       # idempotent double-reserve
+            if self.budget_bytes <= 0:
+                fits = True       # unbudgeted manager: track, never refuse
+            elif dev_ord == 0:
+                fits, victims = self._make_room_locked(est, exclude=name)
+            else:
+                fits = (self._device_used.get(dev_ord, 0) + est
+                        <= self.budget_bytes)
+            if fits:
+                self._replica_bytes[key] = (dev_ord, est)
+                used = self._device_used.get(dev_ord, 0) + est
+                self._device_used[dev_ord] = used
+                if used > self._device_peak.get(dev_ord, 0):
+                    self._device_peak[dev_ord] = used
+            else:
+                self.replica_reserve_failures += 1
+        self._finish_spills(victims)
+        if not fits:
+            log.warning("fleet: no room for replica %d of %s on device %d "
+                        "(%d bytes)", slot, name, dev_ord, est)
+            self._event("replica_reserve_failed", model=name, slot=slot,
+                        device=dev_ord, est_bytes=est)
+        return fits
+
+    def commit_replica(self, name: str, slot: int, actual: int) -> None:
+        """Adjust a reservation to the built ensemble's actual bytes
+        (estimate -> exact, same as promotion's commit)."""
+        key = (str(name), int(slot))
+        actual = int(actual)
+        with self._lock:
+            rec = self._replica_bytes.get(key)
+            if rec is None:
+                return
+            dev_ord, est = rec
+            self._replica_bytes[key] = (dev_ord, actual)
+            used = max(self._device_used.get(dev_ord, 0) + actual - est, 0)
+            self._device_used[dev_ord] = used
+            if used > self._device_peak.get(dev_ord, 0):
+                self._device_peak[dev_ord] = used
+
+    def release_replica(self, name: str, slot: int) -> None:
+        """Return a replica's bytes to its device's budget (ReplicaSet
+        stop/scale-down; in-flight dispatches finish on references)."""
+        key = (str(name), int(slot))
+        with self._lock:
+            rec = self._replica_bytes.pop(key, None)
+            if rec is None:
+                return
+            dev_ord, b = rec
+            self._device_used[dev_ord] = max(
+                self._device_used.get(dev_ord, 0) - b, 0)
 
     def stop(self) -> None:
         """Stop the promotion worker (idempotent)."""
@@ -624,13 +710,17 @@ class HbmResidencyManager:
         budget.  Returns (fits, victims); the caller ALWAYS finishes the
         victims' spill outside the lock — even on a failed fit — so no
         device bytes outlive the accounting."""
-        if self.budget_bytes <= 0 or incoming > self.budget_bytes:
+        # replica bytes parked on device 0 (per-device ledger) shrink the
+        # classic ledger's room; they are pinned by their ReplicaSet, so
+        # they act as an immovable floor, never as eviction candidates
+        floor = self._device_used.get(0, 0)
+        if self.budget_bytes <= 0 or incoming + floor > self.budget_bytes:
             return False, []
         victims: List[Tuple] = []
         trigger = self.high_watermark * self.budget_bytes
         target = min(self.low_watermark * self.budget_bytes,
-                     self.budget_bytes - incoming)
-        if self.resident_bytes + incoming > trigger:
+                     self.budget_bytes - incoming) - floor
+        if self.resident_bytes + floor + incoming > trigger:
             cands = sorted(
                 (r for r in self._records.values()
                  if r.state == RESIDENT and r.name != exclude),
@@ -648,7 +738,8 @@ class HbmResidencyManager:
                 r.state = SPILLED
         # remaining overshoot means everything else is an in-flight
         # reservation: the caller backs off and retries
-        return self.resident_bytes + incoming <= self.budget_bytes, victims
+        return (self.resident_bytes + floor + incoming
+                <= self.budget_bytes), victims
 
     def _finish_spills(self, victims: List[Tuple]) -> None:
         """OUTSIDE the lock: drop the victims' device caches and record
@@ -722,10 +813,20 @@ class HbmResidencyManager:
                          "promote_failures": r.promote_failures,
                          "spilled_snapshot": r.spill_sha is not None}
                 for r in self._records.values()}
+            devices = {
+                str(d): {"replica_bytes": self._device_used.get(d, 0),
+                         "peak_replica_bytes": self._device_peak.get(d, 0),
+                         "replicas": sum(
+                             1 for (dv, _b) in self._replica_bytes.values()
+                             if dv == d)}
+                for d in sorted(set(self._device_used)
+                                | set(self._device_peak))}
             return {
                 "budget_bytes": self.budget_bytes,
                 "resident_bytes": self.resident_bytes,
                 "peak_resident_bytes": self.peak_resident_bytes,
+                "devices": devices,
+                "replica_reserve_failures": self.replica_reserve_failures,
                 "high_watermark": self.high_watermark,
                 "low_watermark": self.low_watermark,
                 "promotions": self.promotions,

@@ -44,6 +44,11 @@ class ModelEntry:
         # per-batch device/host decision then also asks "is this tenant
         # device-RESIDENT right now?" (serving/fleet.py)
         self.fleet = fleet
+        # ReplicaSet (serving/replicas.py) when the tenant is replicated
+        # across device fault domains; None keeps the single-device path
+        # (tpu_replica_count=1 must stay byte-identical to pre-replica
+        # serving, so the classic path below is untouched)
+        self.replicas = None
         self.loaded_at = time.time()
         self.warmed_buckets: List[int] = []
         g = booster._gbdt
@@ -72,6 +77,12 @@ class ModelEntry:
         silent unaccounted rebuild through the gbdt cache."""
         g = self.booster._gbdt
         if self.use_device(X.shape[0]):
+            rset = self.replicas
+            if rset is not None:
+                # replicated tenant: least-outstanding routing across the
+                # per-device copies, loss-free failover, host walk only
+                # when zero replicas are healthy (serving/replicas.py)
+                return rset.predict(X, raw_score=raw_score)
             if self.fleet is None:
                 return self.predict_device(X, raw_score=raw_score), True
             ens = self.fleet.checkout(self.name, self)
@@ -122,6 +133,9 @@ class ModelEntry:
         }
         if self.fleet is not None:
             out["residency"] = self.fleet.residency(self.name)
+        rset = self.replicas
+        if rset is not None:
+            out["replicas"] = rset.snapshot()
         return out
 
 
@@ -134,17 +148,25 @@ class ModelRegistry:
                  max_batch_rows: int = 256,
                  warmup_buckets: Optional[List[int]] = None,
                  profiler: Optional[Profiler] = None,
-                 fleet=None):
+                 fleet=None, replica_count: int = 1,
+                 replica_opts: Optional[Dict] = None):
         self.max_models = max(int(max_models), 1)
         # HbmResidencyManager (serving/fleet.py) when device residency is
         # byte-budgeted; None keeps the pre-fleet always-resident behavior
         self.fleet = fleet
+        # replica_count > 1: every loaded tenant gets a ReplicaSet
+        # (serving/replicas.py) at that count; exactly 1 keeps the
+        # classic single-device path (entry.replicas stays None)
+        self.replica_count = max(int(replica_count), 1)
+        self.replica_opts = dict(replica_opts or {})
         self.min_device_work = int(min_device_work)
         self.max_batch_rows = int(max_batch_rows)
         # [] / None -> every pow2 bucket the batcher can emit
         self.warmup_bucket_list = (list(warmup_buckets) if warmup_buckets
                                    else predict_ops.pow2_buckets(
                                        self.max_batch_rows))
+        self.replica_opts.setdefault("warmup_buckets",
+                                     self.warmup_bucket_list)
         self.profiler = profiler or Profiler(enabled=True)
         self._lock = threading.Lock()
         self._entries: Dict[str, ModelEntry] = {}
@@ -194,7 +216,7 @@ class ModelRegistry:
             # residency accounting only ever tracks the live version
             with self.profiler.phase("serve/model_warmup"):
                 entry.warmup(self.warmup_bucket_list)
-        evicted: List[str] = []
+        evicted: List[ModelEntry] = []
         with self._lock:
             current = self._versions.get(name, 0)
             if version < current:
@@ -211,18 +233,23 @@ class ModelRegistry:
             while len(self._entries) > self.max_models:
                 lru = min((n for n in self._entries if n != name),
                           key=lambda n: self._last_used.get(n, 0.0))
-                del self._entries[lru]
+                evicted.append(self._entries.pop(lru))
                 self._last_used.pop(lru, None)
                 self._prior.pop(lru, None)
-                evicted.append(lru)
-        for n in evicted:
+        # the demoted entry's replicas release their device bytes NOW
+        # (rollback rebuilds a fresh set at the same count); in-flight
+        # batches on the old set finish on references
+        self._stop_replicas(demoted)
+        for dropped in evicted:
             log.warning("registry over capacity (%d): evicted %s",
-                        self.max_models, n)
+                        self.max_models, dropped.name)
+            self._stop_replicas(dropped)
             if self.fleet is not None:
-                self.fleet.release(n)
+                self.fleet.release(dropped.name)
         if self.fleet is not None:
             with self.profiler.phase("serve/model_warmup"):
                 self.fleet.admit(entry, promote=warmup)
+        self._attach_replicas(entry, self.replica_count)
         log.info("registry: %s v%d live (%d trees, %d features, "
                  "buckets %s)", name, entry.version, entry.num_trees,
                  entry.num_features, entry.warmed_buckets or "host-only")
@@ -250,7 +277,17 @@ class ModelRegistry:
         re-warmed right after install, outside the lock.
         Current and prior swap places, so a bad rollback can itself be
         rolled back.  Raises ModelNotFoundError when there is no prior
-        version to return to."""
+        version to return to.
+
+        Replica-aware: a replicated tenant rolls back AT ITS CURRENT
+        replica count — the count is read and the new entry installed in
+        ONE critical section, so a concurrent set_replica_count cannot
+        interleave between "decide the count" and "install the entry"
+        and silently drop the fleet back to one copy.  The demoted set's
+        device bytes are released outside the lock and a fresh set is
+        built for the reinstalled version (requests ride the host walk
+        for the build's duration, exactly like the fleet re-promotion
+        path)."""
         with self._lock:
             current = self._entries.get(name)
             prior = self._prior.get(name)
@@ -269,9 +306,15 @@ class ModelRegistry:
                           and cache[1] is not None)
             entry.warmed_buckets = (list(prior.warmed_buckets)
                                     if still_warm else [])
+            # ONE critical section: count decision + entry install —
+            # the reinstalled version keeps the demoted one's replica
+            # count even when set_replica_count races this rollback
+            keep_count = (current.replicas.count
+                          if current.replicas is not None else 1)
             self._entries[name] = entry
             self._prior[name] = current
             self._last_used[name] = time.time()
+        self._stop_replicas(current)
         if self.fleet is not None:
             # async re-promotion: the rollback stays O(dict assignment),
             # requests ride the host walk until the build commits
@@ -281,6 +324,7 @@ class ModelRegistry:
             # re-promote now (outside the lock) instead of serving a
             # torn entry that claims warm buckets it does not have
             entry.warmup(self.warmup_bucket_list)
+        self._attach_replicas(entry, keep_count)
         log.warning("registry: %s rolled back to v%d (the v%d booster)",
                     name, version, prior.version)
         default_registry().counter(
@@ -305,16 +349,91 @@ class ModelRegistry:
 
     def evict(self, name: str) -> bool:
         with self._lock:
-            existed = self._entries.pop(name, None) is not None
+            dropped = self._entries.pop(name, None)
             self._last_used.pop(name, None)
             self._prior.pop(name, None)
             # keep the version counter: a re-load of the same name must
             # not reuse a version clients may have already seen
-        if existed:
+        if dropped is not None:
+            self._stop_replicas(dropped)
             if self.fleet is not None:
                 self.fleet.release(name)
             log.info("registry: evicted %s", name)
-        return existed
+        return dropped is not None
+
+    # -- replicas ------------------------------------------------------- #
+    def replica_set(self, name: str):
+        """The tenant's live ReplicaSet, or None (no LRU touch — this is
+        the metrics-scrape accessor)."""
+        with self._lock:
+            entry = self._entries.get(name)
+            return None if entry is None else entry.replicas
+
+    def set_replica_count(self, name: str, n: int) -> int:
+        """The control plane's replica actuator: grow or shrink `name`
+        to `n` per-device replicas.  ``n == 1`` tears the ReplicaSet
+        down entirely — the tenant returns to the EXACT single-device
+        path (entry.replicas is None), so scale-to-one is byte-identical
+        to never having replicated.  Builds run outside the registry
+        lock; installs re-check the entry is still current.  Returns the
+        resulting count (growth may fall short of `n` when devices have
+        no room)."""
+        n = max(int(n), 1)
+        with self._lock:
+            entry = self._entries.get(name)
+            if entry is None:
+                raise ModelNotFoundError(name)
+            rset = entry.replicas
+            if n == 1:
+                entry.replicas = None
+        if n == 1:
+            if rset is not None:
+                rset.stop()
+                log.info("registry: %s scaled down to the single-device "
+                         "path", name)
+            return 1
+        if rset is not None:
+            got = rset.resize(n)
+            log.info("registry: %s resized to %d replica(s)", name, got)
+            return got
+        got = self._attach_replicas(entry, n)
+        return got.count if got is not None else 1
+
+    def _attach_replicas(self, entry: ModelEntry, count: int):
+        """Build a ReplicaSet for `entry` OUTSIDE the lock and install
+        it only if the entry is still current (the stale-load discipline
+        every expensive registry operation follows).  Never raises — a
+        replica build failure leaves the classic path serving."""
+        if count <= 1:
+            return None
+        from .replicas import ReplicaSet
+        try:
+            rset = ReplicaSet(entry, count, fleet=self.fleet,
+                              **self.replica_opts)
+        except Exception as exc:  # noqa: BLE001 — replicas degrade, never fail a load
+            log.warning("registry: replica set for %s failed (%s); "
+                        "single-device path stays live", entry.name, exc)
+            return None
+        if rset.count == 0:
+            # host-only model or zero placements: nothing to route to
+            rset.stop()
+            return None
+        with self._lock:
+            if (self._entries.get(entry.name) is entry
+                    and entry.replicas is None):
+                entry.replicas = rset
+                log.info("registry: %s serving on %d replica(s)",
+                         entry.name, rset.count)
+                return rset
+        rset.stop()          # the entry was swapped/evicted mid-build
+        return None
+
+    @staticmethod
+    def _stop_replicas(entry: Optional[ModelEntry]) -> None:
+        if entry is None or entry.replicas is None:
+            return
+        rset, entry.replicas = entry.replicas, None
+        rset.stop()
 
     def names(self) -> List[str]:
         with self._lock:

@@ -1,0 +1,441 @@
+"""Device-fault-domain replicated serving.
+
+One device ensemble per tenant (the pre-replica serving path) makes
+every local device a shared fate domain: a single sick device trips the
+tenant's circuit breaker and drops ALL of its traffic onto the ~100x
+slower NumPy host walk.  This module turns the local devices into
+independent fault domains:
+
+- **ReplicaSet**: N copies of the frozen ``DeviceEnsemble``, committed
+  to distinct local devices round-robin (``jax.device_put`` pins the
+  ensemble constants, so every jit dispatch against replica *i* executes
+  on device *i*'s fault domain).  Admission stays exact: each replica is
+  priced with ``estimate_device_bytes`` and reserved against the
+  ``HbmResidencyManager``'s per-device byte ledger BEFORE its arrays are
+  built, so ``resident + reserved <= budget`` holds per device, not just
+  globally.  A replica that does not fit is simply not placed — capacity
+  degrades, admission never lies.
+- **ReplicaRouter**: least-outstanding-rows routing in front of the
+  micro-batcher.  Every batch is dispatched to the healthy replica with
+  the fewest in-flight rows; a dispatch failure marks the victim,
+  reroutes the SAME rows to the next sibling (requeue-not-drop — the
+  batch is never lost, never answered with an error while a sibling can
+  serve it), and only when ZERO replicas are healthy does the batch ride
+  the always-available host walk.
+- **Per-device health**: each replica carries its own ``CircuitBreaker``
+  plus an optional periodic liveness probe (a tiny one-row dispatch with
+  a deadline).  An open breaker removes the replica from routing; after
+  ``reset_s`` the breaker's half-open probe — taken by the router or the
+  prober, whichever dispatches first — re-admits the device
+  automatically and the router re-balances.  Recovery needs no operator
+  action.
+
+Scaling is a control-plane lever: ``ModelRegistry.set_replica_count``
+resizes a live set (build outside the registry lock, install under it),
+and the server binds it to the process actuator as the
+``set_replica_count`` policy action (control/policy.py scales up on
+sustained queue-depth alerts, down on residency pressure).
+
+Lock discipline (tpulint `locks` family): ``_lock`` guards the replica
+list, the outstanding-rows table and the counters; ensemble builds,
+warmups, dispatches and probe predicts all run OUTSIDE it.  Breakers
+carry their own internal lock.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..obs import tracing as obs_tracing
+from ..obs.recorder import fleet_event
+from ..ops import predict as predict_ops
+from ..utils import log
+from .admission import CircuitBreaker
+
+
+def local_devices() -> list:
+    """The process-local jax devices (import deferred so host-only
+    tooling can import this module without initializing a backend)."""
+    import jax
+    return list(jax.local_devices())
+
+
+class Replica:
+    """One placed copy: a device-committed ensemble plus its own
+    breaker and counters.  Mutable fields are guarded by the owning
+    ReplicaSet's lock (outstanding/dispatches/failures/probes); the
+    breaker is internally locked."""
+
+    __slots__ = ("slot", "dev_ord", "device", "ens", "breaker",
+                 "outstanding", "dispatches", "failures", "probes")
+
+    def __init__(self, slot: int, dev_ord: int, device, ens,
+                 breaker: CircuitBreaker):
+        self.slot = slot
+        self.dev_ord = dev_ord
+        self.device = device
+        self.ens = ens
+        self.breaker = breaker
+        self.outstanding = 0          # in-flight rows (router load signal)
+        self.dispatches = 0
+        self.failures = 0
+        self.probes = 0
+
+    def healthy(self) -> bool:
+        return self.breaker.state == CircuitBreaker.CLOSED
+
+
+class ReplicaSet:
+    """N per-device replicas of one tenant's frozen ensemble, with
+    least-outstanding-rows routing, per-replica breakers, loss-free
+    failover and an optional liveness prober.
+
+    ``predict`` is the hot path the micro-batcher's batches land on (via
+    ``ModelEntry.predict``); it returns ``(scores, used_device)`` with
+    the same output contract as the single-device path — replicas change
+    WHERE a batch executes, never what it returns.
+    """
+
+    def __init__(self, entry, count: int, fleet=None,
+                 breaker_failures: int = 3, breaker_reset_s: float = 5.0,
+                 probe_interval_s: float = 0.0,
+                 probe_deadline_ms: float = 1000.0,
+                 warmup_buckets: Optional[List[int]] = None,
+                 config=None, clock=time.monotonic):
+        self.entry = entry
+        self.fleet = fleet
+        self.breaker_failures = max(int(breaker_failures), 1)
+        self.breaker_reset_s = max(float(breaker_reset_s), 0.0)
+        self.probe_interval_s = max(float(probe_interval_s), 0.0)
+        self.probe_deadline_ms = max(float(probe_deadline_ms), 1e-3)
+        self.warmup_buckets = list(warmup_buckets or [])
+        self.config = config
+        self._clock = clock
+        self._devices = local_devices()
+        self._lock = threading.Lock()
+        self._resize_lock = threading.Lock()  # serializes resize/stop
+        self._replicas: List[Replica] = []
+        self._events: "collections.deque" = collections.deque(maxlen=64)
+        self._injector = None
+        self._stop_event = threading.Event()
+        self._prober: Optional[threading.Thread] = None
+        self._stopped = False
+        self._rr = 0                  # rotating tie-break (see _pick)
+        # counters (bumped under the lock; scraped lock-free)
+        self.failovers = 0            # batches rerouted off a failed replica
+        self.host_fallbacks = 0       # batches with zero healthy replicas
+        self.reserve_failures = 0     # replicas skipped: no device room
+        for slot in range(max(int(count), 0)):
+            rep = self._build_replica(slot)
+            if rep is not None:
+                with self._lock:
+                    self._replicas.append(rep)
+        self._start_prober()
+
+    # -- placement ----------------------------------------------------- #
+    def _build_replica(self, slot: int) -> Optional[Replica]:
+        """Reserve bytes on the slot's device, then build the committed
+        ensemble OUTSIDE any lock and true-up the reservation.  Returns
+        None (counted, evented) when the device has no room or the model
+        is host-only — the set simply holds fewer replicas."""
+        g = self.entry.booster._gbdt
+        est = predict_ops.estimate_device_bytes(
+            g.models, g.num_tree_per_iteration)
+        if est is None:
+            return None               # device-incapable model: host walk
+        dev_ord = slot % max(len(self._devices), 1)
+        name = self.entry.name
+        if self.fleet is not None and not self.fleet.reserve_replica(
+                name, slot, dev_ord, est):
+            with self._lock:
+                self.reserve_failures += 1
+            self._record_event("reserve_failed", slot=slot, device=dev_ord,
+                               est_bytes=est)
+            return None
+        try:
+            ens = predict_ops.DeviceEnsemble(
+                g.models, g.num_tree_per_iteration,
+                device=self._devices[dev_ord])
+            if not ens.ok:
+                raise RuntimeError("ensemble layout not device-capable")
+            self._warm_replica(ens, dev_ord)
+        except Exception as exc:  # noqa: BLE001 — degrade, never raise
+            if self.fleet is not None:
+                self.fleet.release_replica(name, slot)
+            log.warning("replicas: build of %s slot %d on device %d "
+                        "failed: %s", name, slot, dev_ord, exc)
+            self._record_event("build_failed", slot=slot, device=dev_ord,
+                               error=str(exc))
+            return None
+        if self.fleet is not None:
+            self.fleet.commit_replica(name, slot, ens.device_bytes())
+        breaker = CircuitBreaker(failure_threshold=self.breaker_failures,
+                                 reset_s=self.breaker_reset_s,
+                                 clock=self._clock)
+        return Replica(slot, dev_ord, self._devices[dev_ord], ens, breaker)
+
+    def _warm_replica(self, ens, dev_ord: int) -> None:
+        """Pre-compile the bucket executables on the replica's device.
+        The fleet compile cache key is extended with the DEVICE ordinal:
+        jit executables for committed arrays are device-specific, so a
+        sibling's warmth on device 0 must not suppress device 1's warmup
+        (shape signatures alone would false-share)."""
+        entry = self.entry
+        g = entry.booster._gbdt
+        iters = len(g.models) // max(g.num_tree_per_iteration, 1)
+        cache = self.fleet.compile_cache if self.fleet is not None else None
+        sig = ens.shape_signature(entry.num_features) + ("dev", dev_ord)
+        for b in sorted({int(x) for x in self.warmup_buckets}):
+            if b <= 0 or not entry.use_device(b):
+                continue
+            if cache is not None and cache.check(sig, b):
+                continue
+            ens.warmup_buckets(entry.num_features, [b], iters)
+            if cache is not None:
+                cache.mark(sig, b)
+
+    # -- routing / failover -------------------------------------------- #
+    def predict(self, X: np.ndarray, raw_score: bool = False):
+        """Route one batch: least-outstanding healthy replica first,
+        loss-free failover to siblings on dispatch failure, host walk
+        only when zero replicas are healthy.  Returns
+        ``(scores, used_device)`` — dispatch exceptions never escape to
+        the batcher (the per-model breaker stays closed; health is
+        tracked per DEVICE here)."""
+        g = self.entry.booster._gbdt
+        rows = int(X.shape[0])
+        tried: set = set()
+        while True:
+            rep = self._pick(tried)
+            if rep is None:
+                with self._lock:
+                    self.host_fallbacks += 1
+                self._record_event("host_fallback", rows=rows)
+                return g.predict(X, raw_score=raw_score, device=False), False
+            prev_state = rep.breaker.state
+            with self._lock:
+                rep.outstanding += rows
+            try:
+                if self._injector is not None:
+                    self._injector.check("replica:%d" % rep.slot)
+                out = g.predict_bucketed(
+                    X, raw_score=raw_score, max_bucket=self.entry.max_bucket,
+                    ensemble=rep.ens)
+            except Exception as exc:  # noqa: BLE001 — reroute, never drop
+                rep.breaker.record_failure()
+                with self._lock:
+                    rep.failures += 1
+                    self.failovers += 1
+                tried.add(rep.slot)
+                with obs_tracing.span("serving/failover", "serve",
+                                      model=self.entry.name,
+                                      victim_slot=rep.slot,
+                                      victim_device=rep.dev_ord, rows=rows):
+                    self._record_event("failover", victim=rep.slot,
+                                       device=rep.dev_ord, rows=rows,
+                                       error=str(exc))
+                if rep.breaker.state == CircuitBreaker.OPEN \
+                        and prev_state != CircuitBreaker.OPEN:
+                    self._record_event("breaker_open", victim=rep.slot,
+                                       device=rep.dev_ord)
+                log.warning("replicas: %s slot %d (device %d) dispatch "
+                            "failed (%s); rerouting %d rows",
+                            self.entry.name, rep.slot, rep.dev_ord, exc,
+                            rows)
+                continue
+            finally:
+                with self._lock:
+                    rep.outstanding -= rows
+            rep.breaker.record_success()
+            with self._lock:
+                rep.dispatches += 1
+            if prev_state != CircuitBreaker.CLOSED:
+                self._record_event("readmit", slot=rep.slot,
+                                   device=rep.dev_ord)
+            return out, True
+
+    def _pick(self, tried: set) -> Optional[Replica]:
+        """Least-outstanding-rows healthy candidate, ties broken by a
+        rotating counter.  The micro-batcher dispatches serially, so at
+        pick time every replica is usually idle — a fixed tie-break
+        would pin ALL traffic to one slot, leaving the siblings as cold
+        (and therefore untested) standbys; the rotation keeps every
+        device's executables and health continuously exercised.
+        ``allow()`` is consulted in sorted order: it consumes a
+        half-open probe token ONLY when it returns True, and a True
+        here always leads to a dispatch — so recovering replicas get
+        exactly one organic probe batch, never a wasted token."""
+        with self._lock:
+            cands = [r for r in self._replicas if r.slot not in tried]
+            if cands:
+                self._rr = (self._rr + 1) % (1 << 30)
+                off = self._rr % len(cands)
+                cands = cands[off:] + cands[:off]
+        cands.sort(key=lambda r: r.outstanding)  # stable: rotation = ties
+        for rep in cands:
+            if rep.breaker.allow():
+                return rep
+        return None
+
+    # -- liveness probing ---------------------------------------------- #
+    def _start_prober(self) -> None:
+        if self.probe_interval_s <= 0 or self._prober is not None:
+            return
+        self._prober = threading.Thread(
+            target=self._probe_loop, daemon=True,
+            name="lgbm-replica-probe-%s" % self.entry.name)
+        self._prober.start()
+
+    def _probe_loop(self) -> None:
+        Xp = np.zeros((1, self.entry.num_features), np.float64)
+        g = self.entry.booster._gbdt
+        while not self._stop_event.wait(self.probe_interval_s):
+            with self._lock:
+                reps = list(self._replicas)
+            for rep in reps:
+                if self._stop_event.is_set():
+                    return
+                if not rep.breaker.allow():
+                    continue
+                prev_state = rep.breaker.state
+                t0 = time.monotonic()
+                ok = True
+                try:
+                    if self._injector is not None:
+                        self._injector.check("replica:%d" % rep.slot)
+                    g.predict_bucketed(Xp, max_bucket=self.entry.max_bucket,
+                                       ensemble=rep.ens)
+                except Exception:  # noqa: BLE001 — a probe failure IS data
+                    ok = False
+                if (time.monotonic() - t0) * 1e3 > self.probe_deadline_ms:
+                    ok = False    # a stuck device must not pass its probe
+                with self._lock:
+                    rep.probes += 1
+                if ok:
+                    rep.breaker.record_success()
+                    if prev_state != CircuitBreaker.CLOSED:
+                        self._record_event("readmit", slot=rep.slot,
+                                           device=rep.dev_ord, probe=True)
+                else:
+                    rep.breaker.record_failure()
+                    with self._lock:
+                        rep.failures += 1
+                    if rep.breaker.state == CircuitBreaker.OPEN \
+                            and prev_state != CircuitBreaker.OPEN:
+                        self._record_event("breaker_open", victim=rep.slot,
+                                           device=rep.dev_ord, probe=True)
+
+    # -- scaling ------------------------------------------------------- #
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def resize(self, n: int) -> int:
+        """Grow or shrink to `n` replicas.  Builds run outside the lock;
+        shrink pops the highest slots and returns their bytes to the
+        per-device ledger (in-flight dispatches finish on references).
+        Returns the resulting count (growth may fall short when devices
+        have no room)."""
+        n = max(int(n), 0)
+        with self._resize_lock:
+            with self._lock:
+                if self._stopped:
+                    return 0
+                cur = len(self._replicas)
+                next_slot = ((self._replicas[-1].slot + 1)
+                             if self._replicas else 0)
+                doomed = []
+                if n < cur:
+                    doomed = self._replicas[n:]
+                    del self._replicas[n:]
+            if n > cur:
+                for slot in range(next_slot, next_slot + (n - cur)):
+                    rep = self._build_replica(slot)
+                    if rep is not None:
+                        with self._lock:
+                            self._replicas.append(rep)
+                self._record_event("scale_up", requested=n, got=self.count)
+            elif n < cur:
+                for rep in doomed:
+                    if self.fleet is not None:
+                        self.fleet.release_replica(self.entry.name, rep.slot)
+                    rep.ens = None
+                self._record_event("scale_down", requested=n, got=self.count)
+        return self.count
+
+    def stop(self) -> None:
+        """Halt the prober and return every replica's bytes (idempotent;
+        in-flight dispatches finish on plain references — the hot-swap
+        semantics every other serving teardown uses)."""
+        with self._resize_lock:
+            with self._lock:
+                if self._stopped:
+                    return
+                self._stopped = True
+                doomed, self._replicas = self._replicas, []
+            self._stop_event.set()
+            prober, self._prober = self._prober, None
+            if prober is not None:
+                prober.join(timeout=5.0)
+            for rep in doomed:
+                if self.fleet is not None:
+                    self.fleet.release_replica(self.entry.name, rep.slot)
+                rep.ens = None
+
+    # -- chaos / observability ----------------------------------------- #
+    def arm_injector(self, injector) -> None:
+        """Chaos hook: dispatches for replica slot `i` consult the
+        injector op ``"replica:<i>"`` — `inj.fail("replica:1", count=8)`
+        kills slot 1's next 8 dispatches (router AND prober)."""
+        with self._lock:
+            self._injector = injector
+
+    def _record_event(self, what: str, **fields) -> None:
+        ev = dict(what=what, model=self.entry.name, **fields)
+        with self._lock:
+            self._events.append(ev)
+        if self.config is not None:
+            fleet_event(self.config, "replica_" + what,
+                        model=self.entry.name, **fields)
+
+    def events(self) -> List[Dict]:
+        with self._lock:
+            return list(self._events)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            reps = [{
+                "slot": r.slot, "device": r.dev_ord,
+                "state": r.breaker.state, "healthy": r.healthy(),
+                "outstanding_rows": r.outstanding,
+                "dispatches": r.dispatches, "failures": r.failures,
+                "probes": r.probes,
+                "breaker": r.breaker.snapshot(),
+            } for r in self._replicas]
+            return {
+                "count": len(reps),
+                "healthy": sum(1 for r in reps if r["healthy"]),
+                "failovers": self.failovers,
+                "host_fallbacks": self.host_fallbacks,
+                "reserve_failures": self.reserve_failures,
+                "replicas": reps,
+                "events": list(self._events),
+            }
+
+
+class ReplicaRouter:
+    """Thin façade over a ReplicaSet's routing for callers that want the
+    router without the lifecycle (tests, benches): picks the
+    least-outstanding healthy replica and dispatches with loss-free
+    failover, exactly :meth:`ReplicaSet.predict`."""
+
+    def __init__(self, rset: ReplicaSet):
+        self.rset = rset
+
+    def route(self, X: np.ndarray, raw_score: bool = False):
+        return self.rset.predict(X, raw_score=raw_score)

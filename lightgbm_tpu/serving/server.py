@@ -69,7 +69,17 @@ class Server:
             max_batch_rows=cfg.serve_max_batch_rows,
             warmup_buckets=cfg.serve_warmup_buckets or None,
             profiler=self.profiler,
-            fleet=self.fleet)
+            fleet=self.fleet,
+            # tpu_replica_count=1 keeps entry.replicas None — the exact
+            # pre-replica single-device path (byte-identity is pinned by
+            # test); >1 places per-device fault-domain replicas
+            replica_count=cfg.tpu_replica_count,
+            replica_opts=dict(
+                breaker_failures=cfg.tpu_replica_breaker_failures,
+                breaker_reset_s=cfg.tpu_replica_breaker_reset_s,
+                probe_interval_s=cfg.tpu_replica_probe_interval_s,
+                probe_deadline_ms=cfg.tpu_replica_probe_deadline_ms,
+                config=cfg))
         self._batchers: Dict[str, MicroBatcher] = {}
         self._stats: Dict[str, ModelStats] = {}
         self._breakers: Dict[str, CircuitBreaker] = {}
@@ -117,6 +127,74 @@ class Server:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._http_thread: Optional[threading.Thread] = None
         self._start_t = time.time()
+        # replica-count lever for the policy engine (control/policy.py
+        # scales up on queue pressure, down on residency pressure);
+        # unbound in shutdown(), same pattern as the fleet's levers
+        self._policy_levers = self._bind_policy_levers()
+
+    # -- control-plane levers ------------------------------------------- #
+    def _bind_policy_levers(self):
+        if not bool(getattr(self.config, "tpu_policy", False)):
+            return None
+        from ..control import default_actuator
+
+        def set_replica_count(args):
+            return self._set_replica_count_lever(args or {})
+
+        act = default_actuator()
+        levers = [("set_replica_count", set_replica_count)]
+        for name, fn in levers:
+            act.bind(name, fn)
+        return levers
+
+    def _set_replica_count_lever(self, args: Dict) -> str:
+        """Actuator-facing replica scaling: absolute ``count`` or
+        relative ``delta``; without an explicit ``tenant`` the busiest
+        queue is scaled up / the most-replicated tenant down.  Clamped
+        to [tpu_replica_min, tpu_replica_max]; a no-op target raises so
+        the policy engine records it instead of silently 'succeeding'."""
+        delta = int(args.get("delta", 0))
+        count = args.get("count")
+        tenant = args.get("tenant") or args.get("model")
+        if tenant is None:
+            tenant = self._pick_scale_tenant(delta)
+        if tenant is None:
+            raise ValueError("no tenant eligible for replica scaling")
+        lo = max(int(self.config.tpu_replica_min), 1)
+        hi = max(int(self.config.tpu_replica_max), lo)
+        rset = self.registry.replica_set(tenant)
+        cur = rset.count if rset is not None else 1
+        target = int(count) if count is not None else cur + delta
+        target = min(max(target, lo), hi)
+        if target == cur:
+            raise ValueError(
+                "tenant %s already at %d replica(s) (bounds %d..%d)"
+                % (tenant, cur, lo, hi))
+        got = self.registry.set_replica_count(tenant, target)
+        obs_adapters.publish_replica_metrics(
+            self.metrics, tenant,
+            lambda _n=tenant: self.registry.replica_set(_n))
+        return "tenant %s replicas %d -> %d" % (tenant, cur, got)
+
+    def _pick_scale_tenant(self, delta: int) -> Optional[str]:
+        """Scale-up targets the deepest queue (the tenant the alert is
+        about); scale-down the most-replicated tenant (the biggest
+        residency refund)."""
+        with self._lock:
+            batchers = dict(self._batchers)
+        if delta >= 0:
+            best, depth = None, -1
+            for name, b in batchers.items():
+                d = b.queue_depth_rows()
+                if d > depth:
+                    best, depth = name, d
+            return best
+        best, count = None, 1
+        for name in batchers:
+            rset = self.registry.replica_set(name)
+            if rset is not None and rset.count > count:
+                best, count = name, rset.count
+        return best
 
     # -- model lifecycle ---------------------------------------------- #
     def load_model(self, name: Optional[str] = None,
@@ -152,6 +230,10 @@ class Server:
                 if self._quota is not None:
                     obs_adapters.publish_quota_metrics(
                         self.metrics, name, self._quota)
+        if entry.replicas is not None:
+            obs_adapters.publish_replica_metrics(
+                self.metrics, name,
+                lambda _n=name: self.registry.replica_set(_n))
         return entry
 
     def evict_model(self, name: str) -> bool:
@@ -458,6 +540,17 @@ class Server:
         return True
 
     def shutdown(self) -> None:
+        with self._lock:
+            levers, self._policy_levers = self._policy_levers, None
+        if levers:
+            from ..control import default_actuator
+            act = default_actuator()
+            for lever_name, fn in levers:
+                act.unbind(lever_name, fn)
+        for name in self.registry.names():
+            rset = self.registry.replica_set(name)
+            if rset is not None:
+                rset.stop()
         with self._lock:
             supervisor, self._supervisor = self._supervisor, None
         if supervisor is not None:

@@ -1,0 +1,504 @@
+"""Device-fault-domain replicated serving (serving/replicas.py): placement
+on distinct virtual devices, least-outstanding routing, loss-free failover,
+per-device breakers + half-open recovery, per-device byte ledger, the
+device-keyed compile cache, the scale lever and its policy/alert plumbing —
+all on the 8-device virtual CPU platform (conftest)."""
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import MetricsRegistry
+from lightgbm_tpu.ops import predict as predict_ops
+from lightgbm_tpu.serving import (FleetFaultInjector, HbmResidencyManager,
+                                  ModelRegistry, ReplicaSet, Server)
+from lightgbm_tpu.serving.admission import CircuitBreaker
+
+
+def _train(params=None, n=400, nf=8, iters=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, nf)
+    y = 2.0 * X[:, 0] - X[:, 1] + 0.05 * rng.randn(n)
+    base = {"objective": "regression", "num_leaves": 15, "verbose": -1,
+            "min_data_in_leaf": 5}
+    base.update(params or {})
+    bst = lgb.Booster(params=base, train_set=lgb.Dataset(X, label=y))
+    for _ in range(iters):
+        bst.update()
+    return bst
+
+
+@pytest.fixture(scope="module")
+def booster():
+    return _train()
+
+
+def _server(booster, name="m", **over):
+    params = {"serve_batch_wait_ms": 2.0, "serve_warmup_buckets": [1, 8],
+              "serve_request_timeout_ms": 30_000.0,
+              "serve_min_device_work": 0}
+    params.update(over)
+    srv = Server(params)
+    srv.load_model(name, model_str=booster.model_to_string())
+    return srv
+
+
+def _registry(booster, count, name="m", fleet=None, **opts):
+    reg = ModelRegistry(min_device_work=0, max_batch_rows=64,
+                        warmup_buckets=[1, 8], fleet=fleet,
+                        replica_count=count, replica_opts=opts)
+    reg.load(name, model_str=booster.model_to_string())
+    return reg
+
+
+# --------------------------------------------------------------------- #
+# count=1: the replica machinery must not exist at all
+# --------------------------------------------------------------------- #
+def test_count_one_is_exact_single_device_path(booster):
+    srv = _server(booster, tpu_replica_count=1)
+    X = np.random.RandomState(5).rand(11, 8)
+    try:
+        assert srv.registry.replica_set("m") is None
+        assert srv.registry.get("m").replicas is None
+        out = srv.predict(X, model="m")
+        # byte-identical to the pre-replica device path
+        np.testing.assert_array_equal(out,
+                                      booster._gbdt.predict(X, device=True))
+        assert "replicas" not in srv.registry.get("m").info()
+    finally:
+        srv.shutdown()
+
+
+# --------------------------------------------------------------------- #
+# placement + output contract
+# --------------------------------------------------------------------- #
+def test_replicas_on_distinct_devices_same_outputs(booster):
+    srv = _server(booster, tpu_replica_count=3)
+    X = np.random.RandomState(6).rand(13, 8)
+    try:
+        rset = srv.registry.replica_set("m")
+        assert rset is not None
+        snap = rset.snapshot()
+        assert snap["count"] == 3 and snap["healthy"] == 3
+        assert len({r["device"] for r in snap["replicas"]}) == 3
+        ref = booster._gbdt.predict(X, device=True)
+        out = srv.predict(X, model="m")
+        np.testing.assert_array_equal(out, ref)
+        # every replica, when forced to serve, returns the same scores
+        for _ in range(6):
+            np.testing.assert_array_equal(srv.predict(X, model="m"), ref)
+        assert "replicas" in srv.registry.get("m").info()
+    finally:
+        srv.shutdown()
+
+
+def test_router_prefers_least_outstanding(booster):
+    reg = _registry(booster, 2)
+    rset = reg.get("m").replicas
+    try:
+        with rset._lock:
+            reps = list(rset._replicas)
+            reps[0].outstanding = 100
+        for _ in range(4):                    # load dominates the rotation
+            assert rset._pick(set()).slot == 1
+        with rset._lock:
+            reps[0].outstanding = 0
+            reps[1].outstanding = 100
+        for _ in range(4):
+            assert rset._pick(set()).slot == 0
+        # all idle: the rotating tie-break spreads serial traffic so no
+        # replica becomes a cold standby
+        with rset._lock:
+            reps[1].outstanding = 0
+        picks = {rset._pick(set()).slot for _ in range(4)}
+        assert picks == {0, 1}
+        assert rset._pick({0}).slot == 1
+        assert rset._pick({0, 1}) is None
+    finally:
+        rset.stop()
+
+
+# --------------------------------------------------------------------- #
+# failover: loss-free, host walk only at zero healthy
+# --------------------------------------------------------------------- #
+def test_failover_under_threaded_hammer_is_loss_free(booster):
+    srv = _server(booster, tpu_replica_count=3,
+                  tpu_replica_breaker_failures=2,
+                  tpu_replica_breaker_reset_s=30.0)
+    X = np.random.RandomState(7).rand(8, 8)
+    ref = booster._gbdt.predict(X, device=True)
+    rset = srv.registry.replica_set("m")
+    inj = FleetFaultInjector()
+    rset.arm_injector(inj)
+    errors = []
+
+    def client(i):
+        try:
+            out = srv.predict(X, model="m")
+            if not np.array_equal(np.asarray(out), ref):
+                errors.append("wrong output")
+        except Exception as exc:  # noqa: BLE001 — a raise IS the lost batch
+            errors.append(repr(exc))
+
+    try:
+        inj.fail("replica:0", count=4)
+        with ThreadPoolExecutor(8) as pool:
+            list(pool.map(client, range(48)))
+        assert not errors, errors
+        snap = rset.snapshot()
+        assert snap["failovers"] >= 1          # rerouting happened
+        assert snap["host_fallbacks"] == 0     # siblings absorbed it all
+        victim = next(r for r in snap["replicas"] if r["slot"] == 0)
+        assert victim["failures"] >= 1
+        assert victim["state"] == CircuitBreaker.OPEN
+        assert snap["healthy"] == 2
+        # telemetry names the victim
+        evs = [e for e in rset.events() if e["what"] == "failover"]
+        assert evs and all(e["victim"] == 0 for e in evs)
+        assert any(e["what"] == "breaker_open" for e in rset.events())
+    finally:
+        srv.shutdown()
+
+
+def test_zero_healthy_replicas_ride_host_walk(booster):
+    reg = _registry(booster, 2, breaker_failures=1, breaker_reset_s=60.0)
+    rset = reg.get("m").replicas
+    inj = FleetFaultInjector()
+    rset.arm_injector(inj)
+    X = np.random.RandomState(8).rand(6, 8)
+    try:
+        inj.fail("replica:0", count=-1)
+        inj.fail("replica:1", count=-1)
+        out, used_device = reg.get("m").predict(X)
+        assert used_device is False
+        np.testing.assert_array_equal(
+            np.asarray(out), booster._gbdt.predict(X, device=False))
+        snap = rset.snapshot()
+        assert snap["healthy"] == 0
+        assert snap["host_fallbacks"] >= 1
+        assert any(e["what"] == "host_fallback" for e in rset.events())
+    finally:
+        rset.stop()
+
+
+def test_breaker_half_open_readmits_recovered_replica(booster):
+    now = [0.0]
+    reg = _registry(booster, 2, breaker_failures=1, breaker_reset_s=10.0,
+                    clock=lambda: now[0])
+    rset = reg.get("m").replicas
+    inj = FleetFaultInjector()
+    rset.arm_injector(inj)
+    X = np.random.RandomState(9).rand(4, 8)
+    ref = booster._gbdt.predict(X, device=True)
+    try:
+        inj.fail("replica:0", count=1)
+        # rotation covers both slots within two picks: slot 0 fails and
+        # the SAME rows are served by its sibling
+        for _ in range(2):
+            out, _ = reg.get("m").predict(X)
+            np.testing.assert_array_equal(np.asarray(out), ref)
+        assert rset.snapshot()["healthy"] == 1
+        # before reset_s the victim stays out of the rotation
+        assert all(r.slot == 1 for r in [rset._pick(set())])
+        # past reset_s: half-open probe re-admits on the organic dispatch
+        now[0] = 11.0
+        for _ in range(4):
+            out, _ = reg.get("m").predict(X)
+            np.testing.assert_array_equal(np.asarray(out), ref)
+        snap = rset.snapshot()
+        assert snap["healthy"] == 2
+        victim = next(r for r in snap["replicas"] if r["slot"] == 0)
+        assert victim["state"] == CircuitBreaker.CLOSED
+        assert victim["breaker"]["open_count"] == 1
+        assert any(e["what"] == "readmit" for e in rset.events())
+    finally:
+        rset.stop()
+
+
+def test_liveness_prober_detects_and_readmits(booster):
+    reg = _registry(booster, 2, breaker_failures=1, breaker_reset_s=0.2,
+                    probe_interval_s=0.05, probe_deadline_ms=60_000.0)
+    rset = reg.get("m").replicas
+    inj = FleetFaultInjector()
+    rset.arm_injector(inj)
+    try:
+        inj.fail("replica:1", count=1)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            snap = rset.snapshot()
+            if snap["healthy"] < 2:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("prober never tripped the failed replica")
+        # the fault is consumed: the next probe after reset_s re-admits
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if rset.snapshot()["healthy"] == 2:
+                break
+            time.sleep(0.02)
+        snap = rset.snapshot()
+        assert snap["healthy"] == 2
+        assert any(r["probes"] > 0 for r in snap["replicas"])
+    finally:
+        rset.stop()
+
+
+# --------------------------------------------------------------------- #
+# per-device byte ledger: admission stays exact PER DEVICE
+# --------------------------------------------------------------------- #
+def _fleet_for(booster, copies_per_device):
+    g = booster._gbdt
+    g._sync_model()
+    est = predict_ops.estimate_device_bytes(g.models,
+                                            g.num_tree_per_iteration)
+    return HbmResidencyManager(int(est * (copies_per_device + 0.5)),
+                               warmup_buckets=[8]), int(est)
+
+
+def test_per_device_ledger_degrades_capacity_not_admission(booster):
+    # budget fits ~2.5 copies per device; device 0 also carries the
+    # classic resident copy.  Asking for 17 replicas (slots wrap all 8
+    # devices, slots 0/8/16 -> device 0) must refuse the copies that
+    # would overflow device 0 — and ONLY those: capacity degrades,
+    # admission never over-commits a device.
+    fleet, est = _fleet_for(booster, 2)
+    reg = _registry(booster, 17, fleet=fleet)
+    rset = reg.get("m").replicas
+    try:
+        snap = rset.snapshot()
+        assert snap["reserve_failures"] >= 1
+        assert snap["count"] + snap["reserve_failures"] == 17
+        assert snap["count"] >= 15               # only device 0 is tight
+        assert fleet.replica_reserve_failures == snap["reserve_failures"]
+        fs = fleet.snapshot()
+        for dev, d in fs["devices"].items():
+            assert d["replica_bytes"] <= fleet.budget_bytes, dev
+        # device 0: classic resident + replica bytes still within budget
+        assert (fs["resident_bytes"] + fs["devices"]["0"]["replica_bytes"]
+                <= fleet.budget_bytes)
+        assert any(e["what"] == "reserve_failed" for e in rset.events())
+    finally:
+        rset.stop()
+        # every replica byte returned to its device
+        fs = fleet.snapshot()
+        assert all(d["replica_bytes"] == 0
+                   for d in fs["devices"].values()), fs["devices"]
+        fleet.stop()
+
+
+def test_replica_release_returns_device_bytes(booster):
+    fleet, est = _fleet_for(booster, 4)
+    reg = _registry(booster, 3, fleet=fleet)
+    rset = reg.get("m").replicas
+    try:
+        assert rset.count == 3
+        used_before = {d: v["replica_bytes"]
+                       for d, v in fleet.snapshot()["devices"].items()}
+        assert sum(used_before.values()) > 0
+        assert reg.set_replica_count("m", 2) == 2
+        used_after = {d: v["replica_bytes"]
+                      for d, v in fleet.snapshot()["devices"].items()}
+        assert sum(used_after.values()) < sum(used_before.values())
+    finally:
+        reg.set_replica_count("m", 1)
+        assert all(v["replica_bytes"] == 0
+                   for v in fleet.snapshot()["devices"].values())
+        fleet.stop()
+
+
+# --------------------------------------------------------------------- #
+# compile cache: device-keyed, no false sharing, no retraces
+# --------------------------------------------------------------------- #
+def test_compile_cache_is_device_keyed(booster):
+    fleet, _est = _fleet_for(booster, 8)
+    reg = _registry(booster, 2, fleet=fleet, warmup_buckets=[8])
+    rset = reg.get("m").replicas
+    try:
+        cache = fleet.compile_cache
+        with cache._lock:
+            keys = list(cache._warm)
+        devs = {sig[-1] for sig, _b in keys
+                if len(sig) >= 2 and sig[-2] == "dev"}
+        # one warmup entry per device: device 0's warmth never suppressed
+        # device 1's warmup (shape signatures alone would false-share)
+        assert {0, 1} <= devs
+        # a second set for the same model re-uses both devices' warmth
+        hits_before = cache.hits
+        extra = ReplicaSet(reg.get("m"), 2, fleet=fleet,
+                           warmup_buckets=[8])
+        try:
+            assert cache.hits > hits_before
+        finally:
+            extra.stop()
+    finally:
+        rset.stop()
+        fleet.stop()
+
+
+def test_same_device_replicas_do_not_retrace(booster):
+    from lightgbm_tpu.obs import device as obs_device
+    reg = _registry(booster, 2)
+    entry = reg.get("m")
+    rset = entry.replicas
+    g = booster._gbdt
+    X = np.random.RandomState(10).rand(8, 8)
+    try:
+        with rset._lock:
+            reps = list(rset._replicas)
+        for rep in reps:                       # compile both devices once
+            g.predict_bucketed(X, max_bucket=entry.max_bucket,
+                               ensemble=rep.ens)
+        before = obs_device.compile_counts()["traces"]
+        for _ in range(4):                     # alternate devices, warm
+            for rep in reps:
+                g.predict_bucketed(X, max_bucket=entry.max_bucket,
+                                   ensemble=rep.ens)
+        assert obs_device.compile_counts()["traces"] == before
+    finally:
+        rset.stop()
+
+
+# --------------------------------------------------------------------- #
+# scale lever + policy plumbing
+# --------------------------------------------------------------------- #
+def test_set_replica_count_grows_shrinks_and_tears_down(booster):
+    reg = _registry(booster, 2)
+    try:
+        rset = reg.get("m").replicas
+        assert rset.count == 2
+        assert reg.set_replica_count("m", 4) == 4
+        assert reg.get("m").replicas is rset            # resized in place
+        assert reg.set_replica_count("m", 3) == 3
+        # n=1 tears the set down: back to the EXACT single-device path
+        assert reg.set_replica_count("m", 1) == 1
+        assert reg.get("m").replicas is None
+        X = np.random.RandomState(11).rand(6, 8)
+        out, used = reg.get("m").predict(X)
+        assert used is True
+        np.testing.assert_array_equal(
+            np.asarray(out), booster._gbdt.predict(X, device=True))
+        # and it can come back
+        assert reg.set_replica_count("m", 2) == 2
+    finally:
+        reg.set_replica_count("m", 1)
+
+
+def test_server_scale_lever_clamps_and_reports(booster):
+    srv = _server(booster, tpu_replica_count=2, tpu_replica_max=3)
+    try:
+        msg = srv._set_replica_count_lever({"model": "m", "delta": 5})
+        assert "2 -> 3" in msg                  # clamped at tpu_replica_max
+        with pytest.raises(ValueError):
+            srv._set_replica_count_lever({"model": "m", "delta": 1})
+        msg = srv._set_replica_count_lever({"model": "m", "count": 1})
+        assert "3 -> 1" in msg
+        assert srv.registry.replica_set("m") is None
+        # tenant auto-pick: scale-up goes to the (only) queue
+        msg = srv._set_replica_count_lever({"delta": 1})
+        assert "tenant m" in msg and srv.registry.replica_set("m").count == 2
+    finally:
+        srv.shutdown()
+
+
+def test_policy_dry_run_is_bitwise_non_perturbing(booster):
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.control import (Actuator, PolicyEngine, PolicyRule,
+                                      TokenBucket)
+    srv = _server(booster, tpu_replica_count=2)
+    X = np.random.RandomState(12).rand(9, 8)
+    try:
+        before = np.asarray(srv.predict(X, model="m"))
+        cfg = Config({"objective": "regression", "verbosity": -1,
+                      "tpu_policy": True, "tpu_policy_dry_run": True})
+        rule = PolicyRule("replica_scale_up",
+                          when={"alert": "serve_queue_pressure"},
+                          action="set_replica_count", args={"delta": 1},
+                          cooldown_rounds=0)
+        eng = PolicyEngine(cfg, rules=[rule], actuator=Actuator(),
+                           registry=MetricsRegistry(),
+                           bucket=TokenBucket(100, 60.0))
+        eng.actuator.bind("set_replica_count",
+                          lambda a: srv._set_replica_count_lever(a or {}))
+        (d,) = eng.on_round(1, transitions=[{
+            "rule": "serve_queue_pressure", "state": "firing",
+            "metric": "lgbm_serve_queue_depth_rows", "kind": "sustained",
+            "value": 900.0, "threshold": 512.0, "tick": 1}])
+        assert d["status"] == "dry_run"
+        assert srv.registry.replica_set("m").count == 2   # untouched
+        after = np.asarray(srv.predict(X, model="m"))
+        np.testing.assert_array_equal(before, after)
+    finally:
+        srv.shutdown()
+
+
+def test_default_alert_and_policy_rules_cover_replica_scaling():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.control.policy import default_policy_rules
+    from lightgbm_tpu.obs.alerts import default_rules
+    cfg = Config({"objective": "regression", "verbosity": -1,
+                  "tpu_fleet_hbm_budget_mb": 64})
+    names = {r.name for r in default_rules(cfg)}
+    assert "serve_queue_pressure" in names
+    assert "residency_pressure" in names
+    # no budget -> no residency alert (nothing to relieve)
+    cfg0 = Config({"objective": "regression", "verbosity": -1})
+    assert "residency_pressure" not in {r.name for r in default_rules(cfg0)}
+    actions = {r.name: r for r in default_policy_rules()}
+    up, down = actions["replica_scale_up"], actions["replica_scale_down"]
+    assert up.action == down.action == "set_replica_count"
+    assert up.alert == "serve_queue_pressure" and up.args["delta"] == 1
+    assert down.alert == "residency_pressure" and down.args["delta"] == -1
+
+
+# --------------------------------------------------------------------- #
+# observability: the per-device gauges tell the kill_device story
+# --------------------------------------------------------------------- #
+def test_replica_gauges_flip_on_breaker_open(booster):
+    srv = _server(booster, tpu_replica_count=2,
+                  tpu_replica_breaker_failures=1,
+                  tpu_replica_breaker_reset_s=60.0)
+    X = np.random.RandomState(13).rand(4, 8)
+    try:
+        rset = srv.registry.replica_set("m")
+        snap = rset.snapshot()
+        dev = {r["slot"]: str(r["device"]) for r in snap["replicas"]}
+        healthy = srv.metrics.get("lgbm_replica_healthy", model="m",
+                                  slot="0", device=dev[0])
+        assert healthy is not None and healthy.value == 1.0
+        assert srv.metrics.get("lgbm_replica_count",
+                               model="m").value == 2.0
+        inj = FleetFaultInjector()
+        rset.arm_injector(inj)
+        inj.fail("replica:0", count=1)
+        for _ in range(2):        # rotation covers both slots in two picks
+            srv.predict(X, model="m")
+        assert healthy.value == 0.0
+        assert srv.metrics.get("lgbm_replica_healthy_count",
+                               model="m").value == 1.0
+        assert srv.metrics.get("lgbm_replica_failovers_total",
+                               model="m").value >= 1.0
+        sibling = srv.metrics.get("lgbm_replica_healthy", model="m",
+                                  slot="1", device=dev[1])
+        assert sibling.value == 1.0
+    finally:
+        srv.shutdown()
+
+
+def test_config_validates_and_aliases_replica_params():
+    from lightgbm_tpu.config import Config
+    cfg = Config({"objective": "regression", "verbosity": -1,
+                  "replicas": 4, "replica_max": 6})
+    assert cfg.tpu_replica_count == 4 and cfg.tpu_replica_max == 6
+    for bad in ({"tpu_replica_count": 0},
+                {"tpu_replica_min": 3, "tpu_replica_max": 2},
+                {"tpu_replica_probe_interval_s": -1.0},
+                {"tpu_replica_probe_deadline_ms": 0.0},
+                {"tpu_replica_breaker_failures": 0}):
+        params = {"objective": "regression", "verbosity": -1}
+        params.update(bad)
+        with pytest.raises(Exception):
+            Config(params)

@@ -299,6 +299,60 @@ def test_registry_rollback_under_concurrent_load(booster, booster_v2):
     assert reg.get("m").version == 42   # 2 loads + 40 rollbacks
 
 
+def test_rollback_preserves_replica_count_under_concurrent_load(booster,
+                                                                booster_v2):
+    """A replicated tenant rolls back AT ITS CURRENT replica count: the
+    count decision and the entry install share one critical section, so
+    rollback churn racing threaded prediction reinstalls the demoted
+    version on the same number of devices — never silently dropping the
+    fleet back to one copy — and every result is exactly one model's
+    output."""
+    reg = ModelRegistry(warmup_buckets=[1, 8], min_device_work=0,
+                        max_batch_rows=64, replica_count=3)
+    X = np.random.RandomState(21).rand(8, 8)
+    out1 = booster._gbdt.predict(X, device=True)
+    out2 = booster_v2._gbdt.predict(X, device=True)
+    reg.load("m", model_str=booster.model_to_string())
+    reg.load("m", model_str=booster_v2.model_to_string())
+    assert reg.replica_set("m").count == 3
+    # an explicit scale-down must survive the rollbacks below
+    assert reg.set_replica_count("m", 2) == 2
+    stop = threading.Event()
+    errors = []
+
+    def client():
+        try:
+            while not stop.is_set():
+                out, _ = reg.get("m").predict(X)
+                if not (np.array_equal(out, out1)
+                        or np.array_equal(out, out2)):
+                    errors.append("torn output")
+                    return
+        except Exception as exc:   # noqa: BLE001 — fail the test, not the thread
+            errors.append(repr(exc))
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(6):
+            entry = reg.rollback("m")
+            rset = reg.replica_set("m")
+            assert rset is not None and rset.count == 2, \
+                "rollback changed the replica count"
+            assert reg.get("m") is entry
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10.0)
+    assert not errors, errors
+    # scale-to-one then rollback: the single-device tenant STAYS single
+    reg.set_replica_count("m", 1)
+    reg.rollback("m")
+    assert reg.replica_set("m") is None
+    reg.set_replica_count("m", 1)
+
+
 def test_rollback_after_device_cache_eviction(booster, booster_v2):
     """Rolling back to a prior whose device ensemble was evicted must
     NOT install a torn entry claiming warm buckets it no longer has:

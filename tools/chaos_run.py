@@ -118,6 +118,8 @@ SUPERVISOR_SCENARIOS = ("kill_refit", "bad_promote")
 FLEET_SCENARIOS = ("tenant_storm",)
 # closed-loop control-plane drill (control/ + elastic scale-up)
 POLICY_SCENARIOS = ("policy_loop",)
+# replicated-serving drill (serving/replicas.py)
+REPLICA_SCENARIOS = ("kill_device",)
 
 
 def run_scenario(scenario: str, world: int = 3, rounds: int = 8,
@@ -982,12 +984,160 @@ def run_fleet_scenario(scenario: str, tenants: int = 64,
     }
 
 
+def run_replica_scenario(scenario: str, replicas: int = 3,
+                         duration_s: float = 6.0) -> dict:
+    """kill_device: a 3-replica tenant under steady threaded traffic has
+    one replica's dispatches forced to fail mid-drill.  The contract is
+    the fault-domain promise: ZERO failed or lost predictions, ZERO
+    host-walk fallbacks (the siblings absorb every rerouted batch),
+    degraded throughput no worse than (N-1)/N of the healthy baseline,
+    the victim's breaker opens and then half-open re-admits it with no
+    operator action, and the telemetry names the victim device."""
+    assert scenario in REPLICA_SCENARIOS, scenario
+    import threading
+
+    # distinct fault domains need distinct devices: force the 8-device
+    # virtual CPU platform (the image pre-imports jax, so the flag alone
+    # is not enough — reroute the config and drop any cached backend)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax.extend.backend
+        jax.extend.backend.clear_backends()
+    except (ImportError, AttributeError):
+        from jax._src import xla_bridge as _xb
+        _xb._clear_backends()
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import FleetFaultInjector, Server
+
+    X, y = _drift_data(400, seed=5)
+    booster = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "min_data_in_leaf": 5, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=8)
+    srv = Server(verbosity=-1,
+                 serve_min_device_work=1,
+                 serve_max_batch_rows=64,
+                 serve_warmup_buckets=[1, 16, 64],
+                 serve_batch_wait_ms=1.0,
+                 tpu_replica_count=replicas,
+                 tpu_replica_breaker_failures=2,
+                 tpu_replica_breaker_reset_s=0.5,
+                 # slow enough that the ROUTER (not the prober) eats the
+                 # injected faults and proves loss-free rerouting; the
+                 # prober still backstops re-admission
+                 tpu_replica_probe_interval_s=1.0,
+                 tpu_replica_probe_deadline_ms=60_000.0)
+    srv.load_model("m", model_str=booster.model_to_string())
+    rset = srv.registry.replica_set("m")
+    assert rset is not None and rset.count == replicas, \
+        "replica set failed to place"
+    inj = FleetFaultInjector()
+    rset.arm_injector(inj)
+    victim_slot = 1
+    victim_dev = next(r["device"] for r in rset.snapshot()["replicas"]
+                      if r["slot"] == victim_slot)
+    Xq, _ = _drift_data(16, seed=99)
+    failures, preds = [0], [0]
+    flock = threading.Lock()
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                srv.predict(Xq, model="m")
+                with flock:
+                    preds[0] += 1
+            except Exception:   # noqa: BLE001 — the drill counts ANY failure
+                with flock:
+                    failures[0] += 1
+
+    threads = [threading.Thread(target=hammer, daemon=True)
+               for _ in range(4)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    phase_s = duration_s / 3.0
+    # phase 1: healthy baseline throughput
+    time.sleep(phase_s)
+    with flock:
+        baseline = preds[0]
+    # phase 2: kill the victim's next dispatches (router AND prober see
+    # the faults; breaker_failures=2, so the breaker opens mid-phase)
+    inj.fail("replica:%d" % victim_slot, count=8)
+    time.sleep(phase_s)
+    with flock:
+        degraded = preds[0] - baseline
+    # phase 3: the faults are consumed; half-open must re-admit the
+    # victim with no operator action
+    readmit_ok = False
+    deadline = time.monotonic() + max(phase_s, 10.0)
+    while time.monotonic() < deadline:
+        snap = rset.snapshot()
+        v = next(r for r in snap["replicas"] if r["slot"] == victim_slot)
+        if v["healthy"] and v["breaker"]["open_count"] >= 1:
+            readmit_ok = True
+            break
+        time.sleep(0.05)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    snap = rset.snapshot()
+    victim = next(r for r in snap["replicas"] if r["slot"] == victim_slot)
+    events = rset.events()
+    failover_evs = [e for e in events if e["what"] == "failover"]
+    victim_named = bool(failover_evs) and all(
+        e["victim"] == victim_slot and e["device"] == victim_dev
+        for e in failover_evs)
+    # the per-device gauge told the story: breaker open -> healthy 0
+    healthy_gauge = srv.metrics.get("lgbm_replica_healthy", model="m",
+                                    slot=str(victim_slot),
+                                    device=str(victim_dev))
+    gauge_ok = (healthy_gauge is not None
+                and healthy_gauge.value == float(victim["healthy"]))
+    # sampled correctness (device path is f32 on the fast tier)
+    got = np.asarray(srv.predict(Xq, model="m")).ravel()
+    ref = np.asarray(booster.predict(Xq)).ravel()
+    sampled_ok = bool(np.allclose(got, ref, rtol=1e-4, atol=1e-5))
+    srv.shutdown()
+    floor = baseline * (replicas - 1) / float(replicas)
+    ok = (failures[0] == 0
+          and snap["host_fallbacks"] == 0
+          and snap["failovers"] >= 1
+          and victim["breaker"]["open_count"] >= 1
+          and readmit_ok
+          and degraded >= floor
+          and victim_named
+          and gauge_ok
+          and sampled_ok)
+    return {
+        "scenario": scenario, "ok": ok,
+        "replicas": replicas, "victim_slot": victim_slot,
+        "victim_device": victim_dev,
+        "predictions": preds[0], "predict_failures": failures[0],
+        "baseline_preds": baseline, "degraded_preds": degraded,
+        "throughput_floor": floor,
+        "failovers": snap["failovers"],
+        "host_fallbacks": snap["host_fallbacks"],
+        "breaker_open_count": victim["breaker"]["open_count"],
+        "readmitted": readmit_ok,
+        "failover_events_name_victim": victim_named,
+        "healthy_gauge_consistent": gauge_ok,
+        "sampled_outputs_match": sampled_ok,
+        "total_s": round(time.monotonic() - t0, 3),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--scenario",
                     choices=SCENARIOS + SUPERVISOR_SCENARIOS
                     + FLEET_SCENARIOS + HYBRID_SCENARIOS
-                    + POLICY_SCENARIOS,
+                    + POLICY_SCENARIOS + REPLICA_SCENARIOS,
                     default="kill_rank")
     ap.add_argument("--world", type=int, default=3)
     ap.add_argument("--rounds", type=int, default=8)
@@ -1001,7 +1151,11 @@ def main(argv=None) -> int:
         args.rounds = min(args.rounds, 5)
         args.rows = min(args.rows, 180)
         args.chaos_round = min(args.chaos_round, 2)
-    if args.scenario in FLEET_SCENARIOS:
+    if args.scenario in REPLICA_SCENARIOS:
+        summary = run_replica_scenario(
+            args.scenario, replicas=3,
+            duration_s=3.0 if args.fast else 6.0)
+    elif args.scenario in FLEET_SCENARIOS:
         summary = run_fleet_scenario(
             args.scenario,
             tenants=16 if args.fast else 64,

@@ -108,6 +108,17 @@ def extract_metrics(bench: Dict) -> Dict:
             val = float(parsed["value"])
             out["serve_open_loop_p99_ms"] = val  # tpulint: ok=config-phantom-param
         return out
+    if parsed.get("metric") == "serve_replicas_p99_ms":
+        # tools/serve_bench.py --replicas sweep: tail latency at the
+        # highest replica count is a CEILING; the matching rows_s is a
+        # throughput floor (TPU backends only, like every other floor)
+        if parsed.get("value") is not None:
+            val = float(parsed["value"])
+            out["serve_replicas_p99_ms"] = val  # tpulint: ok=config-phantom-param
+        if detail.get("rows_s") is not None:
+            rows_s = float(detail["rows_s"])
+            out["serve_replicas_rows_s"] = rows_s  # tpulint: ok=config-phantom-param
+        return out
     higgs = (detail.get("higgs") or {}).get("throughput_mrows_iter_s")
     if higgs is None:
         higgs = parsed.get("value")   # pre-detail bench format (r01/r02)
@@ -200,6 +211,8 @@ TRACKED_METRICS = {"higgs_mrows_iter_s": "higgs",
                    "higgs_mesh8_mrows_iter_s": "higgs_mesh8",
                    "higgs_hybrid_mrows_iter_s": "higgs_hybrid",
                    "serve_open_loop_p99_ms": "serve_p99",
+                   "serve_replicas_p99_ms": "serve_replicas_p99",
+                   "serve_replicas_rows_s": "serve_replicas_rows_s",
                    "mesh2_host_share": "mesh2_host_share"}
 
 # LATENCY metrics: gated as a CEILING (breach above baseline+tolerance)
@@ -207,6 +220,7 @@ TRACKED_METRICS = {"higgs_mrows_iter_s": "higgs",
 # numbers enforce.  Commit their baselines with a generous --margin
 # (shared CI machines jitter tail latency far more than throughput).
 CEILING_METRICS = frozenset({"serve_open_loop_p99_ms",
+                             "serve_replicas_p99_ms",
                              "mesh2_host_share"})
 
 # a ceiling pinned from a near-zero smoke reading would be vacuous

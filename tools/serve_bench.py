@@ -20,14 +20,24 @@ Two modes:
   level shows up in p99 instead of being absorbed by the client.  Emits
   a p50/p99-latency-at-offered-QPS BENCH line.
 
+A third mode sweeps replica counts (--replicas, serving/replicas.py):
+one fresh server per count under the SAME open-loop offered load,
+emitting p50/p99 + achieved throughput per replica count — the
+capacity curve the set_replica_count lever buys (and the ledger line
+tools/perf_gate.py gates as serve_replicas_p99_ms / _rows_s).
+
 Usage: python tools/serve_bench.py [requests_per_level] [model_trees]
        python tools/serve_bench.py --open-loop [--qps 50,200,800]
            [--duration-s 5] [--trees 64]
+       python tools/serve_bench.py --replicas 1,2,4,8 [--qps ...]
+           [--duration-s 5] [--runhist PATH]
 Emits one BENCH-style JSON line:
   {"metric": "serve_concurrency_speedup_x32", "value": ..., "unit": "x",
    "vs_baseline": ..., "detail": {...}}
 or, open-loop:
   {"metric": "serve_open_loop_p99_ms", "value": ..., "unit": "ms", ...}
+or, replica sweep:
+  {"metric": "serve_replicas_p99_ms", "value": ..., "unit": "ms", ...}
 """
 import argparse
 import json
@@ -205,6 +215,109 @@ def _open_loop_main(args):
     return 0 if sustained else 1
 
 
+def _force_virtual_devices(n: int = 8) -> None:
+    """Replica sweeps need distinct fault domains; on a single-device
+    CPU backend (standalone tool run, no conftest), split the host into
+    `n` virtual devices.  The image pre-imports jax, so setting the flag
+    alone is not enough — reroute the config and drop cached backends."""
+    import os
+    import jax
+    if len(jax.local_devices()) > 1:
+        return
+    if jax.default_backend() != "cpu":
+        return                         # real accelerators: use what's there
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=%d" % n).strip()
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax.extend.backend
+        jax.extend.backend.clear_backends()
+    except (ImportError, AttributeError):
+        from jax._src import xla_bridge as _xb
+        _xb._clear_backends()
+
+
+def _replica_sweep_main(args):
+    """One fresh server per replica count, all under the same offered
+    Poisson load (the HIGHEST --qps level, so queueing pressure — the
+    thing extra replicas relieve — is actually present)."""
+    _force_virtual_devices()
+    counts = sorted({max(int(c), 1) for c in args.replicas.split(",")})
+    offered = max(float(q) for q in args.qps.split(","))
+    bst = _train(args.trees)
+    model_str = bst.model_to_string()
+    rng = np.random.RandomState(1)
+    rows = [rng.rand(1, 28) for _ in range(64)]
+    arrivals = np.random.RandomState(7)
+    levels, histograms = {}, {}
+    for n in counts:
+        server = Server({"serve_model_name": "bench",
+                         "serve_min_device_work": 0,
+                         "serve_batch_wait_ms": 2.0,
+                         "serve_max_batch_rows": 256,
+                         "serve_request_timeout_ms": 60_000.0,
+                         "serve_warmup_buckets": [1, 2, 4, 8, 16, 32, 64,
+                                                  128, 256],
+                         "tpu_replica_count": n,
+                         "tpu_replica_max": max(n, 8)})
+        server.load_model("bench", model_str=model_str)
+        rset = server.registry.replica_set("bench")
+        placed = rset.count if rset is not None else 1
+        _run_level(server, rows, 4, 32)   # settle the dispatch path
+        r = _run_open_loop(server, rows, offered, args.duration_s,
+                           arrivals)
+        server.shutdown()
+        histograms["latency_ms@%dreplicas" % n] = r.pop("histogram")
+        r["replicas_requested"] = n
+        r["replicas_placed"] = placed
+        levels[str(n)] = r
+        print("replicas=%-2d (placed %d): achieved %8.1f qps  "
+              "p50=%.2f ms  p99=%.2f ms  errors=%d"
+              % (n, placed, r["achieved_qps"], r["p50_ms"], r["p99_ms"],
+                 r["errors"]))
+
+    if args.runhist:
+        from lightgbm_tpu.obs.timeseries import SeriesStore, write_runhist
+        store = SeriesStore()
+        for i, n in enumerate(counts):
+            r = levels[str(n)]
+            for field in ("achieved_qps", "p50_ms", "p99_ms", "errors"):
+                store.observe("serve_replicas/%s" % field, i + 1,
+                              r[field], replicas=str(n))
+        ok = write_runhist(args.runhist, {
+            "kind": "serve_bench", "mode": "replica_sweep",
+            "offered_qps": offered, "duration_s": args.duration_s,
+            "trees": args.trees,
+            "replica_counts": [str(n) for n in counts],
+        }, store, histograms=histograms)
+        if ok:
+            print("RUNHIST written to %s (%d latency histograms)"
+                  % (args.runhist, len(histograms)))
+
+    head = levels[str(counts[-1])]
+    result = {
+        "metric": "serve_replicas_p99_ms",
+        "value": head["p99_ms"],
+        "unit": "ms",
+        "vs_baseline": head["achieved_qps"],
+        "detail": {
+            "mode": "replica_sweep_open_loop",
+            "offered_qps": offered,
+            "duration_s": args.duration_s,
+            "model_trees": args.trees,
+            "levels": levels,
+            # 1-row requests: achieved qps IS the rows/s throughput the
+            # ledger floors (tools/perf_baseline.json serve_replicas_*)
+            "rows_s": head["achieved_qps"],
+            "quality_ok": all(r["errors"] == 0 for r in levels.values()),
+        },
+    }
+    print(json.dumps(result))
+    return 0 if result["detail"]["quality_ok"] else 1
+
+
 def _parse_args(argv):
     ap = argparse.ArgumentParser(
         description="Serving bench: closed-loop concurrency sweep or "
@@ -220,6 +333,10 @@ def _parse_args(argv):
                     help="comma-separated offered QPS levels")
     ap.add_argument("--duration-s", type=float, default=5.0,
                     help="seconds per offered-QPS level")
+    ap.add_argument("--replicas", default="",
+                    help="comma-separated replica counts; sweeps a fresh "
+                         "server per count under the highest --qps level "
+                         "(serving/replicas.py capacity curve)")
     ap.add_argument("--runhist", metavar="PATH", default="",
                     help="open-loop mode: write a RUNHIST artifact with "
                          "the FULL latency histogram per QPS level "
@@ -232,6 +349,8 @@ def _parse_args(argv):
 
 def main(argv=None):
     args = _parse_args(argv)
+    if args.replicas:
+        return _replica_sweep_main(args)
     if args.open_loop:
         return _open_loop_main(args)
     requests, trees = args.requests, args.trees
